@@ -1,0 +1,23 @@
+"""drep_tpu — a TPU-native genome dereplication and comparison framework.
+
+A from-scratch rebuild of the capabilities of dRep (SilasK/drep fork of
+MrOlm/drep; see SURVEY.md): quality-filter genomes, form coarse primary
+clusters from an all-vs-all MinHash (Mash) distance matrix, refine with
+pairwise ANI into secondary clusters, and pick one winner genome per
+secondary cluster by a quality score.
+
+The execution model is TPU-first rather than a port of the reference's
+subprocess orchestration (reference: drep/d_cluster/external.py shells out
+to `mash`/`fastANI`; unverifiable against the empty reference mount — see
+SURVEY.md §0):
+
+- host ingest: FASTA -> canonical k-mer 64-bit hashes -> packed sketches
+- device compute: vmapped / Pallas all-pairs kernels over ``jax.sharding.Mesh``
+- tiny host post-processing into the canonical pandas tables
+  (Bdb/Mdb/Ndb/Cdb/Sdb/Wdb) persisted through :class:`WorkDirectory`.
+"""
+
+__version__ = "0.1.0"
+
+from drep_tpu.utils.logger import setup_logger  # noqa: F401
+from drep_tpu.workdir import WorkDirectory  # noqa: F401

@@ -1,0 +1,3 @@
+from drep_tpu.controller import main
+
+main()

@@ -1,0 +1,163 @@
+"""Analyze stage: figures into `<wd>/figures/`.
+
+Reference parity: drep/d_analyze.py (SURVEY.md §2; reference mount empty)
+— primary dendrogram, per-primary-cluster secondary dendrograms, cluster
+scatterplots, scoring and winner plots. Uses matplotlib only (no seaborn
+dependency); every plot degrades gracefully when its inputs are absent
+(e.g. compare runs have no Sdb/Wdb).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.utils.logger import get_logger
+from drep_tpu.workdir import WorkDirectory
+
+try:  # matplotlib is expected in the image, but never required for compute
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import scipy.cluster.hierarchy as sch
+
+    HAVE_MPL = True
+except Exception:  # pragma: no cover
+    HAVE_MPL = False
+
+
+def _load_clustering(wd: WorkDirectory) -> dict | None:
+    path = os.path.join(wd.location, "data", "Clustering_files", "clustering.pickle")
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def plot_primary_dendrogram(wd: WorkDirectory) -> str | None:
+    cf = _load_clustering(wd)
+    if cf is None or cf.get("primary_linkage") is None or len(cf["primary_linkage"]) == 0:
+        return None
+    out = os.path.join(wd.get_loc("figures"), "Primary_clustering_dendrogram.pdf")
+    fig, ax = plt.subplots(figsize=(10, max(4, len(cf["primary_names"]) * 0.25)))
+    sch.dendrogram(cf["primary_linkage"], labels=cf["primary_names"], orientation="left", ax=ax)
+    ax.set_xlabel("Mash distance")
+    ax.set_title("Primary clustering (MinHash)")
+    fig.tight_layout()
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
+def plot_secondary_dendrograms(wd: WorkDirectory) -> str | None:
+    cf = _load_clustering(wd)
+    if cf is None or not cf.get("secondary"):
+        return None
+    out = os.path.join(wd.get_loc("figures"), "Secondary_clustering_dendrograms.pdf")
+    from matplotlib.backends.backend_pdf import PdfPages
+
+    with PdfPages(out) as pdf:
+        for pc, entry in sorted(cf["secondary"].items()):
+            link, names = entry["linkage"], entry["names"]
+            if link is None or len(link) == 0:
+                continue
+            fig, ax = plt.subplots(figsize=(8, max(3, len(names) * 0.3)))
+            sch.dendrogram(link, labels=names, orientation="left", ax=ax)
+            ax.set_xlabel("1 - ANI")
+            ax.set_title(f"Secondary clustering, primary cluster {pc}")
+            fig.tight_layout()
+            pdf.savefig(fig)
+            plt.close(fig)
+    return out
+
+
+def plot_cluster_scatter(wd: WorkDirectory) -> str | None:
+    if not (wd.hasDb("Cdb") and wd.hasDb("genomeInformation")):
+        return None
+    cdb, stats = wd.get_db("Cdb"), wd.get_db("genomeInformation")
+    df = cdb.merge(stats, on="genome")
+    out = os.path.join(wd.get_loc("figures"), "Clustering_scatterplots.pdf")
+    fig, ax = plt.subplots(figsize=(8, 6))
+    clusters = df["primary_cluster"].astype(int)
+    sc = ax.scatter(df["length"], df["N50"], c=clusters, cmap="tab20", s=30)
+    ax.set_xscale("log")
+    ax.set_yscale("log")
+    ax.set_xlabel("genome length (bp)")
+    ax.set_ylabel("N50")
+    ax.set_title("Genomes by primary cluster")
+    fig.colorbar(sc, label="primary cluster")
+    fig.tight_layout()
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
+def plot_scoring(wd: WorkDirectory) -> str | None:
+    if not wd.hasDb("Sdb"):
+        return None
+    sdb = wd.get_db("Sdb")
+    cdb = wd.get_db("Cdb")
+    wdb = wd.get_db("Wdb") if wd.hasDb("Wdb") else None
+    df = sdb.merge(cdb[["genome", "secondary_cluster"]], on="genome")
+    out = os.path.join(wd.get_loc("figures"), "Cluster_scoring.pdf")
+    fig, ax = plt.subplots(figsize=(10, 5))
+    order = sorted(df["secondary_cluster"].unique())
+    for i, cl in enumerate(order):
+        grp = df[df["secondary_cluster"] == cl]
+        ax.scatter([i] * len(grp), grp["score"], s=20, color="tab:blue", alpha=0.6)
+        if wdb is not None:
+            w = wdb[wdb["cluster"] == cl]
+            if len(w):
+                ax.scatter([i], w["score"], s=60, color="tab:red", marker="*")
+    ax.set_xticks(range(len(order)))
+    ax.set_xticklabels(order, rotation=90, fontsize=6)
+    ax.set_ylabel("score")
+    ax.set_title("Scores per secondary cluster (winner starred)")
+    fig.tight_layout()
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
+def plot_winners(wd: WorkDirectory) -> str | None:
+    if not (wd.hasDb("Wdb") and wd.hasDb("genomeInformation")):
+        return None
+    wdb = wd.get_db("Wdb").merge(wd.get_db("genomeInformation"), on="genome")
+    out = os.path.join(wd.get_loc("figures"), "Winning_genomes.pdf")
+    fig, axes = plt.subplots(1, 2, figsize=(10, 4))
+    axes[0].hist(wdb["length"], bins=20)
+    axes[0].set_xlabel("winner genome length")
+    axes[1].hist(np.log10(wdb["N50"].clip(lower=1)), bins=20)
+    axes[1].set_xlabel("log10 N50")
+    fig.suptitle("Winning genomes")
+    fig.tight_layout()
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
+def plot_all(wd: WorkDirectory) -> list[str]:
+    if not HAVE_MPL:  # pragma: no cover
+        get_logger().warning("matplotlib unavailable — skipping figures")
+        return []
+    made = []
+    for fn in (
+        plot_primary_dendrogram,
+        plot_secondary_dendrograms,
+        plot_cluster_scatter,
+        plot_scoring,
+        plot_winners,
+    ):
+        try:
+            out = fn(wd)
+        except Exception as e:  # plots must never kill a pipeline run
+            get_logger().warning("plotting %s failed: %s", fn.__name__, e)
+            out = None
+        if out:
+            made.append(out)
+    get_logger().info("analyze: wrote %d figures", len(made))
+    return made

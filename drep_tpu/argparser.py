@@ -1,0 +1,95 @@
+"""CLI argument tree.
+
+Reference parity: drep/argumentParser.py (SURVEY.md §2; reference mount
+empty) — subcommands `compare`, `dereplicate`, `check_dependencies`, with
+the reference's flag groups and names (FILTERING, GENOME COMPARISON,
+CLUSTERING, SCORING, WARNINGS) plus the TPU-native additions
+(`--primary_algorithm jax_mash`, `--S_algorithm jax_ani` are the defaults
+here; the reference's subprocess algorithms remain selectable).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from drep_tpu import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="drep-tpu",
+        description="TPU-native genome dereplication and comparison (dRep-compatible pipeline)",
+    )
+    parser.add_argument("--version", action="version", version=f"drep-tpu {__version__}")
+    sub = parser.add_subparsers(dest="operation", required=True)
+
+    def add_common(p: argparse.ArgumentParser, with_filter: bool, with_scoring: bool):
+        p.add_argument("work_directory", help="directory for tables, figures, logs (the resume checkpoint)")
+        p.add_argument("-g", "--genomes", nargs="*", default=None, help="genome FASTA files")
+        p.add_argument("-p", "--processes", type=int, default=6)
+        p.add_argument("-d", "--debug", action="store_true")
+
+        comp = p.add_argument_group("GENOME COMPARISON")
+        comp.add_argument("--primary_algorithm", default="jax_mash",
+                          help="primary (coarse) comparison engine [jax_mash|mash]")
+        comp.add_argument("--S_algorithm", default="jax_ani",
+                          help="secondary (ANI) comparison engine [jax_ani|fastANI]")
+        comp.add_argument("-ms", "--MASH_sketch", type=int, default=1000)
+        comp.add_argument("--scale", type=int, default=200,
+                          help="FracMinHash scale for jax_ani (smaller = more precise)")
+        comp.add_argument("-k", "--kmer_size", type=int, default=21)
+        comp.add_argument("--SkipMash", action="store_true")
+        comp.add_argument("--SkipSecondary", action="store_true")
+        comp.add_argument("-nc", "--cov_thresh", type=float, default=0.1)
+
+        clus = p.add_argument_group("CLUSTERING")
+        clus.add_argument("-pa", "--P_ani", type=float, default=0.9)
+        clus.add_argument("-sa", "--S_ani", type=float, default=0.95)
+        clus.add_argument("--clusterAlg", default="average",
+                          choices=["average", "single", "complete", "weighted", "ward"])
+        clus.add_argument("--multiround_primary_clustering", action="store_true")
+        clus.add_argument("--primary_chunksize", type=int, default=5000)
+        clus.add_argument("--greedy_secondary_clustering", action="store_true")
+
+        warn = p.add_argument_group("WARNINGS")
+        warn.add_argument("--warn_dist", type=float, default=0.25)
+        warn.add_argument("--warn_sim", type=float, default=0.98)
+        warn.add_argument("--warn_aln", type=float, default=0.25)
+
+        tpu = p.add_argument_group("TPU EXECUTION")
+        tpu.add_argument("--mesh_shape", type=int, default=None,
+                         help="shard all-pairs tiles over this many devices (default: all)")
+        tpu.add_argument("--skip_plots", action="store_true")
+
+        if with_filter:
+            filt = p.add_argument_group("FILTERING")
+            filt.add_argument("-l", "--length", type=int, default=50_000)
+            filt.add_argument("-comp", "--completeness", type=float, default=75.0)
+            filt.add_argument("-con", "--contamination", type=float, default=25.0)
+            filt.add_argument("--ignoreGenomeQuality", action="store_true")
+            filt.add_argument("--genomeInfo", default=None,
+                              help="CSV with genome,completeness,contamination")
+
+        if with_scoring:
+            sc = p.add_argument_group("SCORING")
+            sc.add_argument("-comW", "--completeness_weight", type=float, default=1.0)
+            sc.add_argument("-conW", "--contamination_weight", type=float, default=5.0)
+            sc.add_argument("-strW", "--strain_heterogeneity_weight", type=float, default=1.0)
+            sc.add_argument("-N50W", "--N50_weight", type=float, default=0.5)
+            sc.add_argument("-sizeW", "--size_weight", type=float, default=0.0)
+            sc.add_argument("-centW", "--centrality_weight", type=float, default=1.0)
+            sc.add_argument("--extra_weight_table", default=None)
+
+    cmp_p = sub.add_parser("compare", help="cluster genomes without dereplicating")
+    add_common(cmp_p, with_filter=False, with_scoring=False)
+
+    der_p = sub.add_parser("dereplicate", help="filter, cluster, and pick winner genomes")
+    add_common(der_p, with_filter=True, with_scoring=True)
+
+    sub.add_parser("check_dependencies", help="probe TPU topology and optional external binaries")
+
+    return parser
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    return build_parser().parse_args(argv)

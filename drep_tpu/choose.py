@@ -1,0 +1,143 @@
+"""Choose stage: score genomes, pick one winner per secondary cluster.
+
+Reference parity: drep/d_choose.py (SURVEY.md §2; reference mount empty).
+The scoring formula is the reference's (flag-weighted, defaults shown):
+
+    score = comW(1)·completeness − conW(5)·contamination
+          + strW(1)·strain_heterogeneity + N50W(0.5)·log10(N50)
+          + sizeW(0)·log10(size) + centW(1)·(centrality − S_ani)
+
+`centrality` is the genome's mean symmetrized ANI to the other members of
+its secondary cluster (from Ndb). Winners are copied into
+`<wd>/dereplicated_genomes/`. Without quality data the quality terms
+contribute 0 (with a loud warning from the filter stage).
+"""
+
+from __future__ import annotations
+
+import shutil
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu import schemas
+from drep_tpu.utils.logger import get_logger
+from drep_tpu.workdir import WorkDirectory
+
+SCORE_DEFAULTS: dict[str, Any] = {
+    "completeness_weight": 1.0,   # -comW
+    "contamination_weight": 5.0,  # -conW
+    "strain_heterogeneity_weight": 1.0,  # -strW
+    "N50_weight": 0.5,            # -N50W
+    "size_weight": 0.0,           # -sizeW
+    "centrality_weight": 1.0,     # -centW
+    "S_ani": 0.95,
+}
+
+
+def compute_centrality(ndb: pd.DataFrame, cdb: pd.DataFrame) -> pd.Series:
+    """Mean symmetrized ANI of each genome to co-members of its secondary
+    cluster. Genomes with no comparisons (singletons) get centrality 0."""
+    cent = pd.Series(0.0, index=cdb["genome"])
+    if len(ndb) == 0:
+        return cent
+    cluster_of = cdb.set_index("genome")["secondary_cluster"]
+    df = ndb.loc[ndb["querry"] != ndb["reference"], ["querry", "reference", "ani"]].copy()
+    # canonical unordered pair, then mean over the (up to two) directions
+    lo = np.minimum(df["querry"], df["reference"])
+    hi = np.maximum(df["querry"], df["reference"])
+    df["g1"], df["g2"] = lo, hi
+    pair = df.groupby(["g1", "g2"], sort=False)["ani"].mean().reset_index()
+    same = pair["g1"].map(cluster_of).to_numpy() == pair["g2"].map(cluster_of).to_numpy()
+    pair = pair[same]
+    if len(pair) == 0:
+        return cent
+    melted = pd.concat(
+        [
+            pair[["g1", "ani"]].rename(columns={"g1": "genome"}),
+            pair[["g2", "ani"]].rename(columns={"g2": "genome"}),
+        ]
+    )
+    per_genome = melted.groupby("genome")["ani"].mean()
+    cent.update(per_genome)
+    return cent
+
+
+def score_genomes(
+    cdb: pd.DataFrame,
+    stats: pd.DataFrame,
+    quality: pd.DataFrame | None,
+    ndb: pd.DataFrame,
+    extra_weights: pd.DataFrame | None = None,
+    **kwargs,
+) -> pd.DataFrame:
+    kw = dict(SCORE_DEFAULTS)
+    kw.update({k: v for k, v in kwargs.items() if v is not None and k in SCORE_DEFAULTS})
+
+    df = cdb[["genome", "secondary_cluster"]].merge(
+        stats[["genome", "length", "N50"]], on="genome", how="left"
+    )
+    if quality is not None:
+        df = df.merge(quality, on="genome", how="left")
+    for col in ("completeness", "contamination", "strain_heterogeneity"):
+        if col not in df.columns:
+            df[col] = 0.0
+        df[col] = df[col].fillna(0.0)
+
+    centrality = compute_centrality(ndb, cdb)
+    df["centrality"] = df["genome"].map(centrality).fillna(0.0)
+
+    score = (
+        kw["completeness_weight"] * df["completeness"]
+        - kw["contamination_weight"] * df["contamination"]
+        + kw["strain_heterogeneity_weight"] * df["strain_heterogeneity"]
+        + kw["N50_weight"] * np.log10(df["N50"].clip(lower=1))
+        + kw["size_weight"] * np.log10(df["length"].clip(lower=1))
+        + kw["centrality_weight"] * (df["centrality"] - kw["S_ani"])
+    )
+    if extra_weights is not None:
+        extra = extra_weights.set_index("genome").iloc[:, 0]
+        score = score + df["genome"].map(extra).fillna(0.0)
+    df["score"] = score
+    return df
+
+
+def pick_winners(sdb_full: pd.DataFrame) -> pd.DataFrame:
+    """Argmax score within each secondary cluster; ties break by genome name
+    (deterministic)."""
+    rows = []
+    for cluster, grp in sdb_full.groupby("secondary_cluster", sort=True):
+        grp = grp.sort_values(["score", "genome"], ascending=[False, True])
+        top = grp.iloc[0]
+        rows.append({"genome": top["genome"], "cluster": cluster, "score": top["score"]})
+    return pd.DataFrame(rows)
+
+
+def d_choose_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.DataFrame:
+    """Score + pick winners; stores Sdb/Wdb; copies winners; returns Wdb."""
+    logger = get_logger()
+    cdb = wd.get_db("Cdb")
+    ndb = wd.get_db("Ndb") if wd.hasDb("Ndb") else schemas.empty("Ndb")
+    stats = wd.get_db("genomeInformation")
+    quality = wd.get_db("genomeInfo") if wd.hasDb("genomeInfo") else None
+
+    extra = None
+    if kwargs.get("extra_weight_table"):
+        extra = pd.read_csv(kwargs["extra_weight_table"], sep=None, engine="python")
+
+    sdb_full = score_genomes(cdb, stats, quality, ndb, extra_weights=extra, **kwargs)
+    sdb = sdb_full[["genome", "score"]]
+    wd.store_db(schemas.validate(sdb, "Sdb"), "Sdb")
+
+    wdb = pick_winners(sdb_full)
+    wd.store_db(schemas.validate(wdb, "Wdb"), "Wdb")
+
+    out_dir = wd.get_loc("dereplicated_genomes")
+    loc = bdb.set_index("genome")["location"]
+    for row in wdb.itertuples():
+        src = loc.get(row.genome)
+        if src is not None:
+            shutil.copy(src, out_dir)
+    logger.info("choose: %d winners from %d genomes", len(wdb), len(cdb))
+    return wdb

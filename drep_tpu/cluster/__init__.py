@@ -1,0 +1,1 @@
+from drep_tpu.cluster.controller import d_cluster_wrapper  # noqa: F401

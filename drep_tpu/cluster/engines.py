@@ -1,0 +1,45 @@
+"""Built-in comparison engines registered with the dispatch.
+
+`jax_mash` / `jax_ani` are the TPU-native paths (BASELINE.json north star);
+`mash` / `fastANI` subprocess fallbacks live in cluster/external.py and are
+registered lazily there.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from drep_tpu.cluster.dispatch import register_primary, register_secondary
+from drep_tpu.ingest import GenomeSketches
+from drep_tpu.ops.containment import all_vs_all_containment, pack_scaled_sketches
+from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
+
+
+@register_primary("jax_mash")
+def primary_jax_mash(gs: GenomeSketches, tile: int = 256, **_) -> tuple[np.ndarray, np.ndarray]:
+    """All-vs-all Mash distance from bottom-k sketches on device.
+
+    Returns (dist [N,N], similarity [N,N]) where similarity = 1 - dist
+    (the Mdb convention).
+    """
+    packed = pack_sketches(gs.bottom, gs.names, gs.sketch_size)
+    dist, _jac = all_vs_all_mash(packed, k=gs.k, tile=tile)
+    return dist, 1.0 - dist
+
+
+@register_secondary("jax_ani")
+def secondary_jax_ani(
+    gs: GenomeSketches, indices: list[int], tile: int = 128, **_
+) -> tuple[np.ndarray, np.ndarray]:
+    """Directional containment (ani, cov) matrices for a genome subset.
+
+    `indices` index into gs.names; matrices are [m, m] in that order.
+    """
+    sketches = [gs.scaled[i] for i in indices]
+    names = [gs.names[i] for i in indices]
+    packed = pack_scaled_sketches(sketches, names)
+    return all_vs_all_containment(packed, k=gs.k, tile=tile)
+
+
+# subprocess fallbacks register themselves on import
+from drep_tpu.cluster import external as _external  # noqa: E402,F401

@@ -1,0 +1,106 @@
+"""Subprocess fallbacks onto the reference's external binaries.
+
+Reference parity: drep/d_cluster/external.py (run_MASH,
+run_pairwise_fastANI — SURVEY.md §2; reference mount empty, upstream
+layout). These paths exist so a user with `mash`/`fastANI` on $PATH can
+cross-validate the TPU engines or run without a device; they are NOT the
+default. Each engine raises a clear error when its binary is missing.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.cluster.dispatch import register_primary, register_secondary
+from drep_tpu.ingest import GenomeSketches
+from drep_tpu.utils.logger import get_logger
+
+
+def _require(binary: str) -> str:
+    path = shutil.which(binary)
+    if path is None:
+        raise RuntimeError(
+            f"external binary {binary!r} not found on $PATH — use the TPU-native "
+            f"engine (jax_mash/jax_ani) or install {binary}"
+        )
+    return path
+
+
+def _run(cmd: list[str]) -> str:
+    get_logger().debug("subprocess: %s", " ".join(cmd))
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"{cmd[0]} failed (exit {res.returncode}): {res.stderr[-2000:]}")
+    return res.stdout
+
+
+@register_primary("mash")
+def primary_mash(gs: GenomeSketches, bdb: pd.DataFrame | None = None, processes: int = 1, **_):
+    """`mash sketch` + `mash dist` all-vs-all (reference primary default)."""
+    _require("mash")
+    if bdb is None:
+        raise ValueError("mash fallback needs Bdb (paths to the FASTA files)")
+    loc = {r.genome: r.location for r in bdb.itertuples()}
+    names = gs.names
+    with tempfile.TemporaryDirectory() as tmp:
+        msh = os.path.join(tmp, "all")
+        paths = [loc[g] for g in names]
+        _run(["mash", "sketch", "-p", str(processes), "-s", str(gs.sketch_size), "-o", msh] + paths)
+        out = _run(["mash", "dist", "-p", str(processes), f"{msh}.msh", f"{msh}.msh"])
+    n = len(names)
+    index = {os.path.basename(p): i for i, p in enumerate(paths)}
+    dist = np.ones((n, n), dtype=np.float32)
+    for line in out.strip().splitlines():
+        ref, qry, d, _p, _shared = line.split("\t")
+        i = index[os.path.basename(ref)]
+        j = index[os.path.basename(qry)]
+        dist[i, j] = float(d)
+    np.fill_diagonal(dist, 0.0)
+    return dist, 1.0 - dist
+
+
+@register_secondary("fastANI")
+def secondary_fastani(
+    gs: GenomeSketches,
+    indices: list[int],
+    bdb: pd.DataFrame | None = None,
+    processes: int = 1,
+    **_,
+):
+    """Pairwise fastANI within one primary cluster (reference S default)."""
+    _require("fastANI")
+    if bdb is None:
+        raise ValueError("fastANI fallback needs Bdb (paths to the FASTA files)")
+    loc = {r.genome: r.location for r in bdb.itertuples()}
+    names = [gs.names[i] for i in indices]
+    paths = [loc[g] for g in names]
+    m = len(names)
+    ani = np.zeros((m, m), dtype=np.float32)
+    cov = np.zeros((m, m), dtype=np.float32)
+    with tempfile.TemporaryDirectory() as tmp:
+        lst = os.path.join(tmp, "genomes.txt")
+        with open(lst, "w") as f:
+            f.write("\n".join(paths) + "\n")
+        out_f = os.path.join(tmp, "fastani.out")
+        _run(["fastANI", "--ql", lst, "--rl", lst, "-t", str(processes), "-o", out_f])
+        index = {p: i for i, p in enumerate(paths)}
+        with open(out_f) as f:
+            for line in f:
+                q, r, a, frag_mapped, frag_total = line.split("\t")
+                i, j = index[q], index[r]
+                ani[i, j] = float(a) / 100.0
+                cov[i, j] = float(frag_mapped) / max(float(frag_total), 1.0)
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov
+
+
+def available_binaries() -> dict[str, str | None]:
+    """Probe the reference's external tool suite (for check_dependencies)."""
+    return {b: shutil.which(b) for b in ["mash", "fastANI", "nucmer", "prodigal", "checkm", "centrifuge", "ANIcalculator"]}

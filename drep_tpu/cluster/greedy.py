@@ -1,0 +1,125 @@
+"""Greedy-incremental secondary clustering — the 100k-genome scale path.
+
+Reference parity: `--greedy_secondary_clustering` (drep/d_cluster/
+controller.py; SURVEY.md §3.2 — "compare each genome only to existing
+cluster representatives; new rep if all < S_ani"; reference mount empty).
+Reduces the per-primary-cluster cost from O(m^2) comparisons to O(m·reps).
+
+TPU-shaped execution: genomes are processed in blocks. One device call
+computes the [block, reps] containment tile plus the [block, block]
+within-block tile; the strictly-sequential assignment logic (a genome can
+become a rep mid-block) then runs on host over those precomputed numbers —
+so the device sees large fixed-shape batches, never a per-genome launch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.ingest import GenomeSketches
+from drep_tpu.ops.containment import all_vs_all_containment, pack_scaled_sketches
+from drep_tpu.ops.minhash import PAD_ID
+from drep_tpu.ops.containment import containment_ani_tile
+
+
+def _pad_pack(ids: np.ndarray, counts: np.ndarray, rows: list[int], pad_to: int):
+    out_ids = np.full((pad_to, ids.shape[1]), PAD_ID, dtype=np.int32)
+    out_counts = np.zeros(pad_to, dtype=np.int32)
+    if rows:
+        out_ids[: len(rows)] = ids[rows]
+        out_counts[: len(rows)] = counts[rows]
+    return out_ids, out_counts
+
+
+def greedy_secondary_cluster(
+    gs: GenomeSketches,
+    bdb: pd.DataFrame,
+    indices: list[int],
+    pc: int,
+    kw: dict[str, Any],
+    block: int = 128,
+) -> tuple[pd.DataFrame, np.ndarray]:
+    """Returns (Ndb rows for the comparisons performed, labels 1..R).
+
+    Genomes are visited largest-first (most k-mers), the reference's
+    heuristic that big complete genomes make good representatives.
+    """
+    s_ani, cov_thresh = kw["S_ani"], kw["cov_thresh"]
+    m = len(indices)
+    order = sorted(range(m), key=lambda t: -int(gs.gdb["n_kmers"].iloc[indices[t]]))
+
+    packed = pack_scaled_sketches([gs.scaled[indices[t]] for t in order], [gs.names[indices[t]] for t in order])
+    ids, counts = packed.ids, packed.counts
+
+    labels_ordered = np.zeros(m, dtype=np.int64)
+    reps: list[int] = []  # positions (in `order` space) of representatives
+    ndb_rows: list[dict] = []
+
+    for b0 in range(0, m, block):
+        rows = list(range(b0, min(b0 + block, m)))
+        nb = len(rows)
+        b_ids, b_counts = _pad_pack(ids, counts, rows, block)
+
+        # block vs existing reps (padded to a block multiple for shape reuse);
+        # both directions, because the coverage gate — like the default
+        # all-pairs path — requires cov >= cov_thresh in BOTH directions
+        rep_pad = max(-(-len(reps) // block) * block, block)
+        r_ids, r_counts = _pad_pack(ids, counts, reps, rep_pad)
+        ani_vs_reps = np.zeros((block, rep_pad), np.float32)
+        cov_vs_reps = np.zeros((block, rep_pad), np.float32)
+        cov_rev_reps = np.zeros((block, rep_pad), np.float32)
+        for r0 in range(0, rep_pad, block):
+            a, c = containment_ani_tile(
+                b_ids, b_counts, r_ids[r0 : r0 + block], r_counts[r0 : r0 + block], k=gs.k
+            )
+            _, c_rev = containment_ani_tile(
+                r_ids[r0 : r0 + block], r_counts[r0 : r0 + block], b_ids, b_counts, k=gs.k
+            )
+            ani_vs_reps[:, r0 : r0 + block] = np.asarray(a)
+            cov_vs_reps[:, r0 : r0 + block] = np.asarray(c)
+            cov_rev_reps[:, r0 : r0 + block] = np.asarray(c_rev).T
+
+        # block vs itself (for genomes that become reps mid-block)
+        a_blk, c_blk = containment_ani_tile(b_ids, b_counts, b_ids, b_counts, k=gs.k)
+        a_blk, c_blk = np.asarray(a_blk), np.asarray(c_blk)
+
+        for t, pos in enumerate(rows):
+            best_lab, best_ani = 0, 0.0
+            for ri, rep_pos in enumerate(reps):
+                if rep_pos >= b0:  # rep created inside this block
+                    ani_v = a_blk[t, rep_pos - b0]
+                    cov_v = c_blk[t, rep_pos - b0]
+                    cov_r = c_blk[rep_pos - b0, t]
+                else:
+                    ani_v = ani_vs_reps[t, ri]
+                    cov_v = cov_vs_reps[t, ri]
+                    cov_r = cov_rev_reps[t, ri]
+                ndb_rows.append(
+                    {
+                        "reference": packed.names[rep_pos],
+                        "querry": packed.names[pos],
+                        "ani": float(ani_v),
+                        "alignment_coverage": float(cov_v),
+                        "ref_coverage": float(cov_r),
+                        "querry_coverage": float(cov_v),
+                        "primary_cluster": pc,
+                    }
+                )
+                if ani_v >= s_ani and cov_v >= cov_thresh and cov_r >= cov_thresh and ani_v > best_ani:
+                    best_lab, best_ani = ri + 1, float(ani_v)
+            if best_lab == 0:
+                reps.append(pos)
+                best_lab = len(reps)
+            labels_ordered[pos] = best_lab
+
+    # back to the original `indices` order
+    labels = np.zeros(m, dtype=np.int64)
+    for t in range(m):
+        labels[order[t]] = labels_ordered[t]
+    ndb = pd.DataFrame(ndb_rows) if ndb_rows else pd.DataFrame(
+        columns=["reference", "querry", "ani", "alignment_coverage", "ref_coverage", "querry_coverage", "primary_cluster"]
+    )
+    return ndb, labels

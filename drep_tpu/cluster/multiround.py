@@ -1,0 +1,74 @@
+"""Chunked (multi-round) primary clustering for very large genome sets.
+
+Reference parity: `--multiround_primary_clustering` / `--primary_chunksize`
+(drep/d_cluster/compare_utils.py::multiround_primary_clustering, SURVEY.md
+§2; reference mount empty). Avoids materializing the full N^2 Mash table:
+
+round 1: split genomes into chunks, all-vs-all Mash + clustering within
+         each chunk; elect one representative (most k-mers) per
+         within-chunk cluster.
+round 2: all-vs-all Mash over the representatives only; merge clusters
+         whose representatives co-cluster; every genome inherits its
+         representative's final cluster.
+
+This is an approximation (as in the reference): genomes whose similarity
+straddles two chunks only merge if their representatives do.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.ingest import GenomeSketches
+from drep_tpu.ops.linkage import cluster_hierarchical
+from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
+from drep_tpu.utils.logger import get_logger
+
+
+def _cluster_chunk(gs: GenomeSketches, idx: list[int], cutoff: float, method: str) -> np.ndarray:
+    packed = pack_sketches([gs.bottom[i] for i in idx], [gs.names[i] for i in idx], gs.sketch_size)
+    dist, _ = all_vs_all_mash(packed, k=gs.k)
+    labels, _ = cluster_hierarchical(dist, cutoff, method=method)
+    return labels
+
+
+def multiround_primary_clustering(
+    gs: GenomeSketches, bdb: pd.DataFrame, kw: dict[str, Any]
+) -> np.ndarray:
+    logger = get_logger()
+    n = len(gs.names)
+    chunk = int(kw["primary_chunksize"])
+    cutoff = 1.0 - kw["P_ani"]
+    method = kw["clusterAlg"]
+    nk = gs.gdb["n_kmers"].to_numpy()
+
+    # round 1: within-chunk clustering, elect representatives
+    rep_of_genome = np.zeros(n, dtype=np.int64)  # genome -> its representative index
+    reps: list[int] = []
+    for c0 in range(0, n, chunk):
+        idx = list(range(c0, min(c0 + chunk, n)))
+        labels = _cluster_chunk(gs, idx, cutoff, method)
+        for lab in range(1, int(labels.max()) + 1):
+            members = [idx[t] for t in range(len(idx)) if labels[t] == lab]
+            rep = max(members, key=lambda i: int(nk[i]))
+            reps.append(rep)
+            for i in members:
+                rep_of_genome[i] = rep
+    logger.info("multiround: %d chunks -> %d representatives", -(-n // chunk), len(reps))
+
+    # round 2: cluster the representatives
+    rep_labels = _cluster_chunk(gs, reps, cutoff, method)
+    label_of_rep = {rep: int(rep_labels[t]) for t, rep in enumerate(reps)}
+
+    raw = np.array([label_of_rep[int(rep_of_genome[i])] for i in range(n)], dtype=np.int64)
+    # renumber by first appearance for determinism
+    out = np.zeros(n, dtype=np.int64)
+    seen: dict[int, int] = {}
+    for i, lab in enumerate(raw):
+        if int(lab) not in seen:
+            seen[int(lab)] = len(seen) + 1
+        out[i] = seen[int(lab)]
+    return out

@@ -1,0 +1,64 @@
+"""Top-level controller: parsed args -> workflow.
+
+Reference parity: drep/controller.py::Controller (SURVEY.md §2; reference
+mount empty) — maps subcommands to workflows, sets up logging, and hosts
+check_dependencies (which here probes the TPU topology first, then the
+optional external binaries for the subprocess fallback paths).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from drep_tpu.argparser import parse_args
+from drep_tpu.utils.logger import get_logger, setup_logger
+from drep_tpu.workflows import compare_wrapper, dereplicate_wrapper
+
+
+class Controller:
+    def parseArguments(self, args: argparse.Namespace) -> None:  # noqa: N802 — reference name
+        op = args.operation
+        if op == "check_dependencies":
+            self.check_dependencies_operation()
+            return
+        kwargs = {k: v for k, v in vars(args).items() if k not in ("operation",)}
+        if kwargs.pop("debug", False):
+            setup_logger(None, verbosity=logging.DEBUG)
+        wd_loc = kwargs.pop("work_directory")
+        genomes = kwargs.pop("genomes", None)
+        if op == "compare":
+            self.compare_operation(wd_loc, genomes, **kwargs)
+        elif op == "dereplicate":
+            self.dereplicate_operation(wd_loc, genomes, **kwargs)
+        else:
+            raise ValueError(f"unknown operation {op!r}")
+
+    def compare_operation(self, wd_loc, genomes, **kwargs):
+        return compare_wrapper(wd_loc, genomes, **kwargs)
+
+    def dereplicate_operation(self, wd_loc, genomes, **kwargs):
+        return dereplicate_wrapper(wd_loc, genomes, **kwargs)
+
+    def check_dependencies_operation(self) -> None:
+        setup_logger(None)
+        logger = get_logger()
+        import jax
+
+        devices = jax.devices()
+        logger.info("JAX backend: %s; %d device(s)", jax.default_backend(), len(devices))
+        for d in devices:
+            logger.info("  device: %s", d)
+        from drep_tpu.cluster.external import available_binaries
+
+        for name, path in sorted(available_binaries().items()):
+            status = path if path else "NOT FOUND (subprocess fallback unavailable; TPU engines unaffected)"
+            logger.info("  external %-14s %s", name, status)
+
+
+def main(argv: list[str] | None = None) -> None:
+    Controller().parseArguments(parse_args(argv))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Evaluate stage: warn about near-threshold cluster boundaries.
+
+Reference parity: drep/d_evaluate.py (SURVEY.md §2; reference mount empty)
+— defaults --warn_dist 0.25, --warn_sim 0.98, --warn_aln 0.25. Emits
+`<wd>/log/warnings.txt` flagging (a) winner pairs whose primary (Mash)
+distance is suspiciously close, (b) winner pairs in different secondary
+clusters with high ANI, (c) secondary comparisons with low alignment
+coverage — the clusters that might be over- or under-split.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import pandas as pd
+
+from drep_tpu.utils.logger import get_logger
+from drep_tpu.workdir import WorkDirectory
+
+EVALUATE_DEFAULTS: dict[str, Any] = {
+    "warn_dist": 0.25,
+    "warn_sim": 0.98,
+    "warn_aln": 0.25,
+}
+
+
+def evaluate_warnings(
+    mdb: pd.DataFrame | None,
+    ndb: pd.DataFrame | None,
+    cdb: pd.DataFrame,
+    wdb: pd.DataFrame,
+    **kwargs,
+) -> list[str]:
+    kw = dict(EVALUATE_DEFAULTS)
+    kw.update({k: v for k, v in kwargs.items() if v is not None and k in EVALUATE_DEFAULTS})
+    warnings: list[str] = []
+    winners = set(wdb["genome"])
+    cluster_of = cdb.set_index("genome")["secondary_cluster"]
+
+    if mdb is not None and len(mdb):
+        close = mdb[
+            (mdb["genome1"] != mdb["genome2"])
+            & mdb["genome1"].isin(winners)
+            & mdb["genome2"].isin(winners)
+            & (mdb["dist"] <= kw["warn_dist"])
+        ]
+        for row in close.itertuples():
+            if row.genome1 < row.genome2:
+                warnings.append(
+                    f"Primary: winners {row.genome1} and {row.genome2} have Mash "
+                    f"distance {row.dist:.4f} (<= warn_dist {kw['warn_dist']})"
+                )
+
+    if ndb is not None and len(ndb):
+        for row in ndb.itertuples():
+            a, b = row.querry, row.reference
+            if a >= b or a not in winners or b not in winners:
+                continue
+            if cluster_of.get(a) != cluster_of.get(b) and row.ani >= kw["warn_sim"]:
+                warnings.append(
+                    f"Secondary: winners {a} and {b} are in different secondary "
+                    f"clusters but have ANI {row.ani:.4f} (>= warn_sim {kw['warn_sim']})"
+                )
+        low_aln = ndb[(ndb["alignment_coverage"] > 0) & (ndb["alignment_coverage"] <= kw["warn_aln"])]
+        for row in low_aln.itertuples():
+            if row.querry < row.reference:
+                warnings.append(
+                    f"Coverage: {row.querry} vs {row.reference} aligned only "
+                    f"{row.alignment_coverage:.3f} (<= warn_aln {kw['warn_aln']})"
+                )
+    return warnings
+
+
+def d_evaluate_wrapper(wd: WorkDirectory, **kwargs) -> list[str]:
+    logger = get_logger()
+    mdb = wd.get_db("Mdb") if wd.hasDb("Mdb") else None
+    ndb = wd.get_db("Ndb") if wd.hasDb("Ndb") else None
+    cdb = wd.get_db("Cdb")
+    wdb = wd.get_db("Wdb") if wd.hasDb("Wdb") else pd.DataFrame({"genome": cdb["genome"]})
+
+    warnings = evaluate_warnings(mdb, ndb, cdb, wdb, **kwargs)
+    path = wd.get_loc("warnings")
+    with open(path, "w") as f:
+        for w in warnings:
+            f.write(w + "\n")
+    logger.info("evaluate: %d warnings -> %s", len(warnings), path)
+    return warnings

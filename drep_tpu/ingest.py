@@ -1,0 +1,155 @@
+"""Genome ingest: FASTA files -> per-genome stats + MinHash/scaled sketches.
+
+This is the host side of the sketching pipeline (SURVEY.md §7 step 2). It
+plays the role of the reference's `mash sketch` fan-out plus
+d_filter.calc_fasta_stats (reference mount empty; upstream layout), but
+produces device-ready packed arrays instead of .msh files. Results are
+cached in the work directory (``data/arrays/sketches.npz``) keyed on the
+sketching arguments, giving sub-stage resume like the reference's cached
+sketch files under ``<wd>/data/``.
+
+Parallelism: a process pool over genomes (numpy releases little GIL during
+the pack matmul, so processes, not threads). The optional C++ ingest
+(drep_tpu.native) replaces the per-genome numpy kernel transparently.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ProcessPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.ops import kmers
+from drep_tpu.utils.fasta import fasta_stats, n50, read_fasta_contigs
+from drep_tpu.utils.logger import get_logger
+from drep_tpu.workdir import WorkDirectory
+
+DEFAULT_SKETCH_SIZE = 1000  # reference: --MASH_sketch default 1000
+DEFAULT_SCALE = 200  # FracMinHash scale for the jax_ani secondary
+
+
+@dataclass
+class GenomeSketches:
+    names: list[str]
+    gdb: pd.DataFrame  # genome, length, N50, contigs, n_kmers
+    bottom: list[np.ndarray]  # uint64 bottom-k sketches (sorted)
+    scaled: list[np.ndarray]  # uint64 scaled sketches (sorted, ragged)
+    k: int
+    sketch_size: int
+    scale: int
+
+
+def _sketch_one(args) -> tuple[str, dict]:
+    name, path, k, sketch_size, scale = args
+    contigs = read_fasta_contigs(path)
+    lengths = np.array([len(c) for c in contigs], dtype=np.int64)
+    all_hashes = [kmers.kmer_hashes(c, k) for c in contigs] or [np.empty(0, np.uint64)]
+    hashes = np.unique(np.concatenate(all_hashes))
+    return name, {
+        "length": int(lengths.sum()) if len(lengths) else 0,
+        "N50": n50(lengths),
+        "contigs": len(contigs),
+        "n_kmers": int(hashes.size),
+        "bottom": kmers.bottom_k_sketch(hashes, sketch_size),
+        "scaled": kmers.scaled_sketch(hashes, scale),
+    }
+
+
+def sketch_genomes(
+    bdb: pd.DataFrame,
+    k: int = kmers.DEFAULT_K,
+    sketch_size: int = DEFAULT_SKETCH_SIZE,
+    scale: int = DEFAULT_SCALE,
+    processes: int = 1,
+    wd: WorkDirectory | None = None,
+) -> GenomeSketches:
+    """Sketch every genome in Bdb; cache/restore via the work directory."""
+    logger = get_logger()
+    args_snapshot = {"k": k, "sketch_size": sketch_size, "scale": scale, "genomes": sorted(bdb["genome"])}
+
+    if wd is not None and wd.has_arrays("sketches") and wd.arguments_match("sketch", args_snapshot):
+        logger.info("loading cached sketches from workdir")
+        return _load(wd, k, sketch_size, scale)
+
+    jobs = [(row.genome, row.location, k, sketch_size, scale) for row in bdb.itertuples()]
+    results: dict[str, dict] = {}
+    if processes > 1 and len(jobs) > 1:
+        with ProcessPoolExecutor(max_workers=processes) as pool:
+            for name, res in pool.map(_sketch_one, jobs):
+                results[name] = res
+    else:
+        for job in jobs:
+            name, res = _sketch_one(job)
+            results[name] = res
+
+    names = list(bdb["genome"])
+    gdb = pd.DataFrame(
+        {
+            "genome": names,
+            "length": [results[g]["length"] for g in names],
+            "N50": [results[g]["N50"] for g in names],
+            "contigs": [results[g]["contigs"] for g in names],
+            "n_kmers": [results[g]["n_kmers"] for g in names],
+        }
+    )
+    out = GenomeSketches(
+        names=names,
+        gdb=gdb,
+        bottom=[results[g]["bottom"] for g in names],
+        scaled=[results[g]["scaled"] for g in names],
+        k=k,
+        sketch_size=sketch_size,
+        scale=scale,
+    )
+    if wd is not None:
+        _save(wd, out)
+        wd.store_arguments("sketch", args_snapshot)
+    return out
+
+
+def _save(wd: WorkDirectory, gs: GenomeSketches) -> None:
+    bcat = np.concatenate(gs.bottom) if gs.bottom else np.empty(0, np.uint64)
+    scat = np.concatenate(gs.scaled) if gs.scaled else np.empty(0, np.uint64)
+    wd.store_arrays(
+        "sketches",
+        bottom=bcat,
+        bottom_offsets=np.cumsum([0] + [len(s) for s in gs.bottom]).astype(np.int64),
+        scaled=scat,
+        scaled_offsets=np.cumsum([0] + [len(s) for s in gs.scaled]).astype(np.int64),
+        names=np.array(gs.names, dtype=object).astype(str),
+    )
+    wd.store_db(gs.gdb, "Gdb")
+
+
+def _load(wd: WorkDirectory, k: int, sketch_size: int, scale: int) -> GenomeSketches:
+    arrs = wd.get_arrays("sketches")
+    names = [str(x) for x in arrs["names"]]
+    bo, so = arrs["bottom_offsets"], arrs["scaled_offsets"]
+    bottom = [arrs["bottom"][bo[i] : bo[i + 1]] for i in range(len(names))]
+    scaled = [arrs["scaled"][so[i] : so[i + 1]] for i in range(len(names))]
+    return GenomeSketches(
+        names=names,
+        gdb=wd.get_db("Gdb"),
+        bottom=bottom,
+        scaled=scaled,
+        k=k,
+        sketch_size=sketch_size,
+        scale=scale,
+    )
+
+
+def make_bdb(genome_paths: list[str]) -> pd.DataFrame:
+    """Genome list -> Bdb (genome name = basename, reference convention)."""
+    import os
+
+    names = [os.path.basename(p) for p in genome_paths]
+    if len(set(names)) != len(names):
+        raise ValueError("duplicate genome basenames in input list")
+    return pd.DataFrame({"genome": names, "location": [os.path.abspath(p) for p in genome_paths]})
+
+
+def genome_info_from_stats(paths: list[str]) -> pd.DataFrame:
+    """Convenience: length/N50 stats table for a list of FASTAs (no quality)."""
+    return pd.DataFrame([fasta_stats(p).__dict__ for p in paths])

@@ -1,0 +1,119 @@
+"""Containment ANI on device — the `jax_ani` secondary engine.
+
+Replaces the reference's per-primary-cluster fastANI subprocess fan-out
+(drep/d_cluster/external.py::run_pairwise_fastANI over multiprocessing.Pool,
+SURVEY.md §3.2 hot loop #3; reference mount empty) with a sketch-based
+containment estimator computed entirely on device:
+
+- host: FracMinHash ("scaled") sketches — all k-mer hashes below 2^64/scale
+  — so sketch size tracks genome size and containment |A∩B|/|A| is estimable.
+  Hashes are mapped to a dense int32 id space (see ops/minhash.py for why
+  that is exact on a 64-bit-hash / 32-bit-device gap).
+- device: per pair, intersection size via ``searchsorted`` of row A's sorted
+  ids in row B's (O(S log S), static shapes, vmapped over pair tiles).
+
+ANI model: containment C = |A∩B|/|A| estimates (1-p)^k under the iid
+substitution model, so ``ANI = C^(1/k)`` (the standard containment-ANI
+transform, cf. Mash screen / sourmash). C itself doubles as the
+alignment-fraction proxy used for the reference's ``cov_thresh`` gating
+(pairs with coverage < cov_thresh get similarity zeroed, as in the
+reference's Ndb post-processing).
+
+Directionality matches fastANI's query->reference rows: ani(A->B) uses
+C(A,B); clustering uses the symmetrized mean like the reference's pivot.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+
+
+def pack_scaled_sketches(
+    sketches: list[np.ndarray], names: list[str], pad_multiple: int = 128
+) -> PackedSketches:
+    """Ragged uint64 scaled sketches -> padded int32 id matrix [N, S].
+
+    S = max sketch length rounded up to `pad_multiple` (lane-friendly).
+    """
+    if not sketches:
+        raise ValueError("no sketches to pack")
+    vocab = np.unique(np.concatenate(sketches))
+    if vocab.size >= np.iinfo(np.int32).max:
+        raise ValueError("id space overflow: >2^31 distinct sketch hashes")
+    width = max(max(len(s) for s in sketches), 1)
+    width = -(-width // pad_multiple) * pad_multiple
+    n = len(sketches)
+    ids = np.full((n, width), PAD_ID, dtype=np.int32)
+    counts = np.zeros(n, dtype=np.int32)
+    for i, s in enumerate(sketches):
+        ids[i, : len(s)] = np.searchsorted(vocab, s).astype(np.int32)
+        counts[i] = len(s)
+    return PackedSketches(ids=ids, counts=counts, names=list(names))
+
+
+def _pair_intersection(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """|A ∩ B| for two sorted, PAD_ID-padded int32 rows (static shapes)."""
+    idx = jnp.searchsorted(b, a)
+    idx = jnp.clip(idx, 0, b.shape[0] - 1)
+    hit = (b[idx] == a) & (a != PAD_ID)
+    return jnp.sum(hit.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def containment_ani_tile(a_ids, a_counts, b_ids, b_counts, *, k: int = 21):
+    """Directional ANI + coverage tiles between sketch blocks.
+
+    Returns (ani[Ta,Tb], cov[Ta,Tb]) where row i is query A_i against
+    reference B_j: cov = C(A_i, B_j) = |A∩B|/|A|, ani = C^(1/k).
+    """
+
+    def one_pair(a, na, b, nb):
+        inter = _pair_intersection(a, b)
+        cov = jnp.where(na > 0, inter / jnp.maximum(na, 1), 0.0)
+        ani = jnp.where(cov > 0.0, jnp.exp(jnp.log(jnp.maximum(cov, 1e-30)) / k), 0.0)
+        return ani.astype(jnp.float32), cov.astype(jnp.float32)
+
+    row = jax.vmap(one_pair, in_axes=(None, None, 0, 0))
+    tile = jax.vmap(row, in_axes=(0, 0, None, None))
+    return tile(a_ids, a_counts, b_ids, b_counts)
+
+
+def all_vs_all_containment(
+    packed: PackedSketches, k: int = 21, tile: int = 128
+) -> tuple[np.ndarray, np.ndarray]:
+    """Full directional [N, N] (ani, cov) matrices via fixed-shape tiles.
+
+    ani[i, j] = ANI of query i against reference j (NOT symmetric when
+    genome sizes differ — symmetrize downstream as the pipeline requires).
+    """
+    n, s = packed.n, packed.sketch_size
+    nt = -(-n // tile) * tile
+    ids = np.full((nt, s), PAD_ID, dtype=np.int32)
+    ids[:n] = packed.ids
+    counts = np.zeros(nt, dtype=np.int32)
+    counts[:n] = packed.counts
+
+    ani = np.zeros((nt, nt), dtype=np.float32)
+    cov = np.zeros((nt, nt), dtype=np.float32)
+    for i0 in range(0, nt, tile):
+        for j0 in range(0, nt, tile):
+            a, c = containment_ani_tile(
+                ids[i0 : i0 + tile],
+                counts[i0 : i0 + tile],
+                ids[j0 : j0 + tile],
+                counts[j0 : j0 + tile],
+                k=k,
+            )
+            ani[i0 : i0 + tile, j0 : j0 + tile] = np.asarray(a)
+            cov[i0 : i0 + tile, j0 : j0 + tile] = np.asarray(c)
+    ani = ani[:n, :n]
+    cov = cov[:n, :n]
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov

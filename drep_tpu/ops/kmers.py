@@ -1,0 +1,98 @@
+"""Canonical k-mer extraction and 64-bit hashing (host ingest).
+
+This replaces the role of Mash's C++ sketching stage (reference:
+drep/d_cluster/external.py::sketch_genome shells out to `mash sketch`;
+SURVEY.md §2b — reference mount empty). Design per SURVEY.md §7 step 2:
+FASTA -> canonical k-mer stream -> uint64 hashes, computed with vectorized
+numpy (a C++ fast path can slot in behind the same function signatures).
+
+Encoding: A=0 C=1 G=2 T=3, k<=31 packed into a uint64 (2 bits/base).
+Canonical k-mer = min(forward, reverse-complement) of the packed value,
+hashed with the splitmix64 finalizer (a strong 64-bit mixer; we do NOT
+claim hash-compatibility with Mash's MurmurHash3 — the reference binary is
+unavailable, so validation is against internal numpy oracles instead).
+
+Windows containing any non-ACGT byte are masked out, which also prevents
+k-mers from spanning contigs when sequences are joined with 'N'.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_K = 21
+
+_CODE = np.full(256, 255, dtype=np.uint8)
+for _i, _b in enumerate(b"ACGT"):
+    _CODE[_b] = _i
+    _CODE[_b + 32] = _i  # lowercase
+
+
+def encode_sequence(seq: bytes) -> np.ndarray:
+    """Bytes -> 2-bit codes (uint8), 255 for non-ACGT."""
+    return _CODE[np.frombuffer(seq, dtype=np.uint8)]
+
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 finalizer (public-domain mixer) on uint64."""
+    z = x.astype(np.uint64)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def packed_kmers(seq: bytes, k: int = DEFAULT_K) -> np.ndarray:
+    """All valid canonical k-mers of `seq`, packed into uint64 (unsorted,
+    in sequence order, duplicates retained)."""
+    if k > 31:
+        raise ValueError("k must be <= 31 to pack into uint64 (2 bits/base)")
+    codes = encode_sequence(seq)
+    n = len(codes) - k + 1
+    if n <= 0:
+        return np.empty(0, dtype=np.uint64)
+
+    windows = np.lib.stride_tricks.sliding_window_view(codes, k)
+    # valid windows: no 255 anywhere. cumsum trick avoids an [n, k] reduction.
+    invalid = (codes == 255).astype(np.int64)
+    cs = np.concatenate([[0], np.cumsum(invalid)])
+    valid = (cs[k:] - cs[:-k]) == 0
+
+    pow_f = (np.uint64(4) ** np.arange(k - 1, -1, -1, dtype=np.uint64))
+    pow_r = (np.uint64(4) ** np.arange(k, dtype=np.uint64))
+    # chunk the [n, k] uint64 window matmul: bounds transient memory to
+    # ~CHUNK*k*8 bytes instead of ~n*k*8 (~1 GB for a 5 Mb contig at k=21)
+    CHUNK = 1 << 18
+    canon = np.empty(n, dtype=np.uint64)
+    for c0 in range(0, n, CHUNK):
+        w = windows[c0 : c0 + CHUNK].astype(np.uint64)
+        fwd = w @ pow_f
+        rev = (np.uint64(3) - w) @ pow_r
+        canon[c0 : c0 + CHUNK] = np.minimum(fwd, rev)
+    return canon[valid]
+
+
+def kmer_hashes(seq: bytes, k: int = DEFAULT_K) -> np.ndarray:
+    """Sorted unique hashes of the canonical k-mer *set* of `seq`."""
+    canon = packed_kmers(seq, k)
+    if canon.size == 0:
+        return canon
+    return np.unique(splitmix64(canon))
+
+
+def bottom_k_sketch(hashes: np.ndarray, sketch_size: int) -> np.ndarray:
+    """Bottom-s MinHash sketch: the `sketch_size` smallest unique hashes,
+    ascending. (`hashes` must already be sorted unique, as from
+    :func:`kmer_hashes`.)"""
+    return hashes[:sketch_size]
+
+
+def scaled_sketch(hashes: np.ndarray, scale: int) -> np.ndarray:
+    """FracMinHash ("scaled") sketch: all unique hashes below 2^64/scale.
+
+    Sketch size tracks genome size (|kmers|/scale in expectation), which
+    makes containment — and hence ANI — estimable from sketches alone.
+    """
+    if scale <= 0:
+        raise ValueError("scale must be positive")
+    max_hash = np.uint64((1 << 64) // scale - 1) if scale > 1 else np.uint64(0xFFFFFFFFFFFFFFFF)
+    return hashes[hashes <= max_hash]

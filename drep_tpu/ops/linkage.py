@@ -1,0 +1,100 @@
+"""Hierarchical clustering on distance matrices.
+
+Reference parity: drep/d_cluster/utils.py::cluster_hierarchical — pivot pair
+table -> square matrix -> scipy linkage(method=clusterAlg) -> fcluster(
+t=1-threshold, criterion='distance') (SURVEY.md §2; reference mount empty).
+
+Two engines:
+- ``scipy`` (host): exact reference semantics for every linkage method
+  (average is the reference default). Fine through ~10k genomes.
+- ``device`` (jit): single-linkage flat clusters at a cutoff == connected
+  components of the thresholded distance graph, computed as min-label
+  propagation (a few O(N^2) matrix ops per sweep — XLA/VPU friendly, no
+  data-dependent shapes). Used by the large-N / on-device paths where
+  average linkage's sequential merges don't map to the hardware.
+
+Cluster labels are renumbered 1..C by first appearance in genome order,
+deterministically, for both engines (so goldens are stable).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.cluster.hierarchy as sch
+import scipy.spatial.distance as ssd
+
+
+def _renumber_first_appearance(labels: np.ndarray) -> np.ndarray:
+    """Map arbitrary labels -> 1..C ordered by first appearance."""
+    out = np.zeros(len(labels), dtype=np.int64)
+    mapping: dict[int, int] = {}
+    for i, lab in enumerate(labels):
+        key = int(lab)
+        if key not in mapping:
+            mapping[key] = len(mapping) + 1
+        out[i] = mapping[key]
+    return out
+
+
+def cluster_hierarchical(
+    dist: np.ndarray,
+    cutoff: float,
+    method: str = "average",
+) -> tuple[np.ndarray, np.ndarray]:
+    """Flat clusters of a square distance matrix at cophenetic cutoff.
+
+    Returns (labels 1..C int64 by first appearance, scipy linkage matrix).
+    """
+    dist = np.asarray(dist, dtype=np.float64)
+    n = dist.shape[0]
+    if n == 1:
+        return np.ones(1, dtype=np.int64), np.empty((0, 4))
+    dist = np.maximum(dist, dist.T)  # enforce symmetry for squareform
+    np.fill_diagonal(dist, 0.0)
+    condensed = ssd.squareform(dist, checks=False)
+    link = sch.linkage(condensed, method=method)
+    labels = sch.fcluster(link, t=cutoff, criterion="distance")
+    return _renumber_first_appearance(labels), link
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _connected_components_labels(adj: jnp.ndarray) -> jnp.ndarray:
+    """Min-label propagation over a boolean adjacency matrix [N, N].
+
+    labels[i] converges to min node index reachable from i. Sweeps =
+    graph diameter <= N; each sweep is one masked min-reduce (VPU-shaped).
+    """
+    n = adj.shape[0]
+    adj = adj | jnp.eye(n, dtype=bool)
+    init = jnp.arange(n, dtype=jnp.int32)
+
+    def body(state):
+        labels, _ = state
+        # neighbor minimum: min over j with adj[i, j] of labels[j]
+        big = jnp.int32(n)
+        cand = jnp.where(adj, labels[None, :], big)
+        new = jnp.minimum(labels, jnp.min(cand, axis=1))
+        # two-hop acceleration: pointer jumping labels[labels]
+        new = jnp.minimum(new, new[new])
+        return new, jnp.any(new != labels)
+
+    def cond(state):
+        return state[1]
+
+    labels, _ = jax.lax.while_loop(cond, body, (init, jnp.array(True)))
+    return labels
+
+
+def single_linkage_device(dist, cutoff: float) -> np.ndarray:
+    """Single-linkage flat clusters at `cutoff` via on-device components.
+
+    Exactly equals scipy single-linkage + fcluster(criterion='distance') —
+    a cluster is a connected component of {d <= cutoff} (verified in tests).
+    """
+    adj = jnp.asarray(dist) <= cutoff
+    labels = np.asarray(_connected_components_labels(adj))
+    return _renumber_first_appearance(labels)

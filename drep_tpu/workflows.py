@@ -1,0 +1,64 @@
+"""Top-level workflows composing the pipeline stages.
+
+Reference parity: drep/d_workflows.py (SURVEY.md §2/§3; reference mount
+empty): dereplicate = filter -> cluster -> choose -> evaluate -> analyze;
+compare = cluster -> evaluate -> analyze (no filter/choose).
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from drep_tpu.choose import d_choose_wrapper
+from drep_tpu.cluster.controller import d_cluster_wrapper
+from drep_tpu.evaluate import d_evaluate_wrapper
+from drep_tpu.filter import d_filter_wrapper
+from drep_tpu.ingest import make_bdb
+from drep_tpu.utils.logger import get_logger, setup_logger
+from drep_tpu.workdir import WorkDirectory
+
+
+def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]:
+    wd = WorkDirectory(wd_loc)
+    setup_logger(wd.get_dir("log"))
+    if genomes:
+        bdb = make_bdb(genomes)
+        wd.store_db(bdb, "Bdb")
+    elif wd.hasDb("Bdb"):
+        bdb = wd.get_db("Bdb")  # resume from an existing workdir
+    else:
+        raise ValueError("no genomes given and workdir has no stored Bdb")
+    return wd, bdb
+
+
+def compare_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> pd.DataFrame:
+    """`compare`: cluster + evaluate + analyze. Returns Cdb."""
+    wd, bdb = _init(wd_loc, genomes or [])
+    cdb = d_cluster_wrapper(wd, bdb, **kwargs)
+    # per-genome stats for downstream stages come from the ingest pass's Gdb
+    # (one FASTA read per genome, not a second parse)
+    wd.store_db(wd.get_db("Gdb")[["genome", "length", "N50", "contigs"]], "genomeInformation")
+    d_evaluate_wrapper(wd, **kwargs)
+    if not kwargs.get("skip_plots", False):
+        from drep_tpu.analyze import plot_all
+
+        plot_all(wd)
+    get_logger().info("compare finished: %d genomes, %d secondary clusters",
+                      len(cdb), cdb["secondary_cluster"].nunique())
+    return cdb
+
+
+def dereplicate_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> pd.DataFrame:
+    """`dereplicate`: filter + cluster + choose + evaluate + analyze.
+    Returns Wdb (the winners)."""
+    wd, bdb = _init(wd_loc, genomes or [])
+    filtered = d_filter_wrapper(wd, bdb, genomeInfo=kwargs.pop("genomeInfo", None), **kwargs)
+    d_cluster_wrapper(wd, filtered, **kwargs)
+    wdb = d_choose_wrapper(wd, filtered, **kwargs)
+    d_evaluate_wrapper(wd, **kwargs)
+    if not kwargs.get("skip_plots", False):
+        from drep_tpu.analyze import plot_all
+
+        plot_all(wd)
+    get_logger().info("dereplicate finished: %d winners", len(wdb))
+    return wdb

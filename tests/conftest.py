@@ -1,0 +1,45 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The reference has no fake backend (SURVEY.md §4); our multi-device tests run
+on CPU with XLA's forced host device count, so sharding/collective code is
+exercised without TPU hardware. Must be set before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+import pytest  # noqa: E402
+
+GENOME_DIR = os.path.join(os.path.dirname(__file__), "genomes")
+GENOME_NAMES = ["genome_A", "genome_B", "genome_C", "genome_D", "genome_E"]
+
+
+@pytest.fixture(scope="session")
+def genome_paths() -> list[str]:
+    return [os.path.join(GENOME_DIR, f"{g}.fasta") for g in GENOME_NAMES]
+
+
+@pytest.fixture(scope="session")
+def bdb(genome_paths) -> pd.DataFrame:
+    from drep_tpu.ingest import make_bdb
+
+    return make_bdb(genome_paths)
+
+
+@pytest.fixture(scope="session")
+def sketches(bdb):
+    """Session-cached sketches of the 5 fixture genomes (k=21 defaults)."""
+    from drep_tpu.ingest import sketch_genomes
+
+    return sketch_genomes(bdb)
+
+
+@pytest.fixture()
+def rng() -> np.random.Generator:
+    return np.random.default_rng(0)

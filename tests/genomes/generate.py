@@ -1,0 +1,70 @@
+"""Deterministic generator for the bundled 5-genome test fixture.
+
+Mirrors the reference's tests/genomes/*.fasta fixture role (SURVEY.md §4):
+5 small genomes whose expected clustering is known by construction —
+
+- genome_A: 120 kb random sequence (3 contigs)
+- genome_B: A with 1% point mutations  -> ANI ~0.99: same secondary cluster as A
+- genome_C: A with 8% point mutations  -> ANI ~0.92: same primary cluster,
+            different secondary cluster (S_ani default 0.95)
+- genome_D: independent 110 kb random sequence
+- genome_E: D with 0.5% point mutations -> same secondary cluster as D
+
+Expected at defaults (P_ani 0.9, S_ani 0.95): primary {A,B,C} and {D,E};
+secondary {A,B}, {C}, {D,E} -> 3 dereplication winners.
+
+Run from the repo root: python tests/genomes/generate.py
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+BASES = np.frombuffer(b"ACGT", dtype=np.uint8)
+OUT_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def random_genome(rng: np.random.Generator, length: int) -> np.ndarray:
+    return BASES[rng.integers(0, 4, size=length)]
+
+
+def mutate(rng: np.random.Generator, seq: np.ndarray, rate: float) -> np.ndarray:
+    out = seq.copy()
+    pos = np.nonzero(rng.random(len(seq)) < rate)[0]
+    # substitute with a *different* base so the realized rate equals `rate`
+    shift = rng.integers(1, 4, size=len(pos))
+    code = np.searchsorted(BASES, out[pos])
+    out[pos] = BASES[(code + shift) % 4]
+    return out
+
+
+def write_fasta(path: str, seq: np.ndarray, n_contigs: int, name: str) -> None:
+    bounds = np.linspace(0, len(seq), n_contigs + 1).astype(int)
+    with open(path, "w") as f:
+        for c in range(n_contigs):
+            chunk = seq[bounds[c] : bounds[c + 1]].tobytes().decode()
+            f.write(f">{name}_contig_{c}\n")
+            for i in range(0, len(chunk), 80):
+                f.write(chunk[i : i + 80] + "\n")
+
+
+def main() -> None:
+    rng = np.random.default_rng(20260729)
+    a = random_genome(rng, 120_000)
+    d = random_genome(rng, 110_000)
+    genomes = {
+        "genome_A": (a, 3),
+        "genome_B": (mutate(rng, a, 0.01), 3),
+        "genome_C": (mutate(rng, a, 0.08), 4),
+        "genome_D": (d, 2),
+        "genome_E": (mutate(rng, d, 0.005), 2),
+    }
+    for name, (seq, contigs) in genomes.items():
+        write_fasta(os.path.join(OUT_DIR, f"{name}.fasta"), seq, contigs, name)
+    print(f"wrote {len(genomes)} genomes to {OUT_DIR}")
+
+
+if __name__ == "__main__":
+    main()

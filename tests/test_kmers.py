@@ -1,0 +1,94 @@
+"""Kernel unit tests vs pure-Python oracles (SURVEY.md §4: the reference has
+no kernel tests; the rebuild validates each numeric kernel against a slow
+honest implementation)."""
+
+import numpy as np
+import pytest
+
+from drep_tpu.ops import kmers
+
+COMP = {"A": "T", "C": "G", "G": "C", "T": "A"}
+CODE = {"A": 0, "C": 1, "G": 2, "T": 3}
+
+
+def oracle_canonical_kmers(seq: str, k: int) -> list[int]:
+    out = []
+    for i in range(len(seq) - k + 1):
+        w = seq[i : i + k]
+        if any(c not in CODE for c in w):
+            continue
+        rc = "".join(COMP[c] for c in reversed(w))
+        fwd = sum(CODE[c] * 4 ** (k - 1 - j) for j, c in enumerate(w))
+        rev = sum(CODE[c] * 4 ** (k - 1 - j) for j, c in enumerate(rc))
+        out.append(min(fwd, rev))
+    return out
+
+
+def oracle_splitmix64(x: int) -> int:
+    mask = (1 << 64) - 1
+    z = x & mask
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & mask
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & mask
+    return (z ^ (z >> 31)) & mask
+
+
+def test_packed_kmers_match_oracle(rng):
+    seq = "".join(rng.choice(list("ACGT"), size=200))
+    for k in (3, 7, 21, 31):
+        got = kmers.packed_kmers(seq.encode(), k)
+        want = oracle_canonical_kmers(seq, k)
+        assert got.tolist() == want
+
+
+def test_packed_kmers_mask_non_acgt():
+    seq = b"ACGTNACGT"
+    got = kmers.packed_kmers(seq, 4)
+    # valid windows: ACGT (pos 0) and ACGT (pos 5); all windows touching N drop
+    want = oracle_canonical_kmers(seq.decode(), 4)
+    assert got.tolist() == want
+    assert len(got) == 2
+
+
+def test_packed_kmers_lowercase_and_revcomp_invariance(rng):
+    seq = "".join(rng.choice(list("ACGT"), size=500))
+    rc = "".join(COMP[c] for c in reversed(seq))
+    a = kmers.kmer_hashes(seq.encode(), 21)
+    b = kmers.kmer_hashes(rc.encode(), 21)
+    c = kmers.kmer_hashes(seq.lower().encode(), 21)
+    assert np.array_equal(a, b)  # canonicalization: strand-independent
+    assert np.array_equal(a, c)
+
+
+def test_splitmix64_matches_oracle(rng):
+    xs = rng.integers(0, 2**63, size=50, dtype=np.uint64)
+    got = kmers.splitmix64(xs)
+    for x, g in zip(xs, got):
+        assert int(g) == oracle_splitmix64(int(x))
+
+
+def test_kmer_hashes_sorted_unique():
+    seq = b"ACGT" * 100
+    h = kmers.kmer_hashes(seq, 21)
+    assert np.array_equal(h, np.unique(h))
+
+
+def test_bottom_k_and_scaled_sketch():
+    h = np.sort(np.random.default_rng(1).integers(0, 2**63, 10_000, dtype=np.uint64))
+    h = np.unique(h)
+    bk = kmers.bottom_k_sketch(h, 100)
+    assert len(bk) == 100 and np.array_equal(bk, h[:100])
+    sc = kmers.scaled_sketch(h, scale=4)
+    assert (sc <= np.uint64((1 << 64) // 4 - 1)).all()
+    # expectation: ~|h|/scale elements survive
+    assert 0.5 * len(h) / 4 < len(sc) < 2.0 * len(h) / 4
+
+
+def test_short_sequence_edge_cases():
+    assert kmers.packed_kmers(b"ACG", 21).size == 0
+    assert kmers.packed_kmers(b"", 21).size == 0
+    assert kmers.kmer_hashes(b"NNNNNNNNNNNNNNNNNNNNNNNN", 21).size == 0
+
+
+def test_scale_validation():
+    with pytest.raises(ValueError):
+        kmers.scaled_sketch(np.empty(0, np.uint64), 0)

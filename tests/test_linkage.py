@@ -1,0 +1,64 @@
+"""Clustering equivalence: device single-linkage vs scipy; determinism."""
+
+import numpy as np
+import scipy.cluster.hierarchy as sch
+import scipy.spatial.distance as ssd
+
+from drep_tpu.ops.linkage import (
+    _renumber_first_appearance,
+    cluster_hierarchical,
+    single_linkage_device,
+)
+
+
+def _random_dist(rng, n):
+    d = rng.random((n, n)).astype(np.float64)
+    d = (d + d.T) / 2
+    np.fill_diagonal(d, 0.0)
+    return d
+
+
+def test_device_single_linkage_equals_scipy(rng):
+    for n in (2, 5, 17, 60):
+        d = _random_dist(rng, n)
+        for cutoff in (0.05, 0.25, 0.5, 0.9):
+            got = single_linkage_device(d, cutoff)
+            link = sch.linkage(ssd.squareform(d, checks=False), method="single")
+            want = _renumber_first_appearance(sch.fcluster(link, t=cutoff, criterion="distance"))
+            assert np.array_equal(got, want), (n, cutoff)
+
+
+def test_cluster_hierarchical_average(rng):
+    d = _random_dist(rng, 20)
+    labels, link = cluster_hierarchical(d, 0.3, method="average")
+    want = _renumber_first_appearance(
+        sch.fcluster(sch.linkage(ssd.squareform(d, checks=False), method="average"), t=0.3, criterion="distance")
+    )
+    assert np.array_equal(labels, want)
+    assert link.shape == (19, 4)
+
+
+def test_single_genome():
+    labels, link = cluster_hierarchical(np.zeros((1, 1)), 0.1)
+    assert labels.tolist() == [1]
+    assert len(link) == 0
+
+
+def test_all_identical_one_cluster():
+    d = np.zeros((6, 6))
+    labels, _ = cluster_hierarchical(d, 0.1)
+    assert labels.tolist() == [1] * 6
+    assert np.array_equal(single_linkage_device(d, 0.1), labels)
+
+
+def test_all_distant_all_singletons():
+    n = 8
+    d = np.ones((n, n))
+    np.fill_diagonal(d, 0.0)
+    labels, _ = cluster_hierarchical(d, 0.1)
+    assert labels.tolist() == list(range(1, n + 1))
+    assert np.array_equal(single_linkage_device(d, 0.1), labels)
+
+
+def test_first_appearance_numbering():
+    assert _renumber_first_appearance(np.array([5, 5, 2, 9, 2])).tolist() == [1, 1, 2, 3, 2]

@@ -1,0 +1,90 @@
+"""Choose-stage scoring formula and winner selection (reference parity:
+drep/d_choose.py — formula weights comW 1, conW 5, strW 1, N50W 0.5,
+sizeW 0, centW 1; SURVEY.md §2)."""
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.choose import compute_centrality, pick_winners, score_genomes
+
+
+def _tables():
+    cdb = pd.DataFrame(
+        {
+            "genome": ["a", "b", "c"],
+            "secondary_cluster": ["1_1", "1_1", "2_1"],
+        }
+    )
+    stats = pd.DataFrame(
+        {
+            "genome": ["a", "b", "c"],
+            "length": [100_000, 200_000, 150_000],
+            "N50": [10_000, 50_000, 30_000],
+        }
+    )
+    quality = pd.DataFrame(
+        {
+            "genome": ["a", "b", "c"],
+            "completeness": [95.0, 80.0, 99.0],
+            "contamination": [1.0, 5.0, 0.0],
+        }
+    )
+    ndb = pd.DataFrame(
+        {
+            "reference": ["b", "a"],
+            "querry": ["a", "b"],
+            "ani": [0.98, 0.96],
+            "alignment_coverage": [0.9, 0.9],
+            "ref_coverage": [0.9, 0.9],
+            "querry_coverage": [0.9, 0.9],
+            "primary_cluster": [1, 1],
+        }
+    )
+    return cdb, stats, quality, ndb
+
+
+def test_score_formula_by_hand():
+    cdb, stats, quality, ndb = _tables()
+    df = score_genomes(cdb, stats, quality, ndb)
+    # genome a: 1*95 - 5*1 + 1*0 + 0.5*log10(1e4) + 0*log10(1e5) + 1*(0.97-0.95)
+    cent_a = (0.98 + 0.96) / 2  # symmetrized single pair
+    want_a = 95 - 5 + 0.5 * 4 + (cent_a - 0.95)
+    got_a = float(df.loc[df["genome"] == "a", "score"].iloc[0])
+    assert abs(got_a - want_a) < 1e-9
+
+
+def test_centrality_only_within_cluster():
+    cdb, stats, quality, ndb = _tables()
+    cent = compute_centrality(ndb, cdb)
+    assert abs(cent["a"] - 0.97) < 1e-12
+    assert abs(cent["b"] - 0.97) < 1e-12
+    assert cent["c"] == 0.0  # singleton: no comparisons
+
+
+def test_pick_winners_ties_deterministic():
+    sdb_full = pd.DataFrame(
+        {
+            "genome": ["x", "y", "z"],
+            "secondary_cluster": ["1_1", "1_1", "2_1"],
+            "score": [5.0, 5.0, 1.0],
+        }
+    )
+    wdb = pick_winners(sdb_full)
+    assert len(wdb) == 2
+    # tie in 1_1 -> lexicographically first genome wins
+    assert wdb.loc[wdb["cluster"] == "1_1", "genome"].iloc[0] == "x"
+
+
+def test_missing_quality_scores_zero():
+    cdb, stats, _, ndb = _tables()
+    df = score_genomes(cdb, stats, None, ndb)
+    assert (df["completeness"] == 0).all()
+    assert np.isfinite(df["score"]).all()
+
+
+def test_extra_weight_table():
+    cdb, stats, quality, ndb = _tables()
+    extra = pd.DataFrame({"genome": ["a"], "weight": [1000.0]})
+    df = score_genomes(cdb, stats, quality, ndb, extra_weights=extra)
+    base = score_genomes(cdb, stats, quality, ndb)
+    assert abs((df["score"] - base["score"]).iloc[0] - 1000.0) < 1e-9

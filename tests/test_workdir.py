@@ -1,0 +1,59 @@
+"""WorkDirectory: the persistence/checkpoint substrate (SURVEY.md §5.4)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from drep_tpu.workdir import WorkDirectory
+
+
+def test_store_get_roundtrip(tmp_path):
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    df = pd.DataFrame({"genome": ["a", "b"], "score": [1.5, 2.5]})
+    wd.store_db(df, "Sdb")
+    assert wd.hasDb("Sdb")
+    out = wd.get_db("Sdb")
+    pd.testing.assert_frame_equal(df, out)
+
+
+def test_missing_table_raises(tmp_path):
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    assert not wd.hasDb("Cdb")
+    with pytest.raises(FileNotFoundError):
+        wd.get_db("Cdb")
+
+
+def test_arrays_roundtrip(tmp_path):
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    a = np.arange(10, dtype=np.uint64)
+    b = np.ones((3, 4), dtype=np.int32)
+    wd.store_arrays("sketches", a=a, b=b)
+    out = wd.get_arrays("sketches")
+    assert np.array_equal(out["a"], a)
+    assert np.array_equal(out["b"], b)
+
+
+def test_arguments_match(tmp_path):
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    args = {"P_ani": 0.9, "S_ani": 0.95, "genomes": ["a", "b"]}
+    assert not wd.arguments_match("cluster", args)
+    wd.store_arguments("cluster", args)
+    assert wd.arguments_match("cluster", args)
+    assert not wd.arguments_match("cluster", {**args, "S_ani": 0.99})
+    # restricting keys ignores non-resume-relevant changes
+    assert wd.arguments_match("cluster", {**args, "S_ani": 0.99}, keys=["P_ani", "genomes"])
+
+
+def test_numpy_types_serializable(tmp_path):
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    wd.store_arguments("x", {"a": np.int64(3), "b": np.float32(0.5), "c": np.array([1, 2])})
+    stored = wd.get_arguments("x")
+    assert stored == {"a": 3, "b": 0.5, "c": [1, 2]}
+
+
+def test_subdirs_created(tmp_path):
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    import os
+
+    for sub in ("data", "data_tables", "figures", "log", "dereplicated_genomes"):
+        assert os.path.isdir(os.path.join(wd.location, sub))
