@@ -1,0 +1,73 @@
+"""Benchmark: genome-pairs/sec/chip on the jax_mash all-vs-all engine.
+
+Prints ONE JSON line:
+  {"metric": "genome-pairs/sec/chip", "value": N, "unit": "pairs/s", "vs_baseline": N}
+
+Metric definition follows BASELINE.json ("genome-pairs/sec/chip on dRep
+compare"): unique genome pairs (N*(N-1)/2) divided by wall-clock of the
+all-vs-all Mash-distance computation on one chip, at N=2048 genomes and
+sketch size 1024 (realistic production shape; the reference default sketch
+is 1000, padded here to a lane-friendly 1024).
+
+`vs_baseline`: BASELINE.json `published` is empty (no published reference
+number exists — SURVEY.md §6), so the honest denominator is the north-star
+requirement: 100k MAGs in <30 min on v5e-16 => 100k*(100k-1)/2 pairs /
+1800 s / 16 chips ~= 1.736e5 pairs/s/chip. vs_baseline > 1 means this
+engine clears the north-star rate for its primary stage.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_GENOMES = 2048
+SKETCH_SIZE = 1024
+K = 21
+TILE = 512
+NORTH_STAR_PAIRS_PER_SEC_PER_CHIP = (100_000 * 99_999 / 2) / 1800.0 / 16.0
+
+
+def main() -> None:
+    from drep_tpu.ops.minhash import PackedSketches, all_vs_all_mash
+
+    rng = np.random.default_rng(0)
+    ids = np.sort(
+        rng.integers(0, 2**30, size=(N_GENOMES, SKETCH_SIZE), dtype=np.int32), axis=1
+    )
+    counts = np.full((N_GENOMES,), SKETCH_SIZE, dtype=np.int32)
+    packed = PackedSketches(
+        ids=ids, counts=counts, names=[f"g{i}" for i in range(N_GENOMES)]
+    )
+
+    # warmup: compile the tile kernel
+    all_vs_all_mash(
+        PackedSketches(ids=ids[: 2 * TILE], counts=counts[: 2 * TILE], names=[]),
+        k=K,
+        tile=TILE,
+    )
+
+    t0 = time.perf_counter()
+    dist, _ = all_vs_all_mash(packed, k=K, tile=TILE)  # returns host numpy: synchronized
+    dt = time.perf_counter() - t0
+
+    pairs = N_GENOMES * (N_GENOMES - 1) / 2
+    pairs_per_sec = pairs / dt
+    n_chips = 1  # all_vs_all_mash runs single-chip; per-chip by construction
+    value = pairs_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "genome-pairs/sec/chip",
+                "value": round(value, 1),
+                "unit": "pairs/s",
+                "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,7 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "multiround_primary_clustering": False,
     "primary_chunksize": 5000,
     "mdb_dense_limit": 2000,
+    "mesh_shape": None,
 }
 
 _RESUME_KEYS = [
@@ -110,7 +111,7 @@ def _primary_clusters(
         labels = multiround_primary_clustering(gs, bdb, kw)
         return labels, None, np.empty((0, 4))
     engine = dispatch.get_primary(kw["primary_algorithm"])
-    dist, _sim = engine(gs, bdb=bdb, processes=kw["processes"])
+    dist, _sim = engine(gs, bdb=bdb, processes=kw["processes"], mesh_shape=kw["mesh_shape"])
     cutoff = 1.0 - kw["P_ani"]
     if kw["clusterAlg"] == "single" and n > 64:
         labels = single_linkage_device(dist, cutoff)
@@ -129,7 +130,7 @@ def _secondary_for_cluster(
 ) -> tuple[pd.DataFrame, np.ndarray, np.ndarray]:
     """One primary cluster -> (Ndb rows, secondary labels 1.., linkage)."""
     engine = dispatch.get_secondary(kw["S_algorithm"])
-    ani, cov = engine(gs, indices, bdb=bdb, processes=kw["processes"])
+    ani, cov = engine(gs, indices, bdb=bdb, processes=kw["processes"], mesh_shape=kw["mesh_shape"])
     names = [gs.names[i] for i in indices]
     m = len(names)
 

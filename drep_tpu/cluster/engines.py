@@ -3,6 +3,11 @@
 `jax_mash` / `jax_ani` are the TPU-native paths (BASELINE.json north star);
 `mash` / `fastANI` subprocess fallbacks live in cluster/external.py and are
 registered lazily there.
+
+Both engines pick their execution layout automatically: single-device tiled
+loops on one chip, ring-sharded ``shard_map`` all-pairs (parallel/allpairs)
+when the mesh has more than one device and the problem is big enough to
+amortize the collectives.
 """
 
 from __future__ import annotations
@@ -14,22 +19,59 @@ from drep_tpu.ingest import GenomeSketches
 from drep_tpu.ops.containment import all_vs_all_containment, pack_scaled_sketches
 from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
 
+# below this many genomes a multi-device ring costs more in collective
+# latency + padding than it saves in compute
+MESH_MIN_GENOMES = 64
+
+
+def _mesh_or_none(mesh_shape: int | None, n: int):
+    import jax
+
+    from drep_tpu.parallel.mesh import make_mesh
+
+    n_avail = len(jax.devices())
+    n_dev = mesh_shape if mesh_shape is not None else n_avail
+    if n_dev > 1 and n >= MESH_MIN_GENOMES:
+        return make_mesh(n_dev)
+    return None
+
+
+def mash_distance_matrix(packed, k: int, mesh_shape: int | None = None, tile: int = 256) -> np.ndarray:
+    """[N, N] Mash distance with automatic single-chip / mesh selection.
+
+    Shared by the jax_mash engine and the multiround chunked path so both
+    honor `mesh_shape` identically.
+    """
+    mesh = _mesh_or_none(mesh_shape, packed.n)
+    if mesh is not None:
+        from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+
+        return sharded_mash_allpairs(packed, k=k, mesh=mesh)
+    dist, _jac = all_vs_all_mash(packed, k=k, tile=tile)
+    return dist
+
 
 @register_primary("jax_mash")
-def primary_jax_mash(gs: GenomeSketches, tile: int = 256, **_) -> tuple[np.ndarray, np.ndarray]:
+def primary_jax_mash(
+    gs: GenomeSketches, tile: int = 256, mesh_shape: int | None = None, **_
+) -> tuple[np.ndarray, np.ndarray]:
     """All-vs-all Mash distance from bottom-k sketches on device.
 
     Returns (dist [N,N], similarity [N,N]) where similarity = 1 - dist
     (the Mdb convention).
     """
     packed = pack_sketches(gs.bottom, gs.names, gs.sketch_size)
-    dist, _jac = all_vs_all_mash(packed, k=gs.k, tile=tile)
+    dist = mash_distance_matrix(packed, gs.k, mesh_shape=mesh_shape, tile=tile)
     return dist, 1.0 - dist
 
 
 @register_secondary("jax_ani")
 def secondary_jax_ani(
-    gs: GenomeSketches, indices: list[int], tile: int = 128, **_
+    gs: GenomeSketches,
+    indices: list[int],
+    tile: int = 128,
+    mesh_shape: int | None = None,
+    **_,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Directional containment (ani, cov) matrices for a genome subset.
 
@@ -38,6 +80,11 @@ def secondary_jax_ani(
     sketches = [gs.scaled[i] for i in indices]
     names = [gs.names[i] for i in indices]
     packed = pack_scaled_sketches(sketches, names)
+    mesh = _mesh_or_none(mesh_shape, packed.n)
+    if mesh is not None:
+        from drep_tpu.parallel.allpairs import sharded_containment_allpairs
+
+        return sharded_containment_allpairs(packed, k=gs.k, mesh=mesh)
     return all_vs_all_containment(packed, k=gs.k, tile=tile)
 
 

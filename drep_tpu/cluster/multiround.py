@@ -24,13 +24,17 @@ import pandas as pd
 
 from drep_tpu.ingest import GenomeSketches
 from drep_tpu.ops.linkage import cluster_hierarchical
-from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
+from drep_tpu.ops.minhash import pack_sketches
 from drep_tpu.utils.logger import get_logger
 
 
-def _cluster_chunk(gs: GenomeSketches, idx: list[int], cutoff: float, method: str) -> np.ndarray:
+def _cluster_chunk(
+    gs: GenomeSketches, idx: list[int], cutoff: float, method: str, mesh_shape: int | None
+) -> np.ndarray:
+    from drep_tpu.cluster.engines import mash_distance_matrix
+
     packed = pack_sketches([gs.bottom[i] for i in idx], [gs.names[i] for i in idx], gs.sketch_size)
-    dist, _ = all_vs_all_mash(packed, k=gs.k)
+    dist = mash_distance_matrix(packed, gs.k, mesh_shape=mesh_shape)
     labels, _ = cluster_hierarchical(dist, cutoff, method=method)
     return labels
 
@@ -43,6 +47,7 @@ def multiround_primary_clustering(
     chunk = int(kw["primary_chunksize"])
     cutoff = 1.0 - kw["P_ani"]
     method = kw["clusterAlg"]
+    mesh_shape = kw.get("mesh_shape")
     nk = gs.gdb["n_kmers"].to_numpy()
 
     # round 1: within-chunk clustering, elect representatives
@@ -50,7 +55,7 @@ def multiround_primary_clustering(
     reps: list[int] = []
     for c0 in range(0, n, chunk):
         idx = list(range(c0, min(c0 + chunk, n)))
-        labels = _cluster_chunk(gs, idx, cutoff, method)
+        labels = _cluster_chunk(gs, idx, cutoff, method, mesh_shape)
         for lab in range(1, int(labels.max()) + 1):
             members = [idx[t] for t in range(len(idx)) if labels[t] == lab]
             rep = max(members, key=lambda i: int(nk[i]))
@@ -60,7 +65,7 @@ def multiround_primary_clustering(
     logger.info("multiround: %d chunks -> %d representatives", -(-n // chunk), len(reps))
 
     # round 2: cluster the representatives
-    rep_labels = _cluster_chunk(gs, reps, cutoff, method)
+    rep_labels = _cluster_chunk(gs, reps, cutoff, method, mesh_shape)
     label_of_rep = {rep: int(rep_labels[t]) for t, rep in enumerate(reps)}
 
     raw = np.array([label_of_rep[int(rep_of_genome[i])] for i in range(n)], dtype=np.int64)

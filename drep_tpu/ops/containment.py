@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+from drep_tpu.ops.minhash import PAD_ID, PackedSketches, pad_packed_rows
 
 
 def pack_scaled_sketches(
@@ -92,12 +92,9 @@ def all_vs_all_containment(
     ani[i, j] = ANI of query i against reference j (NOT symmetric when
     genome sizes differ — symmetrize downstream as the pipeline requires).
     """
-    n, s = packed.n, packed.sketch_size
-    nt = -(-n // tile) * tile
-    ids = np.full((nt, s), PAD_ID, dtype=np.int32)
-    ids[:n] = packed.ids
-    counts = np.zeros(nt, dtype=np.int32)
-    counts[:n] = packed.counts
+    n = packed.n
+    ids, counts = pad_packed_rows(packed.ids, packed.counts, tile)
+    nt = ids.shape[0]
 
     ani = np.zeros((nt, nt), dtype=np.float32)
     cov = np.zeros((nt, nt), dtype=np.float32)

@@ -68,6 +68,23 @@ def pack_sketches(sketches: list[np.ndarray], names: list[str], sketch_size: int
     return PackedSketches(ids=ids, counts=counts, names=list(names))
 
 
+def pad_packed_rows(ids: np.ndarray, counts: np.ndarray, multiple: int):
+    """Pad a packed sketch matrix to a row multiple: PAD_ID rows, zero counts.
+
+    The single shared implementation of the padding invariant used by the
+    tiled single-device loops and the mesh-sharded path alike.
+    """
+    n = ids.shape[0]
+    nt = -(-n // multiple) * multiple
+    if nt == n:
+        return ids, counts
+    pad_ids = np.full((nt, ids.shape[1]), PAD_ID, dtype=ids.dtype)
+    pad_ids[:n] = ids
+    pad_counts = np.zeros(nt, dtype=counts.dtype)
+    pad_counts[:n] = counts
+    return pad_ids, pad_counts
+
+
 def _pair_shared(a: jnp.ndarray, b: jnp.ndarray, na: jnp.ndarray, nb: jnp.ndarray):
     """Mash estimator core for one pair of sorted padded id rows.
 
@@ -119,12 +136,9 @@ def all_vs_all_mash(
     call has the same static shape (one XLA compilation, cached). For very
     large N use drep_tpu.parallel.allpairs (mesh-sharded) instead.
     """
-    n, s = packed.n, packed.sketch_size
-    nt = -(-n // tile) * tile
-    ids = np.full((nt, s), PAD_ID, dtype=np.int32)
-    ids[:n] = packed.ids
-    counts = np.zeros(nt, dtype=np.int32)
-    counts[:n] = packed.counts
+    n = packed.n
+    ids, counts = pad_packed_rows(packed.ids, packed.counts, tile)
+    nt = ids.shape[0]
 
     dist = np.ones((nt, nt), dtype=np.float32)
     jac = np.zeros((nt, nt), dtype=np.float32)

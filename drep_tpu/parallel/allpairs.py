@@ -1,0 +1,145 @@
+"""Mesh-sharded all-pairs comparison — the distributed compute core.
+
+Replaces the reference's multiprocessing.Pool fan-out of pairwise subprocess
+jobs (SURVEY.md §2c, §3.2) with the canonical TPU pattern (SURVEY.md §7
+step 7, SNIPPETS.md ring patterns): genomes are row-sharded over a 1-D
+mesh; each device holds 1/D of the sketches and computes its stripe of the
+distance matrix while the "B" operand ring-rotates over the mesh axis with
+``lax.ppermute`` — D steps, each overlapping an ICI hop with a tile of
+compute, never materializing more than 2/D of the sketches per device.
+
+The jitted shard_map programs are cached per (kernel kind, k, mesh), so
+repeated calls — e.g. one per large primary cluster during secondary
+clustering — recompile only when shapes actually change.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from drep_tpu.ops.containment import containment_ani_tile
+from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
+from drep_tpu.parallel.mesh import AXIS, make_mesh
+
+
+def _ring_allpairs_shard(a_ids, a_counts, tile_fn, n_outputs: int):
+    """Per-shard body (runs under shard_map): local A block vs ring-rotating
+    B block. Returns [n_local, N_global] stripes for each tile output."""
+    n_devices = lax.psum(1, AXIS)
+    my = lax.axis_index(AXIS)
+    n_local = a_ids.shape[0]
+
+    b_ids, b_counts = a_ids, a_counts
+    # mark the accumulators as device-varying so the scan carry type is
+    # stable (the updates are derived from axis_index and vary over the mesh)
+    outs = [
+        lax.pcast(jnp.zeros((n_local, n_local * n_devices), jnp.float32), (AXIS,), to="varying")
+        for _ in range(n_outputs)
+    ]
+    perm = [(j, (j + 1) % n_devices) for j in range(n_devices)]
+
+    def step(i, carry):
+        b_ids, b_counts, *outs = carry
+        tiles = tile_fn(a_ids, a_counts, b_ids, b_counts)
+        if not isinstance(tiles, tuple):
+            tiles = (tiles,)
+        # after i rotations device m holds block (m - i) mod D
+        src = jnp.remainder(my - i, n_devices)
+        col0 = src * n_local
+        outs = [
+            lax.dynamic_update_slice(out, tile.astype(jnp.float32), (0, col0))
+            for out, tile in zip(outs, tiles)
+        ]
+        b_ids = lax.ppermute(b_ids, AXIS, perm)
+        b_counts = lax.ppermute(b_counts, AXIS, perm)
+        return (b_ids, b_counts, *outs)
+
+    carry = lax.fori_loop(0, n_devices, step, (b_ids, b_counts, *outs))
+    return tuple(carry[2:])
+
+
+def _mash_tile(k: int):
+    def tile(a_ids, a_counts, b_ids, b_counts):
+        d, _j = mash_distance_tile(a_ids, a_counts, b_ids, b_counts, k=k)
+        return d
+
+    return tile
+
+
+def _containment_tile(k: int):
+    def tile(a_ids, a_counts, b_ids, b_counts):
+        return containment_ani_tile(a_ids, a_counts, b_ids, b_counts, k=k)
+
+    return tile
+
+
+_TILE_KINDS: dict[str, tuple[Callable[[int], Callable], int]] = {
+    "mash": (_mash_tile, 1),
+    "containment": (_containment_tile, 2),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_fn(kind: str, k: int, mesh) -> tuple[Callable, int]:
+    """One jitted shard_map program per (kernel kind, k, mesh); jax.jit then
+    caches per input shape, so same-shape calls are compile-free."""
+    make_tile, n_outputs = _TILE_KINDS[kind]
+    fn = jax.jit(
+        jax.shard_map(
+            functools.partial(
+                _ring_allpairs_shard, tile_fn=make_tile(k), n_outputs=n_outputs
+            ),
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS)),
+            out_specs=tuple(P(AXIS, None) for _ in range(n_outputs)),
+        )
+    )
+    return fn, n_outputs
+
+
+def ring_allpairs(
+    packed: PackedSketches,
+    kind: str,
+    k: int,
+    mesh=None,
+) -> tuple[np.ndarray, ...]:
+    """Run the `kind` tile kernel over every pair of rows, sharded over the
+    mesh. Returns full [N, N] float32 matrices (one per kernel output),
+    gathered to host and trimmed to the real N."""
+    if mesh is None:
+        mesh = make_mesh()
+    n_devices = mesh.devices.size
+    n = packed.n
+    ids, counts = pad_packed_rows(packed.ids, packed.counts, n_devices)
+
+    ids_d = jax.device_put(ids, NamedSharding(mesh, P(AXIS, None)))
+    counts_d = jax.device_put(counts, NamedSharding(mesh, P(AXIS)))
+
+    fn, _ = _ring_fn(kind, k, mesh)
+    outs = fn(ids_d, counts_d)
+    # np.array (copy): jax buffers are read-only and callers fill diagonals
+    return tuple(np.array(o)[:n, :n] for o in outs)
+
+
+def sharded_mash_allpairs(packed: PackedSketches, k: int = 21, mesh=None) -> np.ndarray:
+    """[N, N] Mash distance matrix, ring-sharded over the mesh."""
+    (dist,) = ring_allpairs(packed, "mash", k, mesh=mesh)
+    np.fill_diagonal(dist, 0.0)
+    return dist
+
+
+def sharded_containment_allpairs(
+    packed: PackedSketches, k: int = 21, mesh=None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Directional ([N,N] ani, [N,N] cov), ring-sharded over the mesh."""
+    ani, cov = ring_allpairs(packed, "containment", k, mesh=mesh)
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov

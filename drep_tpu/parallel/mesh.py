@@ -1,0 +1,60 @@
+"""Device-mesh construction and multi-host initialization.
+
+The reference has NO distributed runtime at all (SURVEY.md §2c — its only
+parallelism is a local multiprocessing.Pool); this module is the greenfield
+TPU equivalent: a 1-D mesh over all chips (ICI within a slice, DCN across
+hosts once `jax.distributed.initialize` has run), over which the all-pairs
+tile grid is sharded (parallel/allpairs.py).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS = "x"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """1-D mesh over the first `n_devices` devices (default: all)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, only {len(devices)} present")
+        devices = devices[:n_devices]
+    return jax.make_mesh((len(devices),), (AXIS,), devices=devices)
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard axis 0 (genomes/rows) over the mesh; trailing axes replicated."""
+    return NamedSharding(mesh, P(AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(coordinator: str | None = None, num_processes: int | None = None, process_id: int | None = None) -> None:
+    """Multi-host bring-up (v5e-64-style pods; SURVEY.md §5.8).
+
+    On single-host runs this is a no-op. On multi-host, either rely on the
+    TPU environment auto-detection (no arguments) or pass explicit
+    coordinator/process counts.
+    """
+    # must run BEFORE any backend use (jax.devices()/process_count() would
+    # initialize the local backend and make distributed init impossible)
+    try:
+        if coordinator is None and num_processes is None:
+            jax.distributed.initialize()
+        else:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+    except RuntimeError as e:
+        # already initialized (idempotent re-entry) is fine; anything else
+        # must surface — silently continuing single-host on a pod would
+        # compute wrong results
+        if "already initialized" not in str(e).lower():
+            raise

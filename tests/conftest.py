@@ -7,10 +7,19 @@ exercised without TPU hardware. Must be set before jax initializes.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# hard override: the runtime environment presets JAX_PLATFORMS (e.g. to the
+# TPU tunnel), which would give the test session 1 real chip instead of the
+# 8-device virtual mesh these tests are written against. jax may already be
+# imported by a pytest plugin (jaxtyping), so set the config, not just env.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pandas as pd  # noqa: E402

@@ -1,0 +1,61 @@
+"""Mesh-sharded all-pairs vs the single-device tiled reference, on the
+8-device virtual CPU mesh (SURVEY.md §4: the multi-device fake-backend
+tests the reference never had)."""
+
+import jax
+import numpy as np
+import pytest
+
+from drep_tpu.ops.containment import all_vs_all_containment, pack_scaled_sketches
+from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
+from drep_tpu.parallel.allpairs import sharded_containment_allpairs, sharded_mash_allpairs
+from drep_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual CPU devices"
+    return make_mesh(8)
+
+
+def _sketch_set(rng, n, s):
+    base = np.unique(rng.integers(0, 2**62, size=6 * s * n, dtype=np.uint64))
+    rng.shuffle(base)
+    shared = base[:s]
+    out = []
+    for i in range(n):
+        own = base[s * (i + 1) : s * (i + 2)]
+        mix = int(s * rng.random() * 0.8)
+        out.append(np.sort(np.unique(np.concatenate([shared[:mix], own[: s - mix]]))[:s]))
+    return out
+
+
+def test_sharded_mash_matches_single_device(rng, mesh8):
+    s = 64
+    n = 20  # not a multiple of 8: exercises padding
+    sketches = _sketch_set(rng, n, s)
+    packed = pack_sketches(sketches, [f"g{i}" for i in range(n)], s)
+    want, _ = all_vs_all_mash(packed, k=21, tile=8)
+    got = sharded_mash_allpairs(packed, k=21, mesh=mesh8)
+    assert got.shape == (n, n)
+    assert np.allclose(got, want, atol=1e-6)
+
+
+def test_sharded_containment_matches_single_device(rng, mesh8):
+    n = 11
+    sketches = _sketch_set(rng, n, 96)
+    packed = pack_scaled_sketches(sketches, [f"g{i}" for i in range(n)], pad_multiple=32)
+    want_ani, want_cov = all_vs_all_containment(packed, k=21, tile=8)
+    got_ani, got_cov = sharded_containment_allpairs(packed, k=21, mesh=mesh8)
+    assert np.allclose(got_ani, want_ani, atol=1e-6)
+    assert np.allclose(got_cov, want_cov, atol=1e-6)
+
+
+def test_mesh_size_one(rng):
+    mesh1 = make_mesh(1)
+    s = 32
+    sketches = _sketch_set(rng, 5, s)
+    packed = pack_sketches(sketches, [f"g{i}" for i in range(5)], s)
+    want, _ = all_vs_all_mash(packed, k=21, tile=8)
+    got = sharded_mash_allpairs(packed, k=21, mesh=mesh1)
+    assert np.allclose(got, want, atol=1e-6)
