@@ -20,9 +20,12 @@ import numpy as np
 import pandas as pd
 
 from drep_tpu.ingest import GenomeSketches
-from drep_tpu.ops.containment import all_vs_all_containment, pack_scaled_sketches
+from drep_tpu.ops.containment import (
+    cap_gather_tile,
+    containment_ani_tile,
+    pack_scaled_sketches,
+)
 from drep_tpu.ops.minhash import PAD_ID
-from drep_tpu.ops.containment import containment_ani_tile
 
 
 def _pad_pack(ids: np.ndarray, counts: np.ndarray, rows: list[int], pad_to: int):
@@ -53,6 +56,8 @@ def greedy_secondary_cluster(
 
     packed = pack_scaled_sketches([gs.scaled[indices[t]] for t in order], [gs.names[indices[t]] for t in order])
     ids, counts = packed.ids, packed.counts
+    # cap the [block, block, S] gather working set (shared TPU-crash guard)
+    block = cap_gather_tile(ids.shape[1], block)
 
     labels_ordered = np.zeros(m, dtype=np.int64)
     reps: list[int] = []  # positions (in `order` space) of representatives

@@ -84,6 +84,74 @@ def containment_ani_tile(a_ids, a_counts, b_ids, b_counts, *, k: int = 21):
     return tile(a_ids, a_counts, b_ids, b_counts)
 
 
+# budget for the dense indicator matrix [m, V] in bf16 (elements, ~256 MB)
+MATMUL_BUDGET_ELEMS = 1 << 27
+_VOCAB_BUCKET = 8192  # round V up: buckets compilations across clusters
+
+# cap on tile*tile*row_width elements for batched-gather tiles: oversized
+# gathers have been observed to hard-crash the TPU runtime (not OOM — a
+# worker fault), so every gather-tile path must respect this
+GATHER_BUDGET_ELEMS = 1 << 26
+
+
+def cap_gather_tile(row_width: int, tile: int, budget: int = GATHER_BUDGET_ELEMS) -> int:
+    """Largest power-of-two tile with tile^2 * row_width <= budget (min 8)."""
+    cap = max(8, int((float(budget) / max(row_width, 1)) ** 0.5))
+    return min(tile, 1 << (cap.bit_length() - 1))
+
+
+def matmul_vocab_pad(packed: PackedSketches) -> int:
+    """Bucketed indicator width for the MXU path (one scan of packed.ids).
+
+    The budget check and the kernel must use the SAME padded width — the
+    raw vocab can be far below the bucket size.
+    """
+    valid = packed.ids != PAD_ID
+    vmax = int(packed.ids[valid].max()) + 1 if valid.any() else 1
+    return -(-vmax // _VOCAB_BUCKET) * _VOCAB_BUCKET
+
+
+@functools.partial(jax.jit, static_argnames=("v_pad", "k"))
+def _containment_matmul(ids, counts, *, v_pad: int, k: int):
+    """Intersection counts as an MXU matmul of 0/1 indicator rows.
+
+    counts[i,j] = |A_i ∩ A_j| = <ind_i, ind_j> over the id vocabulary —
+    bf16 0/1 inputs with f32 accumulation are exact up to 2^24. This is
+    where the systolic array earns its keep: one [m, V] x [V, m] matmul
+    replaces m^2 searchsorted passes.
+    """
+    m, s = ids.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (m, s), 0)
+    valid = ids != PAD_ID
+    cols = jnp.where(valid, ids, v_pad)  # pads land in a trash column
+    ind = jnp.zeros((m, v_pad + 1), jnp.bfloat16).at[rows, cols].set(1.0)
+    ind = ind[:, :v_pad]
+    inter = jnp.dot(ind, ind.T, preferred_element_type=jnp.float32)
+    na = jnp.maximum(counts.astype(jnp.float32), 1.0)
+    cov = inter / na[:, None]
+    ani = jnp.where(cov > 0.0, jnp.exp(jnp.log(jnp.maximum(cov, 1e-30)) / k), 0.0)
+    return ani, cov
+
+
+def all_vs_all_containment_matmul(
+    packed: PackedSketches, k: int = 21, v_pad: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """MXU path for the directional (ani, cov) matrices. Use when
+    m * (v_pad+1) fits MATMUL_BUDGET_ELEMS; exact-equal to the searchsorted
+    path (verified in tests). Pass a precomputed `v_pad` (from
+    :func:`matmul_vocab_pad`) to avoid rescanning packed.ids."""
+    if v_pad is None:
+        v_pad = matmul_vocab_pad(packed)
+    ani, cov = _containment_matmul(
+        jnp.asarray(packed.ids), jnp.asarray(packed.counts), v_pad=v_pad, k=k
+    )
+    ani = np.array(ani)
+    cov = np.array(cov)
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov
+
+
 def all_vs_all_containment(
     packed: PackedSketches, k: int = 21, tile: int = 128
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -93,6 +161,7 @@ def all_vs_all_containment(
     genome sizes differ — symmetrize downstream as the pipeline requires).
     """
     n = packed.n
+    tile = cap_gather_tile(packed.sketch_size, tile)
     ids, counts = pad_packed_rows(packed.ids, packed.counts, tile)
     nt = ids.shape[0]
 

@@ -90,6 +90,12 @@ def _pair_shared(a: jnp.ndarray, b: jnp.ndarray, na: jnp.ndarray, nb: jnp.ndarra
 
     Returns (shared, s_use): `shared` = number of hashes present in BOTH
     sketches among the bottom-`s_use` distinct hashes of the union.
+
+    Implementation note: this sort-based formulation is deliberate. A
+    gather-based alternative (searchsorted + binary search in value space,
+    asymptotically cheaper) measured ~70x SLOWER on v5e — batched gathers
+    serialize on the scalar unit, while one big fused sort/cumsum chain
+    stays on the VPU. Don't "optimize" this back to gathers.
     """
     s = a.shape[0]
     x = jnp.sort(jnp.concatenate([a, b]))

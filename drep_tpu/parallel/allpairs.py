@@ -57,8 +57,16 @@ def _ring_allpairs_shard(a_ids, a_counts, tile_fn, n_outputs: int):
             lax.dynamic_update_slice(out, tile.astype(jnp.float32), (0, col0))
             for out, tile in zip(outs, tiles)
         ]
-        b_ids = lax.ppermute(b_ids, AXIS, perm)
-        b_counts = lax.ppermute(b_counts, AXIS, perm)
+
+        def rotate(ops):
+            bi, bc = ops
+            return lax.ppermute(bi, AXIS, perm), lax.ppermute(bc, AXIS, perm)
+
+        # the final iteration's rotation result is never read — skip the
+        # ICI traffic (the predicate is uniform across devices)
+        b_ids, b_counts = lax.cond(
+            i < n_devices - 1, rotate, lambda ops: ops, (b_ids, b_counts)
+        )
         return (b_ids, b_counts, *outs)
 
     carry = lax.fori_loop(0, n_devices, step, (b_ids, b_counts, *outs))

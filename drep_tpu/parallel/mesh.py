@@ -10,7 +10,7 @@ tile grid is sharded (parallel/allpairs.py).
 from __future__ import annotations
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 AXIS = "x"
 
@@ -23,15 +23,6 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
             raise ValueError(f"requested {n_devices} devices, only {len(devices)} present")
         devices = devices[:n_devices]
     return jax.make_mesh((len(devices),), (AXIS,), devices=devices)
-
-
-def row_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard axis 0 (genomes/rows) over the mesh; trailing axes replicated."""
-    return NamedSharding(mesh, P(AXIS))
-
-
-def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
 
 
 def initialize_distributed(coordinator: str | None = None, num_processes: int | None = None, process_id: int | None = None) -> None:
@@ -52,9 +43,18 @@ def initialize_distributed(coordinator: str | None = None, num_processes: int | 
                 num_processes=num_processes,
                 process_id=process_id,
             )
+    except ValueError:
+        # auto-detect found no cluster environment (single-host run):
+        # "coordinator_address should be defined" — expected, proceed local
+        if coordinator is not None or num_processes is not None:
+            raise  # explicit multi-host args were wrong — surface it
     except RuntimeError as e:
-        # already initialized (idempotent re-entry) is fine; anything else
-        # must surface — silently continuing single-host on a pod would
-        # compute wrong results
-        if "already initialized" not in str(e).lower():
+        # tolerable: (a) distributed already initialized (idempotent
+        # re-entry), (b) local backend already up in this process (library
+        # use after other JAX work — distributed init is impossible now and
+        # the run is single-process by construction). Anything else must
+        # surface — silently continuing single-host on a pod would compute
+        # wrong results.
+        msg = str(e).lower()
+        if "already initialized" not in msg and "must be called before" not in msg:
             raise
