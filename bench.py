@@ -31,7 +31,8 @@ NORTH_STAR_PAIRS_PER_SEC_PER_CHIP = (100_000 * 99_999 / 2) / 1800.0 / 16.0
 
 
 def main() -> None:
-    from drep_tpu.ops.minhash import PackedSketches, all_vs_all_mash
+    from drep_tpu.cluster.engines import mash_distance_matrix
+    from drep_tpu.ops.minhash import PackedSketches
 
     rng = np.random.default_rng(0)
     ids = np.sort(
@@ -42,15 +43,11 @@ def main() -> None:
         ids=ids, counts=counts, names=[f"g{i}" for i in range(N_GENOMES)]
     )
 
-    # warmup: compile the tile kernel
-    all_vs_all_mash(
-        PackedSketches(ids=ids[: 2 * TILE], counts=counts[: 2 * TILE], names=[]),
-        k=K,
-        tile=TILE,
-    )
+    # warmup: compile the production (auto-selected) kernel at full shape
+    mash_distance_matrix(packed, k=K, tile=TILE)
 
     t0 = time.perf_counter()
-    dist, _ = all_vs_all_mash(packed, k=K, tile=TILE)  # returns host numpy: synchronized
+    dist = mash_distance_matrix(packed, k=K, tile=TILE)  # host numpy: synchronized
     dt = time.perf_counter() - t0
 
     pairs = N_GENOMES * (N_GENOMES - 1) / 2

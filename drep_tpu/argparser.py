@@ -32,6 +32,10 @@ def build_parser() -> argparse.ArgumentParser:
         comp = p.add_argument_group("GENOME COMPARISON")
         comp.add_argument("--primary_algorithm", default="jax_mash",
                           help="primary (coarse) comparison engine [jax_mash|mash]")
+        comp.add_argument("--primary_estimator", default="auto",
+                          choices=["auto", "sort", "matmul"],
+                          help="jax_mash Jaccard estimator: sort=union-bottom-s "
+                               "(reference Mash), matmul=MXU common-threshold")
         comp.add_argument("--S_algorithm", default="jax_ani",
                           help="secondary (ANI) comparison engine [jax_ani|fastANI]")
         comp.add_argument("-ms", "--MASH_sketch", type=int, default=1000)

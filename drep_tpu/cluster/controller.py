@@ -53,6 +53,7 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "primary_chunksize": 5000,
     "mdb_dense_limit": 2000,
     "mesh_shape": None,
+    "primary_estimator": "auto",
 }
 
 _RESUME_KEYS = [
@@ -61,6 +62,7 @@ _RESUME_KEYS = [
     "cov_thresh",
     "clusterAlg",
     "primary_algorithm",
+    "primary_estimator",
     "S_algorithm",
     "MASH_sketch",
     "scale",
@@ -111,7 +113,13 @@ def _primary_clusters(
         labels = multiround_primary_clustering(gs, bdb, kw)
         return labels, None, np.empty((0, 4))
     engine = dispatch.get_primary(kw["primary_algorithm"])
-    dist, _sim = engine(gs, bdb=bdb, processes=kw["processes"], mesh_shape=kw["mesh_shape"])
+    dist, _sim = engine(
+        gs,
+        bdb=bdb,
+        processes=kw["processes"],
+        mesh_shape=kw["mesh_shape"],
+        primary_estimator=kw["primary_estimator"],
+    )
     cutoff = 1.0 - kw["P_ani"]
     if kw["clusterAlg"] == "single" and n > 64:
         labels = single_linkage_device(dist, cutoff)

@@ -29,12 +29,17 @@ from drep_tpu.utils.logger import get_logger
 
 
 def _cluster_chunk(
-    gs: GenomeSketches, idx: list[int], cutoff: float, method: str, mesh_shape: int | None
+    gs: GenomeSketches,
+    idx: list[int],
+    cutoff: float,
+    method: str,
+    mesh_shape: int | None,
+    estimator: str = "auto",
 ) -> np.ndarray:
     from drep_tpu.cluster.engines import mash_distance_matrix
 
     packed = pack_sketches([gs.bottom[i] for i in idx], [gs.names[i] for i in idx], gs.sketch_size)
-    dist = mash_distance_matrix(packed, gs.k, mesh_shape=mesh_shape)
+    dist = mash_distance_matrix(packed, gs.k, mesh_shape=mesh_shape, estimator=estimator)
     labels, _ = cluster_hierarchical(dist, cutoff, method=method)
     return labels
 
@@ -48,6 +53,7 @@ def multiround_primary_clustering(
     cutoff = 1.0 - kw["P_ani"]
     method = kw["clusterAlg"]
     mesh_shape = kw.get("mesh_shape")
+    estimator = kw.get("primary_estimator", "auto")
     nk = gs.gdb["n_kmers"].to_numpy()
 
     # round 1: within-chunk clustering, elect representatives
@@ -55,7 +61,7 @@ def multiround_primary_clustering(
     reps: list[int] = []
     for c0 in range(0, n, chunk):
         idx = list(range(c0, min(c0 + chunk, n)))
-        labels = _cluster_chunk(gs, idx, cutoff, method, mesh_shape)
+        labels = _cluster_chunk(gs, idx, cutoff, method, mesh_shape, estimator)
         for lab in range(1, int(labels.max()) + 1):
             members = [idx[t] for t in range(len(idx)) if labels[t] == lab]
             rep = max(members, key=lambda i: int(nk[i]))
@@ -65,7 +71,7 @@ def multiround_primary_clustering(
     logger.info("multiround: %d chunks -> %d representatives", -(-n // chunk), len(reps))
 
     # round 2: cluster the representatives
-    rep_labels = _cluster_chunk(gs, reps, cutoff, method, mesh_shape)
+    rep_labels = _cluster_chunk(gs, reps, cutoff, method, mesh_shape, estimator)
     label_of_rep = {rep: int(rep_labels[t]) for t, rep in enumerate(reps)}
 
     raw = np.array([label_of_rep[int(rep_of_genome[i])] for i in range(n)], dtype=np.int64)

@@ -1,0 +1,165 @@
+"""MXU path for the all-vs-all MinHash Jaccard — chunked indicator matmuls.
+
+Motivation: the sort-based estimator (ops/minhash.py) is VPU-bound at
+O(s log^2 s) per pair. Intersection counts, however, are a matmul:
+``inter[i,j] = <ind_i, ind_j>`` over the hash-id vocabulary, which puts the
+whole primary stage on the systolic array (measured ~10-20x faster at
+production shapes on v5e).
+
+Estimator (common-threshold MinHash, exact — not an approximation of
+Jaccard): for pair (i, j) let t = min(t_i, t_j) where t_i is the largest
+hash in sketch i (its bottom-s threshold). Below t, BOTH sketches are
+complete samples of their genomes, so
+
+    j_est = |S_i ∩ S_j| / (|S_i <= t| + |S_j <= t| - |S_i ∩ S_j|)
+
+is an unbiased Jaccard estimate with effective sample size ~s (every
+element of the intersection is automatically <= t). This differs from the
+reference Mash's union-bottom-s estimator only in which unbiased sample it
+conditions on (per-pair values differ within estimator variance; both are
+validated against oracles in tests).
+
+Execution: hash ids are globally column-sorted and cut into chunks at
+column boundaries; within a chunk, columns are relabeled dense (any
+injective relabeling preserves inner products), so every chunk scatters
+into the same fixed [N, W] indicator and one ``lax.scan`` accumulates
+
+    inter += I @ I.T          (intersection counts)
+    below += I @ (col_value <= t_j)   (per-pair below-threshold counts)
+
+entirely on the MXU with two [N, W] x [W, N] matmuls per chunk.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from drep_tpu.ops.minhash import PAD_ID, PackedSketches, mash_distance_from_jaccard
+
+# per-chunk entry budget: W columns of bf16 indicator [N, W]. Chosen so the
+# indicator stays ~tens of MB for a few thousand rows.
+DEFAULT_CHUNK_ENTRIES = 16384
+
+
+def _build_chunks(ids: np.ndarray, counts: np.ndarray, chunk_entries: int):
+    """Column-sorted (row, dense-col, col-value) chunk tensors, padded to a
+    common width; chunks never split a column (inner products need every
+    occurrence of a hash id in the same chunk)."""
+    n, s = ids.shape
+    valid = ids != PAD_ID
+    rows_flat = np.repeat(np.arange(n, dtype=np.int32), s)[valid.ravel()]
+    cols_flat = ids.ravel()[valid.ravel()]
+    order = np.argsort(cols_flat, kind="stable")
+    rows_flat = rows_flat[order]
+    cols_flat = cols_flat[order]
+    total = len(cols_flat)
+
+    cuts = [0]
+    while cuts[-1] < total:
+        end = min(cuts[-1] + chunk_entries, total)
+        # advance to the next column boundary
+        while end < total and cols_flat[end] == cols_flat[end - 1]:
+            end += 1
+        cuts.append(end)
+    n_chunks = len(cuts) - 1
+
+    width = max(cuts[i + 1] - cuts[i] for i in range(n_chunks))
+    rows_c = np.full((n_chunks, width), n, dtype=np.int32)  # pad -> trash row
+    dcol_c = np.full((n_chunks, width), width, dtype=np.int32)  # pad -> trash col
+    vals_c = np.full((n_chunks, width), np.iinfo(np.int32).max, dtype=np.int32)
+    for c in range(n_chunks):
+        lo, hi = cuts[c], cuts[c + 1]
+        if hi == lo:
+            continue
+        seg_cols = cols_flat[lo:hi]
+        # dense relabel within the chunk (seg_cols is sorted)
+        is_first = np.concatenate([[True], seg_cols[1:] != seg_cols[:-1]])
+        dcol = np.cumsum(is_first) - 1
+        rows_c[c, : hi - lo] = rows_flat[lo:hi]
+        dcol_c[c, : hi - lo] = dcol.astype(np.int32)
+        # column values for the threshold comparison, padded with int32 max
+        distinct_vals = seg_cols[is_first]
+        vals_c[c, : len(distinct_vals)] = distinct_vals
+    return rows_c, dcol_c, vals_c
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _accumulate_chunks(rows_c, dcol_c, vals_c, thresholds, *, n: int):
+    """lax.scan over chunks: inter += I@I.T, below += I@T. Returns f32
+    [n, n] matrices (exact: 0/1 bf16 products, f32 accumulation)."""
+    width = rows_c.shape[1]
+
+    def step(carry, chunk):
+        inter, below = carry
+        rows, dcol, vals = chunk
+        ind = jnp.zeros((n + 1, width + 1), jnp.bfloat16).at[rows, dcol].set(1.0)
+        ind = ind[:n, :width]
+        # NT-layout dot_general: contract the W axis of both operands
+        # directly (no transpose materialization)
+        inter = inter + jax.lax.dot_general(
+            ind, ind, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        t_mat = (vals[None, :] <= thresholds[:, None]).astype(jnp.bfloat16)  # [n, W]
+        below = below + jax.lax.dot_general(
+            ind, t_mat, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return (inter, below), None
+
+    init = (
+        jnp.zeros((n, n), jnp.float32),
+        jnp.zeros((n, n), jnp.float32),
+    )
+    (inter, below), _ = jax.lax.scan(step, init, (rows_c, dcol_c, vals_c))
+    return inter, below
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def _jaccard_from_counts(inter, below, counts, thresholds, *, k: int):
+    nf = counts.astype(jnp.float32)
+    t_i = thresholds[:, None]
+    t_j = thresholds[None, :]
+    # restricted union size at t_min = min(t_i, t_j)
+    u = jnp.where(
+        t_j < t_i,
+        below + nf[None, :] - inter,  # below[i,j] = |S_i <= t_j|, S_j complete
+        nf[:, None] + below.T - inter,  # S_i complete, below[j,i] = |S_j <= t_i|
+    )
+    j = jnp.where(u > 0, inter / jnp.maximum(u, 1.0), 0.0)
+    dist = mash_distance_from_jaccard(j, k)
+    return dist, j
+
+
+def all_vs_all_mash_matmul(
+    packed: PackedSketches, k: int = 21, chunk_entries: int = DEFAULT_CHUNK_ENTRIES
+) -> tuple[np.ndarray, np.ndarray]:
+    """Full [N, N] (dist, jaccard) via the MXU estimator."""
+    ids, counts = packed.ids, packed.counts
+    n = packed.n
+    if n == 0:
+        return np.zeros((0, 0), np.float32), np.zeros((0, 0), np.float32)
+    if int(counts.max()) == 0:
+        # all sketches empty: maximal distance everywhere (matches the sort
+        # path), identity on the diagonal
+        dist = np.ones((n, n), np.float32)
+        jac = np.zeros((n, n), np.float32)
+        np.fill_diagonal(dist, 0.0)
+        np.fill_diagonal(jac, 1.0)
+        return dist, jac
+    # per-genome bottom-s threshold = largest valid id in the row
+    t = np.where(
+        counts > 0, ids[np.arange(n), np.maximum(counts - 1, 0)], np.int32(-1)
+    ).astype(np.int32)
+    rows_c, dcol_c, vals_c = _build_chunks(ids, counts, chunk_entries)
+    inter, below = _accumulate_chunks(
+        jnp.asarray(rows_c), jnp.asarray(dcol_c), jnp.asarray(vals_c), jnp.asarray(t), n=n
+    )
+    dist, jac = _jaccard_from_counts(inter, below, jnp.asarray(counts), jnp.asarray(t), k=k)
+    dist = np.array(dist)
+    jac = np.array(jac)
+    np.fill_diagonal(dist, 0.0)
+    np.fill_diagonal(jac, 1.0)
+    return dist, jac
