@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
         clus.add_argument("--multiround_primary_clustering", action="store_true")
         clus.add_argument("--primary_chunksize", type=int, default=5000)
         clus.add_argument("--greedy_secondary_clustering", action="store_true")
+        clus.add_argument("--run_tertiary_clustering", action="store_true",
+                          help="re-compare secondary-cluster representatives across "
+                               "primary-cluster boundaries and merge co-clustering groups")
 
         warn = p.add_argument_group("WARNINGS")
         warn.add_argument("--warn_dist", type=float, default=0.25)

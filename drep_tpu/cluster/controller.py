@@ -27,7 +27,7 @@ import numpy as np
 import pandas as pd
 
 from drep_tpu import schemas
-from drep_tpu.cluster import dispatch
+from drep_tpu.cluster import dispatch, pairs
 from drep_tpu.cluster import engines  # noqa: F401 — registers built-in engines
 from drep_tpu.ingest import DEFAULT_SCALE, DEFAULT_SKETCH_SIZE, GenomeSketches, sketch_genomes
 from drep_tpu.ops.kmers import DEFAULT_K
@@ -49,6 +49,7 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "SkipMash": False,
     "SkipSecondary": False,
     "greedy_secondary_clustering": False,
+    "run_tertiary_clustering": False,
     "multiround_primary_clustering": False,
     "primary_chunksize": 5000,
     "mdb_dense_limit": 2000,
@@ -70,6 +71,7 @@ _RESUME_KEYS = [
     "SkipMash",
     "SkipSecondary",
     "greedy_secondary_clustering",
+    "run_tertiary_clustering",
     "genomes",
 ]
 
@@ -140,32 +142,9 @@ def _secondary_for_cluster(
     engine = dispatch.get_secondary(kw["S_algorithm"])
     ani, cov = engine(gs, indices, bdb=bdb, processes=kw["processes"], mesh_shape=kw["mesh_shape"])
     names = [gs.names[i] for i in indices]
-    m = len(names)
 
-    # Ndb: directional rows, fastANI-style (query row i against reference j)
-    ii, jj = np.meshgrid(np.arange(m), np.arange(m), indexing="ij")
-    mask = ii.ravel() != jj.ravel()
-    ii, jj = ii.ravel()[mask], jj.ravel()[mask]
-    arr = np.array(names)
-    ndb = pd.DataFrame(
-        {
-            "reference": arr[jj],
-            "querry": arr[ii],
-            "ani": ani[ii, jj].astype(np.float64),
-            "alignment_coverage": cov[ii, jj].astype(np.float64),
-            "ref_coverage": cov[jj, ii].astype(np.float64),
-            "querry_coverage": cov[ii, jj].astype(np.float64),
-            "primary_cluster": pc,
-        }
-    )
-
-    # coverage gate (reference: cov < cov_thresh -> similarity zeroed), then
-    # symmetrize like the reference's pivot for clustering
-    sym_ani = (ani + ani.T) / 2.0
-    gate = (cov >= kw["cov_thresh"]) & (cov.T >= kw["cov_thresh"])
-    sym_ani = np.where(gate, sym_ani, 0.0)
-    np.fill_diagonal(sym_ani, 1.0)
-    dist = 1.0 - sym_ani
+    ndb = pairs.directional_ndb(names, ani, cov, pc)
+    dist = 1.0 - pairs.gated_symmetric_ani(ani, cov, kw["cov_thresh"])
     labels, link = cluster_hierarchical(dist, 1.0 - kw["S_ani"], method=kw["clusterAlg"])
     return ndb, labels, link
 
@@ -239,7 +218,6 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         if ndb_parts
         else schemas.empty("Ndb")
     )
-    wd.store_db(schemas.validate(ndb, "Ndb"), "Ndb")
 
     cdb = pd.DataFrame(
         {
@@ -251,6 +229,21 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
             "primary_cluster": primary,
         }
     )
+
+    if kw["run_tertiary_clustering"]:
+        if kw["SkipSecondary"]:
+            logger.warning(
+                "--run_tertiary_clustering ignored: requires secondary clustering "
+                "(remove --SkipSecondary)"
+            )
+        else:
+            from drep_tpu.cluster.tertiary import run_tertiary_clustering
+
+            cdb, tertiary_ndb = run_tertiary_clustering(gs, bdb, cdb, kw)
+            if len(tertiary_ndb):
+                ndb = pd.concat([ndb, tertiary_ndb], ignore_index=True)
+
+    wd.store_db(schemas.validate(ndb, "Ndb"), "Ndb")
     wd.store_db(schemas.validate(cdb, "Cdb"), "Cdb")
 
     cf_dir = wd.get_dir(os.path.join("data", "Clustering_files"))

@@ -62,7 +62,15 @@ def mash_distance_matrix(
     mesh = _mesh_or_none(mesh_shape, packed.n)
     # the ring path computes the sort (union-bottom-s) estimator, so it
     # serves both 'auto' and an explicit 'sort' request on a mesh
-    if estimator in ("auto", "sort") and mesh is not None:
+    if mesh is not None:
+        if estimator == "matmul":
+            from drep_tpu.utils.logger import get_logger
+
+            get_logger().warning(
+                "primary_estimator='matmul' is single-chip only — using the "
+                "mesh ring (sort estimator) to honor the %d-device mesh",
+                mesh.devices.size,
+            )
         from drep_tpu.parallel.allpairs import sharded_mash_allpairs
 
         return sharded_mash_allpairs(packed, k=k, mesh=mesh)

@@ -1,0 +1,106 @@
+"""Tertiary clustering: merge secondary clusters across primary boundaries.
+
+Reference parity: `--run_tertiary_clustering` (drep/d_cluster — SURVEY.md §2
+argument-parser row; reference mount empty). Primary (Mash) clustering is
+approximate; two genomes of the same species can land in different primary
+clusters and therefore never meet in a secondary comparison. Tertiary
+clustering closes that hole: one representative per secondary cluster is
+compared all-vs-all with the secondary (ANI) engine, representatives that
+clear the S_ani + coverage gate are clustered, and their secondary clusters
+merge. Same-primary representative pairs are masked out of both the merge
+graph and the emitted Ndb rows — their clustering was already decided by the
+secondary stage over full cluster membership, and tertiary must not override
+it (nor duplicate those pairs in Ndb).
+
+TPU shape: the representative set is small (one genome per species-level
+cluster), so this is a single all-vs-all containment pass — the same tiled /
+MXU / ring machinery as the secondary stage, one device dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.cluster import dispatch, pairs
+from drep_tpu.ingest import GenomeSketches
+from drep_tpu.ops.linkage import cluster_hierarchical
+from drep_tpu.utils.logger import get_logger
+
+
+def pick_representatives(cdb: pd.DataFrame, gdb: pd.DataFrame) -> pd.DataFrame:
+    """One representative per secondary cluster: the member with the most
+    distinct k-mers (largest information content — the same heuristic the
+    greedy path uses for rep election). Deterministic tie-break by name."""
+    df = cdb.merge(gdb[["genome", "n_kmers"]], on="genome", how="left")
+    df["n_kmers"] = df["n_kmers"].fillna(0)
+    df = df.sort_values(["n_kmers", "genome"], ascending=[False, True])
+    return df.groupby("secondary_cluster", sort=True).head(1)[
+        ["genome", "secondary_cluster", "primary_cluster"]
+    ]
+
+
+def run_tertiary_clustering(
+    gs: GenomeSketches,
+    bdb: pd.DataFrame,
+    cdb: pd.DataFrame,
+    kw: dict[str, Any],
+) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """Returns (updated Cdb, tertiary Ndb rows — cross-primary pairs only).
+
+    Secondary clusters whose representatives cluster at S_ani (with the
+    two-sided coverage gate, like the secondary stage) are merged; merged
+    groups take the label of their first-appearing member cluster, so runs
+    without cross-primary duplicates leave Cdb unchanged.
+    """
+    logger = get_logger()
+    reps = pick_representatives(cdb, gs.gdb)
+    m = len(reps)
+    rep_primary = reps["primary_cluster"].to_numpy()
+    cross = rep_primary[:, None] != rep_primary[None, :]
+    if m <= 1 or not cross.any():
+        return cdb, pairs.empty_ndb()
+
+    name_to_idx = {g: i for i, g in enumerate(gs.names)}
+    indices = [name_to_idx[g] for g in reps["genome"]]
+    engine = dispatch.get_secondary(kw["S_algorithm"])
+    ani, cov = engine(
+        gs, indices, bdb=bdb, processes=kw.get("processes", 1), mesh_shape=kw.get("mesh_shape")
+    )
+
+    rep_names = list(reps["genome"])
+    # primary_cluster 0 marks tertiary (cross-primary) comparisons
+    ndb = pairs.directional_ndb(rep_names, ani, cov, 0, pair_mask=cross)
+    sym_ani = pairs.gated_symmetric_ani(ani, cov, kw["cov_thresh"], allow_mask=cross)
+    labels, _ = cluster_hierarchical(1.0 - sym_ani, 1.0 - kw["S_ani"], method=kw["clusterAlg"])
+
+    # merged group -> label of its first-appearing member secondary cluster
+    rep_cluster = list(reps["secondary_cluster"])
+    merged_label: dict[str, str] = {}
+    group_name: dict[int, str] = {}
+    n_merges = 0
+    for t in range(m):
+        grp = int(labels[t])
+        if grp not in group_name:
+            group_name[grp] = rep_cluster[t]
+        else:
+            n_merges += 1
+        merged_label[rep_cluster[t]] = group_name[grp]
+
+    if n_merges == 0:
+        logger.info("tertiary clustering: no cross-primary merges")
+        return cdb, ndb
+
+    out = cdb.copy()
+    out["secondary_cluster"] = out["secondary_cluster"].map(merged_label).fillna(
+        out["secondary_cluster"]
+    )
+    logger.info(
+        "tertiary clustering: merged %d secondary clusters (%d -> %d)",
+        n_merges,
+        cdb["secondary_cluster"].nunique(),
+        out["secondary_cluster"].nunique(),
+    )
+    return out, ndb

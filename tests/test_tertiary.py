@@ -1,0 +1,74 @@
+"""Tertiary clustering: cross-primary merge of secondary clusters."""
+
+import pandas as pd
+
+from drep_tpu.cluster.tertiary import pick_representatives, run_tertiary_clustering
+from drep_tpu.workflows import compare_wrapper
+
+KW = {
+    "S_ani": 0.95,
+    "cov_thresh": 0.1,
+    "clusterAlg": "average",
+    "S_algorithm": "jax_ani",
+    "processes": 1,
+    "mesh_shape": None,
+}
+
+
+def _cdb(sketches, secondary, primary):
+    return pd.DataFrame(
+        {
+            "genome": sketches.names,
+            "secondary_cluster": secondary,
+            "threshold": 0.05,
+            "cluster_method": "average",
+            "comparison_algorithm": "jax_ani",
+            "primary_cluster": primary,
+        }
+    )
+
+
+def test_tertiary_merges_wrongly_split_clusters(sketches, bdb):
+    # pretend primary clustering split A and B (ANI ~0.99) into different
+    # primary clusters — tertiary must merge their secondary clusters
+    cdb = _cdb(sketches, ["1_1", "2_1", "3_1", "4_1", "4_1"], [1, 2, 3, 4, 4])
+    out, ndb = run_tertiary_clustering(sketches, bdb, cdb, dict(KW))
+    by = out.set_index("genome")["secondary_cluster"]
+    assert by["genome_A.fasta"] == by["genome_B.fasta"] == "1_1"
+    assert by["genome_C.fasta"] == "3_1"
+    assert by["genome_D.fasta"] == by["genome_E.fasta"] == "4_1"
+    assert (ndb["primary_cluster"] == 0).all()  # tertiary marker rows
+    assert len(ndb) == 4 * 3  # all-vs-all over the 4 representatives
+
+
+def test_tertiary_no_merge_is_identity(sketches, bdb):
+    cdb = _cdb(sketches, ["1_1", "1_1", "1_2", "2_1", "2_1"], [1, 1, 1, 2, 2])
+    out, _ = run_tertiary_clustering(sketches, bdb, cdb, dict(KW))
+    pd.testing.assert_frame_equal(out, cdb)
+
+
+def test_tertiary_never_merges_within_a_primary_cluster(sketches, bdb):
+    # A and B (ANI ~0.99) share a primary cluster but were split by the
+    # secondary stage — tertiary must NOT override that decision, and must
+    # not emit duplicate same-primary Ndb rows
+    cdb = _cdb(sketches, ["1_1", "1_2", "1_3", "2_1", "2_1"], [1, 1, 1, 2, 2])
+    out, ndb = run_tertiary_clustering(sketches, bdb, cdb, dict(KW))
+    pd.testing.assert_frame_equal(out, cdb)
+    same_primary = {("genome_A.fasta", "genome_B.fasta"), ("genome_B.fasta", "genome_A.fasta")}
+    assert not any((q, r) in same_primary for q, r in zip(ndb["querry"], ndb["reference"]))
+
+
+def test_pick_representatives_one_per_cluster(sketches):
+    cdb = _cdb(sketches, ["1_1", "1_1", "1_2", "2_1", "2_1"], [1, 1, 1, 2, 2])
+    reps = pick_representatives(cdb, sketches.gdb)
+    assert len(reps) == 3
+    assert set(reps["secondary_cluster"]) == {"1_1", "1_2", "2_1"}
+
+
+def test_compare_with_tertiary_flag(tmp_path, genome_paths):
+    wd = str(tmp_path / "tertiary_wd")
+    cdb = compare_wrapper(
+        wd, genome_paths, skip_plots=True, run_tertiary_clustering=True
+    )
+    # fixture has no cross-primary duplicates: clustering unchanged
+    assert cdb["secondary_cluster"].nunique() == 3
