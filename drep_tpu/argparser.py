@@ -37,7 +37,8 @@ def build_parser() -> argparse.ArgumentParser:
                           help="jax_mash Jaccard estimator: sort=union-bottom-s "
                                "(reference Mash), matmul=MXU common-threshold")
         comp.add_argument("--S_algorithm", default="jax_ani",
-                          help="secondary (ANI) comparison engine [jax_ani|fastANI]")
+                          help="secondary (ANI) comparison engine "
+                               "[jax_ani|fastANI|ANImf|ANIn|gANI|goANI]")
         comp.add_argument("-ms", "--MASH_sketch", type=int, default=1000)
         comp.add_argument("--scale", type=int, default=200,
                           help="FracMinHash scale for jax_ani (smaller = more precise)")

@@ -1,0 +1,279 @@
+"""Alignment-based ANI engines: ANImf / ANIn (nucmer) and gANI / goANI.
+
+Reference parity: drep/d_cluster/external.py::run_nucmer +
+process_deltafiles and the gANI/goANI runners (SURVEY.md §2 secondary-
+compare row; reference mount empty, upstream layout). These are subprocess
+fallbacks around the reference's external binaries — kept so every
+`--S_algorithm` name the reference accepts keeps working here — NOT the TPU
+path (`jax_ani` is; SURVEY.md §2b scopes MUMmer out of the kernel rebuild).
+
+The nucmer delta parsing/filtering is pure Python and unit-tested against
+synthetic .delta files, so the numeric contract holds even on machines
+without the binaries (this image has none).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.cluster.dispatch import register_secondary
+from drep_tpu.cluster.external import require_binary, run_subprocess as _run
+from drep_tpu.ingest import GenomeSketches
+
+
+@dataclass
+class DeltaAlignment:
+    ref_name: str
+    qry_name: str
+    ref_start: int
+    ref_end: int
+    qry_start: int
+    qry_end: int
+    errors: int
+
+    @property
+    def qry_aligned(self) -> int:
+        return abs(self.qry_end - self.qry_start) + 1
+
+    @property
+    def ref_aligned(self) -> int:
+        return abs(self.ref_end - self.ref_start) + 1
+
+
+def parse_delta(path: str) -> list[DeltaAlignment]:
+    """Parse a nucmer .delta file into alignment records.
+
+    Format: two header lines (paths, program), then per sequence pair a
+    ``>ref qry ref_len qry_len`` line followed by alignment headers of 7
+    integers (ref_start ref_end qry_start qry_end errors sim_errors stops)
+    each trailed by indel-offset lines terminated with a lone ``0``.
+    """
+    out: list[DeltaAlignment] = []
+    ref = qry = None
+    with open(path) as f:
+        lines = f.read().splitlines()
+    i = 2  # skip path + program header lines
+    while i < len(lines):
+        line = lines[i].strip()
+        if not line:
+            i += 1
+            continue
+        if line.startswith(">"):
+            parts = line[1:].split()
+            ref, qry = parts[0], parts[1]
+            i += 1
+            continue
+        fields = line.split()
+        if len(fields) == 7 and ref is not None:
+            rs, re_, qs, qe, err, _sim, _stp = (int(x) for x in fields)
+            out.append(DeltaAlignment(ref, qry, rs, re_, qs, qe, err))
+            i += 1
+            while i < len(lines) and lines[i].strip() != "0":
+                i += 1
+            i += 1  # consume the terminating 0
+            continue
+        i += 1
+    return out
+
+
+def _merge_intervals(ivals: list[tuple[int, int]]) -> int:
+    """Total length covered by possibly-overlapping 1-based closed intervals."""
+    if not ivals:
+        return 0
+    ivals = sorted((min(a, b), max(a, b)) for a, b in ivals)
+    total, cur_lo, cur_hi = 0, *ivals[0]
+    for lo, hi in ivals[1:]:
+        if lo > cur_hi + 1:
+            total += cur_hi - cur_lo + 1
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    return total + (cur_hi - cur_lo + 1)
+
+
+def filter_best_per_query_region(alns: list[DeltaAlignment]) -> list[DeltaAlignment]:
+    """Greedy 1-to-1 filtering on the query axis — the role of MUMmer's
+    ``delta-filter -q`` in the reference's ANImf ("mf" = many-to-one
+    filtered): alignments are taken longest-first, and one that overlaps an
+    already-claimed query region of the same query sequence by >50% of its
+    own length is dropped (repeats would otherwise inflate ANI coverage)."""
+    claimed: dict[str, list[tuple[int, int]]] = {}
+    kept: list[DeltaAlignment] = []
+    for aln in sorted(alns, key=lambda a: -a.qry_aligned):
+        lo, hi = sorted((aln.qry_start, aln.qry_end))
+        overlap = 0
+        for clo, chi in claimed.get(aln.qry_name, []):
+            overlap += max(0, min(hi, chi) - max(lo, clo) + 1)
+        if overlap * 2 > aln.qry_aligned:
+            continue
+        claimed.setdefault(aln.qry_name, []).append((lo, hi))
+        kept.append(aln)
+    return kept
+
+
+def ani_cov_from_alignments(
+    alns: list[DeltaAlignment], qry_len: int, ref_len: int
+) -> tuple[float, float, float]:
+    """(ani, qry_coverage, ref_coverage) from alignment records.
+
+    ANI = 1 - errors/aligned, length-weighted over alignments (the
+    reference's process_deltafiles contract); coverage = merged aligned
+    fraction of each genome.
+    """
+    if not alns:
+        return 0.0, 0.0, 0.0
+    tot = sum(a.qry_aligned for a in alns)
+    err = sum(a.errors for a in alns)
+    ani = max(0.0, 1.0 - err / max(tot, 1))
+
+    def merged(key, ival):  # intervals merge within one contig, not across
+        by_name: dict[str, list[tuple[int, int]]] = {}
+        for a in alns:
+            by_name.setdefault(key(a), []).append(ival(a))
+        return sum(_merge_intervals(v) for v in by_name.values())
+
+    qcov = merged(lambda a: a.qry_name, lambda a: (a.qry_start, a.qry_end)) / max(qry_len, 1)
+    rcov = merged(lambda a: a.ref_name, lambda a: (a.ref_start, a.ref_end)) / max(ref_len, 1)
+    return ani, min(qcov, 1.0), min(rcov, 1.0)
+
+
+def _require(binary: str) -> str:
+    return require_binary(binary, hint="--S_algorithm jax_ani")
+
+
+def _nucmer_pair(args) -> tuple[int, int, float, float, float]:
+    i, j, qry_path, ref_path, qry_len, ref_len, tmp, filtered = args
+    prefix = os.path.join(tmp, f"p{i}_{j}")
+    _run(["nucmer", "--mum", "-p", prefix, ref_path, qry_path])
+    alns = parse_delta(prefix + ".delta")
+    if filtered:
+        alns = filter_best_per_query_region(alns)
+    ani, qcov, rcov = ani_cov_from_alignments(alns, qry_len, ref_len)
+    return i, j, ani, qcov, rcov
+
+
+def _nucmer_allpairs(
+    gs: GenomeSketches, indices: list[int], bdb: pd.DataFrame, processes: int, filtered: bool
+):
+    _require("nucmer")
+    loc = {r.genome: r.location for r in bdb.itertuples()}
+    glen = gs.gdb.set_index("genome")["length"]
+    names = [gs.names[i] for i in indices]
+    m = len(names)
+    ani = np.zeros((m, m), np.float32)
+    cov = np.zeros((m, m), np.float32)
+    with tempfile.TemporaryDirectory() as tmp:
+        jobs = [
+            (i, j, loc[names[i]], loc[names[j]], int(glen[names[i]]), int(glen[names[j]]), tmp, filtered)
+            for i in range(m)
+            for j in range(m)
+            if i != j
+        ]
+        # nucmer is an external process: threads are enough to fan it out
+        with ThreadPoolExecutor(max_workers=max(processes, 1)) as pool:
+            for i, j, a, qcov, _rcov in pool.map(_nucmer_pair, jobs):
+                ani[i, j] = a
+                cov[i, j] = qcov
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov
+
+
+@register_secondary("ANImf")
+def secondary_animf(gs, indices, bdb=None, processes: int = 1, **_):
+    """nucmer + best-per-query-region filtering (reference ANImf)."""
+    if bdb is None:
+        raise ValueError("ANImf needs Bdb (paths to the FASTA files)")
+    return _nucmer_allpairs(gs, indices, bdb, processes, filtered=True)
+
+
+@register_secondary("ANIn")
+def secondary_anin(gs, indices, bdb=None, processes: int = 1, **_):
+    """Raw nucmer alignments, unfiltered (reference ANIn)."""
+    if bdb is None:
+        raise ValueError("ANIn needs Bdb (paths to the FASTA files)")
+    return _nucmer_allpairs(gs, indices, bdb, processes, filtered=False)
+
+
+def parse_gani_file(path: str, name1: str, name2: str):
+    """Parse ANIcalculator output: GENOME1 GENOME2 AF(1->2) AF(2->1)
+    ANI(1->2) ANI(2->1); returns ((ani12, af12), (ani21, af21))."""
+    with open(path) as f:
+        lines = [ln.split("\t") for ln in f.read().splitlines() if ln.strip()]
+    for row in lines:
+        if len(row) >= 6 and {row[0], row[1]} == {name1, name2}:
+            af12, af21, ani12, ani21 = (float(x) for x in row[2:6])
+            if row[0] != name1:  # swap to the requested orientation
+                af12, af21, ani12, ani21 = af21, af12, ani21, ani12
+            return (ani12 / 100.0, af12), (ani21 / 100.0, af21)
+    raise RuntimeError(f"pair {name1}/{name2} missing from ANIcalculator output {path}")
+
+
+def _prodigal_genes(fasta: str, out_dir: str, stem: str) -> str:
+    """Gene nucleotide FASTA via prodigal (shared by gANI/goANI).
+
+    `stem` must be unique per genome — basenames can collide across input
+    directories, so callers key by genome index, never by file name.
+    """
+    _require("prodigal")
+    base = os.path.join(out_dir, stem)
+    genes = base + ".genes.fna"
+    if not os.path.exists(genes):
+        _run(["prodigal", "-i", fasta, "-d", genes, "-m", "-p", "meta", "-o", base + ".gff", "-q"])
+    return genes
+
+
+def _gani_pair(args) -> tuple[int, int, float, float, float, float]:
+    i, j, genes_i, genes_j, tmp = args
+    pair_dir = os.path.join(tmp, f"g{i}_{j}")
+    _run(
+        ["ANIcalculator", "-genome1fna", genes_i, "-genome2fna", genes_j,
+         "-outdir", pair_dir, "-outfile", "ani.out"],
+    )
+    (a12, f12), (a21, f21) = parse_gani_file(
+        os.path.join(pair_dir, "ani.out"),
+        os.path.basename(genes_i).rsplit(".fna", 1)[0],
+        os.path.basename(genes_j).rsplit(".fna", 1)[0],
+    )
+    return i, j, a12, f12, a21, f21
+
+
+@register_secondary("gANI")
+def secondary_gani(gs, indices, bdb=None, processes: int = 1, **_):
+    """ANIcalculator on prodigal gene calls (reference gANI)."""
+    _require("ANIcalculator")
+    if bdb is None:
+        raise ValueError("gANI needs Bdb (paths to the FASTA files)")
+    loc = {r.genome: r.location for r in bdb.itertuples()}
+    names = [gs.names[i] for i in indices]
+    m = len(names)
+    ani = np.zeros((m, m), np.float32)
+    cov = np.zeros((m, m), np.float32)
+    with tempfile.TemporaryDirectory() as tmp:
+        genes = [_prodigal_genes(loc[g], tmp, stem=f"genome_{t}") for t, g in enumerate(names)]
+        jobs = [
+            (i, j, genes[i], genes[j], tmp) for i in range(m) for j in range(i + 1, m)
+        ]
+        # ANIcalculator is an external process: threads fan it out fine
+        with ThreadPoolExecutor(max_workers=max(processes, 1)) as pool:
+            for i, j, a12, f12, a21, f21 in pool.map(_gani_pair, jobs):
+                ani[i, j], cov[i, j] = a12, f12
+                ani[j, i], cov[j, i] = a21, f21
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov
+
+
+@register_secondary("goANI")
+def secondary_goani(gs, indices, bdb=None, processes: int = 1, **_):
+    """Open-source gANI replacement (prodigal + nsimscan in the reference)."""
+    raise NotImplementedError(
+        "goANI subprocess path is not implemented in this build — use "
+        "--S_algorithm jax_ani (TPU-native) or gANI/ANImf"
+    )

@@ -148,4 +148,5 @@ def secondary_jax_ani(
 
 
 # subprocess fallbacks register themselves on import
+from drep_tpu.cluster import anim as _anim  # noqa: E402,F401
 from drep_tpu.cluster import external as _external  # noqa: E402,F401

@@ -22,22 +22,29 @@ from drep_tpu.ingest import GenomeSketches
 from drep_tpu.utils.logger import get_logger
 
 
-def _require(binary: str) -> str:
+def require_binary(binary: str, hint: str = "jax_mash/jax_ani") -> str:
+    """Resolve an external binary or fail with the TPU-native alternative."""
     path = shutil.which(binary)
     if path is None:
         raise RuntimeError(
             f"external binary {binary!r} not found on $PATH — use the TPU-native "
-            f"engine (jax_mash/jax_ani) or install {binary}"
+            f"engine ({hint}) or install {binary}"
         )
     return path
 
 
-def _run(cmd: list[str]) -> str:
+def run_subprocess(cmd: list[str], cwd: str | None = None) -> str:
+    """Run one external tool invocation; raise with captured stderr on failure."""
     get_logger().debug("subprocess: %s", " ".join(cmd))
-    res = subprocess.run(cmd, capture_output=True, text=True)
+    res = subprocess.run(cmd, capture_output=True, text=True, cwd=cwd)
     if res.returncode != 0:
         raise RuntimeError(f"{cmd[0]} failed (exit {res.returncode}): {res.stderr[-2000:]}")
     return res.stdout
+
+
+# backwards-compatible module-internal aliases
+_require = require_binary
+_run = run_subprocess
 
 
 @register_primary("mash")
@@ -101,6 +108,37 @@ def secondary_fastani(
     return ani, cov
 
 
-def available_binaries() -> dict[str, str | None]:
-    """Probe the reference's external tool suite (for check_dependencies)."""
-    return {b: shutil.which(b) for b in ["mash", "fastANI", "nucmer", "prodigal", "checkm", "centrifuge", "ANIcalculator"]}
+EXTERNAL_SUITE = [
+    "mash", "fastANI", "nucmer", "prodigal", "checkm", "centrifuge", "ANIcalculator", "nsimscan",
+]
+
+# how each binary reports its version (find_program parity: d_bonus.py)
+_VERSION_FLAGS = {
+    "mash": ["--version"],
+    "fastANI": ["--version"],
+    "nucmer": ["--version"],
+    "prodigal": ["-v"],
+    "checkm": [],  # checkm prints usage with version header on bare call
+    "centrifuge": ["--version"],
+}
+
+
+def find_program(binary: str) -> tuple[str | None, str | None]:
+    """(path, version) of an external binary — d_bonus.find_program parity.
+
+    Version is best-effort: first non-empty output line of the tool's
+    version invocation, None when unavailable."""
+    path = shutil.which(binary)
+    if path is None:
+        return None, None
+    flags = _VERSION_FLAGS.get(binary)
+    if flags is None:
+        return path, None
+    try:
+        res = subprocess.run(
+            [binary] + flags, capture_output=True, text=True, timeout=30
+        )
+        out = (res.stdout + res.stderr).strip().splitlines()
+        return path, next((ln.strip() for ln in out if ln.strip()), None)
+    except Exception:
+        return path, None

@@ -49,10 +49,14 @@ class Controller:
         logger.info("JAX backend: %s; %d device(s)", jax.default_backend(), len(devices))
         for d in devices:
             logger.info("  device: %s", d)
-        from drep_tpu.cluster.external import available_binaries
+        from drep_tpu.cluster.external import EXTERNAL_SUITE, find_program
 
-        for name, path in sorted(available_binaries().items()):
-            status = path if path else "NOT FOUND (subprocess fallback unavailable; TPU engines unaffected)"
+        for name in sorted(EXTERNAL_SUITE):
+            path, version = find_program(name)
+            if path is None:
+                status = "NOT FOUND (subprocess fallback unavailable; TPU engines unaffected)"
+            else:
+                status = f"{path}  ({version})" if version else path
             logger.info("  external %-14s %s", name, status)
 
 

@@ -1,0 +1,119 @@
+"""nucmer delta parsing, filtering, and ANI/coverage math (no binaries).
+
+The ANImf/ANIn engines shell out to nucmer (absent in this image); their
+numeric core — delta parsing, best-per-query-region filtering, weighted ANI
+and merged coverage — is pure Python and pinned here on synthetic .delta
+files, like the reference's process_deltafiles contract.
+"""
+
+import numpy as np
+import pytest
+
+from drep_tpu.cluster.anim import (
+    DeltaAlignment,
+    ani_cov_from_alignments,
+    filter_best_per_query_region,
+    parse_delta,
+    parse_gani_file,
+)
+from drep_tpu.cluster.dispatch import SECONDARY_ALGORITHMS, get_secondary
+
+
+@pytest.fixture()
+def delta_file(tmp_path):
+    # two alignments for ctgR/ctgQ (second reversed on the query strand),
+    # with indel-offset lines that the parser must skip
+    content = """\
+/ref.fa /qry.fa
+NUCMER
+>ctgR ctgQ 10000 8000
+1 5000 1 5001 25 25 0
+12
+-4
+0
+6000 9999 8000 4001 40 40 0
+0
+>ctgR2 ctgQ2 2000 2000
+100 1099 200 1199 10 10 0
+7
+0
+"""
+    p = tmp_path / "test.delta"
+    p.write_text(content)
+    return str(p)
+
+
+def test_parse_delta(delta_file):
+    alns = parse_delta(delta_file)
+    assert len(alns) == 3
+    a = alns[0]
+    assert (a.ref_name, a.qry_name) == ("ctgR", "ctgQ")
+    assert (a.ref_start, a.ref_end, a.qry_start, a.qry_end, a.errors) == (1, 5000, 1, 5001, 25)
+    assert alns[1].qry_start == 8000 and alns[1].qry_end == 4001  # reverse strand
+    assert alns[2].ref_name == "ctgR2"
+
+
+def test_ani_cov_math(delta_file):
+    alns = parse_delta(delta_file)
+    ani, qcov, rcov = ani_cov_from_alignments(alns, qry_len=10000, ref_len=12000)
+    aligned = 5001 + 4000 + 1000
+    errors = 25 + 40 + 10
+    assert ani == pytest.approx(1.0 - errors / aligned)
+    # ctgQ intervals (1,5001) and (4001,8000) overlap -> merge to 1..8000;
+    # ctgQ2 adds 1000. ctgR: 5000 + 4000 disjoint; ctgR2 adds 1000.
+    assert qcov == pytest.approx((8000 + 1000) / 10000)
+    assert rcov == pytest.approx((5000 + 4000 + 1000) / 12000)
+
+
+def test_ani_cov_empty():
+    assert ani_cov_from_alignments([], 1000, 1000) == (0.0, 0.0, 0.0)
+
+
+def test_coverage_merges_overlaps():
+    alns = [
+        DeltaAlignment("r", "q", 1, 600, 1, 600, 0),
+        DeltaAlignment("r", "q", 301, 900, 301, 900, 0),  # overlaps first
+    ]
+    _, qcov, rcov = ani_cov_from_alignments(alns, 1000, 1000)
+    assert qcov == pytest.approx(0.9)  # merged 1..900, not 600+600
+    assert rcov == pytest.approx(0.9)
+
+
+def test_filter_best_per_query_region():
+    big = DeltaAlignment("r1", "q", 1, 5000, 1, 5000, 10)
+    dup = DeltaAlignment("r2", "q", 1, 4000, 500, 4500, 5)  # repeat: same query region
+    elsewhere = DeltaAlignment("r2", "q", 1, 2000, 6000, 8000, 5)
+    other_q = DeltaAlignment("r1", "q2", 1, 3000, 1, 3000, 0)
+    kept = filter_best_per_query_region([big, dup, elsewhere, other_q])
+    assert big in kept and elsewhere in kept and other_q in kept
+    assert dup not in kept
+
+
+def test_parse_gani_file(tmp_path):
+    p = tmp_path / "ani.out"
+    p.write_text("GENOME1\tGENOME2\tAF(1->2)\tAF(2->1)\tANI(1->2)\tANI(2->1)\n"
+                 "gA.genes\tgB.genes\t0.80\t0.70\t98.5\t98.1\n")
+    (a12, f12), (a21, f21) = parse_gani_file(str(p), "gA.genes", "gB.genes")
+    assert (a12, f12, a21, f21) == (0.985, 0.80, 0.981, 0.70)
+    # swapped orientation
+    (b12, g12), (b21, g21) = parse_gani_file(str(p), "gB.genes", "gA.genes")
+    assert (b12, g12, b21, g21) == (0.981, 0.70, 0.985, 0.80)
+
+
+def test_all_reference_algorithms_registered():
+    for name in ("jax_ani", "fastANI", "ANImf", "ANIn", "gANI", "goANI"):
+        assert name in SECONDARY_ALGORITHMS, name
+
+
+def test_missing_binary_raises_informative(sketches, bdb, monkeypatch):
+    import drep_tpu.cluster.external as ext
+
+    monkeypatch.setattr(ext.shutil, "which", lambda _: None)
+    engine = get_secondary("ANImf")
+    with pytest.raises(RuntimeError, match="nucmer"):
+        engine(sketches, [0, 1], bdb=bdb)
+
+
+def test_goani_not_implemented(sketches, bdb):
+    with pytest.raises(NotImplementedError, match="jax_ani"):
+        get_secondary("goANI")(sketches, [0, 1], bdb=bdb)
