@@ -169,17 +169,23 @@ def _nucmer_allpairs(
     ani = np.zeros((m, m), np.float32)
     cov = np.zeros((m, m), np.float32)
     with tempfile.TemporaryDirectory() as tmp:
+        # ANIn (unfiltered) is direction-symmetric: one nucmer run yields
+        # both directions (ani is shared; rcov IS the reverse coverage).
+        # ANImf's query-axis filter makes directions differ, so both run.
         jobs = [
             (i, j, loc[names[i]], loc[names[j]], int(glen[names[i]]), int(glen[names[j]]), tmp, filtered)
             for i in range(m)
             for j in range(m)
-            if i != j
+            if (i != j if filtered else i < j)
         ]
         # nucmer is an external process: threads are enough to fan it out
         with ThreadPoolExecutor(max_workers=max(processes, 1)) as pool:
-            for i, j, a, qcov, _rcov in pool.map(_nucmer_pair, jobs):
+            for i, j, a, qcov, rcov in pool.map(_nucmer_pair, jobs):
                 ani[i, j] = a
                 cov[i, j] = qcov
+                if not filtered:
+                    ani[j, i] = a
+                    cov[j, i] = rcov
     np.fill_diagonal(ani, 1.0)
     np.fill_diagonal(cov, 1.0)
     return ani, cov
@@ -202,17 +208,35 @@ def secondary_anin(gs, indices, bdb=None, processes: int = 1, **_):
 
 
 def parse_gani_file(path: str, name1: str, name2: str):
-    """Parse ANIcalculator output: GENOME1 GENOME2 AF(1->2) AF(2->1)
-    ANI(1->2) ANI(2->1); returns ((ani12, af12), (ani21, af21))."""
+    """Parse ANIcalculator output by HEADER NAME (column order varies across
+    versions — the reference parses by name for the same reason). Returns
+    ((ani12, af12), (ani21, af21)); a pair absent from the output means no
+    significant alignment (an expected outcome at loose primary cutoffs),
+    reported as zeros, not an error."""
     with open(path) as f:
         lines = [ln.split("\t") for ln in f.read().splitlines() if ln.strip()]
-    for row in lines:
-        if len(row) >= 6 and {row[0], row[1]} == {name1, name2}:
-            af12, af21, ani12, ani21 = (float(x) for x in row[2:6])
-            if row[0] != name1:  # swap to the requested orientation
-                af12, af21, ani12, ani21 = af21, af12, ani21, ani12
-            return (ani12 / 100.0, af12), (ani21 / 100.0, af21)
-    raise RuntimeError(f"pair {name1}/{name2} missing from ANIcalculator output {path}")
+    if not lines:
+        return (0.0, 0.0), (0.0, 0.0)
+    header = [h.strip().upper() for h in lines[0]]
+    col = {name: i for i, name in enumerate(header)}
+    needed = ["GENOME1", "GENOME2", "ANI(1->2)", "ANI(2->1)", "AF(1->2)", "AF(2->1)"]
+    missing = [c for c in needed if c not in col]
+    if missing:
+        raise RuntimeError(f"unrecognized ANIcalculator header {header} in {path}: missing {missing}")
+    for row in lines[1:]:
+        if len(row) < len(header):
+            continue
+        g1, g2 = row[col["GENOME1"]], row[col["GENOME2"]]
+        if {g1, g2} != {name1, name2}:
+            continue
+        ani12 = float(row[col["ANI(1->2)"]])
+        ani21 = float(row[col["ANI(2->1)"]])
+        af12 = float(row[col["AF(1->2)"]])
+        af21 = float(row[col["AF(2->1)"]])
+        if g1 != name1:  # swap to the requested orientation
+            ani12, ani21, af12, af21 = ani21, ani12, af21, af12
+        return (ani12 / 100.0, af12), (ani21 / 100.0, af21)
+    return (0.0, 0.0), (0.0, 0.0)
 
 
 def _prodigal_genes(fasta: str, out_dir: str, stem: str) -> str:
@@ -256,12 +280,18 @@ def secondary_gani(gs, indices, bdb=None, processes: int = 1, **_):
     ani = np.zeros((m, m), np.float32)
     cov = np.zeros((m, m), np.float32)
     with tempfile.TemporaryDirectory() as tmp:
-        genes = [_prodigal_genes(loc[g], tmp, stem=f"genome_{t}") for t, g in enumerate(names)]
-        jobs = [
-            (i, j, genes[i], genes[j], tmp) for i in range(m) for j in range(i + 1, m)
-        ]
-        # ANIcalculator is an external process: threads fan it out fine
+        # prodigal and ANIcalculator are external processes: threads fan
+        # both out fine (gene calling dominates per-genome wall-clock)
         with ThreadPoolExecutor(max_workers=max(processes, 1)) as pool:
+            genes = list(
+                pool.map(
+                    lambda tg: _prodigal_genes(loc[tg[1]], tmp, stem=f"genome_{tg[0]}"),
+                    enumerate(names),
+                )
+            )
+            jobs = [
+                (i, j, genes[i], genes[j], tmp) for i in range(m) for j in range(i + 1, m)
+            ]
             for i, j, a12, f12, a21, f21 in pool.map(_gani_pair, jobs):
                 ani[i, j], cov[i, j] = a12, f12
                 ani[j, i], cov[j, i] = a21, f21

@@ -43,6 +43,13 @@ class GenomeSketches:
 
 def _sketch_one(args) -> tuple[str, dict]:
     name, path, k, sketch_size, scale = args
+
+    from drep_tpu.native import sketch_fasta_native
+
+    native = sketch_fasta_native(path, k, sketch_size, scale)
+    if native is not None:
+        return name, native
+
     contigs = read_fasta_contigs(path)
     lengths = np.array([len(c) for c in contigs], dtype=np.int64)
     all_hashes = [kmers.kmer_hashes(c, k) for c in contigs] or [np.empty(0, np.uint64)]

@@ -1,0 +1,192 @@
+// Native host-ingest kernel: FASTA -> canonical k-mer hashes -> sketches.
+//
+// C++ implementation of the hot host-side loop (SURVEY.md §7 step 2 /
+// hard part (f): ingest throughput for 100k FASTAs). Byte-for-byte
+// equivalent to the numpy path in drep_tpu/ops/kmers.py +
+// drep_tpu/utils/fasta.py (verified in tests/test_native.py):
+//
+//   - contigs: lines after a '>' header, whitespace stripped, uppercased
+//   - encoding A=0 C=1 G=2 T=3 (case-insensitive), 2 bits/base, k <= 31
+//   - canonical k-mer = min(forward, reverse-complement) of the packed value
+//   - hash = splitmix64 finalizer; k-mer set = sorted unique hashes
+//   - bottom-k sketch = first `sketch_size` unique hashes ascending
+//   - scaled sketch = all unique hashes <= scaled_max (FracMinHash)
+//   - N50 matches utils/fasta.py::n50 (descending cumsum, first >= total/2)
+//
+// Reads plain and gzip FASTA through zlib's gzopen (transparent for both).
+// Build: g++ -O3 -std=c++17 -shared -fPIC ingest.cc -o libdrep_native.so -lz
+// (driven by drep_tpu/native/__init__.py; ctypes bindings, no pybind11).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <zlib.h>
+
+extern "C" {
+
+typedef struct {
+  int64_t length;      // total assembly length (bp)
+  int64_t n50;         // assembly N50
+  int32_t n_contigs;   // number of contigs
+  int64_t n_kmers;     // number of DISTINCT canonical k-mer hashes
+  int64_t bottom_len;  // entries in `bottom`
+  int64_t scaled_len;  // entries in `scaled`
+  uint64_t* bottom;    // sorted ascending, malloc'd (free via drep_sketch_free)
+  uint64_t* scaled;    // sorted ascending, malloc'd
+} DrepSketch;
+
+static inline uint64_t splitmix64(uint64_t z) {
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+// returns 0 on success, -1 file error, -2 bad args
+int drep_sketch_fasta(const char* path, int k, int64_t sketch_size,
+                      uint64_t scaled_max, DrepSketch* out) {
+  if (k < 1 || k > 31 || out == nullptr) return -2;
+  std::memset(out, 0, sizeof(*out));
+
+  gzFile f = gzopen(path, "rb");
+  if (f == nullptr) return -1;
+
+  // base codes: A=0 C=1 G=2 T=3, 255 = invalid (resets the rolling window)
+  static uint8_t code[256];
+  std::memset(code, 255, sizeof(code));
+  code[(unsigned)'A'] = code[(unsigned)'a'] = 0;
+  code[(unsigned)'C'] = code[(unsigned)'c'] = 1;
+  code[(unsigned)'G'] = code[(unsigned)'g'] = 2;
+  code[(unsigned)'T'] = code[(unsigned)'t'] = 3;
+
+  const uint64_t mask = (k == 32) ? ~0ULL : ((1ULL << (2 * k)) - 1);
+  const int shift = 2 * (k - 1);
+
+  std::vector<uint64_t> hashes;
+  std::vector<int64_t> contig_lengths;
+
+  uint64_t fwd = 0, rev = 0;
+  int run = 0;             // valid bases in the current window
+  int64_t contig_len = 0;  // bases in the current contig
+
+  // a contig exists only if sequence accumulated (headers with no sequence
+  // produce nothing — fasta.py::read_fasta_contigs appends only when chunks
+  // are non-empty)
+  auto end_contig = [&]() {
+    if (contig_len > 0) contig_lengths.push_back(contig_len);
+    contig_len = 0;
+    fwd = rev = 0;
+    run = 0;
+  };
+
+  // per-line processing with Python's line.strip() semantics: leading and
+  // trailing whitespace dropped, INTERNAL whitespace kept — it counts
+  // toward contig length and, being non-ACGT, breaks the k-mer window
+  // (exactly what the numpy oracle does after read_fasta_contigs)
+  auto process_line = [&](const std::string& line) {
+    if (line.empty()) return;
+    if (line[0] == '>') {
+      end_contig();
+      return;
+    }
+    size_t lo = 0, hi = line.size();
+    while (lo < hi && (unsigned char)line[lo] <= ' ') ++lo;
+    while (hi > lo && (unsigned char)line[hi - 1] <= ' ') --hi;
+    for (size_t i = lo; i < hi; ++i) {
+      ++contig_len;
+      uint8_t b = code[(unsigned char)line[i]];
+      if (b == 255) {  // non-ACGT (incl. internal whitespace): break window
+        run = 0;
+        fwd = rev = 0;
+        continue;
+      }
+      fwd = ((fwd << 2) | b) & mask;
+      rev = (rev >> 2) | ((uint64_t)(3 - b) << shift);
+      if (++run >= k) {
+        hashes.push_back(splitmix64(fwd < rev ? fwd : rev));
+      }
+    }
+  };
+
+  std::vector<unsigned char> buf(1 << 20);
+  std::string line;
+  int nread;
+  while ((nread = gzread(f, buf.data(), (unsigned)buf.size())) > 0) {
+    for (int i = 0; i < nread; ++i) {
+      if (buf[i] == '\n') {
+        process_line(line);
+        line.clear();
+      } else {
+        line.push_back((char)buf[i]);
+      }
+    }
+  }
+  // a truncated/corrupt gzip stream surfaces as nread==0 with a non-OK
+  // error state (the numpy path raises EOFError there — so must we)
+  int errnum = Z_OK;
+  gzerror(f, &errnum);
+  bool read_error = (nread < 0) || (errnum != Z_OK && errnum != Z_STREAM_END);
+  read_error |= (gzclose(f) != Z_OK);
+  if (read_error) return -1;
+  process_line(line);
+  end_contig();
+
+  // distinct canonical k-mer hash set, ascending
+  std::sort(hashes.begin(), hashes.end());
+  hashes.erase(std::unique(hashes.begin(), hashes.end()), hashes.end());
+
+  int64_t total = 0;
+  for (int64_t len : contig_lengths) total += len;
+  out->length = total;
+  out->n_contigs = (int32_t)contig_lengths.size();
+  out->n_kmers = (int64_t)hashes.size();
+
+  // N50: descending lengths, first cumulative sum >= total/2 (fasta.py::n50)
+  if (!contig_lengths.empty()) {
+    std::sort(contig_lengths.begin(), contig_lengths.end(),
+              std::greater<int64_t>());
+    const double half = (double)total / 2.0;
+    int64_t csum = 0;
+    out->n50 = contig_lengths.back();
+    for (int64_t len : contig_lengths) {
+      csum += len;
+      if ((double)csum >= half) {
+        out->n50 = len;
+        break;
+      }
+    }
+  }
+
+  const int64_t nb =
+      std::min<int64_t>(sketch_size < 0 ? 0 : sketch_size, hashes.size());
+  out->bottom = (uint64_t*)std::malloc(sizeof(uint64_t) * (nb ? nb : 1));
+  if (!out->bottom) return -2;
+  std::memcpy(out->bottom, hashes.data(), sizeof(uint64_t) * nb);
+  out->bottom_len = nb;
+
+  const int64_t ns =
+      std::upper_bound(hashes.begin(), hashes.end(), scaled_max) -
+      hashes.begin();
+  out->scaled = (uint64_t*)std::malloc(sizeof(uint64_t) * (ns ? ns : 1));
+  if (!out->scaled) {
+    std::free(out->bottom);
+    out->bottom = nullptr;
+    return -2;
+  }
+  std::memcpy(out->scaled, hashes.data(), sizeof(uint64_t) * ns);
+  out->scaled_len = ns;
+  return 0;
+}
+
+void drep_sketch_free(DrepSketch* out) {
+  if (out == nullptr) return;
+  std::free(out->bottom);
+  std::free(out->scaled);
+  out->bottom = nullptr;
+  out->scaled = nullptr;
+}
+
+}  // extern "C"

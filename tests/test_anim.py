@@ -89,15 +89,38 @@ def test_filter_best_per_query_region():
     assert dup not in kept
 
 
-def test_parse_gani_file(tmp_path):
+def test_parse_gani_file_by_header(tmp_path):
+    # real ANIcalculator column order: ANI columns precede AF columns
     p = tmp_path / "ani.out"
-    p.write_text("GENOME1\tGENOME2\tAF(1->2)\tAF(2->1)\tANI(1->2)\tANI(2->1)\n"
-                 "gA.genes\tgB.genes\t0.80\t0.70\t98.5\t98.1\n")
+    p.write_text("GENOME1\tGENOME2\tANI(1->2)\tANI(2->1)\tAF(1->2)\tAF(2->1)\n"
+                 "gA.genes\tgB.genes\t98.5\t98.1\t0.80\t0.70\n")
     (a12, f12), (a21, f21) = parse_gani_file(str(p), "gA.genes", "gB.genes")
     assert (a12, f12, a21, f21) == (0.985, 0.80, 0.981, 0.70)
     # swapped orientation
     (b12, g12), (b21, g21) = parse_gani_file(str(p), "gB.genes", "gA.genes")
     assert (b12, g12, b21, g21) == (0.981, 0.70, 0.985, 0.80)
+
+
+def test_parse_gani_file_column_order_independent(tmp_path):
+    # header-name parsing must survive a different column order
+    p = tmp_path / "ani.out"
+    p.write_text("GENOME1\tGENOME2\tAF(1->2)\tAF(2->1)\tANI(1->2)\tANI(2->1)\n"
+                 "gA.genes\tgB.genes\t0.80\t0.70\t98.5\t98.1\n")
+    (a12, f12), (a21, f21) = parse_gani_file(str(p), "gA.genes", "gB.genes")
+    assert (a12, f12, a21, f21) == (0.985, 0.80, 0.981, 0.70)
+
+
+def test_parse_gani_missing_pair_means_no_alignment(tmp_path):
+    p = tmp_path / "ani.out"
+    p.write_text("GENOME1\tGENOME2\tANI(1->2)\tANI(2->1)\tAF(1->2)\tAF(2->1)\n")
+    assert parse_gani_file(str(p), "x", "y") == ((0.0, 0.0), (0.0, 0.0))
+
+
+def test_parse_gani_bad_header_raises(tmp_path):
+    p = tmp_path / "ani.out"
+    p.write_text("WHAT\tEVER\n")
+    with pytest.raises(RuntimeError, match="unrecognized"):
+        parse_gani_file(str(p), "x", "y")
 
 
 def test_all_reference_algorithms_registered():

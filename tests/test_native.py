@@ -1,0 +1,144 @@
+"""Native C++ ingest: byte-for-byte equivalence with the numpy oracle.
+
+The C++ path (drep_tpu/native/ingest.cc) must produce EXACTLY the same
+stats and sketch hash sets as ops/kmers.py + utils/fasta.py — same
+canonical packing, same splitmix64, same N50 convention — on the fixture
+genomes and on adversarial synthetic FASTAs (lowercase, Ns, multi-line,
+empty headers, gzip).
+"""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from drep_tpu.native import get_library, sketch_fasta_native
+from drep_tpu.ops import kmers
+from drep_tpu.utils.fasta import fasta_stats, n50, read_fasta_contigs
+
+def test_build_succeeds_when_compiler_present():
+    # deliberately NOT behind needs_native: if g++ exists, a failed build is
+    # a BUG in ingest.cc, and skipping the whole module would mask it
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ on this machine")
+    assert get_library() is not None, "g++ present but native build failed"
+
+
+needs_native = pytest.mark.skipif(
+    get_library() is None, reason="native library unavailable (no g++?)"
+)
+
+K, SKETCH, SCALE = 21, 1000, 200
+
+
+def _oracle(path):
+    contigs = read_fasta_contigs(path)
+    lengths = np.array([len(c) for c in contigs], dtype=np.int64)
+    hashes = np.unique(
+        np.concatenate([kmers.kmer_hashes(c, K) for c in contigs] or [np.empty(0, np.uint64)])
+    )
+    return {
+        "length": int(lengths.sum()) if len(lengths) else 0,
+        "N50": n50(lengths),
+        "contigs": len(contigs),
+        "n_kmers": int(hashes.size),
+        "bottom": kmers.bottom_k_sketch(hashes, SKETCH),
+        "scaled": kmers.scaled_sketch(hashes, SCALE),
+    }
+
+
+def _assert_equal(native, oracle):
+    assert native["length"] == oracle["length"]
+    assert native["N50"] == oracle["N50"]
+    assert native["contigs"] == oracle["contigs"]
+    assert native["n_kmers"] == oracle["n_kmers"]
+    np.testing.assert_array_equal(native["bottom"], oracle["bottom"])
+    np.testing.assert_array_equal(native["scaled"], oracle["scaled"])
+
+
+@needs_native
+def test_native_matches_oracle_on_fixtures(genome_paths):
+    for path in genome_paths:
+        native = sketch_fasta_native(path, K, SKETCH, SCALE)
+        _assert_equal(native, _oracle(path))
+
+
+@needs_native
+def test_native_adversarial_fasta(tmp_path):
+    content = (
+        ">c1 description words\n"
+        "acgtACGTacgtACGTacgtACGTNNNNacgtacgtacgtacgtacgtacgt\n"
+        "ACGTACGTACGTACGTACGTACGT\n"
+        ">empty_contig\n"
+        ">c2\n"
+        "TTTTTTTTTTTTTTTTTTTTTTTTGGGGGGGGCCCCCCCCAAAAAAAAACGT\n"
+        ">c3_internal_whitespace\n"
+        "  ACGTACGTACGTACGTACGTACGTA CGTACGTACGTACGTACGTACGTACGT\t\r\n"
+    )
+    p = tmp_path / "adv.fasta"
+    p.write_text(content)
+    native = sketch_fasta_native(str(p), K, SKETCH, SCALE)
+    _assert_equal(native, _oracle(str(p)))
+    assert native["contigs"] == 3  # the empty header makes no contig
+
+
+@needs_native
+def test_native_truncated_gzip_raises(tmp_path, genome_paths):
+    gz = tmp_path / "trunc.fasta.gz"
+    with open(genome_paths[0], "rb") as fin, gzip.open(gz, "wb") as fout:
+        fout.write(fin.read())
+    data = gz.read_bytes()
+    gz.write_bytes(data[: len(data) // 2])  # chop the stream mid-way
+    with pytest.raises(RuntimeError, match="truncated"):
+        sketch_fasta_native(str(gz), K, SKETCH, SCALE)
+
+
+@needs_native
+def test_native_gzip(tmp_path, genome_paths):
+    gz = tmp_path / "g.fasta.gz"
+    with open(genome_paths[0], "rb") as fin, gzip.open(gz, "wb") as fout:
+        fout.write(fin.read())
+    native = sketch_fasta_native(str(gz), K, SKETCH, SCALE)
+    _assert_equal(native, _oracle(genome_paths[0]))
+
+
+@needs_native
+def test_native_missing_file_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        sketch_fasta_native(str(tmp_path / "nope.fasta"), K, SKETCH, SCALE)
+
+
+@needs_native
+def test_native_stats_match_fasta_stats(genome_paths):
+    for path in genome_paths:
+        native = sketch_fasta_native(path, K, SKETCH, SCALE)
+        st = fasta_stats(path)
+        assert (native["length"], native["N50"], native["contigs"]) == (
+            st.length,
+            st.N50,
+            st.contigs,
+        )
+
+
+@needs_native
+def test_env_kill_switch(monkeypatch, genome_paths):
+    monkeypatch.setenv("DREP_TPU_NO_NATIVE", "1")
+    assert sketch_fasta_native(genome_paths[0], K, SKETCH, SCALE) is None
+
+
+@needs_native
+def test_pipeline_uses_native_transparently(bdb):
+    # ingest through the public API must give identical sketches either way
+    from drep_tpu.ingest import _sketch_one
+
+    row = next(bdb.itertuples())
+    _, via_native = _sketch_one((row.genome, row.location, K, SKETCH, SCALE))
+    os.environ["DREP_TPU_NO_NATIVE"] = "1"
+    try:
+        _, via_numpy = _sketch_one((row.genome, row.location, K, SKETCH, SCALE))
+    finally:
+        del os.environ["DREP_TPU_NO_NATIVE"]
+    _assert_equal(via_native, via_numpy)
