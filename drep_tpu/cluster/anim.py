@@ -236,6 +236,17 @@ def parse_gani_file(path: str, name1: str, name2: str):
         if g1 != name1:  # swap to the requested orientation
             ani12, ani21, af12, af21 = ani21, ani12, af21, af12
         return (ani12 / 100.0, af12), (ani21 / 100.0, af21)
+    if len(lines) > 1:
+        # rows exist but none mention the requested pair — likely a genome
+        # name-normalization mismatch, which would otherwise masquerade as
+        # "no significant alignment" for EVERY pair
+        from drep_tpu.utils.logger import get_logger
+
+        get_logger().warning(
+            "gANI output %s has %d rows but none match pair (%s, %s) — "
+            "check genome name normalization",
+            path, len(lines) - 1, name1, name2,
+        )
     return (0.0, 0.0), (0.0, 0.0)
 
 

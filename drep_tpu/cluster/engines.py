@@ -110,8 +110,13 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
     1. MXU indicator-matmul — ~340x faster than the gather path and exact;
        used whenever the [m, vocab] bf16 indicator fits the budget.
     2. ring-sharded mesh path (multi-device, beyond-budget clusters).
-    3. tiled searchsorted fallback (auto-capped tiles).
+    3. Pallas bitonic-merge kernel (ops/pallas_merge.py) — matmul-speed but
+       vocabulary-independent, so it owns the big-cluster/big-vocab regime
+       the matmul budget excludes (TPU only).
+    4. tiled searchsorted fallback (CPU; gathers are fine off-TPU).
     """
+    import jax
+
     from drep_tpu.ops.containment import (
         MATMUL_BUDGET_ELEMS,
         all_vs_all_containment_matmul,
@@ -126,6 +131,10 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
         from drep_tpu.parallel.allpairs import sharded_containment_allpairs
 
         return sharded_containment_allpairs(packed, k=k, mesh=mesh)
+    if jax.devices()[0].platform == "tpu":
+        from drep_tpu.ops.pallas_merge import all_vs_all_containment_pallas
+
+        return all_vs_all_containment_pallas(packed, k=k)
     return all_vs_all_containment(packed, k=k, tile=tile)
 
 

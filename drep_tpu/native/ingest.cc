@@ -45,6 +45,21 @@ static inline uint64_t splitmix64(uint64_t z) {
   return z ^ (z >> 31);
 }
 
+// base codes: A=0 C=1 G=2 T=3, 255 = invalid (resets the rolling window).
+// Initialized once at load time — concurrent drep_sketch_fasta callers
+// (ctypes drops the GIL) must never observe a half-built table.
+struct BaseCode {
+  uint8_t code[256];
+  BaseCode() {
+    std::memset(code, 255, sizeof(code));
+    code[(unsigned)'A'] = code[(unsigned)'a'] = 0;
+    code[(unsigned)'C'] = code[(unsigned)'c'] = 1;
+    code[(unsigned)'G'] = code[(unsigned)'g'] = 2;
+    code[(unsigned)'T'] = code[(unsigned)'t'] = 3;
+  }
+};
+static const BaseCode kBase;
+
 // returns 0 on success, -1 file error, -2 bad args
 int drep_sketch_fasta(const char* path, int k, int64_t sketch_size,
                       uint64_t scaled_max, DrepSketch* out) {
@@ -54,14 +69,7 @@ int drep_sketch_fasta(const char* path, int k, int64_t sketch_size,
   gzFile f = gzopen(path, "rb");
   if (f == nullptr) return -1;
 
-  // base codes: A=0 C=1 G=2 T=3, 255 = invalid (resets the rolling window)
-  static uint8_t code[256];
-  std::memset(code, 255, sizeof(code));
-  code[(unsigned)'A'] = code[(unsigned)'a'] = 0;
-  code[(unsigned)'C'] = code[(unsigned)'c'] = 1;
-  code[(unsigned)'G'] = code[(unsigned)'g'] = 2;
-  code[(unsigned)'T'] = code[(unsigned)'t'] = 3;
-
+  const uint8_t* code = kBase.code;
   const uint64_t mask = (k == 32) ? ~0ULL : ((1ULL << (2 * k)) - 1);
   const int shift = 2 * (k - 1);
 

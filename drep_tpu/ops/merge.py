@@ -1,0 +1,50 @@
+"""Bitonic merge of pre-sorted sketch rows — the shared compare-exchange core.
+
+Both all-pairs estimators (the Mash union-bottom-s Jaccard in ops/minhash.py
+and the containment intersection in ops/pallas_merge.py) need the sorted
+merge of two already-sorted hash-id rows. A full ``jnp.sort`` of the
+concatenation costs O(log^2 L) compare-exchange stages; but the
+concatenation of an ascending row with a reversed ascending row is
+*bitonic*, so Batcher's bitonic merge finishes in O(log L) stages — each a
+full-width vectorized min/max, which is exactly what the VPU wants.
+
+Replaces nothing in the reference (the reference's merge lives inside Mash's
+C++ heap walk, d_cluster/external.py::run_MASH upstream; reference mount
+empty) — this is the TPU-native formulation of the same sorted-merge step.
+
+PAD handling: PAD_ID (int32 max) sorts after every real id, so padded rows
+stay sorted and pads accumulate at the tail of the merged row.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 1)."""
+    return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+
+
+def merge_sorted_rows(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Sorted merge of two ascending rows along the last axis.
+
+    a, b: [..., S] ascending (PAD_ID-padded). S must be a power of two —
+    callers pad with PAD_ID (``next_pow2``) first; padding keeps rows
+    ascending so the bitonic precondition holds. Returns [..., 2S]
+    ascending. Identical output to ``jnp.sort(concatenate([a, b]))``.
+    """
+    s = a.shape[-1]
+    if s & (s - 1):
+        raise ValueError(f"merge width {s} is not a power of two — pad with PAD_ID first")
+    # ascending ++ descending = bitonic
+    x = jnp.concatenate([a, jnp.flip(b, axis=-1)], axis=-1)
+    length = 2 * s
+    d = s
+    while d >= 1:
+        y = x.reshape(*x.shape[:-1], length // (2 * d), 2, d)
+        lo = jnp.minimum(y[..., 0, :], y[..., 1, :])
+        hi = jnp.maximum(y[..., 0, :], y[..., 1, :])
+        x = jnp.stack([lo, hi], axis=-2).reshape(*x.shape[:-1], length)
+        d //= 2
+    return x

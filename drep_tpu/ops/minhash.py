@@ -91,14 +91,23 @@ def _pair_shared(a: jnp.ndarray, b: jnp.ndarray, na: jnp.ndarray, nb: jnp.ndarra
     Returns (shared, s_use): `shared` = number of hashes present in BOTH
     sketches among the bottom-`s_use` distinct hashes of the union.
 
-    Implementation note: this sort-based formulation is deliberate. A
-    gather-based alternative (searchsorted + binary search in value space,
-    asymptotically cheaper) measured ~70x SLOWER on v5e — batched gathers
-    serialize on the scalar unit, while one big fused sort/cumsum chain
-    stays on the VPU. Don't "optimize" this back to gathers.
+    Implementation notes, both deliberate:
+    - merge, don't sort: the rows are already sorted, so a bitonic merge
+      (ops/merge.py, O(log S) min/max stages) replaces the O(log^2 S)
+      full-sort network with identical output.
+    - no gathers: a searchsorted/binary-search alternative (asymptotically
+      cheaper) measured ~70x SLOWER on v5e — batched gathers serialize on
+      the scalar unit, while the fused merge/cumsum chain stays on the VPU.
     """
+    from drep_tpu.ops.merge import merge_sorted_rows, next_pow2
+
     s = a.shape[0]
-    x = jnp.sort(jnp.concatenate([a, b]))
+    s2 = next_pow2(s)
+    if s2 != s:
+        pad = jnp.full((s2 - s,), PAD_ID, dtype=a.dtype)
+        a = jnp.concatenate([a, pad])
+        b = jnp.concatenate([b, pad])
+    x = merge_sorted_rows(a, b)
     is_real = x != PAD_ID
     dup = jnp.concatenate([jnp.zeros(1, bool), x[1:] == x[:-1]]) & is_real
     start = is_real & ~dup

@@ -1,0 +1,199 @@
+"""Pallas TPU kernel: batched k-mer containment via in-VMEM bitonic merge.
+
+The hot op of the `jax_ani` secondary stage (SURVEY.md §7 step 6 calls for
+exactly this kernel) is the pairwise intersection size of sorted hash-id
+rows — the TPU-native replacement for fastANI's k-mer containment core
+(drep/d_cluster/external.py::run_pairwise_fastANI upstream; reference mount
+empty). The production MXU indicator-matmul path (ops/containment.py) is
+preferred while the [m, vocab] indicator fits its budget; THIS kernel is
+the scale path: its cost is O(S log S) per pair regardless of vocabulary
+size, so giant primary clusters (where vocab * m blows the matmul budget)
+stay fast without falling back to scalar-unit gathers.
+
+Per grid cell (one [TA, 128] tile of the pair matrix) the kernel keeps one
+A block and one B block resident in VMEM and, for each A row, merges it
+with every B row at once via Batcher's bitonic merge (ops/merge.py is the
+jnp formulation): an ascending row concatenated with a descending row is
+bitonic, so log2(2S) compare-exchange stages — implemented as full-width
+`pltpu.roll` + min/max, all VPU work with no lane-hostile reshapes — yield
+the sorted merge, and adjacent duplicates are exactly the intersection.
+
+TPU block constraints pin the pair-tile's last dim to 128 (the lane width),
+so the B tile is fixed at 128 rows and VMEM budget caps the mergeable
+sketch width (PALLAS_MAX_WIDTH); wider sketches take the jnp formulation of
+the same merge (XLA spills its temporaries to HBM instead of failing).
+
+CPU/test execution uses `interpret=True` (the reference has no fake
+backend; we follow SURVEY.md §4's rebuild note instead).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from drep_tpu.ops.merge import merge_sorted_rows, next_pow2
+from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+
+TILE_B = 128  # lane width — the pair tile's last dim must be 128-aligned
+TILE_A = 128
+# widest sketch whose [TILE_B, 2*S2] merge working set fits VMEM (~16 MB)
+PALLAS_MAX_WIDTH = 2048
+
+
+def _merge_bitonic(x: jnp.ndarray, length: int) -> jnp.ndarray:
+    """Bitonic merge of a [rows, length] bitonic batch, via roll + masked
+    min/max (Mosaic-friendly: no sub-lane reshapes)."""
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    d = length // 2
+    while d >= 1:
+        left = pltpu.roll(x, length - d, 1)  # partner for the low half: x[p + d]
+        right = pltpu.roll(x, d, 1)  # partner for the high half: x[p - d]
+        low_half = (col % (2 * d)) < d
+        x = jnp.where(low_half, jnp.minimum(x, left), jnp.maximum(x, right))
+        d //= 2
+    return x
+
+
+def _intersect_kernel(a_ref, b_ref, out_ref):
+    """a_ref [TA, S2] DESCENDING rows; b_ref [TB, S2] ascending rows;
+    out_ref [TA, TB] int32 pairwise intersection counts."""
+    ta = a_ref.shape[0]
+    tb, s2 = b_ref.shape
+    length = 2 * s2
+    b_block = b_ref[:]
+    col = jax.lax.broadcasted_iota(jnp.int32, (tb, length), 1)
+
+    def body(i, _):
+        a_row = a_ref[i, :]
+        x = jnp.concatenate(
+            [b_block, jnp.broadcast_to(a_row[None, :], (tb, s2))], axis=1
+        )
+        x = _merge_bitonic(x, length)
+        prev = pltpu.roll(x, 1, 1)
+        dup = (x == prev) & (x != PAD_ID) & (col > 0)
+        out_ref[i, :] = jnp.sum(dup.astype(jnp.int32), axis=1)
+        return 0
+
+    jax.lax.fori_loop(0, ta, body, 0)
+
+
+def _use_interpret() -> bool:
+    # device platform, not jax.default_backend(): TPU access can ride a
+    # plugin whose backend name differs while devices still report "tpu"
+    return jax.devices()[0].platform != "tpu"
+
+
+@functools.partial(jax.jit, static_argnames=("tile_a", "tile_b", "interpret"))
+def _intersect_grid(a_rev, b, *, tile_a: int, tile_b: int, interpret: bool):
+    na, s2 = a_rev.shape
+    nb = b.shape[0]
+    grid = (na // tile_a, nb // tile_b)
+    return pl.pallas_call(
+        _intersect_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_a, s2), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_b, s2), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_a, tile_b), lambda i, j: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((na, nb), jnp.int32),
+        interpret=interpret,
+    )(a_rev, b)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _intersect_tile_jnp(a_ids, b_ids):
+    """jnp fallback: same merge, vmapped over a pair tile; XLA manages the
+    temporaries, so any sketch width works (at HBM-spill cost)."""
+
+    def one_pair(a, b):
+        x = merge_sorted_rows(a, b)
+        dup = (x[1:] == x[:-1]) & (x[1:] != PAD_ID)
+        return jnp.sum(dup.astype(jnp.int32))
+
+    row = jax.vmap(one_pair, in_axes=(None, 0))
+    return jax.vmap(row, in_axes=(0, None))(a_ids, b_ids)
+
+
+def _pad_cols_pow2(ids: np.ndarray, s2: int) -> np.ndarray:
+    if ids.shape[1] == s2:
+        return ids
+    out = np.full((ids.shape[0], s2), PAD_ID, dtype=ids.dtype)
+    out[:, : ids.shape[1]] = ids
+    return out
+
+
+def _pad_rows(ids: np.ndarray, multiple: int) -> np.ndarray:
+    n = ids.shape[0]
+    nt = -(-n // multiple) * multiple
+    if nt == n:
+        return ids
+    return np.pad(ids, ((0, nt - n), (0, 0)), constant_values=PAD_ID)
+
+
+def intersect_counts_pallas(
+    a_ids: np.ndarray, b_ids: np.ndarray, jnp_tile: int = 128
+) -> np.ndarray:
+    """Pairwise |A_i ∩ B_j| for sorted PAD_ID-padded int32 id rows.
+
+    Returns int32 [na, nb]. Rows are padded to tile multiples and widths to
+    a shared power of two on the host; the Pallas kernel is fixed-shape.
+    Widths beyond PALLAS_MAX_WIDTH stream through the jnp merge in
+    host-tiled blocks instead.
+    """
+    na, nb = a_ids.shape[0], b_ids.shape[0]
+    s2 = max(128, next_pow2(max(a_ids.shape[1], b_ids.shape[1])))
+    a = _pad_cols_pow2(np.ascontiguousarray(a_ids), s2)
+    b = _pad_cols_pow2(np.ascontiguousarray(b_ids), s2)
+
+    if s2 <= PALLAS_MAX_WIDTH:
+        a = _pad_rows(a, TILE_A)
+        b = _pad_rows(b, TILE_B)
+        # reverse A rows host-side: ascending ++ reversed-ascending = bitonic
+        inter = _intersect_grid(
+            np.ascontiguousarray(a[:, ::-1]),
+            b,
+            tile_a=TILE_A,
+            tile_b=TILE_B,
+            interpret=_use_interpret(),
+        )
+        return np.asarray(inter)[:na, :nb]
+
+    a = _pad_rows(a, jnp_tile)
+    b = _pad_rows(b, jnp_tile)
+    inter = np.zeros((a.shape[0], b.shape[0]), dtype=np.int32)
+    for i0 in range(0, a.shape[0], jnp_tile):
+        for j0 in range(0, b.shape[0], jnp_tile):
+            inter[i0 : i0 + jnp_tile, j0 : j0 + jnp_tile] = np.asarray(
+                _intersect_tile_jnp(
+                    a[i0 : i0 + jnp_tile], b[j0 : j0 + jnp_tile]
+                )
+            )
+    return inter[:na, :nb]
+
+
+def all_vs_all_containment_pallas(
+    packed: PackedSketches, k: int = 21
+) -> tuple[np.ndarray, np.ndarray]:
+    """Directional ([N,N] ani, [N,N] cov) via the merge kernel.
+
+    Same contract as ops/containment.py's other all_vs_all_* paths:
+    cov[i,j] = |A_i ∩ A_j| / |A_i|, ani = cov^(1/k), diagonal pinned to 1.
+    """
+    inter = intersect_counts_pallas(packed.ids, packed.ids).astype(np.float32)
+    na = np.maximum(packed.counts.astype(np.float32), 1.0)
+    cov = inter / na[:, None]
+    ani = np.where(cov > 0.0, np.exp(np.log(np.maximum(cov, 1e-30)) / k), 0.0)
+    ani = ani.astype(np.float32)
+    cov = cov.astype(np.float32)
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov

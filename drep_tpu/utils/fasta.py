@@ -26,7 +26,11 @@ class FastaStats:
 
 
 def _open_maybe_gzip(path: str):
-    if path.endswith(".gz"):
+    # content-based detection (gzip magic), matching the native path's
+    # transparent gzopen — a ".gz" name must not change how bytes are parsed
+    with open(path, "rb") as probe:
+        magic = probe.read(2)
+    if magic == b"\x1f\x8b":
         return gzip.open(path, "rb")
     return open(path, "rb")
 
@@ -44,8 +48,9 @@ def read_fasta_contigs(path: str) -> list[bytes]:
             if chunks:
                 contigs.append(b"".join(chunks).upper())
                 chunks = []
-        elif line:
-            chunks.append(line.strip())
+        elif stripped := line.strip():
+            # whitespace-only lines add no contig (the native path agrees)
+            chunks.append(stripped)
     if chunks:
         contigs.append(b"".join(chunks).upper())
     return contigs

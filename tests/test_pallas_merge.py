@@ -1,0 +1,80 @@
+"""Bitonic merge network + Pallas containment kernel vs numpy oracles.
+
+Kernel unit tests the reference never had (SURVEY.md §4 rebuild note (a)):
+on CPU the pallas_call runs in interpret mode; the same code path compiles
+on TPU.
+"""
+
+import numpy as np
+import pytest
+
+from drep_tpu.ops.containment import all_vs_all_containment, pack_scaled_sketches
+from drep_tpu.ops.minhash import PAD_ID
+from drep_tpu.ops.pallas_merge import (
+    all_vs_all_containment_pallas,
+    intersect_counts_pallas,
+)
+
+
+def _random_rows(rng, n, width, max_fill):
+    """Sorted unique PAD-padded int32 rows with ragged fill."""
+    ids = np.full((n, width), PAD_ID, dtype=np.int32)
+    counts = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        m = int(rng.integers(0, max_fill + 1))
+        vals = np.unique(rng.integers(0, 4 * max_fill, size=m).astype(np.int32))
+        ids[i, : len(vals)] = vals
+        counts[i] = len(vals)
+    return ids, counts
+
+
+def test_merge_sorted_rows_equals_sort(rng):
+    import jax.numpy as jnp
+
+    from drep_tpu.ops.merge import merge_sorted_rows
+
+    a = np.sort(rng.integers(0, 1 << 20, size=(7, 256)).astype(np.int32), axis=1)
+    b = np.sort(rng.integers(0, 1 << 20, size=(7, 256)).astype(np.int32), axis=1)
+    got = np.asarray(merge_sorted_rows(jnp.asarray(a), jnp.asarray(b)))
+    want = np.sort(np.concatenate([a, b], axis=1), axis=1)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_merge_rejects_non_pow2():
+    import jax.numpy as jnp
+
+    from drep_tpu.ops.merge import merge_sorted_rows
+
+    with pytest.raises(ValueError):
+        merge_sorted_rows(jnp.zeros((2, 100), jnp.int32), jnp.zeros((2, 100), jnp.int32))
+
+
+def test_intersect_counts_vs_numpy_oracle(rng):
+    a_ids, _ = _random_rows(rng, 9, 300, 200)  # non-pow2 width, ragged rows
+    b_ids, _ = _random_rows(rng, 5, 300, 200)
+    got = intersect_counts_pallas(a_ids, b_ids)
+    for i in range(9):
+        ai = a_ids[i][a_ids[i] != PAD_ID]
+        for j in range(5):
+            bj = b_ids[j][b_ids[j] != PAD_ID]
+            assert got[i, j] == len(np.intersect1d(ai, bj)), (i, j)
+
+
+def test_intersect_empty_rows(rng):
+    a_ids = np.full((3, 128), PAD_ID, dtype=np.int32)
+    b_ids, _ = _random_rows(rng, 3, 128, 64)
+    assert (intersect_counts_pallas(a_ids, b_ids) == 0).all()
+
+
+def test_all_vs_all_matches_searchsorted_path(rng):
+    """The Pallas kernel must agree exactly with the reference containment
+    path (same packed layout, same ANI transform)."""
+    sketches = [
+        np.unique(rng.integers(0, 1 << 40, size=int(rng.integers(5, 400))).astype(np.uint64))
+        for _ in range(17)
+    ]
+    packed = pack_scaled_sketches(sketches, [f"g{i}" for i in range(17)])
+    ani_p, cov_p = all_vs_all_containment_pallas(packed, k=21)
+    ani_s, cov_s = all_vs_all_containment(packed, k=21)
+    np.testing.assert_allclose(cov_p, cov_s, atol=1e-6)
+    np.testing.assert_allclose(ani_p, ani_s, atol=1e-6)
