@@ -58,6 +58,14 @@ def build_parser() -> argparse.ArgumentParser:
         clus.add_argument("--run_tertiary_clustering", action="store_true",
                           help="re-compare secondary-cluster representatives across "
                                "primary-cluster boundaries and merge co-clustering groups")
+        clus.add_argument("--streaming_primary", action="store_true",
+                          help="out-of-core primary clustering: thresholded edge stream "
+                               "with per-block checkpoints and union-find components "
+                               "(single linkage); auto-enabled beyond --streaming_threshold")
+        clus.add_argument("--streaming_block", type=int, default=1024)
+        clus.add_argument("--streaming_threshold", type=int, default=30_000,
+                          help="genome count beyond which the primary stage streams "
+                               "instead of materializing the N^2 matrix")
 
         warn = p.add_argument_group("WARNINGS")
         warn.add_argument("--warn_dist", type=float, default=0.25)

@@ -207,6 +207,9 @@ def secondary_anin(gs, indices, bdb=None, processes: int = 1, **_):
     return _nucmer_allpairs(gs, indices, bdb, processes, filtered=False)
 
 
+_WARNED_GANI_MISMATCH: list[bool] = []
+
+
 def parse_gani_file(path: str, name1: str, name2: str):
     """Parse ANIcalculator output by HEADER NAME (column order varies across
     versions — the reference parses by name for the same reason). Returns
@@ -236,15 +239,18 @@ def parse_gani_file(path: str, name1: str, name2: str):
         if g1 != name1:  # swap to the requested orientation
             ani12, ani21, af12, af21 = ani21, ani12, af21, af12
         return (ani12 / 100.0, af12), (ani21 / 100.0, af21)
-    if len(lines) > 1:
+    if len(lines) > 1 and not _WARNED_GANI_MISMATCH:
         # rows exist but none mention the requested pair — likely a genome
         # name-normalization mismatch, which would otherwise masquerade as
-        # "no significant alignment" for EVERY pair
+        # "no significant alignment" for EVERY pair. Warn once: when the
+        # condition is real it hits every parse and would flood the log.
         from drep_tpu.utils.logger import get_logger
 
+        _WARNED_GANI_MISMATCH.append(True)
         get_logger().warning(
             "gANI output %s has %d rows but none match pair (%s, %s) — "
-            "check genome name normalization",
+            "check genome name normalization (reported once; likely affects "
+            "every pair in this run)",
             path, len(lines) - 1, name1, name2,
         )
     return (0.0, 0.0), (0.0, 0.0)

@@ -55,6 +55,9 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "mdb_dense_limit": 2000,
     "mesh_shape": None,
     "primary_estimator": "auto",
+    "streaming_primary": False,
+    "streaming_block": 1024,
+    "streaming_threshold": 30_000,
 }
 
 _RESUME_KEYS = [
@@ -72,6 +75,8 @@ _RESUME_KEYS = [
     "SkipSecondary",
     "greedy_secondary_clustering",
     "run_tertiary_clustering",
+    "streaming_primary",
+    "streaming_threshold",  # auto-enables streaming, which changes linkage
     "genomes",
 ]
 
@@ -101,19 +106,54 @@ def _mdb_from_dist(dist: np.ndarray, names: list[str], dense_limit: int, p_ani: 
     )
 
 
+def _streaming_mdb(edges, names: list[str]) -> pd.DataFrame:
+    """Sparse Mdb from thresholded streaming edges: both directions plus the
+    diagonal, matching the thresholded branch of `_mdb_from_dist`."""
+    ii, jj, dd = edges
+    n = len(names)
+    arr = np.array(names)
+    g1 = np.concatenate([arr[ii], arr[jj], arr])
+    g2 = np.concatenate([arr[jj], arr[ii], arr])
+    d = np.concatenate([dd, dd, np.zeros(n, np.float32)])
+    return pd.DataFrame({"genome1": g1, "genome2": g2, "dist": d, "similarity": 1.0 - d})
+
+
 def _primary_clusters(
-    gs: GenomeSketches, bdb: pd.DataFrame, kw: dict[str, Any]
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Returns (labels 1..C, dist matrix, linkage)."""
+    gs: GenomeSketches, bdb: pd.DataFrame, kw: dict[str, Any], wd: WorkDirectory | None = None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, pd.DataFrame | None]:
+    """Returns (labels 1..C, dist matrix | None, linkage, sparse Mdb | None)."""
+    logger = get_logger()
     n = len(gs.names)
     if kw["SkipMash"] or n == 1:
         # reference --SkipMash: everything lands in one primary cluster
-        return np.ones(n, dtype=np.int64), np.zeros((n, n), np.float32), np.empty((0, 4))
+        return np.ones(n, dtype=np.int64), np.zeros((n, n), np.float32), np.empty((0, 4)), None
     if kw["multiround_primary_clustering"] and n > kw["primary_chunksize"]:
         from drep_tpu.cluster.multiround import multiround_primary_clustering
 
         labels = multiround_primary_clustering(gs, bdb, kw)
-        return labels, None, np.empty((0, 4))
+        return labels, None, np.empty((0, 4)), None
+    if kw["streaming_primary"] or (
+        kw["primary_algorithm"] == "jax_mash" and n >= kw["streaming_threshold"]
+    ):
+        from drep_tpu.ops.minhash import pack_sketches
+        from drep_tpu.parallel.streaming import streaming_primary_clusters
+
+        if kw["clusterAlg"] != "single":
+            logger.warning(
+                "streaming primary computes single-linkage (connected components "
+                "at 1-P_ani); --clusterAlg %s applies only to secondary clustering",
+                kw["clusterAlg"],
+            )
+        ckpt = wd.get_dir(os.path.join("data", "streaming_primary")) if wd is not None else None
+        packed = pack_sketches(gs.bottom, gs.names, gs.sketch_size)
+        labels, edges = streaming_primary_clusters(
+            packed,
+            gs.k,
+            kw["P_ani"],
+            block=kw["streaming_block"],
+            checkpoint_dir=ckpt,
+        )
+        return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names)
     engine = dispatch.get_primary(kw["primary_algorithm"])
     dist, _sim = engine(
         gs,
@@ -128,7 +168,7 @@ def _primary_clusters(
         link = np.empty((0, 4))
     else:
         labels, link = cluster_hierarchical(dist, cutoff, method=kw["clusterAlg"])
-    return labels, dist, link
+    return labels, dist, link, None
 
 
 def _secondary_for_cluster(
@@ -171,13 +211,15 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     n = len(gs.names)
     logger.info("clustering %d genomes (primary=%s, secondary=%s)", n, kw["primary_algorithm"], kw["S_algorithm"])
 
-    primary, pdist, plink = _primary_clusters(gs, bdb, kw)
+    primary, pdist, plink, sparse_mdb = _primary_clusters(gs, bdb, kw, wd=wd)
     n_primary = int(primary.max()) if n else 0
     logger.info("primary clustering: %d clusters from %d genomes", n_primary, n)
 
     if pdist is not None:
         mdb = _mdb_from_dist(pdist, gs.names, kw["mdb_dense_limit"], kw["P_ani"])
         wd.store_db(schemas.validate(mdb, "Mdb"), "Mdb")
+    elif sparse_mdb is not None:
+        wd.store_db(schemas.validate(sparse_mdb, "Mdb"), "Mdb")
 
     clustering_files: dict[str, Any] = {
         "primary_linkage": plink,

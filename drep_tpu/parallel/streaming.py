@@ -1,0 +1,221 @@
+"""Out-of-core streaming primary comparison — the 100k-genome path.
+
+The dense engines (ops/minhash.py, parallel/allpairs.py) materialize the
+full [N, N] distance matrix; at N=100k that is 40 GB per output and cannot
+live on host or device. The reference handles this regime by chunked
+multiround clustering (drep/d_cluster/compare_utils.py::
+multiround_primary_clustering, SURVEY.md §2; reference mount empty). This
+module is the TPU-native supersession (SURVEY.md §7 step 8 / §5.4):
+
+- the (i, j) row-block tile grid is walked host-side; each tile is computed
+  on device (round-robined over all local chips — JAX dispatch is async, so
+  D tiles are in flight at once) and immediately **thresholded on host**:
+  only edges with ``dist <= cutoff`` survive. Memory is O(edges), never
+  O(N^2).
+- every finished row-block appends a checkpoint shard
+  (``row_XXXXX.npz`` with its surviving edges) under the work directory;
+  a preempted run resumes by skipping finished shards — the shard-level
+  checkpointing the reference's CSV-only resume cannot do mid-stage.
+- primary clusters are the connected components of the thresholded edge
+  graph (host union-find). At a distance cutoff this is EXACTLY
+  single-linkage fcluster(t=cutoff): two genomes share a cluster iff a
+  path of <=cutoff edges connects them. (Average linkage needs the dense
+  matrix; at streaming scale the reference, too, gives up exact average
+  linkage — its multiround path is also containment-by-rounds.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
+from drep_tpu.utils.logger import get_logger
+
+DEFAULT_BLOCK = 1024
+_META = "meta.json"
+
+
+def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
+    """Union-find over edges -> labels 1..C, numbered by first member index
+    (deterministic; partitions match single-linkage fcluster at the cutoff)."""
+    parent = np.arange(n, dtype=np.int64)
+
+    def find(x: int) -> int:
+        root = x
+        while parent[root] != root:
+            root = parent[root]
+        while parent[x] != root:  # path compression
+            parent[x], x = root, parent[x]
+        return root
+
+    for a, b in zip(ii.tolist(), jj.tolist()):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            # union by smaller index keeps roots = first members
+            if ra < rb:
+                parent[rb] = ra
+            else:
+                parent[ra] = rb
+    roots = np.array([find(i) for i in range(n)], dtype=np.int64)
+    labels = np.zeros(n, dtype=np.int64)
+    next_label = 1
+    root_label: dict[int, int] = {}
+    for i in range(n):
+        r = int(roots[i])
+        if r not in root_label:
+            root_label[r] = next_label
+            next_label += 1
+        labels[i] = root_label[r]
+    return labels
+
+
+def _checkpoint_valid(ckpt_dir: str, meta: dict[str, Any]) -> bool:
+    loc = os.path.join(ckpt_dir, _META)
+    if not os.path.exists(loc):
+        return False
+    with open(loc) as f:
+        stored = json.load(f)
+    return stored == meta
+
+
+def streaming_mash_edges(
+    packed: PackedSketches,
+    k: int,
+    cutoff: float,
+    block: int = DEFAULT_BLOCK,
+    checkpoint_dir: str | None = None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """All unordered pairs (i < j) with Mash distance <= cutoff.
+
+    Returns (ii, jj, dist) arrays. Never materializes more than one
+    row-block stripe of the distance matrix on host, and round-robins tiles
+    over every local device.
+    """
+    import jax
+
+    logger = get_logger()
+    n = packed.n
+    block = max(1, min(block, max(8, n)))
+    ids, counts = pad_packed_rows(packed.ids, packed.counts, block)
+    nt = ids.shape[0]
+    n_blocks = nt // block
+    devices = jax.devices()
+
+    meta = {
+        "n": n,
+        "block": block,
+        "k": k,
+        "cutoff": round(float(cutoff), 12),
+        "sketch_size": int(packed.sketch_size),
+        "n_blocks": n_blocks,
+    }
+    resume = False
+    if checkpoint_dir is not None:
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        if _checkpoint_valid(checkpoint_dir, meta):
+            resume = True
+        else:
+            for f in os.listdir(checkpoint_dir):  # stale shards: clear
+                if f.endswith(".npz") or f == _META:
+                    os.remove(os.path.join(checkpoint_dir, f))
+            with open(os.path.join(checkpoint_dir, _META), "w") as f:
+                json.dump(meta, f, sort_keys=True)
+
+    all_ii: list[np.ndarray] = []
+    all_jj: list[np.ndarray] = []
+    all_dd: list[np.ndarray] = []
+    n_resumed = 0
+
+    for bi in range(n_blocks):
+        shard = (
+            os.path.join(checkpoint_dir, f"row_{bi:05d}.npz")
+            if checkpoint_dir is not None
+            else None
+        )
+        if resume and shard is not None and os.path.exists(shard):
+            with np.load(shard) as z:
+                all_ii.append(z["ii"])
+                all_jj.append(z["jj"])
+                all_dd.append(z["dist"])
+            n_resumed += 1
+            continue
+
+        i0 = bi * block
+        # one transfer of the A stripe per device, reused by all its tiles
+        a_on: dict[int, tuple] = {}
+        for di, dev in enumerate(devices):
+            a_on[di] = (
+                jax.device_put(ids[i0 : i0 + block], dev),
+                jax.device_put(counts[i0 : i0 + block], dev),
+            )
+        # dispatch the whole stripe asynchronously, one tile per device turn
+        tiles = []
+        for t, bj in enumerate(range(bi, n_blocks)):
+            j0 = bj * block
+            di = t % len(devices)
+            a_ids_d, a_counts_d = a_on[di]
+            d, _j = mash_distance_tile(
+                a_ids_d,
+                a_counts_d,
+                jax.device_put(ids[j0 : j0 + block], devices[di]),
+                jax.device_put(counts[j0 : j0 + block], devices[di]),
+                k=k,
+            )
+            tiles.append((j0, d))
+
+        row_ii: list[np.ndarray] = []
+        row_jj: list[np.ndarray] = []
+        row_dd: list[np.ndarray] = []
+        for j0, d in tiles:
+            d = np.asarray(d)  # sync point for this tile
+            keep = d <= cutoff
+            if j0 == i0:
+                keep &= np.triu(np.ones_like(keep, dtype=bool), 1)  # i < j only
+            ki, kj = np.nonzero(keep)
+            if len(ki):
+                gi = ki + i0
+                gj = kj + j0
+                valid = (gi < n) & (gj < n)
+                row_ii.append(gi[valid])
+                row_jj.append(gj[valid])
+                row_dd.append(d[ki, kj][valid].astype(np.float32))
+
+        ii = np.concatenate(row_ii) if row_ii else np.empty(0, np.int64)
+        jj = np.concatenate(row_jj) if row_jj else np.empty(0, np.int64)
+        dd = np.concatenate(row_dd) if row_dd else np.empty(0, np.float32)
+        if shard is not None:
+            np.savez_compressed(shard, ii=ii, jj=jj, dist=dd)
+        all_ii.append(ii)
+        all_jj.append(jj)
+        all_dd.append(dd)
+
+    if n_resumed:
+        logger.info("streaming primary: resumed %d/%d row-block shards", n_resumed, n_blocks)
+    return (
+        np.concatenate(all_ii) if all_ii else np.empty(0, np.int64),
+        np.concatenate(all_jj) if all_jj else np.empty(0, np.int64),
+        np.concatenate(all_dd) if all_dd else np.empty(0, np.float32),
+    )
+
+
+def streaming_primary_clusters(
+    packed: PackedSketches,
+    k: int,
+    p_ani: float,
+    block: int = DEFAULT_BLOCK,
+    checkpoint_dir: str | None = None,
+) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Streaming primary clustering: (labels 1..C, thresholded edges).
+
+    Edges are exactly the pairs a sparse Mdb keeps (dist <= 1 - P_ani).
+    """
+    cutoff = 1.0 - p_ani
+    ii, jj, dd = streaming_mash_edges(
+        packed, k, cutoff, block=block, checkpoint_dir=checkpoint_dir
+    )
+    labels = connected_components(packed.n, ii, jj)
+    return labels, (ii, jj, dd)
