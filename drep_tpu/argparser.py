@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
         tpu.add_argument("--mesh_shape", type=int, default=None,
                          help="shard all-pairs tiles over this many devices (default: all)")
         tpu.add_argument("--skip_plots", action="store_true")
+        tpu.add_argument("--profile", nargs="?", const="auto", default=None,
+                         help="record a jax.profiler trace of the compare stage "
+                              "(optionally to the given directory; default "
+                              "<wd>/log/jax_trace). perf_counters.json is always written")
 
         if with_filter:
             filt = p.add_argument_group("FILTERING")

@@ -210,6 +210,12 @@ def secondary_anin(gs, indices, bdb=None, processes: int = 1, **_):
 _WARNED_GANI_MISMATCH: list[bool] = []
 
 
+def reset_run_state() -> None:
+    """Clear per-run warn-once flags (workflows call this at run start so a
+    second run in the same process warns again)."""
+    _WARNED_GANI_MISMATCH.clear()
+
+
 def parse_gani_file(path: str, name1: str, name2: str):
     """Parse ANIcalculator output by HEADER NAME (column order varies across
     versions — the reference parses by name for the same reason). Returns

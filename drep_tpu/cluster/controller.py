@@ -120,18 +120,19 @@ def _streaming_mdb(edges, names: list[str]) -> pd.DataFrame:
 
 def _primary_clusters(
     gs: GenomeSketches, bdb: pd.DataFrame, kw: dict[str, Any], wd: WorkDirectory | None = None
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, pd.DataFrame | None]:
-    """Returns (labels 1..C, dist matrix | None, linkage, sparse Mdb | None)."""
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, pd.DataFrame | None, int]:
+    """Returns (labels 1..C, dist matrix | None, linkage, sparse Mdb | None,
+    pairs actually compared — 0 for skipped work, honest across resumes)."""
     logger = get_logger()
     n = len(gs.names)
     if kw["SkipMash"] or n == 1:
         # reference --SkipMash: everything lands in one primary cluster
-        return np.ones(n, dtype=np.int64), np.zeros((n, n), np.float32), np.empty((0, 4)), None
+        return np.ones(n, dtype=np.int64), np.zeros((n, n), np.float32), np.empty((0, 4)), None, 0
     if kw["multiround_primary_clustering"] and n > kw["primary_chunksize"]:
         from drep_tpu.cluster.multiround import multiround_primary_clustering
 
         labels = multiround_primary_clustering(gs, bdb, kw)
-        return labels, None, np.empty((0, 4)), None
+        return labels, None, np.empty((0, 4)), None, 0
     if kw["streaming_primary"] or (
         kw["primary_algorithm"] == "jax_mash" and n >= kw["streaming_threshold"]
     ):
@@ -146,14 +147,14 @@ def _primary_clusters(
             )
         ckpt = wd.get_dir(os.path.join("data", "streaming_primary")) if wd is not None else None
         packed = pack_sketches(gs.bottom, gs.names, gs.sketch_size)
-        labels, edges = streaming_primary_clusters(
+        labels, edges, pairs_computed = streaming_primary_clusters(
             packed,
             gs.k,
             kw["P_ani"],
             block=kw["streaming_block"],
             checkpoint_dir=ckpt,
         )
-        return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names)
+        return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names), pairs_computed
     engine = dispatch.get_primary(kw["primary_algorithm"])
     dist, _sim = engine(
         gs,
@@ -168,7 +169,7 @@ def _primary_clusters(
         link = np.empty((0, 4))
     else:
         labels, link = cluster_hierarchical(dist, cutoff, method=kw["clusterAlg"])
-    return labels, dist, link, None
+    return labels, dist, link, None, n * (n - 1) // 2
 
 
 def _secondary_for_cluster(
@@ -211,7 +212,13 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     n = len(gs.names)
     logger.info("clustering %d genomes (primary=%s, secondary=%s)", n, kw["primary_algorithm"], kw["S_algorithm"])
 
-    primary, pdist, plink, sparse_mdb = _primary_clusters(gs, bdb, kw, wd=wd)
+    import time as _time
+
+    from drep_tpu.utils.profiling import counters
+
+    t0 = _time.perf_counter()
+    primary, pdist, plink, sparse_mdb, pairs_done = _primary_clusters(gs, bdb, kw, wd=wd)
+    counters.add("primary_compare", pairs=pairs_done, seconds=_time.perf_counter() - t0)
     n_primary = int(primary.max()) if n else 0
     logger.info("primary clustering: %d clusters from %d genomes", n_primary, n)
 
@@ -240,13 +247,17 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
             if len(indices) == 1:
                 secondary_names[gs.names[indices[0]]] = f"{pc}_1"
                 continue
+            m = len(indices)
             if greedy:
                 from drep_tpu.cluster.greedy import greedy_secondary_cluster
 
-                ndb, labels = greedy_secondary_cluster(gs, bdb, indices, pc, kw)
+                with counters.stage("secondary_compare"):
+                    ndb, labels = greedy_secondary_cluster(gs, bdb, indices, pc, kw)
+                counters.stages["secondary_compare"].pairs += len(ndb)  # actual comparisons made
                 link = np.empty((0, 4))
             else:
-                ndb, labels, link = _secondary_for_cluster(gs, bdb, indices, pc, kw)
+                with counters.stage("secondary_compare", pairs=m * (m - 1) // 2):
+                    ndb, labels, link = _secondary_for_cluster(gs, bdb, indices, pc, kw)
             ndb_parts.append(ndb)
             clustering_files["secondary"][pc] = {
                 "linkage": link,

@@ -35,7 +35,12 @@ def load_genome_info(source) -> pd.DataFrame:
     """genomeInfo from a CSV path or DataFrame; validates required columns."""
     df = pd.read_csv(source) if isinstance(source, str) else source.copy()
     # tolerate dRep's checkm-style column names
-    renames = {"Completeness": "completeness", "Contamination": "contamination", "Bin Id": "genome"}
+    renames = {
+        "Completeness": "completeness",
+        "Contamination": "contamination",
+        "Bin Id": "genome",
+        "Strain heterogeneity": "strain_heterogeneity",
+    }
     return df.rename(columns={k: v for k, v in renames.items() if k in df.columns})
 
 
@@ -69,12 +74,20 @@ def run_checkm_wrapper(bdb: pd.DataFrame, out_dir: str, processes: int = 1) -> p
         raise RuntimeError(f"checkm failed: {res.stderr[-2000:]}")
     chdb = pd.read_csv(tab, sep="\t")
     chdb = chdb.rename(
-        columns={"Bin Id": "genome", "Completeness": "completeness", "Contamination": "contamination"}
+        columns={
+            "Bin Id": "genome",
+            "Completeness": "completeness",
+            "Contamination": "contamination",
+            "Strain heterogeneity": "strain_heterogeneity",
+        }
     )
     chdb["genome"] = chdb["genome"].map(stem_to_genome)
     if chdb["genome"].isna().any():
         raise RuntimeError("checkm output contained unknown bin ids")
-    return chdb[["genome", "completeness", "contamination"]]
+    cols = ["genome", "completeness", "contamination"]
+    if "strain_heterogeneity" in chdb.columns:  # feeds the strW scoring term
+        cols.append("strain_heterogeneity")
+    return chdb[cols]
 
 
 def d_filter_wrapper(

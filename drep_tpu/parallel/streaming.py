@@ -40,37 +40,25 @@ _META = "meta.json"
 
 
 def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
-    """Union-find over edges -> labels 1..C, numbered by first member index
-    (deterministic; partitions match single-linkage fcluster at the cutoff)."""
-    parent = np.arange(n, dtype=np.int64)
+    """Edge graph -> labels 1..C numbered by first member index
+    (deterministic; partitions match single-linkage fcluster at the cutoff).
 
-    def find(x: int) -> int:
-        root = x
-        while parent[root] != root:
-            root = parent[root]
-        while parent[x] != root:  # path compression
-            parent[x], x = root, parent[x]
-        return root
+    scipy's C union-find: tens of millions of edges at the 100k-genome scale
+    this path exists for must not be walked one Python iteration at a time.
+    """
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components as _cc
 
-    for a, b in zip(ii.tolist(), jj.tolist()):
-        ra, rb = find(a), find(b)
-        if ra != rb:
-            # union by smaller index keeps roots = first members
-            if ra < rb:
-                parent[rb] = ra
-            else:
-                parent[ra] = rb
-    roots = np.array([find(i) for i in range(n)], dtype=np.int64)
-    labels = np.zeros(n, dtype=np.int64)
-    next_label = 1
-    root_label: dict[int, int] = {}
-    for i in range(n):
-        r = int(roots[i])
-        if r not in root_label:
-            root_label[r] = next_label
-            next_label += 1
-        labels[i] = root_label[r]
-    return labels
+    graph = coo_matrix(
+        (np.ones(len(ii), dtype=np.int8), (ii, jj)), shape=(n, n)
+    )
+    _, raw = _cc(graph, directed=False)
+    # relabel to first-occurrence order, vectorized: scipy labels are 0..C-1,
+    # so remap[raw_label] = 1 + rank of that label's first member index
+    _, first_idx = np.unique(raw, return_index=True)
+    remap = np.empty(len(first_idx), dtype=np.int64)
+    remap[np.argsort(first_idx)] = np.arange(1, len(first_idx) + 1)
+    return remap[raw]
 
 
 def _checkpoint_valid(ckpt_dir: str, meta: dict[str, Any]) -> bool:
@@ -82,18 +70,46 @@ def _checkpoint_valid(ckpt_dir: str, meta: dict[str, Any]) -> bool:
     return stored == meta
 
 
+def _fingerprint(packed: PackedSketches) -> str:
+    """Content hash of the packed sketches + genome order. The int32 ids are
+    a run-specific vocabulary remap (ops/minhash.pack_sketches), so shards
+    from a different genome set/order are meaningless even at identical N —
+    the checkpoint meta must pin the actual content, not just the shape."""
+    import hashlib
+
+    h = hashlib.sha1()
+    for name in packed.names:
+        h.update(name.encode())
+        h.update(b"\0")
+    h.update(np.ascontiguousarray(packed.counts).tobytes())
+    h.update(np.ascontiguousarray(packed.ids).tobytes())
+    return h.hexdigest()
+
+
+def _real_pairs_in_tile(i0: int, j0: int, block: int, n: int) -> int:
+    """Unique real (unpadded, i<j) pairs a tile covers."""
+    ra = max(0, min(i0 + block, n) - i0)
+    rb = max(0, min(j0 + block, n) - j0)
+    if i0 == j0:
+        return ra * (ra - 1) // 2
+    return ra * rb
+
+
 def streaming_mash_edges(
     packed: PackedSketches,
     k: int,
     cutoff: float,
     block: int = DEFAULT_BLOCK,
     checkpoint_dir: str | None = None,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """All unordered pairs (i < j) with Mash distance <= cutoff.
 
-    Returns (ii, jj, dist) arrays. Never materializes more than one
-    row-block stripe of the distance matrix on host, and round-robins tiles
-    over every local device.
+    Returns (ii, jj, dist, pairs_computed) — `pairs_computed` counts pair
+    comparisons actually executed this call (resumed shards contribute 0),
+    so perf counters stay honest across resumes. Never materializes more
+    than one row-block stripe of the distance matrix on host; sketches are
+    device-resident (one transfer per device) and tiles round-robin over
+    every local device.
     """
     import jax
 
@@ -112,6 +128,7 @@ def streaming_mash_edges(
         "cutoff": round(float(cutoff), 12),
         "sketch_size": int(packed.sketch_size),
         "n_blocks": n_blocks,
+        "fingerprint": _fingerprint(packed),
     }
     resume = False
     if checkpoint_dir is not None:
@@ -122,13 +139,22 @@ def streaming_mash_edges(
             for f in os.listdir(checkpoint_dir):  # stale shards: clear
                 if f.endswith(".npz") or f == _META:
                     os.remove(os.path.join(checkpoint_dir, f))
-            with open(os.path.join(checkpoint_dir, _META), "w") as f:
+            tmp = os.path.join(checkpoint_dir, _META + ".tmp")
+            with open(tmp, "w") as f:
                 json.dump(meta, f, sort_keys=True)
+            os.replace(tmp, os.path.join(checkpoint_dir, _META))
+
+    # the full padded pack lives on every device (N=100k, s=1000 -> ~400 MB,
+    # well within HBM); tiles are sliced on device, so each block crosses
+    # PCIe exactly once per device instead of once per tile
+    ids_on = [jax.device_put(ids, dev) for dev in devices]
+    counts_on = [jax.device_put(counts, dev) for dev in devices]
 
     all_ii: list[np.ndarray] = []
     all_jj: list[np.ndarray] = []
     all_dd: list[np.ndarray] = []
     n_resumed = 0
+    pairs_computed = 0
 
     for bi in range(n_blocks):
         shard = (
@@ -137,35 +163,33 @@ def streaming_mash_edges(
             else None
         )
         if resume and shard is not None and os.path.exists(shard):
-            with np.load(shard) as z:
-                all_ii.append(z["ii"])
-                all_jj.append(z["jj"])
-                all_dd.append(z["dist"])
-            n_resumed += 1
-            continue
+            try:
+                with np.load(shard) as z:
+                    all_ii.append(z["ii"])
+                    all_jj.append(z["jj"])
+                    all_dd.append(z["dist"])
+                n_resumed += 1
+                continue
+            except Exception:  # truncated/corrupt shard (killed mid-write
+                # before atomic replace existed, disk trouble): recompute it
+                logger.warning("streaming primary: corrupt shard %s — recomputing", shard)
+                os.remove(shard)
 
         i0 = bi * block
-        # one transfer of the A stripe per device, reused by all its tiles
-        a_on: dict[int, tuple] = {}
-        for di, dev in enumerate(devices):
-            a_on[di] = (
-                jax.device_put(ids[i0 : i0 + block], dev),
-                jax.device_put(counts[i0 : i0 + block], dev),
-            )
         # dispatch the whole stripe asynchronously, one tile per device turn
         tiles = []
         for t, bj in enumerate(range(bi, n_blocks)):
             j0 = bj * block
             di = t % len(devices)
-            a_ids_d, a_counts_d = a_on[di]
             d, _j = mash_distance_tile(
-                a_ids_d,
-                a_counts_d,
-                jax.device_put(ids[j0 : j0 + block], devices[di]),
-                jax.device_put(counts[j0 : j0 + block], devices[di]),
+                ids_on[di][i0 : i0 + block],
+                counts_on[di][i0 : i0 + block],
+                ids_on[di][j0 : j0 + block],
+                counts_on[di][j0 : j0 + block],
                 k=k,
             )
             tiles.append((j0, d))
+            pairs_computed += _real_pairs_in_tile(i0, j0, block, n)
 
         row_ii: list[np.ndarray] = []
         row_jj: list[np.ndarray] = []
@@ -188,7 +212,8 @@ def streaming_mash_edges(
         jj = np.concatenate(row_jj) if row_jj else np.empty(0, np.int64)
         dd = np.concatenate(row_dd) if row_dd else np.empty(0, np.float32)
         if shard is not None:
-            np.savez_compressed(shard, ii=ii, jj=jj, dist=dd)
+            np.savez_compressed(shard + ".tmp.npz", ii=ii, jj=jj, dist=dd)
+            os.replace(shard + ".tmp.npz", shard)  # atomic: no torn shards
         all_ii.append(ii)
         all_jj.append(jj)
         all_dd.append(dd)
@@ -199,6 +224,7 @@ def streaming_mash_edges(
         np.concatenate(all_ii) if all_ii else np.empty(0, np.int64),
         np.concatenate(all_jj) if all_jj else np.empty(0, np.int64),
         np.concatenate(all_dd) if all_dd else np.empty(0, np.float32),
+        pairs_computed,
     )
 
 
@@ -208,14 +234,15 @@ def streaming_primary_clusters(
     p_ani: float,
     block: int = DEFAULT_BLOCK,
     checkpoint_dir: str | None = None,
-) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray]]:
-    """Streaming primary clustering: (labels 1..C, thresholded edges).
+) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray], int]:
+    """Streaming primary clustering: (labels 1..C, thresholded edges,
+    pairs actually computed this call).
 
     Edges are exactly the pairs a sparse Mdb keeps (dist <= 1 - P_ani).
     """
     cutoff = 1.0 - p_ani
-    ii, jj, dd = streaming_mash_edges(
+    ii, jj, dd, pairs_computed = streaming_mash_edges(
         packed, k, cutoff, block=block, checkpoint_dir=checkpoint_dir
     )
     labels = connected_components(packed.n, ii, jj)
-    return labels, (ii, jj, dd)
+    return labels, (ii, jj, dd), pairs_computed

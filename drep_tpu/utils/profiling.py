@@ -1,0 +1,104 @@
+"""First-class performance counters + JAX profiler hook.
+
+The reference has no tracing/profiling at all — only wall-time logging and a
+comparison-count ETA estimate (SURVEY.md §5.1; reference mount empty). The
+rebuild's headline metric is genome-pairs/sec/chip (BASELINE.json), so it is
+tracked here as a first-class counter: every compare stage records how many
+pairwise comparisons it performed and how long it took, and the totals are
+written to ``<wd>/log/perf_counters.json`` at the end of every run.
+
+``trace(dir)`` wraps a block in ``jax.profiler.trace`` for TensorBoard-level
+kernel timelines (``--profile`` on the CLI).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+@dataclass
+class _Stage:
+    pairs: int = 0
+    seconds: float = 0.0
+    calls: int = 0
+
+
+@dataclass
+class Counters:
+    """Per-stage pair/time accounting. One process-global instance (the
+    pipeline is single-process on host; device parallelism happens inside a
+    stage) plus independent instances for tests."""
+
+    stages: dict[str, _Stage] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def stage(self, name: str, pairs: int = 0) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            st = self.stages.setdefault(name, _Stage())
+            st.pairs += int(pairs)
+            st.seconds += time.perf_counter() - t0
+            st.calls += 1
+
+    def add(self, name: str, pairs: int, seconds: float) -> None:
+        st = self.stages.setdefault(name, _Stage())
+        st.pairs += int(pairs)
+        st.seconds += float(seconds)
+        st.calls += 1
+
+    def report(self) -> dict[str, Any]:
+        import jax
+
+        n_chips = max(1, len(jax.devices()))
+        out: dict[str, Any] = {"n_chips": n_chips, "stages": {}}
+        total_pairs, total_seconds = 0, 0.0
+        for name, st in self.stages.items():
+            rate = st.pairs / st.seconds if st.seconds > 0 else 0.0
+            out["stages"][name] = {
+                "pairs": st.pairs,
+                "seconds": round(st.seconds, 4),
+                "calls": st.calls,
+                "pairs_per_sec": round(rate, 1),
+                "pairs_per_sec_per_chip": round(rate / n_chips, 1),
+            }
+            total_pairs += st.pairs
+            total_seconds += st.seconds
+        total_rate = total_pairs / total_seconds if total_seconds > 0 else 0.0
+        out["total"] = {
+            "pairs": total_pairs,
+            "seconds": round(total_seconds, 4),
+            "pairs_per_sec_per_chip": round(total_rate / n_chips, 1),
+        }
+        return out
+
+    def write(self, log_dir: str) -> str:
+        path = os.path.join(log_dir, "perf_counters.json")
+        with open(path, "w") as f:
+            json.dump(self.report(), f, indent=1, sort_keys=True)
+        return path
+
+    def reset(self) -> None:
+        self.stages.clear()
+
+
+counters = Counters()  # the process-global instance used by the pipeline
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None) -> Iterator[None]:
+    """jax.profiler.trace when a directory is given; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield

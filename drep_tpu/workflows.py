@@ -25,6 +25,12 @@ def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]
     initialize_distributed()
     wd = WorkDirectory(wd_loc)
     setup_logger(wd.get_dir("log"))
+    # fresh per-run state (library users may call several workflows per process)
+    from drep_tpu.cluster.anim import reset_run_state
+    from drep_tpu.utils.profiling import counters
+
+    counters.reset()
+    reset_run_state()
     if genomes:
         bdb = make_bdb(genomes)
         wd.store_db(bdb, "Bdb")
@@ -35,10 +41,34 @@ def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]
     return wd, bdb
 
 
+def _trace_dir(wd: WorkDirectory, profile) -> str | None:
+    if not profile:
+        return None
+    return profile if isinstance(profile, str) and profile != "auto" else wd.get_dir(
+        "log/jax_trace"
+    )
+
+
+def _finish_counters(wd: WorkDirectory) -> None:
+    from drep_tpu.utils.profiling import counters
+
+    rep = counters.report()
+    path = counters.write(wd.get_dir("log"))
+    total = rep["total"]
+    get_logger().info(
+        "perf: %d pairs in %.2fs = %s pairs/sec/chip (%d chip(s)) -> %s",
+        total["pairs"], total["seconds"], total["pairs_per_sec_per_chip"],
+        rep["n_chips"], path,
+    )
+
+
 def compare_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> pd.DataFrame:
     """`compare`: cluster + evaluate + analyze. Returns Cdb."""
+    from drep_tpu.utils.profiling import trace
+
     wd, bdb = _init(wd_loc, genomes or [])
-    cdb = d_cluster_wrapper(wd, bdb, **kwargs)
+    with trace(_trace_dir(wd, kwargs.pop("profile", None))):
+        cdb = d_cluster_wrapper(wd, bdb, **kwargs)
     # per-genome stats for downstream stages come from the ingest pass's Gdb
     # (one FASTA read per genome, not a second parse)
     wd.store_db(wd.get_db("Gdb")[["genome", "length", "N50", "contigs"]], "genomeInformation")
@@ -47,6 +77,7 @@ def compare_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> 
         from drep_tpu.analyze import plot_all
 
         plot_all(wd)
+    _finish_counters(wd)
     get_logger().info("compare finished: %d genomes, %d secondary clusters",
                       len(cdb), cdb["secondary_cluster"].nunique())
     return cdb
@@ -55,14 +86,18 @@ def compare_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> 
 def dereplicate_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> pd.DataFrame:
     """`dereplicate`: filter + cluster + choose + evaluate + analyze.
     Returns Wdb (the winners)."""
+    from drep_tpu.utils.profiling import trace
+
     wd, bdb = _init(wd_loc, genomes or [])
     filtered = d_filter_wrapper(wd, bdb, genomeInfo=kwargs.pop("genomeInfo", None), **kwargs)
-    d_cluster_wrapper(wd, filtered, **kwargs)
+    with trace(_trace_dir(wd, kwargs.pop("profile", None))):
+        d_cluster_wrapper(wd, filtered, **kwargs)
     wdb = d_choose_wrapper(wd, filtered, **kwargs)
     d_evaluate_wrapper(wd, **kwargs)
     if not kwargs.get("skip_plots", False):
         from drep_tpu.analyze import plot_all
 
         plot_all(wd)
+    _finish_counters(wd)
     get_logger().info("dereplicate finished: %d winners", len(wdb))
     return wdb

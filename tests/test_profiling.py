@@ -1,0 +1,55 @@
+"""Perf counters + profiler hook (SURVEY.md §5.1 rebuild requirement)."""
+
+import json
+import os
+
+from drep_tpu.utils.profiling import Counters, trace
+
+
+def test_counters_stage_accumulates():
+    c = Counters()
+    with c.stage("primary_compare", pairs=10):
+        pass
+    with c.stage("primary_compare", pairs=5):
+        pass
+    rep = c.report()
+    st = rep["stages"]["primary_compare"]
+    assert st["pairs"] == 15
+    assert st["calls"] == 2
+    assert st["seconds"] >= 0
+    assert rep["total"]["pairs"] == 15
+    assert rep["n_chips"] >= 1
+
+
+def test_counters_write(tmp_path):
+    c = Counters()
+    c.add("secondary_compare", pairs=100, seconds=0.5)
+    path = c.write(str(tmp_path))
+    with open(path) as f:
+        rep = json.load(f)
+    assert rep["stages"]["secondary_compare"]["pairs_per_sec"] == 200.0
+
+
+def test_trace_noop_and_real(tmp_path):
+    with trace(None):  # no-op path
+        pass
+    tdir = str(tmp_path / "trace")
+    with trace(tdir):
+        import jax.numpy as jnp
+
+        (jnp.ones(8) * 2).block_until_ready()
+    # jax wrote a plugins/profile tree
+    assert os.path.isdir(tdir)
+    assert any(os.scandir(tdir))
+
+
+def test_pipeline_writes_counters(tmp_path, genome_paths):
+    from drep_tpu.workflows import compare_wrapper
+
+    compare_wrapper(str(tmp_path / "wd"), genome_paths, skip_plots=True)
+    path = tmp_path / "wd" / "log" / "perf_counters.json"
+    assert path.exists()
+    with open(path) as f:
+        rep = json.load(f)
+    assert rep["stages"]["primary_compare"]["pairs"] == 10  # C(5,2)
+    assert "secondary_compare" in rep["stages"]

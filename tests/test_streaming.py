@@ -66,7 +66,8 @@ def test_streaming_edges_match_dense():
     packed = _random_packed()
     cutoff = 0.1
     dist, _ = all_vs_all_mash(packed, k=21)
-    ii, jj, dd = streaming_mash_edges(packed, k=21, cutoff=cutoff, block=16)
+    ii, jj, dd, pairs = streaming_mash_edges(packed, k=21, cutoff=cutoff, block=16)
+    assert pairs == packed.n * (packed.n - 1) // 2  # everything computed fresh
     dense_keep = {
         (i, j)
         for i in range(packed.n)
@@ -80,7 +81,7 @@ def test_streaming_edges_match_dense():
 def test_streaming_partition_matches_single_linkage():
     packed = _random_packed()
     p_ani = 0.9
-    labels_s, _ = streaming_primary_clusters(packed, k=21, p_ani=p_ani, block=16)
+    labels_s, _, _ = streaming_primary_clusters(packed, k=21, p_ani=p_ani, block=16)
     dist, _ = all_vs_all_mash(packed, k=21)
     labels_d, _ = cluster_hierarchical(dist, 1.0 - p_ani, method="single")
     assert _canon(labels_s) == _canon(labels_d)
@@ -89,22 +90,37 @@ def test_streaming_partition_matches_single_linkage():
 def test_streaming_checkpoint_resume(tmp_path):
     packed = _random_packed(n=40, s=32)
     ckpt = str(tmp_path / "ckpt")
-    ii1, jj1, dd1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    ii1, jj1, dd1, p1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
     shards = sorted(glob.glob(os.path.join(ckpt, "row_*.npz")))
     assert len(shards) == 5  # 40 / 8
+    assert p1 == 40 * 39 // 2
 
-    # delete two shards: resume must recompute exactly those and agree
+    # delete two shards: resume must recompute exactly those and agree;
+    # pairs_computed counts only the recomputed stripes
     os.remove(shards[1])
     os.remove(shards[3])
-    ii2, jj2, dd2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    ii2, jj2, dd2, p2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
     assert set(zip(ii2.tolist(), jj2.tolist())) == set(zip(ii1.tolist(), jj1.tolist()))
+    assert 0 < p2 < p1
+
+    # a corrupt shard is detected and recomputed, not fatal
+    with open(shards[2], "wb") as f:
+        f.write(b"not an npz")
+    ii2b, jj2b, _, _ = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    assert set(zip(ii2b.tolist(), jj2b.tolist())) == set(zip(ii1.tolist(), jj1.tolist()))
 
     # changed arguments invalidate the checkpoint (meta mismatch -> rebuild)
-    ii3, _, _ = streaming_mash_edges(packed, k=21, cutoff=0.3, block=8, checkpoint_dir=ckpt)
+    streaming_mash_edges(packed, k=21, cutoff=0.3, block=8, checkpoint_dir=ckpt)
     import json
 
     with open(os.path.join(ckpt, "meta.json")) as f:
         assert json.load(f)["cutoff"] == 0.3
+
+    # different genome content at identical shapes also invalidates (the
+    # int32 ids are a run-specific vocab remap — stale shards are garbage)
+    other = _random_packed(n=40, s=32, seed=9)
+    _, _, _, p_other = streaming_mash_edges(other, k=21, cutoff=0.3, block=8, checkpoint_dir=ckpt)
+    assert p_other == 40 * 39 // 2  # nothing was resumed
 
 
 def test_streaming_via_controller(tmp_path, genome_paths):
