@@ -241,23 +241,34 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         for i, g in enumerate(gs.names):
             secondary_names[g] = f"{primary[i]}_0"
     else:
+        from drep_tpu.cluster.secondary_ckpt import SecondaryCheckpoint
+
         greedy = kw["greedy_secondary_clustering"]
+        ckpt = SecondaryCheckpoint(
+            wd.get_dir(os.path.join("data", "secondary_checkpoints")),
+            snapshot, primary, gs.names,
+        )
         for pc in range(1, n_primary + 1):
             indices = [i for i in range(n) if primary[i] == pc]
             if len(indices) == 1:
                 secondary_names[gs.names[indices[0]]] = f"{pc}_1"
                 continue
             m = len(indices)
-            if greedy:
+            cached = ckpt.load(pc)
+            if cached is not None:
+                ndb, labels, link = cached  # resumed: 0 pairs counted
+            elif greedy:
                 from drep_tpu.cluster.greedy import greedy_secondary_cluster
 
                 with counters.stage("secondary_compare"):
                     ndb, labels = greedy_secondary_cluster(gs, bdb, indices, pc, kw)
                 counters.stages["secondary_compare"].pairs += len(ndb)  # actual comparisons made
                 link = np.empty((0, 4))
+                ckpt.save(pc, ndb, labels, link)
             else:
                 with counters.stage("secondary_compare", pairs=m * (m - 1) // 2):
                     ndb, labels, link = _secondary_for_cluster(gs, bdb, indices, pc, kw)
+                ckpt.save(pc, ndb, labels, link)
             ndb_parts.append(ndb)
             clustering_files["secondary"][pc] = {
                 "linkage": link,
@@ -265,6 +276,7 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
             }
             for idx, lab in zip(indices, labels):
                 secondary_names[gs.names[idx]] = f"{pc}_{lab}"
+        ckpt.finish(n_primary)
 
     ndb = (
         pd.concat(ndb_parts, ignore_index=True)

@@ -1,0 +1,78 @@
+"""Per-primary-cluster checkpointing of the secondary (ANI) stage.
+
+The reference's resume is stage-granular: a crash mid-secondary loses every
+finished cluster because Ndb/Cdb are only written at the end
+(drep/d_cluster — SURVEY.md §5.4; reference mount empty). Here each primary
+cluster's secondary result (Ndb rows, labels, linkage) is persisted the
+moment it finishes, keyed by a fingerprint of the clustering arguments AND
+the primary partition — so a preempted 100k-MAG run resumes exactly where
+it stopped, and any change to flags or upstream clustering invalidates the
+cache wholesale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.utils.ckptmeta import content_fingerprint, open_checkpoint_dir
+from drep_tpu.utils.logger import get_logger
+
+
+class SecondaryCheckpoint:
+    """Cluster-granular checkpoint store under
+    ``<wd>/data/secondary_checkpoints/``. Disabled (no-op) when dir is None."""
+
+    def __init__(self, ckpt_dir: str | None, snapshot: dict[str, Any], primary: np.ndarray, names: list[str]):
+        self.dir = ckpt_dir
+        self.n_resumed = 0
+        if ckpt_dir is None:
+            return
+        meta = {
+            "snapshot": json.loads(json.dumps(snapshot, sort_keys=True, default=str)),
+            "fingerprint": content_fingerprint(names, np.asarray(primary, dtype=np.int64)),
+        }
+        open_checkpoint_dir(ckpt_dir, meta, clear_suffixes=(".pkl",))
+
+    def _loc(self, pc: int) -> str:
+        return os.path.join(self.dir, f"pc_{pc:06d}.pkl")
+
+    def load(self, pc: int):
+        """(ndb, labels, link) for a finished cluster, or None."""
+        if self.dir is None:
+            return None
+        loc = self._loc(pc)
+        if not os.path.exists(loc):
+            return None
+        try:
+            with open(loc, "rb") as f:
+                payload = pickle.load(f)
+            self.n_resumed += 1
+            return payload["ndb"], payload["labels"], payload["link"]
+        except Exception:
+            get_logger().warning("secondary checkpoint: corrupt %s — recomputing", loc)
+            os.remove(loc)
+            return None
+
+    def save(self, pc: int, ndb: pd.DataFrame, labels: np.ndarray, link: np.ndarray) -> None:
+        if self.dir is None:
+            return
+        loc = self._loc(pc)
+        tmp = loc + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"ndb": ndb, "labels": labels, "link": link}, f)
+        os.replace(tmp, loc)  # atomic: no torn checkpoints
+
+    def finish(self, n_total: int) -> None:
+        if self.dir is None:
+            return
+        if self.n_resumed:
+            get_logger().info(
+                "secondary: resumed %d/%d primary clusters from checkpoints",
+                self.n_resumed, n_total,
+            )

@@ -26,9 +26,7 @@ module is the TPU-native supersession (SURVEY.md §7 step 8 / §5.4):
 
 from __future__ import annotations
 
-import json
 import os
-from typing import Any
 
 import numpy as np
 
@@ -36,7 +34,6 @@ from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_
 from drep_tpu.utils.logger import get_logger
 
 DEFAULT_BLOCK = 1024
-_META = "meta.json"
 
 
 def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
@@ -59,31 +56,6 @@ def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
     remap = np.empty(len(first_idx), dtype=np.int64)
     remap[np.argsort(first_idx)] = np.arange(1, len(first_idx) + 1)
     return remap[raw]
-
-
-def _checkpoint_valid(ckpt_dir: str, meta: dict[str, Any]) -> bool:
-    loc = os.path.join(ckpt_dir, _META)
-    if not os.path.exists(loc):
-        return False
-    with open(loc) as f:
-        stored = json.load(f)
-    return stored == meta
-
-
-def _fingerprint(packed: PackedSketches) -> str:
-    """Content hash of the packed sketches + genome order. The int32 ids are
-    a run-specific vocabulary remap (ops/minhash.pack_sketches), so shards
-    from a different genome set/order are meaningless even at identical N —
-    the checkpoint meta must pin the actual content, not just the shape."""
-    import hashlib
-
-    h = hashlib.sha1()
-    for name in packed.names:
-        h.update(name.encode())
-        h.update(b"\0")
-    h.update(np.ascontiguousarray(packed.counts).tobytes())
-    h.update(np.ascontiguousarray(packed.ids).tobytes())
-    return h.hexdigest()
 
 
 def _real_pairs_in_tile(i0: int, j0: int, block: int, n: int) -> int:
@@ -121,34 +93,29 @@ def streaming_mash_edges(
     n_blocks = nt // block
     devices = jax.devices()
 
-    meta = {
-        "n": n,
-        "block": block,
-        "k": k,
-        "cutoff": round(float(cutoff), 12),
-        "sketch_size": int(packed.sketch_size),
-        "n_blocks": n_blocks,
-        "fingerprint": _fingerprint(packed),
-    }
     resume = False
     if checkpoint_dir is not None:
-        os.makedirs(checkpoint_dir, exist_ok=True)
-        if _checkpoint_valid(checkpoint_dir, meta):
-            resume = True
-        else:
-            for f in os.listdir(checkpoint_dir):  # stale shards: clear
-                if f.endswith(".npz") or f == _META:
-                    os.remove(os.path.join(checkpoint_dir, f))
-            tmp = os.path.join(checkpoint_dir, _META + ".tmp")
-            with open(tmp, "w") as f:
-                json.dump(meta, f, sort_keys=True)
-            os.replace(tmp, os.path.join(checkpoint_dir, _META))
+        from drep_tpu.utils.ckptmeta import content_fingerprint, open_checkpoint_dir
+
+        meta = {
+            "n": n,
+            "block": block,
+            "k": k,
+            "cutoff": round(float(cutoff), 12),
+            "sketch_size": int(packed.sketch_size),
+            "n_blocks": n_blocks,
+            # shards from a different genome set/order are meaningless even
+            # at identical N (the int32 ids are a run-specific vocab remap)
+            "fingerprint": content_fingerprint(packed.names, packed.counts, packed.ids),
+        }
+        resume = open_checkpoint_dir(checkpoint_dir, meta, clear_suffixes=(".npz",))
 
     # the full padded pack lives on every device (N=100k, s=1000 -> ~400 MB,
     # well within HBM); tiles are sliced on device, so each block crosses
-    # PCIe exactly once per device instead of once per tile
-    ids_on = [jax.device_put(ids, dev) for dev in devices]
-    counts_on = [jax.device_put(counts, dev) for dev in devices]
+    # PCIe exactly once per device instead of once per tile. Deferred until
+    # a stripe actually computes — a fully-resumed run transfers nothing.
+    ids_on: list | None = None
+    counts_on: list | None = None
 
     all_ii: list[np.ndarray] = []
     all_jj: list[np.ndarray] = []
@@ -164,17 +131,24 @@ def streaming_mash_edges(
         )
         if resume and shard is not None and os.path.exists(shard):
             try:
+                # load ALL members before appending any: zip members are
+                # read lazily, so a partially-corrupt shard must not leave
+                # ii appended while jj/dist raise (misaligned edge arrays)
                 with np.load(shard) as z:
-                    all_ii.append(z["ii"])
-                    all_jj.append(z["jj"])
-                    all_dd.append(z["dist"])
+                    s_ii, s_jj, s_dd = z["ii"], z["jj"], z["dist"]
+                all_ii.append(s_ii)
+                all_jj.append(s_jj)
+                all_dd.append(s_dd)
                 n_resumed += 1
                 continue
-            except Exception:  # truncated/corrupt shard (killed mid-write
-                # before atomic replace existed, disk trouble): recompute it
+            except Exception:  # truncated/corrupt shard (disk trouble,
+                # pre-atomic writer): recompute it
                 logger.warning("streaming primary: corrupt shard %s — recomputing", shard)
                 os.remove(shard)
 
+        if ids_on is None:
+            ids_on = [jax.device_put(ids, dev) for dev in devices]
+            counts_on = [jax.device_put(counts, dev) for dev in devices]
         i0 = bi * block
         # dispatch the whole stripe asynchronously, one tile per device turn
         tiles = []

@@ -1,0 +1,66 @@
+"""Shared checkpoint-directory metadata protocol.
+
+Both shard-level checkpoint stores (parallel/streaming.py row-block shards,
+cluster/secondary_ckpt.py per-cluster results) follow the same contract:
+a ``meta.json`` pins the exact inputs the shards were computed from; on
+open, a matching meta means existing shards are resumable, a mismatch (or
+corrupt meta) clears the directory and atomically writes the new meta.
+One implementation so invalidation semantics can never drift apart.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Iterable
+
+import numpy as np
+
+META_NAME = "meta.json"
+
+
+def content_fingerprint(names: Iterable[str], *arrays: np.ndarray) -> str:
+    """SHA-1 over an ordered name list plus array contents. Pins checkpoint
+    validity to actual inputs — shape-only metas would silently accept
+    shards from a different genome set (the packed int32 ids are a
+    run-specific vocabulary remap)."""
+    h = hashlib.sha1()
+    for name in names:
+        h.update(str(name).encode())
+        h.update(b"\0")
+    for arr in arrays:
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tuple[str, ...]) -> bool:
+    """Prepare `ckpt_dir` for shard storage under `meta`.
+
+    Returns True when a matching meta already exists (existing shards are
+    resumable). Otherwise clears stale shards (files ending in any of
+    `clear_suffixes`, plus the meta) and atomically writes the new meta.
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    loc = os.path.join(ckpt_dir, META_NAME)
+    stored = None
+    if os.path.exists(loc):
+        try:
+            with open(loc) as f:
+                stored = json.load(f)
+        except Exception:
+            stored = None  # corrupt meta -> rebuild
+    if stored == meta:
+        return True
+    for f in os.listdir(ckpt_dir):
+        if f == META_NAME or any(f.endswith(s) for s in clear_suffixes):
+            os.remove(os.path.join(ckpt_dir, f))
+    atomic_write_bytes(loc, json.dumps(meta, sort_keys=True, default=str).encode())
+    return False

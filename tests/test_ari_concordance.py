@@ -1,0 +1,93 @@
+"""ARI concordance of the jax_ani clustering against planted ground truth.
+
+BASELINE.json's acceptance metric is Cdb >= 99% ARI versus a fastANI
+reference run. No fastANI binary exists in this environment (SURVEY.md §0),
+so the honest oracle is ground truth **by construction**: genomes generated
+by mutating common ancestors at controlled rates, giving known pairwise ANI
+on both sides of the S_ani=0.95 cliff —
+
+- 3 primary roots (independent random sequences; cross-root ANI ~0.75,
+  far below P_ani=0.9)
+- 2 secondary ancestors per root at 3.5% divergence (cross-secondary
+  ANI ~0.93: same primary cluster, different secondary)
+- 4 members per secondary ancestor at 1% divergence (within-secondary
+  ANI ~0.98: same secondary cluster)
+
+24 genomes, truth = 3 primary / 6 secondary clusters.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "genomes"))
+from generate import mutate, random_genome, write_fasta  # noqa: E402
+
+
+def adjusted_rand_index(a: list, b: list) -> float:
+    """Standard ARI from the pair-counting contingency table."""
+    a = pd.Categorical(a).codes
+    b = pd.Categorical(b).codes
+    n = len(a)
+    table = np.zeros((a.max() + 1, b.max() + 1), dtype=np.int64)
+    for x, y in zip(a, b):
+        table[x, y] += 1
+
+    def comb2(x):
+        return x * (x - 1) // 2
+
+    sum_ij = comb2(table).sum()
+    sum_a = comb2(table.sum(axis=1)).sum()
+    sum_b = comb2(table.sum(axis=0)).sum()
+    expected = sum_a * sum_b / comb2(n)
+    max_idx = (sum_a + sum_b) / 2
+    if max_idx == expected:
+        return 1.0
+    return (sum_ij - expected) / (max_idx - expected)
+
+
+@pytest.fixture(scope="module")
+def planted(tmp_path_factory):
+    rng = np.random.default_rng(1234)
+    out = tmp_path_factory.mktemp("planted")
+    paths, truth_primary, truth_secondary = [], [], []
+    for p in range(3):
+        root = random_genome(rng, 80_000)
+        for s in range(2):
+            ancestor = mutate(rng, root, 0.035)
+            for m in range(4):
+                seq = mutate(rng, ancestor, 0.01)
+                name = f"p{p}s{s}m{m}"
+                path = str(out / f"{name}.fasta")
+                write_fasta(path, seq, n_contigs=2, name=name)
+                paths.append(path)
+                truth_primary.append(p)
+                truth_secondary.append((p, s))
+    return paths, truth_primary, truth_secondary
+
+
+def test_ari_concordance_at_cliff(tmp_path, planted):
+    from drep_tpu.workflows import compare_wrapper
+
+    paths, truth_primary, truth_secondary = planted
+    cdb = compare_wrapper(str(tmp_path / "wd"), paths, skip_plots=True)
+    order = {os.path.basename(p): i for i, p in enumerate(paths)}
+    cdb = cdb.sort_values("genome", key=lambda s: s.map(order))
+
+    ari_primary = adjusted_rand_index(
+        truth_primary, list(cdb["primary_cluster"])
+    )
+    ari_secondary = adjusted_rand_index(
+        truth_secondary, list(cdb["secondary_cluster"])
+    )
+    assert ari_primary == 1.0, f"primary ARI {ari_primary}"
+    assert ari_secondary >= 0.99, f"secondary ARI {ari_secondary}"
+
+
+def test_ari_function_sanity():
+    assert adjusted_rand_index([1, 1, 2, 2], [5, 5, 9, 9]) == 1.0
+    assert adjusted_rand_index([1, 1, 2, 2], [1, 2, 1, 2]) < 0.1
+    assert adjusted_rand_index([1, 1, 1, 1], [1, 1, 1, 1]) == 1.0

@@ -78,3 +78,16 @@ def test_all_vs_all_matches_searchsorted_path(rng):
     ani_s, cov_s = all_vs_all_containment(packed, k=21)
     np.testing.assert_allclose(cov_p, cov_s, atol=1e-6)
     np.testing.assert_allclose(ani_p, ani_s, atol=1e-6)
+
+
+def test_symmetric_half_grid_matches_general(rng):
+    """The wrapped half-grid self-comparison must equal the rectangular
+    general path exactly, across tile-boundary row counts."""
+    from drep_tpu.ops.pallas_merge import intersect_counts_pallas_self
+
+    for n in (5, 128, 150, 300):
+        ids, _ = _random_rows(rng, n, 200, 150)
+        got = intersect_counts_pallas_self(ids)
+        want = intersect_counts_pallas(ids, ids)
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(got, got.T)

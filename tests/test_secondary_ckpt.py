@@ -1,0 +1,93 @@
+"""Per-cluster secondary checkpointing: resume, invalidation, corruption."""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.cluster.secondary_ckpt import SecondaryCheckpoint
+
+
+def _mk(tmp_path, snapshot=None, primary=None, names=None):
+    return SecondaryCheckpoint(
+        str(tmp_path / "ckpt"),
+        snapshot if snapshot is not None else {"S_ani": 0.95},
+        primary if primary is not None else np.array([1, 1, 2]),
+        names if names is not None else ["a", "b", "c"],
+    )
+
+
+def _payload():
+    ndb = pd.DataFrame({"reference": ["a"], "querry": ["b"], "ani": [0.97]})
+    return ndb, np.array([1, 1]), np.empty((0, 4))
+
+
+def test_save_load_roundtrip(tmp_path):
+    ck = _mk(tmp_path)
+    ndb, labels, link = _payload()
+    ck.save(1, ndb, labels, link)
+
+    ck2 = _mk(tmp_path)
+    got = ck2.load(1)
+    assert got is not None
+    pd.testing.assert_frame_equal(got[0], ndb)
+    np.testing.assert_array_equal(got[1], labels)
+    assert ck2.n_resumed == 1
+    assert ck2.load(2) is None
+
+
+def test_snapshot_change_invalidates(tmp_path):
+    ck = _mk(tmp_path)
+    ck.save(1, *_payload())
+    ck2 = _mk(tmp_path, snapshot={"S_ani": 0.99})
+    assert ck2.load(1) is None  # wholesale invalidation
+
+
+def test_primary_partition_change_invalidates(tmp_path):
+    ck = _mk(tmp_path)
+    ck.save(1, *_payload())
+    ck2 = _mk(tmp_path, primary=np.array([1, 2, 2]))
+    assert ck2.load(1) is None
+
+
+def test_corrupt_checkpoint_recomputed(tmp_path):
+    ck = _mk(tmp_path)
+    ck.save(1, *_payload())
+    pkl = glob.glob(str(tmp_path / "ckpt" / "pc_*.pkl"))[0]
+    with open(pkl, "wb") as f:
+        f.write(b"garbage")
+    ck2 = _mk(tmp_path)
+    assert ck2.load(1) is None  # detected, removed, recomputable
+    assert not os.path.exists(pkl)
+
+
+def test_disabled_is_noop():
+    ck = SecondaryCheckpoint(None, {}, np.array([1]), ["a"])
+    ck.save(1, *_payload())
+    assert ck.load(1) is None
+    ck.finish(1)
+
+
+def test_pipeline_resumes_secondary(tmp_path, genome_paths, monkeypatch):
+    """Crash after secondary checkpoints are written; rerun must reuse them."""
+    from drep_tpu.workflows import compare_wrapper
+
+    wd_loc = str(tmp_path / "wd")
+    compare_wrapper(wd_loc, genome_paths, skip_plots=True)
+    pkls = glob.glob(os.path.join(wd_loc, "data", "secondary_checkpoints", "pc_*.pkl"))
+    assert len(pkls) == 2  # two multi-member primary clusters in the fixture
+
+    # simulate a crash after secondary: remove Cdb/Ndb so the stage reruns,
+    # and make fresh ANI computation blow up — only checkpoints can succeed
+    os.remove(os.path.join(wd_loc, "data_tables", "Cdb.csv"))
+    os.remove(os.path.join(wd_loc, "data_tables", "Ndb.csv"))
+
+    def boom(*a, **k):
+        raise AssertionError("secondary recomputed despite valid checkpoints")
+
+    import drep_tpu.cluster.controller as ctl
+
+    monkeypatch.setattr(ctl, "_secondary_for_cluster", boom)
+    cdb = compare_wrapper(wd_loc, genome_paths, skip_plots=True)
+    assert cdb["secondary_cluster"].nunique() == 3
