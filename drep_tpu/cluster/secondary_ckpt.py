@@ -55,8 +55,13 @@ class SecondaryCheckpoint:
             self.n_resumed += 1
             return payload["ndb"], payload["labels"], payload["link"]
         except Exception:
-            get_logger().warning("secondary checkpoint: corrupt %s — recomputing", loc)
-            os.remove(loc)
+            get_logger().warning("secondary checkpoint: unreadable %s — recomputing", loc)
+            # the remove may itself fail (EACCES, flaky NFS) — degrade to
+            # recompute either way; a checkpoint must never kill the run
+            import contextlib
+
+            with contextlib.suppress(OSError):
+                os.remove(loc)
             return None
 
     def save(self, pc: int, ndb: pd.DataFrame, labels: np.ndarray, link: np.ndarray) -> None:

