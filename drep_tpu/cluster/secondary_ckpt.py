@@ -52,8 +52,9 @@ class SecondaryCheckpoint:
         try:
             with open(loc, "rb") as f:
                 payload = pickle.load(f)
-            self.n_resumed += 1
-            return payload["ndb"], payload["labels"], payload["link"]
+            result = payload["ndb"], payload["labels"], payload["link"]
+            self.n_resumed += 1  # only after the payload fully validates
+            return result
         except Exception:
             get_logger().warning("secondary checkpoint: unreadable %s — recomputing", loc)
             # the remove may itself fail (EACCES, flaky NFS) — degrade to

@@ -111,14 +111,16 @@ def matmul_vocab_pad(packed: PackedSketches) -> int:
     return -(-vmax // _VOCAB_BUCKET) * _VOCAB_BUCKET
 
 
-@functools.partial(jax.jit, static_argnames=("v_pad", "k"))
-def _containment_matmul(ids, counts, *, v_pad: int, k: int):
+@functools.partial(jax.jit, static_argnames=("v_pad",))
+def _intersect_matmul(ids, *, v_pad: int):
     """Intersection counts as an MXU matmul of 0/1 indicator rows.
 
-    counts[i,j] = |A_i ∩ A_j| = <ind_i, ind_j> over the id vocabulary —
+    inter[i,j] = |A_i ∩ A_j| = <ind_i, ind_j> over the id vocabulary —
     bf16 0/1 inputs with f32 accumulation are exact up to 2^24. This is
     where the systolic array earns its keep: one [m, V] x [V, m] matmul
-    replaces m^2 searchsorted passes.
+    replaces m^2 searchsorted passes. Returns int32 counts: the device
+    ships ONE integer matrix and the cov/ani elementwise math runs on host
+    (host<->device links can be the bottleneck on tunneled TPU setups).
     """
     m, s = ids.shape
     rows = jax.lax.broadcasted_iota(jnp.int32, (m, s), 0)
@@ -127,9 +129,21 @@ def _containment_matmul(ids, counts, *, v_pad: int, k: int):
     ind = jnp.zeros((m, v_pad + 1), jnp.bfloat16).at[rows, cols].set(1.0)
     ind = ind[:, :v_pad]
     inter = jnp.dot(ind, ind.T, preferred_element_type=jnp.float32)
-    na = jnp.maximum(counts.astype(jnp.float32), 1.0)
-    cov = inter / na[:, None]
-    ani = jnp.where(cov > 0.0, jnp.exp(jnp.log(jnp.maximum(cov, 1e-30)) / k), 0.0)
+    return inter.astype(jnp.int32)
+
+
+def ani_cov_from_intersections(
+    inter: np.ndarray, counts: np.ndarray, k: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host: directional (ani, cov) from intersection counts.
+    cov = |A∩B|/|A|, ani = cov^(1/k), diagonals pinned to 1."""
+    na = np.maximum(counts.astype(np.float32), 1.0)
+    cov = inter.astype(np.float32) / na[:, None]
+    ani = np.where(cov > 0.0, np.exp(np.log(np.maximum(cov, 1e-30)) / k), 0.0)
+    ani = ani.astype(np.float32)
+    cov = cov.astype(np.float32)
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
     return ani, cov
 
 
@@ -142,14 +156,8 @@ def all_vs_all_containment_matmul(
     :func:`matmul_vocab_pad`) to avoid rescanning packed.ids."""
     if v_pad is None:
         v_pad = matmul_vocab_pad(packed)
-    ani, cov = _containment_matmul(
-        jnp.asarray(packed.ids), jnp.asarray(packed.counts), v_pad=v_pad, k=k
-    )
-    ani = np.array(ani)
-    cov = np.array(cov)
-    np.fill_diagonal(ani, 1.0)
-    np.fill_diagonal(cov, 1.0)
-    return ani, cov
+    inter = np.asarray(_intersect_matmul(jnp.asarray(packed.ids), v_pad=v_pad))
+    return ani_cov_from_intersections(inter, packed.counts, k)
 
 
 def all_vs_all_containment(

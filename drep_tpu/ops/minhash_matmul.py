@@ -48,7 +48,7 @@ from drep_tpu.ops.minhash import PAD_ID, PackedSketches, mash_distance_from_jacc
 DEFAULT_CHUNK_ENTRIES = 16384
 
 
-def _build_chunks(ids: np.ndarray, counts: np.ndarray, chunk_entries: int):
+def _build_chunks(ids: np.ndarray, chunk_entries: int):
     """Column-sorted (row, dense-col) chunk tensors, padded to a common
     width; chunks never split a column (inner products need every
     occurrence of a hash id in the same chunk)."""
@@ -128,9 +128,12 @@ def _below_counts(ids: np.ndarray, counts: np.ndarray, thresholds: np.ndarray) -
 
 
 def _jaccard_host(inter: np.ndarray, below: np.ndarray, counts: np.ndarray, t: np.ndarray, k: int):
-    """Host (numpy) mirror of `_jaccard_from_counts` — the [N, N] elementwise
-    math is a few hundred MFLOP, far cheaper than shipping `below` up and
-    two result matrices back over a slow host<->device link."""
+    """Common-threshold Jaccard + Mash distance, on host: the [N, N]
+    elementwise math is a few hundred MFLOP, far cheaper than shipping
+    `below` up and two result matrices back over a slow host<->device link.
+    u = restricted union at t_min = min(t_i, t_j); the side with the larger
+    threshold is a complete sample below t_min, the other contributes its
+    below-threshold count."""
     nf = counts.astype(np.float32)
     inter = inter.astype(np.float32)
     t_i = t[:, None]
@@ -165,7 +168,7 @@ def all_vs_all_mash_matmul(
     t = np.where(
         counts > 0, ids[np.arange(n), np.maximum(counts - 1, 0)], np.int32(-1)
     ).astype(np.int32)
-    rows_c, dcol_c = _build_chunks(ids, counts, chunk_entries)
+    rows_c, dcol_c = _build_chunks(ids, chunk_entries)
     # minimize link traffic: int16 chunk tensors up (when shapes fit), a
     # single int16 count matrix down, everything elementwise on host
     width = rows_c.shape[1]

@@ -253,12 +253,7 @@ def all_vs_all_containment_pallas(
     Same contract as ops/containment.py's other all_vs_all_* paths:
     cov[i,j] = |A_i ∩ A_j| / |A_i|, ani = cov^(1/k), diagonal pinned to 1.
     """
-    inter = intersect_counts_pallas_self(packed.ids).astype(np.float32)
-    na = np.maximum(packed.counts.astype(np.float32), 1.0)
-    cov = inter / na[:, None]
-    ani = np.where(cov > 0.0, np.exp(np.log(np.maximum(cov, 1e-30)) / k), 0.0)
-    ani = ani.astype(np.float32)
-    cov = cov.astype(np.float32)
-    np.fill_diagonal(ani, 1.0)
-    np.fill_diagonal(cov, 1.0)
-    return ani, cov
+    from drep_tpu.ops.containment import ani_cov_from_intersections
+
+    inter = intersect_counts_pallas_self(packed.ids)
+    return ani_cov_from_intersections(inter, packed.counts, k)
