@@ -131,8 +131,8 @@ def _primary_clusters(
     if kw["multiround_primary_clustering"] and n > kw["primary_chunksize"]:
         from drep_tpu.cluster.multiround import multiround_primary_clustering
 
-        labels = multiround_primary_clustering(gs, bdb, kw)
-        return labels, None, np.empty((0, 4)), None, 0
+        labels, pairs_done = multiround_primary_clustering(gs, bdb, kw)
+        return labels, None, np.empty((0, 4)), None, pairs_done
     if kw["streaming_primary"] or (
         kw["primary_algorithm"] == "jax_mash" and n >= kw["streaming_threshold"]
     ):

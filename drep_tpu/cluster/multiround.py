@@ -46,7 +46,8 @@ def _cluster_chunk(
 
 def multiround_primary_clustering(
     gs: GenomeSketches, bdb: pd.DataFrame, kw: dict[str, Any]
-) -> np.ndarray:
+) -> tuple[np.ndarray, int]:
+    """Returns (labels 1..C, pairs actually compared across both rounds)."""
     logger = get_logger()
     n = len(gs.names)
     chunk = int(kw["primary_chunksize"])
@@ -59,8 +60,10 @@ def multiround_primary_clustering(
     # round 1: within-chunk clustering, elect representatives
     rep_of_genome = np.zeros(n, dtype=np.int64)  # genome -> its representative index
     reps: list[int] = []
+    pairs_compared = 0
     for c0 in range(0, n, chunk):
         idx = list(range(c0, min(c0 + chunk, n)))
+        pairs_compared += len(idx) * (len(idx) - 1) // 2
         labels = _cluster_chunk(gs, idx, cutoff, method, mesh_shape, estimator)
         for lab in range(1, int(labels.max()) + 1):
             members = [idx[t] for t in range(len(idx)) if labels[t] == lab]
@@ -71,6 +74,7 @@ def multiround_primary_clustering(
     logger.info("multiround: %d chunks -> %d representatives", -(-n // chunk), len(reps))
 
     # round 2: cluster the representatives
+    pairs_compared += len(reps) * (len(reps) - 1) // 2
     rep_labels = _cluster_chunk(gs, reps, cutoff, method, mesh_shape, estimator)
     label_of_rep = {rep: int(rep_labels[t]) for t, rep in enumerate(reps)}
 
@@ -82,4 +86,4 @@ def multiround_primary_clustering(
         if int(lab) not in seen:
             seen[int(lab)] = len(seen) + 1
         out[i] = seen[int(lab)]
-    return out
+    return out, pairs_compared

@@ -71,12 +71,30 @@ def evaluate_warnings(
     return warnings
 
 
+def make_widb(wdb: pd.DataFrame, cdb: pd.DataFrame, stats: pd.DataFrame | None, quality: pd.DataFrame | None) -> pd.DataFrame:
+    """Winner-information table (upstream d_evaluate's Widb): one row per
+    winner with its cluster and available stats/quality columns."""
+    widb = wdb.merge(cdb[["genome", "primary_cluster", "secondary_cluster"]], on="genome", how="left")
+    if stats is not None:
+        widb = widb.merge(stats[["genome", "length", "N50"]], on="genome", how="left")
+    if quality is not None:
+        cols = [c for c in ("genome", "completeness", "contamination", "strain_heterogeneity") if c in quality.columns]
+        widb = widb.merge(quality[cols], on="genome", how="left")
+    return widb
+
+
 def d_evaluate_wrapper(wd: WorkDirectory, **kwargs) -> list[str]:
     logger = get_logger()
     mdb = wd.get_db("Mdb") if wd.hasDb("Mdb") else None
     ndb = wd.get_db("Ndb") if wd.hasDb("Ndb") else None
     cdb = wd.get_db("Cdb")
-    wdb = wd.get_db("Wdb") if wd.hasDb("Wdb") else pd.DataFrame({"genome": cdb["genome"]})
+    has_wdb = wd.hasDb("Wdb")
+    wdb = wd.get_db("Wdb") if has_wdb else pd.DataFrame({"genome": cdb["genome"]})
+
+    if has_wdb:
+        stats = wd.get_db("genomeInformation") if wd.hasDb("genomeInformation") else None
+        quality = wd.get_db("genomeInfo") if wd.hasDb("genomeInfo") else None
+        wd.store_db(make_widb(wdb, cdb, stats, quality), "Widb")
 
     warnings = evaluate_warnings(mdb, ndb, cdb, wdb, **kwargs)
     path = wd.get_loc("warnings")

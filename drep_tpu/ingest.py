@@ -52,15 +52,18 @@ def _sketch_one(args) -> tuple[str, dict]:
 
     contigs = read_fasta_contigs(path)
     lengths = np.array([len(c) for c in contigs], dtype=np.int64)
-    all_hashes = [kmers.kmer_hashes(c, k) for c in contigs] or [np.empty(0, np.uint64)]
-    hashes = np.unique(np.concatenate(all_hashes))
+    raw = np.concatenate(
+        [kmers.splitmix64(kmers.packed_kmers(c, k)) for c in contigs]
+        or [np.empty(0, np.uint64)]
+    )
+    bottom, scaled, n_kmers = kmers.sketches_from_raw(raw, sketch_size, scale)
     return name, {
         "length": int(lengths.sum()) if len(lengths) else 0,
         "N50": n50(lengths),
         "contigs": len(contigs),
-        "n_kmers": int(hashes.size),
-        "bottom": kmers.bottom_k_sketch(hashes, sketch_size),
-        "scaled": kmers.scaled_sketch(hashes, scale),
+        "n_kmers": n_kmers,
+        "bottom": bottom,
+        "scaled": scaled,
     }
 
 

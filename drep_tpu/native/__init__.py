@@ -101,10 +101,10 @@ def get_library() -> ctypes.CDLL | None:
 
 
 def scaled_max_hash(scale: int) -> int:
-    """FracMinHash threshold — must equal ops/kmers.py::scaled_sketch."""
-    if scale <= 0:
-        raise ValueError("scale must be positive")
-    return (1 << 64) // scale - 1 if scale > 1 else (1 << 64) - 1
+    """FracMinHash threshold — the shared definition in ops/kmers.py."""
+    from drep_tpu.ops.kmers import max_scaled_hash
+
+    return max_scaled_hash(scale)
 
 
 def sketch_fasta_native(
@@ -135,11 +135,15 @@ def sketch_fasta_native(
         scaled = np.ctypeslib.as_array(out.scaled, shape=(out.scaled_len,)).copy()
     finally:
         lib.drep_sketch_free(ctypes.byref(out))
+    # n_kmers == -1 marks the FracMinHash fast path: the native side never
+    # built the full distinct set, so report the standard cardinality
+    # estimate |scaled| * scale (ops/kmers.py::sketches_from_raw rule)
+    n_kmers = int(out.n_kmers) if out.n_kmers >= 0 else int(out.scaled_len) * scale
     return {
         "length": int(out.length),
         "N50": int(out.n50),
         "contigs": int(out.n_contigs),
-        "n_kmers": int(out.n_kmers),
+        "n_kmers": n_kmers,
         "bottom": bottom.astype(np.uint64),
         "scaled": scaled.astype(np.uint64),
     }

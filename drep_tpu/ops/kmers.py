@@ -86,13 +86,38 @@ def bottom_k_sketch(hashes: np.ndarray, sketch_size: int) -> np.ndarray:
     return hashes[:sketch_size]
 
 
+def max_scaled_hash(scale: int) -> int:
+    """FracMinHash threshold: hashes <= this value are in the scaled sketch.
+    THE single definition — the numpy paths and the native-ingest binding
+    (drep_tpu/native) must all use it so the sketches stay byte-equal."""
+    if scale <= 0:
+        raise ValueError("scale must be positive")
+    return (1 << 64) // scale - 1 if scale > 1 else (1 << 64) - 1
+
+
 def scaled_sketch(hashes: np.ndarray, scale: int) -> np.ndarray:
     """FracMinHash ("scaled") sketch: all unique hashes below 2^64/scale.
 
     Sketch size tracks genome size (|kmers|/scale in expectation), which
     makes containment — and hence ANI — estimable from sketches alone.
     """
-    if scale <= 0:
-        raise ValueError("scale must be positive")
-    max_hash = np.uint64((1 << 64) // scale - 1) if scale > 1 else np.uint64(0xFFFFFFFFFFFFFFFF)
-    return hashes[hashes <= max_hash]
+    return hashes[hashes <= np.uint64(max_scaled_hash(scale))]
+
+
+def sketches_from_raw(raw: np.ndarray, sketch_size: int, scale: int):
+    """(bottom, scaled, n_kmers) from RAW canonical k-mer hashes (duplicates
+    retained, unsorted) — the FracMinHash-first fast path.
+
+    When the scaled (<= 2^64/scale) distinct set already holds >= sketch_size
+    hashes, the bottom-s sketch is exactly its first s entries, so the full
+    multi-million-hash sort/dedup is skipped entirely and `n_kmers` is the
+    standard FracMinHash cardinality estimate |scaled| * scale (used only for
+    representative-ordering heuristics). Small genomes fall back to the exact
+    full dedup. The native C++ ingest (drep_tpu/native/ingest.cc) implements
+    the IDENTICAL rule — the two paths must stay byte-equal.
+    """
+    small_u = np.unique(raw[raw <= np.uint64(max_scaled_hash(scale))])
+    if small_u.size >= sketch_size > 0:
+        return small_u[:sketch_size], small_u, int(small_u.size) * scale
+    full = np.unique(raw)
+    return full[:sketch_size], small_u, int(full.size)

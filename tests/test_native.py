@@ -37,16 +37,18 @@ K, SKETCH, SCALE = 21, 1000, 200
 def _oracle(path):
     contigs = read_fasta_contigs(path)
     lengths = np.array([len(c) for c in contigs], dtype=np.int64)
-    hashes = np.unique(
-        np.concatenate([kmers.kmer_hashes(c, K) for c in contigs] or [np.empty(0, np.uint64)])
+    raw = np.concatenate(
+        [kmers.splitmix64(kmers.packed_kmers(c, K)) for c in contigs]
+        or [np.empty(0, np.uint64)]
     )
+    bottom, scaled, n_kmers = kmers.sketches_from_raw(raw, SKETCH, SCALE)
     return {
         "length": int(lengths.sum()) if len(lengths) else 0,
         "N50": n50(lengths),
         "contigs": len(contigs),
-        "n_kmers": int(hashes.size),
-        "bottom": kmers.bottom_k_sketch(hashes, SKETCH),
-        "scaled": kmers.scaled_sketch(hashes, SCALE),
+        "n_kmers": n_kmers,
+        "bottom": bottom,
+        "scaled": scaled,
     }
 
 
@@ -142,3 +144,25 @@ def test_pipeline_uses_native_transparently(bdb):
     finally:
         del os.environ["DREP_TPU_NO_NATIVE"]
     _assert_equal(via_native, via_numpy)
+
+
+@needs_native
+def test_native_fast_path_matches_oracle(tmp_path):
+    """A genome big enough that the scaled set holds >= sketch_size hashes
+    takes the FracMinHash fast path (skips the full dedup) — both paths
+    must take it identically: same bottom/scaled sketches, same estimated
+    n_kmers."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "genomes"))
+    from generate import random_genome, write_fasta
+
+    rng = np.random.default_rng(7)
+    path = str(tmp_path / "big.fasta")
+    write_fasta(path, random_genome(rng, 1_500_000), n_contigs=10, name="big")
+
+    native = sketch_fasta_native(path, K, SKETCH, SCALE)
+    oracle = _oracle(path)
+    assert len(oracle["scaled"]) >= SKETCH, "fixture too small for the fast path"
+    assert oracle["n_kmers"] == len(oracle["scaled"]) * SCALE  # estimated
+    _assert_equal(native, oracle)

@@ -118,3 +118,20 @@ def test_multiround_matches_default_primary(tmp_path, genome_paths):
     prim_multi = cdb_multi.groupby("primary_cluster")["genome"].apply(frozenset)
     assert set(prim_default) == set(prim_multi)
     assert _partition(cdb_default) == _partition(cdb_multi)
+
+
+# ---- evaluate: Widb ---------------------------------------------------------
+
+
+def test_widb_written_on_dereplicate(tmp_path, genome_paths):
+    names = [p.split("/")[-1] for p in genome_paths]
+    q = _quality_df(names)
+    qpath = str(tmp_path / "q.csv")
+    q.to_csv(qpath, index=False)
+    wdb = dereplicate_wrapper(
+        str(tmp_path / "wd"), genome_paths, genomeInfo=qpath, skip_plots=True
+    )
+    widb = pd.read_csv(tmp_path / "wd" / "data_tables" / "Widb.csv")
+    assert set(widb["genome"]) == set(wdb["genome"])
+    for col in ("secondary_cluster", "length", "N50", "completeness", "contamination", "score"):
+        assert col in widb.columns, col
