@@ -87,16 +87,29 @@ def _fill_defaults(kwargs: dict[str, Any]) -> dict[str, Any]:
     return out
 
 
-def _mdb_from_dist(dist: np.ndarray, names: list[str], dense_limit: int, p_ani: float) -> pd.DataFrame:
+def _warn_dist(kw: dict[str, Any]) -> float:
+    """warn_dist for sparse-Mdb retention — the evaluate stage's default,
+    honoring an explicit 0.0 (warnings disabled)."""
+    from drep_tpu.evaluate import EVALUATE_DEFAULTS
+
+    v = kw.get("warn_dist")
+    return EVALUATE_DEFAULTS["warn_dist"] if v is None else float(v)
+
+
+def _mdb_from_dist(
+    dist: np.ndarray, names: list[str], dense_limit: int, p_ani: float, warn_dist: float = 0.25
+) -> pd.DataFrame:
     """Pair table from the distance matrix. Dense (all N^2 ordered pairs,
     reference-style) for small N; thresholded sparse beyond `dense_limit`
-    so a 100k-genome Mdb does not need 10^10 rows."""
+    so a 100k-genome Mdb does not need 10^10 rows. The sparse threshold
+    keeps pairs up to max(1-P_ani, warn_dist) so the evaluate stage still
+    sees near-threshold winner pairs."""
     n = len(names)
     if n <= dense_limit:
         ii, jj = np.meshgrid(np.arange(n), np.arange(n), indexing="ij")
         ii, jj = ii.ravel(), jj.ravel()
     else:
-        keep = dist <= (1.0 - p_ani)
+        keep = dist <= max(1.0 - p_ani, warn_dist)
         np.fill_diagonal(keep, True)
         ii, jj = np.nonzero(keep)
     d = dist[ii, jj]
@@ -153,6 +166,7 @@ def _primary_clusters(
             kw["P_ani"],
             block=kw["streaming_block"],
             checkpoint_dir=ckpt,
+            keep_dist=_warn_dist(kw),  # evaluate-stage visibility
         )
         return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names), pairs_computed
     engine = dispatch.get_primary(kw["primary_algorithm"])
@@ -223,7 +237,10 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     logger.info("primary clustering: %d clusters from %d genomes", n_primary, n)
 
     if pdist is not None:
-        mdb = _mdb_from_dist(pdist, gs.names, kw["mdb_dense_limit"], kw["P_ani"])
+        mdb = _mdb_from_dist(
+            pdist, gs.names, kw["mdb_dense_limit"], kw["P_ani"],
+            warn_dist=_warn_dist(kw),
+        )
         wd.store_db(schemas.validate(mdb, "Mdb"), "Mdb")
     elif sparse_mdb is not None:
         wd.store_db(schemas.validate(sparse_mdb, "Mdb"), "Mdb")

@@ -62,6 +62,7 @@ def greedy_secondary_cluster(
     labels_ordered = np.zeros(m, dtype=np.int64)
     reps: list[int] = []  # positions (in `order` space) of representatives
     ndb_rows: list[dict] = []
+    name_arr = np.array(packed.names)  # invariant across blocks
 
     for b0 in range(0, m, block):
         rows = list(range(b0, min(b0 + block, m)))
@@ -91,40 +92,47 @@ def greedy_secondary_cluster(
         a_blk, c_blk = containment_ani_tile(b_ids, b_counts, b_ids, b_counts, k=gs.k)
         a_blk, c_blk = np.asarray(a_blk), np.asarray(c_blk)
 
+        # assignment: sequential over genomes (a genome can become a rep
+        # mid-block) but VECTORIZED over reps — the O(reps) inner work is
+        # numpy row math, never a Python pair loop (100k-scale requirement)
+        n_pre = len(reps)  # reps existing before this block (all < b0)
+        in_block: list[int] = []  # block-local positions of mid-block reps
         for t, pos in enumerate(rows):
-            best_lab, best_ani = 0, 0.0
-            for ri, rep_pos in enumerate(reps):
-                if rep_pos >= b0:  # rep created inside this block
-                    ani_v = a_blk[t, rep_pos - b0]
-                    cov_v = c_blk[t, rep_pos - b0]
-                    cov_r = c_blk[rep_pos - b0, t]
-                else:
-                    ani_v = ani_vs_reps[t, ri]
-                    cov_v = cov_vs_reps[t, ri]
-                    cov_r = cov_rev_reps[t, ri]
+            ani_row = np.concatenate([ani_vs_reps[t, :n_pre], a_blk[t, in_block]])
+            cov_row = np.concatenate([cov_vs_reps[t, :n_pre], c_blk[t, in_block]])
+            cov_rev = np.concatenate([cov_rev_reps[t, :n_pre], c_blk[in_block, t]])
+            if len(ani_row):
+                rep_pos_arr = np.array(reps, dtype=np.int64)
                 ndb_rows.append(
                     {
-                        "reference": packed.names[rep_pos],
-                        "querry": packed.names[pos],
-                        "ani": float(ani_v),
-                        "alignment_coverage": float(cov_v),
-                        "ref_coverage": float(cov_r),
-                        "querry_coverage": float(cov_v),
-                        "primary_cluster": pc,
+                        "reference": name_arr[rep_pos_arr],
+                        "querry": np.repeat(name_arr[pos], len(ani_row)),
+                        "ani": ani_row.astype(np.float64),
+                        "alignment_coverage": cov_row.astype(np.float64),
+                        "ref_coverage": cov_rev.astype(np.float64),
+                        "querry_coverage": cov_row.astype(np.float64),
                     }
                 )
-                if ani_v >= s_ani and cov_v >= cov_thresh and cov_r >= cov_thresh and ani_v > best_ani:
-                    best_lab, best_ani = ri + 1, float(ani_v)
-            if best_lab == 0:
-                reps.append(pos)
-                best_lab = len(reps)
-            labels_ordered[pos] = best_lab
+                ok = (ani_row >= s_ani) & (cov_row >= cov_thresh) & (cov_rev >= cov_thresh)
+                if ok.any():
+                    masked = np.where(ok, ani_row, -1.0)
+                    labels_ordered[pos] = int(np.argmax(masked)) + 1
+                    continue
+            reps.append(pos)
+            in_block.append(pos - b0)
+            labels_ordered[pos] = len(reps)
 
     # back to the original `indices` order
     labels = np.zeros(m, dtype=np.int64)
     for t in range(m):
         labels[order[t]] = labels_ordered[t]
-    ndb = pd.DataFrame(ndb_rows) if ndb_rows else pd.DataFrame(
-        columns=["reference", "querry", "ani", "alignment_coverage", "ref_coverage", "querry_coverage", "primary_cluster"]
-    )
+    if ndb_rows:
+        ndb = pd.DataFrame(
+            {key: np.concatenate([r[key] for r in ndb_rows]) for key in ndb_rows[0]}
+        )
+        ndb["primary_cluster"] = pc
+    else:
+        ndb = pd.DataFrame(
+            columns=["reference", "querry", "ani", "alignment_coverage", "ref_coverage", "querry_coverage", "primary_cluster"]
+        )
     return ndb, labels

@@ -10,8 +10,10 @@ module is the TPU-native supersession (SURVEY.md §7 step 8 / §5.4):
 - the (i, j) row-block tile grid is walked host-side; each tile is computed
   on device (round-robined over all local chips — JAX dispatch is async, so
   D tiles are in flight at once) and immediately **thresholded on host**:
-  only edges with ``dist <= cutoff`` survive. Memory is O(edges), never
-  O(N^2).
+  only edges with ``dist <= cutoff`` survive (callers pass
+  max(1-P_ani, warn_dist) so the sparse Mdb keeps evaluate-stage
+  near-threshold pairs; clustering re-filters to <= 1-P_ani). Memory is
+  O(edges), never O(N^2).
 - every finished row-block appends a checkpoint shard
   (``row_XXXXX.npz`` with its surviving edges) under the work directory;
   a preempted run resumes by skipping finished shards — the shard-level
@@ -208,15 +210,20 @@ def streaming_primary_clusters(
     p_ani: float,
     block: int = DEFAULT_BLOCK,
     checkpoint_dir: str | None = None,
+    keep_dist: float = 0.0,
 ) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray], int]:
-    """Streaming primary clustering: (labels 1..C, thresholded edges,
-    pairs actually computed this call).
+    """Streaming primary clustering: (labels 1..C, retained edges, pairs
+    actually computed this call).
 
-    Edges are exactly the pairs a sparse Mdb keeps (dist <= 1 - P_ani).
+    Edges are retained up to max(1 - P_ani, keep_dist) — pass the evaluate
+    stage's warn_dist so near-threshold winner pairs stay visible in the
+    sparse Mdb; clustering itself uses only edges <= 1 - P_ani.
     """
     cutoff = 1.0 - p_ani
+    keep = max(cutoff, keep_dist)
     ii, jj, dd, pairs_computed = streaming_mash_edges(
-        packed, k, cutoff, block=block, checkpoint_dir=checkpoint_dir
+        packed, k, keep, block=block, checkpoint_dir=checkpoint_dir
     )
-    labels = connected_components(packed.n, ii, jj)
+    in_cluster = dd <= cutoff
+    labels = connected_components(packed.n, ii[in_cluster], jj[in_cluster])
     return labels, (ii, jj, dd), pairs_computed
