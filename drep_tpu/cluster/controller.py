@@ -77,6 +77,7 @@ _RESUME_KEYS = [
     "run_tertiary_clustering",
     "streaming_primary",
     "streaming_threshold",  # auto-enables streaming, which changes linkage
+    "warn_dist",  # shapes the sparse Mdb's retention threshold
     "genomes",
 ]
 
@@ -97,7 +98,7 @@ def _warn_dist(kw: dict[str, Any]) -> float:
 
 
 def _mdb_from_dist(
-    dist: np.ndarray, names: list[str], dense_limit: int, p_ani: float, warn_dist: float = 0.25
+    dist: np.ndarray, names: list[str], dense_limit: int, p_ani: float, warn_dist: float
 ) -> pd.DataFrame:
     """Pair table from the distance matrix. Dense (all N^2 ordered pairs,
     reference-style) for small N; thresholded sparse beyond `dense_limit`

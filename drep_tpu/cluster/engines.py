@@ -119,12 +119,14 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
 
     from drep_tpu.ops.containment import (
         MATMUL_BUDGET_ELEMS,
+        ROW_BUCKET,
         all_vs_all_containment_matmul,
         matmul_vocab_pad,
     )
 
     v_pad = matmul_vocab_pad(packed)  # one scan; budget uses the REAL width
-    if packed.n * (v_pad + 1) <= MATMUL_BUDGET_ELEMS:
+    m_bucketed = -(-packed.n // ROW_BUCKET) * ROW_BUCKET  # what gets allocated
+    if m_bucketed * (v_pad + 1) <= MATMUL_BUDGET_ELEMS:
         return all_vs_all_containment_matmul(packed, k=k, v_pad=v_pad)
     mesh = _mesh_or_none(mesh_shape, packed.n)
     if mesh is not None:

@@ -147,16 +147,28 @@ def ani_cov_from_intersections(
     return ani, cov
 
 
+ROW_BUCKET = 64  # row-count quantum: caps XLA compilations across clusters
+# (public: the dispatch budget check must use the BUCKETED row count)
+
+
 def all_vs_all_containment_matmul(
     packed: PackedSketches, k: int = 21, v_pad: int | None = None
 ) -> tuple[np.ndarray, np.ndarray]:
     """MXU path for the directional (ani, cov) matrices. Use when
     m * (v_pad+1) fits MATMUL_BUDGET_ELEMS; exact-equal to the searchsorted
     path (verified in tests). Pass a precomputed `v_pad` (from
-    :func:`matmul_vocab_pad`) to avoid rescanning packed.ids."""
+    :func:`matmul_vocab_pad`) to avoid rescanning packed.ids.
+
+    Rows are padded to a _ROW_BUCKET multiple before the jit call: the
+    secondary stage runs once per primary cluster, and without bucketing
+    every distinct cluster size would trigger a fresh XLA compilation
+    (tens of seconds each on TPU). Sketch width is already bucketed by
+    pack_scaled_sketches, the vocab by matmul_vocab_pad."""
     if v_pad is None:
         v_pad = matmul_vocab_pad(packed)
-    inter = np.asarray(_intersect_matmul(jnp.asarray(packed.ids), v_pad=v_pad))
+    m = packed.n
+    ids, _ = pad_packed_rows(packed.ids, packed.counts, ROW_BUCKET)
+    inter = np.asarray(_intersect_matmul(jnp.asarray(ids), v_pad=v_pad))[:m, :m]
     return ani_cov_from_intersections(inter, packed.counts, k)
 
 

@@ -41,7 +41,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from drep_tpu.ops.minhash import PAD_ID, PackedSketches, mash_distance_from_jaccard
+from drep_tpu.ops.minhash import (
+    PAD_ID,
+    PackedSketches,
+    mash_distance_from_jaccard,
+    pad_packed_rows,
+)
 
 # per-chunk entry budget: W columns of bf16 indicator [N, W]. Chosen so the
 # indicator stays ~tens of MB for a few thousand rows.
@@ -148,14 +153,37 @@ def _jaccard_host(inter: np.ndarray, below: np.ndarray, counts: np.ndarray, t: n
     return dist, j
 
 
+_ROW_BUCKET = 256  # row-count quantum: caps XLA compilations across calls
+_WIDTH_BUCKET = 1024  # chunk-width quantum (chunk widths are data-dependent)
+_NCHUNK_BUCKET = 8  # chunk-count quantum
+
+
+def _bucket_chunks(rows_c: np.ndarray, dcol_c: np.ndarray, n_pad: int):
+    """Pad chunk tensors to quantized (n_chunks, width) so the jitted scan
+    compiles once per bucket, not once per dataset. Trash entries scatter
+    to (row n_pad, col W_b), outside the [:n, :width] slice the matmul sees.
+    """
+    n_chunks, width = rows_c.shape
+    w_b = -(-width // _WIDTH_BUCKET) * _WIDTH_BUCKET
+    c_b = -(-n_chunks // _NCHUNK_BUCKET) * _NCHUNK_BUCKET
+    out_rows = np.full((c_b, w_b), n_pad, dtype=rows_c.dtype)
+    out_dcol = np.full((c_b, w_b), w_b, dtype=dcol_c.dtype)
+    out_rows[:n_chunks, :width] = rows_c
+    # remap the old per-dataset trash column (== width) to the bucketed one
+    out_dcol[:n_chunks, :width] = np.where(dcol_c == width, w_b, dcol_c)
+    return out_rows, out_dcol
+
+
 def all_vs_all_mash_matmul(
     packed: PackedSketches, k: int = 21, chunk_entries: int = DEFAULT_CHUNK_ENTRIES
 ) -> tuple[np.ndarray, np.ndarray]:
     """Full [N, N] (dist, jaccard) via the MXU estimator."""
-    ids, counts = packed.ids, packed.counts
     n = packed.n
     if n == 0:
         return np.zeros((0, 0), np.float32), np.zeros((0, 0), np.float32)
+    # bucket the row count so repeated calls (multiround chunks, resumed
+    # runs) reuse the compiled scan instead of recompiling per shape
+    ids, counts = pad_packed_rows(packed.ids, packed.counts, _ROW_BUCKET)
     if int(counts.max()) == 0:
         # all sketches empty: maximal distance everywhere (matches the sort
         # path), identity on the diagonal
@@ -164,25 +192,29 @@ def all_vs_all_mash_matmul(
         np.fill_diagonal(dist, 0.0)
         np.fill_diagonal(jac, 1.0)
         return dist, jac
+    n_pad = ids.shape[0]
     # per-genome bottom-s threshold = largest valid id in the row
     t = np.where(
-        counts > 0, ids[np.arange(n), np.maximum(counts - 1, 0)], np.int32(-1)
+        counts > 0, ids[np.arange(n_pad), np.maximum(counts - 1, 0)], np.int32(-1)
     ).astype(np.int32)
     rows_c, dcol_c = _build_chunks(ids, chunk_entries)
+    rows_c, dcol_c = _bucket_chunks(rows_c, dcol_c, n_pad)
     # minimize link traffic: int16 chunk tensors up (when shapes fit), a
     # single int16 count matrix down, everything elementwise on host
     width = rows_c.shape[1]
-    compact = n < 2**15 and width + 1 < 2**15 and int(counts.max()) < 2**15
+    compact = n_pad < 2**15 and width + 1 < 2**15 and int(counts.max()) < 2**15
     if compact:
         rows_c = rows_c.astype(np.int16)
         dcol_c = dcol_c.astype(np.int16)
     # dispatch the device scan first (async), then fill `below` on host
     # while the MXU works — the searchsorted pass costs ~zero wall-clock
     inter_dev = _accumulate_chunks(
-        jnp.asarray(rows_c), jnp.asarray(dcol_c), n=n, compact_out=compact
+        jnp.asarray(rows_c), jnp.asarray(dcol_c), n=n_pad, compact_out=compact
     )
     below = _below_counts(ids, counts, t)
     dist, jac = _jaccard_host(np.asarray(inter_dev), below, counts, t, k=k)
+    dist = dist[:n, :n]
+    jac = jac[:n, :n]
     np.fill_diagonal(dist, 0.0)
     np.fill_diagonal(jac, 1.0)
     return dist, jac
