@@ -187,6 +187,28 @@ def _primary_clusters(
     return labels, dist, link, None, n * (n - 1) // 2
 
 
+# batching of small clusters: one device call replaces hundreds of
+# latency-bound round trips (most primary clusters are tiny at scale)
+SMALL_CLUSTER_MAX = 32
+BATCH_ROWS_MAX = 512
+
+
+def _secondary_postprocess(
+    gs: GenomeSketches,
+    indices: list[int],
+    pc: int,
+    kw: dict[str, Any],
+    ani: np.ndarray,
+    cov: np.ndarray,
+) -> tuple[pd.DataFrame, np.ndarray, np.ndarray]:
+    """(ani, cov) for one primary cluster -> (Ndb rows, labels 1.., linkage)."""
+    names = [gs.names[i] for i in indices]
+    ndb = pairs.directional_ndb(names, ani, cov, pc)
+    dist = 1.0 - pairs.gated_symmetric_ani(ani, cov, kw["cov_thresh"])
+    labels, link = cluster_hierarchical(dist, 1.0 - kw["S_ani"], method=kw["clusterAlg"])
+    return ndb, labels, link
+
+
 def _secondary_for_cluster(
     gs: GenomeSketches,
     bdb: pd.DataFrame,
@@ -197,12 +219,7 @@ def _secondary_for_cluster(
     """One primary cluster -> (Ndb rows, secondary labels 1.., linkage)."""
     engine = dispatch.get_secondary(kw["S_algorithm"])
     ani, cov = engine(gs, indices, bdb=bdb, processes=kw["processes"], mesh_shape=kw["mesh_shape"])
-    names = [gs.names[i] for i in indices]
-
-    ndb = pairs.directional_ndb(names, ani, cov, pc)
-    dist = 1.0 - pairs.gated_symmetric_ani(ani, cov, kw["cov_thresh"])
-    labels, link = cluster_hierarchical(dist, 1.0 - kw["S_ani"], method=kw["clusterAlg"])
-    return ndb, labels, link
+    return _secondary_postprocess(gs, indices, pc, kw, ani, cov)
 
 
 def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.DataFrame:
@@ -210,6 +227,9 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     logger = get_logger()
     kw = _fill_defaults(kwargs)
     snapshot = {k: kw.get(k) for k in _RESUME_KEYS if k != "genomes"}
+    # normalize: CLI passes 0.25 explicitly, library callers omit it — the
+    # effective value must snapshot identically from both entry points
+    snapshot["warn_dist"] = _warn_dist(kw)
     snapshot["genomes"] = sorted(bdb["genome"])
 
     if wd.hasDb("Cdb") and wd.arguments_match("cluster", snapshot):
@@ -262,31 +282,66 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         from drep_tpu.cluster.secondary_ckpt import SecondaryCheckpoint
 
         greedy = kw["greedy_secondary_clustering"]
+        batched_fn = None if greedy else dispatch.get_secondary_batched(kw["S_algorithm"])
+        # warn_dist shapes only the Mdb retention, never secondary results —
+        # keep it out of the checkpoint key so changing the warning
+        # threshold does not throw away the whole ANI stage
+        sec_snapshot = {k: v for k, v in snapshot.items() if k != "warn_dist"}
         ckpt = SecondaryCheckpoint(
             wd.get_dir(os.path.join("data", "secondary_checkpoints")),
-            snapshot, primary, gs.names,
+            sec_snapshot, primary, gs.names,
         )
+        multi = []
         for pc in range(1, n_primary + 1):
             indices = [i for i in range(n) if primary[i] == pc]
             if len(indices) == 1:
                 secondary_names[gs.names[indices[0]]] = f"{pc}_1"
-                continue
+            else:
+                multi.append((pc, indices))
+
+        results: dict[int, tuple[pd.DataFrame, np.ndarray, np.ndarray]] = {}
+        small: list[tuple[int, list[int]]] = []
+        for pc, indices in multi:
             m = len(indices)
             cached = ckpt.load(pc)
             if cached is not None:
-                ndb, labels, link = cached  # resumed: 0 pairs counted
+                results[pc] = cached  # resumed: 0 pairs counted
             elif greedy:
                 from drep_tpu.cluster.greedy import greedy_secondary_cluster
 
                 with counters.stage("secondary_compare"):
                     ndb, labels = greedy_secondary_cluster(gs, bdb, indices, pc, kw)
                 counters.stages["secondary_compare"].pairs += len(ndb)  # actual comparisons made
-                link = np.empty((0, 4))
-                ckpt.save(pc, ndb, labels, link)
+                results[pc] = (ndb, labels, np.empty((0, 4)))
+                ckpt.save(pc, *results[pc])
+            elif batched_fn is not None and m <= SMALL_CLUSTER_MAX:
+                small.append((pc, indices))  # one device call for many
             else:
                 with counters.stage("secondary_compare", pairs=m * (m - 1) // 2):
-                    ndb, labels, link = _secondary_for_cluster(gs, bdb, indices, pc, kw)
-                ckpt.save(pc, ndb, labels, link)
+                    results[pc] = _secondary_for_cluster(gs, bdb, indices, pc, kw)
+                ckpt.save(pc, *results[pc])
+
+        # flush the small clusters in row-bounded batches
+        batches: list[list[tuple[int, list[int]]]] = []
+        rows = BATCH_ROWS_MAX + 1  # force a new batch on the first item
+        for item in small:
+            if rows + len(item[1]) > BATCH_ROWS_MAX:
+                batches.append([])
+                rows = 0
+            batches[-1].append(item)
+            rows += len(item[1])
+        for batch in batches:
+            pairs_in_batch = sum(len(ix) * (len(ix) - 1) // 2 for _, ix in batch)
+            with counters.stage("secondary_compare", pairs=pairs_in_batch):
+                outs = batched_fn(
+                    gs, [ix for _, ix in batch], mesh_shape=kw["mesh_shape"]
+                )
+            for (pc, indices), (ani, cov) in zip(batch, outs, strict=True):
+                results[pc] = _secondary_postprocess(gs, indices, pc, kw, ani, cov)
+                ckpt.save(pc, *results[pc])
+
+        for pc, indices in multi:  # assemble in cluster order (deterministic)
+            ndb, labels, link = results[pc]
             ndb_parts.append(ndb)
             clustering_files["secondary"][pc] = {
                 "linkage": link,

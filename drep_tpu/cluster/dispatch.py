@@ -17,6 +17,9 @@ from typing import Callable
 
 PRIMARY_ALGORITHMS: dict[str, Callable] = {}
 SECONDARY_ALGORITHMS: dict[str, Callable] = {}
+# optional batched variants: one device call for MANY small clusters
+# (fn(gs, clusters, **kw) -> list of (ani, cov) in cluster order)
+SECONDARY_BATCHED: dict[str, Callable] = {}
 
 
 def register_primary(name: str):
@@ -43,7 +46,20 @@ def get_primary(name: str) -> Callable:
     return PRIMARY_ALGORITHMS[name]
 
 
+def register_secondary_batched(name: str):
+    def deco(fn):
+        SECONDARY_BATCHED[name] = fn
+        return fn
+
+    return deco
+
+
 def get_secondary(name: str) -> Callable:
     if name not in SECONDARY_ALGORITHMS:
         raise KeyError(f"unknown S_algorithm {name!r}; available: {sorted(SECONDARY_ALGORITHMS)}")
     return SECONDARY_ALGORITHMS[name]
+
+
+def get_secondary_batched(name: str) -> Callable | None:
+    """Batched variant when the engine has one; None -> per-cluster calls."""
+    return SECONDARY_BATCHED.get(name)

@@ -14,7 +14,11 @@ from __future__ import annotations
 
 import numpy as np
 
-from drep_tpu.cluster.dispatch import register_primary, register_secondary
+from drep_tpu.cluster.dispatch import (
+    register_primary,
+    register_secondary,
+    register_secondary_batched,
+)
 from drep_tpu.ingest import GenomeSketches
 from drep_tpu.ops.containment import all_vs_all_containment, pack_scaled_sketches
 from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
@@ -119,14 +123,13 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
 
     from drep_tpu.ops.containment import (
         MATMUL_BUDGET_ELEMS,
-        ROW_BUCKET,
         all_vs_all_containment_matmul,
+        matmul_rows_pad,
         matmul_vocab_pad,
     )
 
     v_pad = matmul_vocab_pad(packed)  # one scan; budget uses the REAL width
-    m_bucketed = -(-packed.n // ROW_BUCKET) * ROW_BUCKET  # what gets allocated
-    if m_bucketed * (v_pad + 1) <= MATMUL_BUDGET_ELEMS:
+    if matmul_rows_pad(packed.n) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS:
         return all_vs_all_containment_matmul(packed, k=k, v_pad=v_pad)
     mesh = _mesh_or_none(mesh_shape, packed.n)
     if mesh is not None:
@@ -156,6 +159,35 @@ def secondary_jax_ani(
     names = [gs.names[i] for i in indices]
     packed = pack_scaled_sketches(sketches, names)
     return containment_matrices(packed, gs.k, mesh_shape=mesh_shape, tile=tile)
+
+
+@register_secondary_batched("jax_ani")
+def secondary_jax_ani_batched(
+    gs: GenomeSketches,
+    clusters: list[list[int]],
+    tile: int = 128,
+    mesh_shape: int | None = None,
+    **_,
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """One device call for MANY small primary clusters.
+
+    At production scale most primary clusters hold a handful of genomes;
+    one dispatch per cluster pays the host<->device round-trip latency
+    hundreds of times. Here every cluster's sketches pack into ONE matrix
+    (shared vocabulary), one intersection matmul runs, and each cluster's
+    diagonal block is sliced out. Cross-cluster blocks are wasted FLOPs —
+    a fine trade while the combined matrix stays small (the caller bounds
+    total rows)."""
+    flat = [i for cl in clusters for i in cl]
+    packed = pack_scaled_sketches([gs.scaled[i] for i in flat], [gs.names[i] for i in flat])
+    ani_all, cov_all = containment_matrices(packed, gs.k, mesh_shape=mesh_shape, tile=tile)
+    out: list[tuple[np.ndarray, np.ndarray]] = []
+    o = 0
+    for cl in clusters:
+        m = len(cl)
+        out.append((ani_all[o : o + m, o : o + m], cov_all[o : o + m, o : o + m]))
+        o += m
+    return out
 
 
 # subprocess fallbacks register themselves on import

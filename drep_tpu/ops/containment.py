@@ -148,7 +148,13 @@ def ani_cov_from_intersections(
 
 
 ROW_BUCKET = 64  # row-count quantum: caps XLA compilations across clusters
-# (public: the dispatch budget check must use the BUCKETED row count)
+
+
+def matmul_rows_pad(n: int) -> int:
+    """Row count the MXU path actually allocates for n genomes — THE
+    definition the dispatch budget check must use (kept next to the kernel
+    so the two cannot drift)."""
+    return -(-n // ROW_BUCKET) * ROW_BUCKET
 
 
 def all_vs_all_containment_matmul(
@@ -167,6 +173,7 @@ def all_vs_all_containment_matmul(
     if v_pad is None:
         v_pad = matmul_vocab_pad(packed)
     m = packed.n
+    # pad_packed_rows rounds to a ROW_BUCKET multiple == matmul_rows_pad(m)
     ids, _ = pad_packed_rows(packed.ids, packed.counts, ROW_BUCKET)
     inter = np.asarray(_intersect_matmul(jnp.asarray(ids), v_pad=v_pad))[:m, :m]
     return ani_cov_from_intersections(inter, packed.counts, k)

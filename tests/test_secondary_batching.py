@@ -1,0 +1,44 @@
+"""Small-cluster batching: one device call must equal per-cluster calls."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from drep_tpu.cluster import dispatch
+from drep_tpu.cluster.engines import secondary_jax_ani, secondary_jax_ani_batched
+from drep_tpu.ingest import GenomeSketches
+
+
+@pytest.fixture(scope="module")
+def gs_many_small():
+    rng = np.random.default_rng(3)
+    n_clusters, per, s = 12, 4, 600
+    names, scaled = [], []
+    for c in range(n_clusters):
+        pool = np.sort(
+            rng.choice(np.uint64(1) << np.uint64(40), size=2 * s, replace=False).astype(np.uint64)
+        )
+        for m in range(per):
+            names.append(f"c{c}m{m}")
+            scaled.append(np.sort(rng.choice(pool, size=s, replace=False)))
+    gdb = pd.DataFrame({"genome": names, "n_kmers": [len(x) for x in scaled]})
+    return GenomeSketches(
+        names=names, gdb=gdb, bottom=[x[:64] for x in scaled], scaled=scaled,
+        k=21, sketch_size=64, scale=200,
+    )
+
+
+def test_batched_equals_per_cluster(gs_many_small):
+    gs = gs_many_small
+    clusters = [list(range(c * 4, c * 4 + 4)) for c in range(12)]
+    batched = secondary_jax_ani_batched(gs, clusters)
+    assert len(batched) == len(clusters)
+    for cl, (ani_b, cov_b) in zip(clusters, batched):
+        ani_s, cov_s = secondary_jax_ani(gs, cl)
+        np.testing.assert_allclose(ani_b, ani_s, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(cov_b, cov_s, rtol=1e-5, atol=1e-6)
+
+
+def test_batched_registered():
+    assert dispatch.get_secondary_batched("jax_ani") is not None
+    assert dispatch.get_secondary_batched("fastANI") is None  # subprocess: per-cluster
