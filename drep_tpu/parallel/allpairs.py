@@ -94,6 +94,35 @@ _TILE_KINDS: dict[str, tuple[Callable[[int], Callable], int]] = {
 }
 
 
+def put_global(arr: np.ndarray, sharding) -> jax.Array:
+    """Host numpy -> globally-sharded jax.Array, multi-host safe.
+
+    Ingest is host-replicated (every process sketches the same genome list),
+    so each process holds the full array and contributes only its
+    addressable shards. ``jax.device_put`` of a host array onto a sharding
+    that spans other processes' devices is not portable; the callback form
+    is the documented multi-host construction path (SURVEY.md §5.8).
+    """
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
+
+
+def gather_global(x: jax.Array) -> np.ndarray:
+    """Globally-sharded jax.Array -> full numpy array on every process.
+
+    ``np.array`` on a non-fully-addressable array raises on >1 process
+    (remote shards have no local buffers); ``process_allgather`` reshards
+    to fully-replicated first (ICI/DCN collective), then reads local data.
+    Single-process keeps the direct copy (no resharding dispatch).
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        # tiled=True is required for global arrays; the result is the fully
+        # replicated value (no extra stacking axis), identical on every host
+        return np.array(multihost_utils.process_allgather(x, tiled=True))
+    return np.array(x)
+
+
 @functools.lru_cache(maxsize=None)
 def _ring_fn(kind: str, k: int, mesh) -> tuple[Callable, int]:
     """One jitted shard_map program per (kernel kind, k, mesh); jax.jit then
@@ -127,13 +156,14 @@ def ring_allpairs(
     n = packed.n
     ids, counts = pad_packed_rows(packed.ids, packed.counts, n_devices)
 
-    ids_d = jax.device_put(ids, NamedSharding(mesh, P(AXIS, None)))
-    counts_d = jax.device_put(counts, NamedSharding(mesh, P(AXIS)))
+    ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
+    counts_d = put_global(counts, NamedSharding(mesh, P(AXIS)))
 
     fn, _ = _ring_fn(kind, k, mesh)
     outs = fn(ids_d, counts_d)
-    # np.array (copy): jax buffers are read-only and callers fill diagonals
-    return tuple(np.array(o)[:n, :n] for o in outs)
+    # copy to host (np.array copies): buffers are read-only and callers
+    # fill diagonals; gather_global handles the >1-process reshard
+    return tuple(gather_global(o)[:n, :n] for o in outs)
 
 
 def sharded_mash_allpairs(packed: PackedSketches, k: int = 21, mesh=None) -> np.ndarray:

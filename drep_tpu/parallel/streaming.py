@@ -93,7 +93,13 @@ def streaming_mash_edges(
     ids, counts = pad_packed_rows(packed.ids, packed.counts, block)
     nt = ids.shape[0]
     n_blocks = nt // block
-    devices = jax.devices()
+    # local devices only: on a multi-host pod jax.devices() includes remote
+    # chips, and device_put to a non-addressable device raises. Row-block
+    # stripes are instead divided across processes (bi % pc == pid below)
+    # and the surviving edges all-gathered at the end.
+    devices = jax.local_devices()
+    pc = jax.process_count()
+    pid = jax.process_index()
 
     resume = False
     if checkpoint_dir is not None:
@@ -110,6 +116,8 @@ def streaming_mash_edges(
             # at identical N (the int32 ids are a run-specific vocab remap)
             "fingerprint": content_fingerprint(packed.names, packed.counts, packed.ids),
         }
+        # process-0-only clear + barrier on >1 process lives inside
+        # open_checkpoint_dir (shared with the secondary shard store)
         resume = open_checkpoint_dir(checkpoint_dir, meta, clear_suffixes=(".npz",))
 
     # the full padded pack lives on every device (N=100k, s=1000 -> ~400 MB,
@@ -126,6 +134,8 @@ def streaming_mash_edges(
     pairs_computed = 0
 
     for bi in range(n_blocks):
+        if bi % pc != pid:
+            continue  # another process owns this row stripe
         shard = (
             os.path.join(checkpoint_dir, f"row_{bi:05d}.npz")
             if checkpoint_dir is not None
@@ -196,11 +206,64 @@ def streaming_mash_edges(
 
     if n_resumed:
         logger.info("streaming primary: resumed %d/%d row-block shards", n_resumed, n_blocks)
+    ii = np.concatenate(all_ii) if all_ii else np.empty(0, np.int64)
+    jj = np.concatenate(all_jj) if all_jj else np.empty(0, np.int64)
+    dd = np.concatenate(all_dd) if all_dd else np.empty(0, np.float32)
+    if pc > 1:
+        ii, jj, dd, pairs_computed = _allgather_edges(ii, jj, dd, pairs_computed)
+    return ii, jj, dd, pairs_computed
+
+
+def _allgather_edges(
+    ii: np.ndarray, jj: np.ndarray, dd: np.ndarray, pairs_computed: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Exchange per-process edge stripes so every process ends with the full
+    edge set (clustering is replicated host work, each process needs all
+    edges). process_allgather needs equal shapes across processes, so pad
+    each stripe to the global max length, stack, and trim per true length.
+
+    Dtype care: jax canonicalizes int64 host arrays to int32 (x64 is off),
+    which would silently wrap `pairs_computed` (~5e9 at N=100k > 2^31) and
+    downcast ii/jj. So 64-bit scalars ride as two uint32 halves, and ii/jj
+    ride as uint32 (indices < N <= 2^31 by the packed-int32 id space; a
+    per-process stripe of 2^32 edges is orders of magnitude past host
+    memory, so lengths fit too).
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    def _split64(v: int) -> list[int]:
+        return [v & 0xFFFFFFFF, v >> 32]
+
+    def _join64(lo: int, hi: int) -> int:
+        return int(lo) | (int(hi) << 32)
+
+    header = np.array(_split64(len(ii)) + _split64(pairs_computed), np.uint32)
+    g_head = np.array(mhu.process_allgather(header))  # [pc, 4]
+    lengths = [_join64(r[0], r[1]) for r in g_head]
+    total_pairs = sum(_join64(r[2], r[3]) for r in g_head)
+    m = max(lengths)
+    if m == 0:
+        return (
+            np.empty(0, np.int64),
+            np.empty(0, np.int64),
+            np.empty(0, np.float32),
+            total_pairs,
+        )
+
+    def _pad(a: np.ndarray) -> np.ndarray:
+        out = np.zeros(m, a.dtype)
+        out[: len(a)] = a
+        return out
+
+    g_ii, g_jj, g_dd = (
+        np.array(mhu.process_allgather(_pad(a)))
+        for a in (ii.astype(np.uint32), jj.astype(np.uint32), dd)
+    )
     return (
-        np.concatenate(all_ii) if all_ii else np.empty(0, np.int64),
-        np.concatenate(all_jj) if all_jj else np.empty(0, np.int64),
-        np.concatenate(all_dd) if all_dd else np.empty(0, np.float32),
-        pairs_computed,
+        np.concatenate([g_ii[p][:c] for p, c in enumerate(lengths)]).astype(np.int64),
+        np.concatenate([g_jj[p][:c] for p, c in enumerate(lengths)]).astype(np.int64),
+        np.concatenate([g_dd[p][:c] for p, c in enumerate(lengths)]),
+        total_pairs,
     )
 
 

@@ -10,9 +10,11 @@ One implementation so invalidation semantics can never drift apart.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
+import uuid
 from typing import Any, Iterable
 
 import numpy as np
@@ -35,7 +37,10 @@ def content_fingerprint(names: Iterable[str], *arrays: np.ndarray) -> str:
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:
-    tmp = path + ".tmp"
+    # globally-unique tmp name: two writers of the same target (shared
+    # checkpoint dir on a pod — pids can collide ACROSS hosts/containers)
+    # must never interleave into one tmp file
+    tmp = f"{path}.tmp-{uuid.uuid4().hex}"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)
@@ -47,7 +52,32 @@ def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tup
     Returns True when a matching meta already exists (existing shards are
     resumable). Otherwise clears stale shards (files ending in any of
     `clear_suffixes`, plus the meta) and atomically writes the new meta.
+
+    Multi-process runs (shared checkpoint dir on a pod): only process 0
+    clears stale shards / rewrites the meta; peers wait on a barrier and
+    then open against the now-matching meta, so the remove loop never runs
+    concurrently. Callers must invoke this in replicated control flow on
+    every process (true for both shard stores — streaming row blocks and
+    secondary per-cluster results).
     """
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils as mhu
+
+        resume = False
+        if jax.process_index() == 0:
+            resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
+        mhu.sync_global_devices("drep_tpu_ckpt_open:" + os.path.abspath(ckpt_dir))
+        if jax.process_index() != 0:
+            resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
+        return resume
+    return _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
+
+
+def _open_checkpoint_dir_local(
+    ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tuple[str, ...]
+) -> bool:
     os.makedirs(ckpt_dir, exist_ok=True)
     loc = os.path.join(ckpt_dir, META_NAME)
     stored = None
@@ -61,6 +91,7 @@ def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tup
         return True
     for f in os.listdir(ckpt_dir):
         if f == META_NAME or any(f.endswith(s) for s in clear_suffixes):
-            os.remove(os.path.join(ckpt_dir, f))
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(os.path.join(ckpt_dir, f))  # a peer may have won the race
     atomic_write_bytes(loc, json.dumps(meta, sort_keys=True, default=str).encode())
     return False

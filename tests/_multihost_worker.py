@@ -1,0 +1,93 @@
+"""Worker process for the 2-process `jax.distributed` equality test.
+
+Run via subprocess by tests/test_multihost.py — NOT collected by pytest.
+Each process owns 2 forced-host CPU devices; together they form a
+4-device, 2-process "pod" over which the ring all-pairs and streaming
+paths must produce results identical to the local dense oracle
+(SURVEY.md §5.8: the multi-host gather/placement contract).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    coord = sys.argv[3]
+    outdir = sys.argv[4]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    # jax 0.9: the forced-host XLA_FLAGS route no longer multiplies CPU
+    # devices; the config knob does, and must be set pre-backend-init
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == 2 * nproc, jax.devices()
+    assert len(jax.local_devices()) == 2
+
+    from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
+    from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+
+    # same seed on every process — host-replicated ingest, as in production
+    rng = np.random.default_rng(7)
+    s, n = 48, 13  # n deliberately not a multiple of 4 devices (padding path)
+    base = np.unique(rng.integers(0, 2**62, size=8 * s * n, dtype=np.uint64))
+    rng.shuffle(base)
+    shared = base[:s]
+    sketches = []
+    for i in range(n):
+        own = base[s * (i + 1) : s * (i + 2)]
+        mix = (i % 4) * s // 8
+        sketches.append(np.sort(np.unique(np.concatenate([shared[:mix], own[: s - mix]]))[:s]))
+    packed = pack_sketches(sketches, [f"g{i}" for i in range(n)], s)
+
+    # dense oracle runs locally (unsharded jit on this process's devices)
+    want, _ = all_vs_all_mash(packed, k=21, tile=8)
+
+    got = sharded_mash_allpairs(packed, k=21, mesh=make_mesh())
+    assert got.shape == (n, n), got.shape
+    assert np.allclose(got, want, atol=1e-6), "ring all-pairs != dense oracle"
+
+    # streaming path: cutoff > 1 keeps every edge; block striping divides
+    # row blocks between the two processes and allgathers the edges back
+    ii, jj, dd, pairs = streaming_mash_edges(packed, k=21, cutoff=2.0, block=4)
+    dense = np.full((n, n), np.inf, np.float32)
+    dense[ii, jj] = dd
+    iu = np.triu_indices(n, 1)
+    assert np.allclose(dense[iu], want[iu].astype(np.float32), atol=1e-6), (
+        "streaming edges != dense oracle"
+    )
+    assert pairs == n * (n - 1) // 2, pairs  # striped counts sum to all pairs
+
+    # shared-checkpoint-dir path: process 0 opens/clears, peers wait; shards
+    # are written per-stripe, then a second call must resume every shard
+    # (pairs_computed sums to 0 across processes) with identical edges
+    ckpt = os.path.join(outdir, "ckpt")
+    ii1, jj1, dd1, pairs1 = streaming_mash_edges(
+        packed, k=21, cutoff=2.0, block=4, checkpoint_dir=ckpt
+    )
+    assert pairs1 == n * (n - 1) // 2, pairs1
+    ii2, jj2, dd2, pairs2 = streaming_mash_edges(
+        packed, k=21, cutoff=2.0, block=4, checkpoint_dir=ckpt
+    )
+    assert pairs2 == 0, pairs2  # fully resumed from the shared shards
+    o1, o2 = np.lexsort((jj1, ii1)), np.lexsort((jj2, ii2))
+    assert np.array_equal(ii1[o1], ii2[o2])
+    assert np.array_equal(jj1[o1], jj2[o2])
+    assert np.array_equal(dd1[o1], dd2[o2])
+
+    with open(os.path.join(outdir, f"ok_{pid}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Two real `jax.distributed` CPU processes must agree with single-process.
+
+The reference has no multi-node story at all (SURVEY.md §2c); this is the
+rebuild's v5e-pod contract (SURVEY.md §5.8) tested the only way it can be
+without a pod: two OS processes, two forced-host CPU devices each, a real
+coordinator handshake, and the assertion that the mesh-sharded ring
+all-pairs and the striped streaming path both reproduce the dense
+single-process numbers exactly.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_distributed_matches_single(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", f"localhost:{port}", str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=REPO,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        # a dead worker leaves its peer blocked in a collective — always
+        # reap both so a failure can't leak orphans holding the port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, f"worker {i} failed:\n{outs[i]}"
+        assert (tmp_path / f"ok_{i}").exists(), f"worker {i} wrote no ok-file:\n{outs[i]}"
