@@ -1,24 +1,40 @@
-"""Benchmark: genome-pairs/sec/chip on the jax_mash all-vs-all engine.
+"""Benchmark: genome-pairs/sec/chip across the pipeline's compute stages.
 
 Prints ONE JSON line:
-  {"metric": "genome-pairs/sec/chip", "value": N, "unit": "pairs/s", "vs_baseline": N}
+  {"metric": "genome-pairs/sec/chip", "value": N, "unit": "pairs/s",
+   "vs_baseline": N, "stages": {...}}
 
-Metric definition follows BASELINE.json ("genome-pairs/sec/chip on dRep
-compare"): unique genome pairs (N*(N-1)/2) divided by wall-clock of the
-all-vs-all Mash-distance computation on one chip, at N=2048 genomes and
-sketch size 1024 (realistic production shape; the reference default sketch
-is 1000, padded here to a lane-friendly 1024).
+Headline metric (BASELINE.json "genome-pairs/sec/chip on dRep compare"):
+unique genome pairs (N*(N-1)/2) / wall-clock of the all-vs-all Mash-distance
+computation on one chip, at N=2048 genomes, sketch 1024 (reference default
+sketch is 1000, padded to a lane-friendly 1024).
+
+`stages` extends the round-1 single-number bench to the full BASELINE
+measurement plan (VERDICT round 1 items 2/6):
+- primary:            jax_mash all-vs-all (the headline number)
+- secondary_matmul:   jax_ani MXU indicator-matmul containment path
+- secondary_pallas:   the Pallas bitonic-merge kernel COMPILED on TPU, with
+                      an exact-equality check against the matmul path at the
+                      same production shape (skipped off-TPU: interpret mode
+                      measures nothing)
+- e2e_10k:            wall-clock to Cdb for a synthetic 10k-genome compare
+                      through the streaming primary + batched secondary path
+                      (sketches pre-planted in a workdir cache — FASTA ingest
+                      for 10k * 4 Mb of sequence is a host-IO benchmark, not
+                      a chip benchmark)
 
 `vs_baseline`: BASELINE.json `published` is empty (no published reference
-number exists — SURVEY.md §6), so the honest denominator is the north-star
-requirement: 100k MAGs in <30 min on v5e-16 => 100k*(100k-1)/2 pairs /
-1800 s / 16 chips ~= 1.736e5 pairs/s/chip. vs_baseline > 1 means this
-engine clears the north-star rate for its primary stage.
+number exists — SURVEY.md §6), so the honest denominator everywhere is the
+north-star requirement: 100k MAGs in <30 min on v5e-16 =>
+100k*(100k-1)/2 pairs / 1800 s / 16 chips ~= 1.736e5 pairs/s/chip.
+vs_baseline > 1 means the stage clears the north-star rate.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import tempfile
 import time
 
 import numpy as np
@@ -29,8 +45,25 @@ K = 21
 TILE = 512
 NORTH_STAR_PAIRS_PER_SEC_PER_CHIP = (100_000 * 99_999 / 2) / 1800.0 / 16.0
 
+# secondary-stage production shape: one large primary cluster
+SEC_M = 512
+SEC_WIDTH = 2048
+SEC_VOCAB = 120_000
 
-def main() -> None:
+
+def _best_of(fn, reps: int = 3) -> float:
+    """Best wall-clock of `reps` runs — tunneled-TPU link bandwidth
+    fluctuates run to run; the best run is the least-congested measurement
+    of the same fixed work."""
+    dt = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        dt = min(dt, time.perf_counter() - t0)
+    return dt
+
+
+def bench_primary() -> dict:
     from drep_tpu.cluster.engines import mash_distance_matrix
     from drep_tpu.ops.minhash import PackedSketches
 
@@ -43,28 +76,190 @@ def main() -> None:
         ids=ids, counts=counts, names=[f"g{i}" for i in range(N_GENOMES)]
     )
 
-    # warmup: compile the production (auto-selected) kernel at full shape
-    mash_distance_matrix(packed, k=K, tile=TILE)
-
-    # best of 3: tunneled-TPU link bandwidth fluctuates run to run; the
-    # best run is the least-congested measurement of the same fixed work
-    dt = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        dist = mash_distance_matrix(packed, k=K, tile=TILE)  # host numpy: synchronized
-        dt = min(dt, time.perf_counter() - t0)
-
+    mash_distance_matrix(packed, k=K, tile=TILE)  # compile warmup at full shape
+    dt = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
     pairs = N_GENOMES * (N_GENOMES - 1) / 2
-    pairs_per_sec = pairs / dt
-    n_chips = 1  # all_vs_all_mash runs single-chip; per-chip by construction
-    value = pairs_per_sec / n_chips
+    value = pairs / dt  # single-chip: per-chip by construction
+    return {
+        "n_genomes": N_GENOMES,
+        "sketch": SKETCH_SIZE,
+        "seconds": round(dt, 4),
+        "pairs_per_sec_per_chip": round(value, 1),
+        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+    }
+
+
+def _secondary_pack():
+    from drep_tpu.ops.minhash import PackedSketches
+
+    rng = np.random.default_rng(1)
+    ids = np.stack(
+        [
+            np.sort(rng.choice(SEC_VOCAB, size=SEC_WIDTH, replace=False)).astype(np.int32)
+            for _ in range(SEC_M)
+        ]
+    )
+    counts = np.full((SEC_M,), SEC_WIDTH, dtype=np.int32)
+    return PackedSketches(ids=ids, counts=counts, names=[f"g{i}" for i in range(SEC_M)])
+
+
+def bench_secondary_matmul(packed) -> dict:
+    from drep_tpu.ops.containment import all_vs_all_containment_matmul
+
+    all_vs_all_containment_matmul(packed, k=K)  # warmup
+    dt = _best_of(lambda: all_vs_all_containment_matmul(packed, k=K))
+    pairs = SEC_M * (SEC_M - 1) / 2
+    value = pairs / dt
+    return {
+        "n_genomes": SEC_M,
+        "sketch": SEC_WIDTH,
+        "seconds": round(dt, 4),
+        "pairs_per_sec_per_chip": round(value, 1),
+        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+    }
+
+
+def bench_secondary_pallas(packed) -> dict:
+    """Compiled Pallas kernel rate + exact equality vs the MXU matmul path
+    (VERDICT item 6: pin the compiled kernel on hardware)."""
+    import jax
+
+    if jax.devices()[0].platform != "tpu":
+        return {"skipped": "not on tpu (interpret mode measures nothing)"}
+
+    import jax.numpy as jnp
+
+    from drep_tpu.ops.containment import _intersect_matmul, matmul_vocab_pad
+    from drep_tpu.ops.pallas_merge import intersect_counts_pallas_self
+
+    inter_p = intersect_counts_pallas_self(packed.ids)  # warmup + result
+    dt = _best_of(lambda: intersect_counts_pallas_self(packed.ids))
+    v_pad = matmul_vocab_pad(packed)
+    inter_m = np.asarray(_intersect_matmul(jnp.asarray(packed.ids), v_pad=v_pad))
+    equal = bool(np.array_equal(inter_p, np.asarray(inter_m)))
+    pairs = SEC_M * (SEC_M - 1) / 2
+    value = pairs / dt
+    return {
+        "n_genomes": SEC_M,
+        "sketch": SEC_WIDTH,
+        "seconds": round(dt, 4),
+        "pairs_per_sec_per_chip": round(value, 1),
+        "equal_to_matmul": equal,
+        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+    }
+
+
+def _plant_sketches(n: int, rng: np.random.Generator):
+    """Synthetic GenomeSketches with planted cluster structure: cluster
+    members share ~90% of bottom-sketch hashes (well inside 1-P_ani) and
+    ~97% of scaled-sketch hashes (ANI ~ 0.9985 > S_ani)."""
+    import pandas as pd
+
+    from drep_tpu.ingest import DEFAULT_SCALE, GenomeSketches
+
+    s_bottom, s_scaled = 1000, 1200
+    names, bottoms, scaleds = [], [], []
+    gi = 0
+    while gi < n:
+        size = min(int(rng.geometric(0.35)), 20, n - gi)
+        c_bottom = np.unique(rng.integers(0, 2**63, size=int(s_bottom * 1.6), dtype=np.uint64))
+        c_scaled = np.unique(rng.integers(0, 2**63, size=int(s_scaled * 1.3), dtype=np.uint64))
+        for _ in range(size):
+            keep_b = rng.random(len(c_bottom)) < 0.90
+            own_b = np.unique(rng.integers(0, 2**63, size=s_bottom // 6, dtype=np.uint64))
+            bottoms.append(np.sort(np.concatenate([c_bottom[keep_b], own_b]))[:s_bottom])
+            keep_s = rng.random(len(c_scaled)) < 0.97
+            own_s = np.unique(rng.integers(0, 2**63, size=s_scaled // 25, dtype=np.uint64))
+            scaleds.append(np.sort(np.concatenate([c_scaled[keep_s], own_s])))
+            names.append(f"synth_{gi}.fasta")
+            gi += 1
+    gdb = pd.DataFrame(
+        {
+            "genome": names,
+            "length": np.full(n, 4_000_000, np.int64),
+            "N50": np.full(n, 50_000, np.int64),
+            "contigs": np.full(n, 100, np.int64),
+            "n_kmers": np.full(n, 3_900_000, np.int64),
+        }
+    )
+    return GenomeSketches(
+        names=names, gdb=gdb, bottom=bottoms, scaled=scaleds,
+        k=K, sketch_size=s_bottom, scale=DEFAULT_SCALE,
+    )
+
+
+def bench_e2e(n: int) -> dict:
+    """Wall-clock to Cdb: streaming primary + batched secondary on planted
+    sketches. The sketch cache is pre-stored in the workdir (the supported
+    resume path), so the measurement starts at the cluster stage — the
+    BASELINE "wall-clock to Cdb" clause — not at host FASTA IO."""
+    import pandas as pd
+
+    import jax
+    from drep_tpu.cluster.controller import d_cluster_wrapper
+    from drep_tpu.ingest import DEFAULT_SCALE, _save, sketch_args_snapshot
+    from drep_tpu.workdir import WorkDirectory
+
+    rng = np.random.default_rng(2)
+    gs = _plant_sketches(n, rng)
+    with tempfile.TemporaryDirectory() as td:
+        wd = WorkDirectory(td)
+        bdb = pd.DataFrame(
+            {"genome": gs.names, "location": [f"/nonexistent/{g}" for g in gs.names]}
+        )
+        _save(wd, gs)
+        wd.store_arguments(
+            "sketch",
+            sketch_args_snapshot(bdb["genome"], K, gs.sketch_size, DEFAULT_SCALE, "splitmix64"),
+        )
+        t0 = time.perf_counter()
+        cdb = d_cluster_wrapper(wd, bdb, streaming_primary=True)
+        dt = time.perf_counter() - t0
+    pairs = n * (n - 1) / 2
+    n_chips = len(jax.local_devices())
+    value = pairs / dt / n_chips
+    return {
+        "n_genomes": n,
+        "seconds": round(dt, 2),
+        "primary_clusters": int(cdb["primary_cluster"].max()),
+        "secondary_clusters": int(cdb["secondary_cluster"].nunique()),
+        "pairs_per_sec_per_chip": round(value, 1),
+        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stages", default="all", help="comma list: primary,secondary,e2e")
+    ap.add_argument("--e2e_n", type=int, default=10_000)
+    args = ap.parse_args()
+    want = set(args.stages.split(",")) if args.stages != "all" else {"primary", "secondary", "e2e"}
+
+    stages: dict = {}
+    if "primary" in want:
+        stages["primary"] = bench_primary()
+    if "secondary" in want:
+        try:
+            packed = _secondary_pack()
+            stages["secondary_matmul"] = bench_secondary_matmul(packed)
+            stages["secondary_pallas"] = bench_secondary_pallas(packed)
+        except Exception as e:  # a broken stage must not kill the headline
+            stages["secondary_error"] = repr(e)
+    if "e2e" in want:
+        try:
+            stages["e2e_10k"] = bench_e2e(args.e2e_n)
+        except Exception as e:
+            stages["e2e_error"] = repr(e)
+
+    head = stages.get("primary", {})
     print(
         json.dumps(
             {
                 "metric": "genome-pairs/sec/chip",
-                "value": round(value, 1),
+                "value": head.get("pairs_per_sec_per_chip"),
                 "unit": "pairs/s",
-                "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+                "vs_baseline": head.get("vs_baseline"),
+                "stages": stages,
             }
         )
     )

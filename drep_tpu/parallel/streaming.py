@@ -37,6 +37,21 @@ from drep_tpu.utils.logger import get_logger
 
 DEFAULT_BLOCK = 1024
 
+# cap on block*block*(2*next_pow2(sketch_width)) elements for one sort-merge
+# tile: the merge materializes s32 temps of exactly that shape, and several
+# live at once — 2^28 elements is ~1 GB per temp, which measured ~3-4 GB
+# peak on v5e (16 GB HBM). An uncapped 1024-block at sketch 1024 wants
+# ~8 GB PER temp and hard-OOMs the chip.
+SORT_TILE_BUDGET_ELEMS = 1 << 28
+
+
+def _cap_block_for_width(block: int, sketch_width: int) -> int:
+    from drep_tpu.ops.merge import next_pow2  # the merge's own padding rule
+
+    merged = 2 * max(128, next_pow2(sketch_width))
+    cap = int((SORT_TILE_BUDGET_ELEMS / merged) ** 0.5)
+    return max(8, min(block, 1 << (cap.bit_length() - 1)))
+
 
 def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
     """Edge graph -> labels 1..C numbered by first member index
@@ -90,6 +105,7 @@ def streaming_mash_edges(
     logger = get_logger()
     n = packed.n
     block = max(1, min(block, max(8, n)))
+    block = _cap_block_for_width(block, packed.sketch_size)
     ids, counts = pad_packed_rows(packed.ids, packed.counts, block)
     nt = ids.shape[0]
     n_blocks = nt // block
