@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
         comp.add_argument("--scale", type=int, default=200,
                           help="FracMinHash scale for jax_ani (smaller = more precise)")
         comp.add_argument("-k", "--kmer_size", type=int, default=21)
+        comp.add_argument("--hash", default="splitmix64",
+                          choices=["splitmix64", "murmur3"],
+                          help="k-mer hash: splitmix64 (fastest) or murmur3 "
+                               "(Mash-compatible for k>16 — sketches comparable "
+                               "to `mash info` output)")
         comp.add_argument("--SkipMash", action="store_true")
         comp.add_argument("--SkipSecondary", action="store_true")
         comp.add_argument("-nc", "--cov_thresh", type=float, default=0.1)

@@ -45,6 +45,7 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "MASH_sketch": DEFAULT_SKETCH_SIZE,
     "scale": DEFAULT_SCALE,
     "kmer_size": DEFAULT_K,
+    "hash": "splitmix64",
     "processes": 1,
     "SkipMash": False,
     "SkipSecondary": False,
@@ -71,6 +72,7 @@ _RESUME_KEYS = [
     "MASH_sketch",
     "scale",
     "kmer_size",
+    "hash",
     "SkipMash",
     "SkipSecondary",
     "greedy_secondary_clustering",
@@ -243,6 +245,7 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         scale=kw["scale"],
         processes=kw["processes"],
         wd=wd,
+        hash_name=kw["hash"],
     )
     n = len(gs.names)
     logger.info("clustering %d genomes (primary=%s, secondary=%s)", n, kw["primary_algorithm"], kw["S_algorithm"])

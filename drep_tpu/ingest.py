@@ -42,18 +42,18 @@ class GenomeSketches:
 
 
 def _sketch_one(args) -> tuple[str, dict]:
-    name, path, k, sketch_size, scale = args
+    name, path, k, sketch_size, scale, hash_name = args
 
     from drep_tpu.native import sketch_fasta_native
 
-    native = sketch_fasta_native(path, k, sketch_size, scale)
+    native = sketch_fasta_native(path, k, sketch_size, scale, hash_name)
     if native is not None:
         return name, native
 
     contigs = read_fasta_contigs(path)
     lengths = np.array([len(c) for c in contigs], dtype=np.int64)
     raw = np.concatenate(
-        [kmers.splitmix64(kmers.packed_kmers(c, k)) for c in contigs]
+        [kmers.hash_kmers(kmers.packed_kmers(c, k), k, hash_name) for c in contigs]
         or [np.empty(0, np.uint64)]
     )
     bottom, scaled, n_kmers = kmers.sketches_from_raw(raw, sketch_size, scale)
@@ -67,6 +67,19 @@ def _sketch_one(args) -> tuple[str, dict]:
     }
 
 
+def sketch_args_snapshot(
+    genomes, k: int, sketch_size: int, scale: int, hash_name: str
+) -> dict:
+    """THE sketch-cache compatibility key. Anything that pre-populates a
+    workdir sketch cache (bench.py's e2e stage, tests) must build the
+    snapshot through this helper so it can never drift from the check in
+    :func:`sketch_genomes`."""
+    return {
+        "k": k, "sketch_size": sketch_size, "scale": scale,
+        "hash": hash_name, "genomes": sorted(genomes),
+    }
+
+
 def sketch_genomes(
     bdb: pd.DataFrame,
     k: int = kmers.DEFAULT_K,
@@ -74,16 +87,17 @@ def sketch_genomes(
     scale: int = DEFAULT_SCALE,
     processes: int = 1,
     wd: WorkDirectory | None = None,
+    hash_name: str = "splitmix64",
 ) -> GenomeSketches:
     """Sketch every genome in Bdb; cache/restore via the work directory."""
     logger = get_logger()
-    args_snapshot = {"k": k, "sketch_size": sketch_size, "scale": scale, "genomes": sorted(bdb["genome"])}
+    args_snapshot = sketch_args_snapshot(bdb["genome"], k, sketch_size, scale, hash_name)
 
     if wd is not None and wd.has_arrays("sketches") and wd.arguments_match("sketch", args_snapshot):
         logger.info("loading cached sketches from workdir")
         return _load(wd, k, sketch_size, scale)
 
-    jobs = [(row.genome, row.location, k, sketch_size, scale) for row in bdb.itertuples()]
+    jobs = [(row.genome, row.location, k, sketch_size, scale, hash_name) for row in bdb.itertuples()]
     results: dict[str, dict] = {}
     if processes > 1 and len(jobs) > 1:
         with ProcessPoolExecutor(max_workers=processes) as pool:

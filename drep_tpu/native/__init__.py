@@ -92,6 +92,7 @@ def get_library() -> ctypes.CDLL | None:
             ctypes.c_int,
             ctypes.c_int64,
             ctypes.c_uint64,
+            ctypes.c_int,  # hash_id: 0 splitmix64, 1 murmur3
             ctypes.POINTER(_DrepSketch),
         ]
         lib.drep_sketch_free.restype = None
@@ -107,8 +108,11 @@ def scaled_max_hash(scale: int) -> int:
     return max_scaled_hash(scale)
 
 
+_HASH_IDS = {"splitmix64": 0, "murmur3": 1}
+
+
 def sketch_fasta_native(
-    path: str, k: int, sketch_size: int, scale: int
+    path: str, k: int, sketch_size: int, scale: int, hash_name: str = "splitmix64"
 ) -> dict | None:
     """Full per-genome ingest in one native call.
 
@@ -122,7 +126,8 @@ def sketch_fasta_native(
         return None
     out = _DrepSketch()
     rc = lib.drep_sketch_fasta(
-        path.encode(), k, sketch_size, scaled_max_hash(scale), ctypes.byref(out)
+        path.encode(), k, sketch_size, scaled_max_hash(scale),
+        _HASH_IDS[hash_name], ctypes.byref(out),
     )
     if rc == -1:
         if not os.path.exists(path):

@@ -47,6 +47,82 @@ static inline uint64_t splitmix64(uint64_t z) {
   return z ^ (z >> 31);
 }
 
+// ---- MurmurHash3_x64_128 (Austin Appleby, public domain), h1 only ----
+// Mash's hash for k > 16: MurmurHash3_x64_128(kmer ASCII bytes, seed 42),
+// first 8 little-endian bytes. Must stay byte-equal to the numpy port in
+// ops/kmers.py::murmur3_x64_128_h1 (verified in tests/test_native.py).
+
+static inline uint64_t rotl64_(uint64_t x, int8_t r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t fmix64_(uint64_t z) {
+  z ^= z >> 33;
+  z *= 0xFF51AFD7ED558CCDULL;
+  z ^= z >> 33;
+  z *= 0xC4CEB9FE1A85EC53ULL;
+  z ^= z >> 33;
+  return z;
+}
+
+static uint64_t murmur3_x64_128_h1(const uint8_t* data, int len, uint32_t seed) {
+  const int nblocks = len / 16;
+  uint64_t h1 = seed, h2 = seed;
+  const uint64_t c1 = 0x87C37B91114253D5ULL, c2 = 0x4CF5AB172766A3B1ULL;
+  for (int i = 0; i < nblocks; ++i) {
+    uint64_t k1, k2;
+    std::memcpy(&k1, data + 16 * i, 8);  // host is little-endian (x86/arm64)
+    std::memcpy(&k2, data + 16 * i + 8, 8);
+    k1 *= c1; k1 = rotl64_(k1, 31); k1 *= c2; h1 ^= k1;
+    h1 = rotl64_(h1, 27); h1 += h2; h1 = h1 * 5 + 0x52DCE729ULL;
+    k2 *= c2; k2 = rotl64_(k2, 33); k2 *= c1; h2 ^= k2;
+    h2 = rotl64_(h2, 31); h2 += h1; h2 = h2 * 5 + 0x38495AB5ULL;
+  }
+  const uint8_t* tail = data + nblocks * 16;
+  uint64_t k1 = 0, k2 = 0;
+  switch (len & 15) {
+    case 15: k2 ^= ((uint64_t)tail[14]) << 48; [[fallthrough]];
+    case 14: k2 ^= ((uint64_t)tail[13]) << 40; [[fallthrough]];
+    case 13: k2 ^= ((uint64_t)tail[12]) << 32; [[fallthrough]];
+    case 12: k2 ^= ((uint64_t)tail[11]) << 24; [[fallthrough]];
+    case 11: k2 ^= ((uint64_t)tail[10]) << 16; [[fallthrough]];
+    case 10: k2 ^= ((uint64_t)tail[9]) << 8; [[fallthrough]];
+    case 9:
+      k2 ^= ((uint64_t)tail[8]);
+      k2 *= c2; k2 = rotl64_(k2, 33); k2 *= c1; h2 ^= k2;
+      [[fallthrough]];
+    case 8: k1 ^= ((uint64_t)tail[7]) << 56; [[fallthrough]];
+    case 7: k1 ^= ((uint64_t)tail[6]) << 48; [[fallthrough]];
+    case 6: k1 ^= ((uint64_t)tail[5]) << 40; [[fallthrough]];
+    case 5: k1 ^= ((uint64_t)tail[4]) << 32; [[fallthrough]];
+    case 4: k1 ^= ((uint64_t)tail[3]) << 24; [[fallthrough]];
+    case 3: k1 ^= ((uint64_t)tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= ((uint64_t)tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k1 ^= ((uint64_t)tail[0]);
+      k1 *= c1; k1 = rotl64_(k1, 31); k1 *= c2; h1 ^= k1;
+  }
+  h1 ^= (uint64_t)len;
+  h2 ^= (uint64_t)len;
+  h1 += h2;
+  h2 += h1;
+  h1 = fmix64_(h1);
+  h2 = fmix64_(h2);
+  h1 += h2;  // h2 += h1 would finish the 128-bit digest; only h1 is used
+  return h1;
+}
+
+static const char kBaseAscii[4] = {'A', 'C', 'G', 'T'};
+
+// canonical packed k-mer -> ASCII -> murmur3 h1 with Mash's seed
+static inline uint64_t murmur3_kmer(uint64_t canon, int k) {
+  uint8_t buf[32];
+  for (int i = 0; i < k; ++i) {
+    buf[i] = (uint8_t)kBaseAscii[(canon >> (2 * (k - 1 - i))) & 3];
+  }
+  return murmur3_x64_128_h1(buf, k, 42);
+}
+
 // LSD radix sort, four 16-bit passes. The hashes are splitmix64 outputs
 // (uniform bits), the worst case for comparison sorts' branch predictors —
 // radix is ~5x faster than std::sort at the 5M-hash scale of a real MAG.
@@ -92,9 +168,10 @@ struct BaseCode {
 static const BaseCode kBase;
 
 // returns 0 on success, -1 file error, -2 bad args
+// hash_id: 0 = splitmix64 over the packed value, 1 = murmur3 (Mash-compatible)
 int drep_sketch_fasta(const char* path, int k, int64_t sketch_size,
-                      uint64_t scaled_max, DrepSketch* out) {
-  if (k < 1 || k > 31 || out == nullptr) return -2;
+                      uint64_t scaled_max, int hash_id, DrepSketch* out) {
+  if (k < 1 || k > 31 || out == nullptr || hash_id < 0 || hash_id > 1) return -2;
   std::memset(out, 0, sizeof(*out));
 
   gzFile f = gzopen(path, "rb");
@@ -145,7 +222,9 @@ int drep_sketch_fasta(const char* path, int k, int64_t sketch_size,
       fwd = ((fwd << 2) | b) & mask;
       rev = (rev >> 2) | ((uint64_t)(3 - b) << shift);
       if (++run >= k) {
-        hashes.push_back(splitmix64(fwd < rev ? fwd : rev));
+        const uint64_t canon = fwd < rev ? fwd : rev;
+        hashes.push_back(hash_id == 1 ? murmur3_kmer(canon, k)
+                                      : splitmix64(canon));
       }
     }
   };

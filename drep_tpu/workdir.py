@@ -26,6 +26,14 @@ from drep_tpu.utils.logger import get_logger
 
 _SUBDIRS = ["data", "data_tables", "figures", "log", "dereplicated_genomes", os.path.join("data", "arrays")]
 
+# snapshot keys added after the first release, with the value every older
+# workdir implicitly used. A stored snapshot missing one of these keys must
+# compare EQUAL to the key's historical default — otherwise upgrading the
+# tool would invalidate every existing cache/resume for no numeric reason.
+LEGACY_SNAPSHOT_DEFAULTS: dict[str, Any] = {
+    "hash": "splitmix64",
+}
+
 
 def _json_default(o: Any):
     if isinstance(o, (np.integer,)):
@@ -103,11 +111,16 @@ class WorkDirectory:
 
         `keys` restricts the comparison to resume-relevant flags (the
         reference compares the clustering-relevant subset, not e.g. -p).
+        Stored snapshots from older releases may lack recently-added keys;
+        those fill in from LEGACY_SNAPSHOT_DEFAULTS so an upgrade does not
+        invalidate byte-identical caches.
         """
         stored = self.get_arguments(stage)
         if stored is None:
             return False
+        stored = {**LEGACY_SNAPSHOT_DEFAULTS, **stored}
         current = json.loads(json.dumps(kwargs, default=_json_default, sort_keys=True))
+        current = {**LEGACY_SNAPSHOT_DEFAULTS, **current}  # both sides, symmetric
         if keys is None:
             keys = sorted(set(stored) | set(current))
         return all(stored.get(k) == current.get(k) for k in keys)

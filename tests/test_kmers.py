@@ -66,6 +66,113 @@ def test_splitmix64_matches_oracle(rng):
         assert int(g) == oracle_splitmix64(int(x))
 
 
+MASK64 = (1 << 64) - 1
+
+
+def oracle_murmur3_h1(data: bytes, seed: int) -> int:
+    """Independent scalar port of MurmurHash3_x64_128 (h1), written from the
+    public-domain reference — the numpy vectorization must match it."""
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & MASK64
+
+    def fmix(z):
+        z ^= z >> 33
+        z = (z * 0xFF51AFD7ED558CCD) & MASK64
+        z ^= z >> 33
+        z = (z * 0xC4CEB9FE1A85EC53) & MASK64
+        z ^= z >> 33
+        return z
+
+    c1, c2 = 0x87C37B91114253D5, 0x4CF5AB172766A3B1
+    h1 = h2 = seed
+    nblocks = len(data) // 16
+    for i in range(nblocks):
+        k1 = int.from_bytes(data[16 * i : 16 * i + 8], "little")
+        k2 = int.from_bytes(data[16 * i + 8 : 16 * i + 16], "little")
+        k1 = (k1 * c1) & MASK64
+        k1 = rotl(k1, 31)
+        k1 = (k1 * c2) & MASK64
+        h1 ^= k1
+        h1 = rotl(h1, 27)
+        h1 = (h1 + h2) & MASK64
+        h1 = (h1 * 5 + 0x52DCE729) & MASK64
+        k2 = (k2 * c2) & MASK64
+        k2 = rotl(k2, 33)
+        k2 = (k2 * c1) & MASK64
+        h2 ^= k2
+        h2 = rotl(h2, 31)
+        h2 = (h2 + h1) & MASK64
+        h2 = (h2 * 5 + 0x38495AB5) & MASK64
+    tail = data[nblocks * 16 :]
+    if len(tail) > 8:
+        k2 = int.from_bytes(tail[8:], "little")
+        k2 = (k2 * c2) & MASK64
+        k2 = rotl(k2, 33)
+        k2 = (k2 * c1) & MASK64
+        h2 ^= k2
+    if len(tail) > 0:
+        k1 = int.from_bytes(tail[:8], "little")
+        k1 = (k1 * c1) & MASK64
+        k1 = rotl(k1, 31)
+        k1 = (k1 * c2) & MASK64
+        h1 ^= k1
+    h1 ^= len(data)
+    h2 ^= len(data)
+    h1 = (h1 + h2) & MASK64
+    h2 = (h2 + h1) & MASK64
+    h1 = fmix(h1)
+    h2 = fmix(h2)
+    return (h1 + h2) & MASK64
+
+
+@pytest.mark.parametrize("length", [1, 5, 8, 9, 15, 16, 17, 21, 24, 31, 33])
+@pytest.mark.parametrize("seed", [0, 42])
+def test_murmur3_matches_scalar_oracle(rng, length, seed):
+    data = rng.integers(0, 256, size=(16, length)).astype(np.uint8)
+    got = kmers.murmur3_x64_128_h1(data, seed=seed)
+    for row, g in zip(data, got):
+        assert int(g) == oracle_murmur3_h1(bytes(row.tolist()), seed)
+
+
+def test_murmur3_zero_length_seed0_is_zero():
+    # true known-answer: x64_128("") with seed 0 finalizes to all-zero bits
+    got = kmers.murmur3_x64_128_h1(np.zeros((1, 0), np.uint8), seed=0)
+    assert int(got[0]) == 0
+
+
+def test_kmer_ascii_bytes_roundtrip():
+    k = 21
+    seq = b"ACGTACGTACGTACGTACGTA"
+    canon = kmers.packed_kmers(seq, k)
+    ascii_rows = kmers.kmer_ascii_bytes(canon, k)
+    # first k-mer is the full (canonical) sequence — decode and re-pack
+    redecoded = bytes(ascii_rows[0].tolist())
+    assert kmers.packed_kmers(redecoded, k)[0] == canon[0]
+
+
+def test_hash_kmers_dispatch(rng):
+    seq = "".join(rng.choice(list("ACGT"), size=300)).encode()
+    canon = kmers.packed_kmers(seq, 21)
+    sm = kmers.hash_kmers(canon, 21, "splitmix64")
+    m3 = kmers.hash_kmers(canon, 21, "murmur3")
+    assert not np.array_equal(sm, m3)
+    # murmur3 values equal the scalar oracle over the ASCII k-mer strings
+    ascii_rows = kmers.kmer_ascii_bytes(canon, 21)
+    for row, g in zip(ascii_rows[:20], m3[:20]):
+        assert int(g) == oracle_murmur3_h1(bytes(row.tolist()), kmers.MASH_SEED)
+    with pytest.raises(ValueError, match="unknown hash"):
+        kmers.hash_kmers(canon, 21, "sha1")
+
+
+def test_murmur3_strand_invariance(rng):
+    seq = "".join(rng.choice(list("ACGT"), size=400))
+    rc = "".join(COMP[c] for c in reversed(seq))
+    a = kmers.kmer_hashes(seq.encode(), 21, hash_name="murmur3")
+    b = kmers.kmer_hashes(rc.encode(), 21, hash_name="murmur3")
+    assert np.array_equal(a, b)
+
+
 def test_kmer_hashes_sorted_unique():
     seq = b"ACGT" * 100
     h = kmers.kmer_hashes(seq, 21)

@@ -137,13 +137,33 @@ def test_pipeline_uses_native_transparently(bdb):
     from drep_tpu.ingest import _sketch_one
 
     row = next(bdb.itertuples())
-    _, via_native = _sketch_one((row.genome, row.location, K, SKETCH, SCALE))
+    _, via_native = _sketch_one((row.genome, row.location, K, SKETCH, SCALE, "splitmix64"))
     os.environ["DREP_TPU_NO_NATIVE"] = "1"
     try:
-        _, via_numpy = _sketch_one((row.genome, row.location, K, SKETCH, SCALE))
+        _, via_numpy = _sketch_one((row.genome, row.location, K, SKETCH, SCALE, "splitmix64"))
     finally:
         del os.environ["DREP_TPU_NO_NATIVE"]
     _assert_equal(via_native, via_numpy)
+
+
+@needs_native
+def test_native_murmur3_matches_numpy(genome_paths):
+    """The Mash-compatible murmur3 hash must be byte-equal across the C++
+    and numpy ingest paths (both sketches AND the FracMinHash fast-path
+    rule are hash-dependent)."""
+    path = genome_paths[0]
+    native = sketch_fasta_native(path, K, SKETCH, SCALE, hash_name="murmur3")
+    contigs = read_fasta_contigs(path)
+    raw = np.concatenate(
+        [kmers.hash_kmers(kmers.packed_kmers(c, K), K, "murmur3") for c in contigs]
+    )
+    bottom, scaled, n_kmers = kmers.sketches_from_raw(raw, SKETCH, SCALE)
+    np.testing.assert_array_equal(native["bottom"], bottom)
+    np.testing.assert_array_equal(native["scaled"], scaled)
+    assert native["n_kmers"] == n_kmers
+    # and it is genuinely a different hash from the default
+    default = sketch_fasta_native(path, K, SKETCH, SCALE)
+    assert not np.array_equal(native["bottom"], default["bottom"])
 
 
 @needs_native

@@ -120,6 +120,22 @@ def test_multiround_matches_default_primary(tmp_path, genome_paths):
     assert _partition(cdb_default) == _partition(cdb_multi)
 
 
+# ---- murmur3 hash option ----------------------------------------------------
+
+
+def test_murmur3_hash_matches_default_partition(tmp_path, genome_paths):
+    """--hash murmur3 (Mash-compatible hashing) changes sketch VALUES but
+    must not change the fixture's clustering — both hashes sample the same
+    k-mer sets uniformly."""
+    cdb_default = compare_wrapper(
+        str(tmp_path / "wd1"), genome_paths, skip_plots=True
+    )
+    cdb_m3 = compare_wrapper(
+        str(tmp_path / "wd2"), genome_paths, hash="murmur3", skip_plots=True
+    )
+    assert _partition(cdb_default) == _partition(cdb_m3)
+
+
 # ---- evaluate: Widb ---------------------------------------------------------
 
 

@@ -44,6 +44,17 @@ def test_arguments_match(tmp_path):
     assert wd.arguments_match("cluster", {**args, "S_ani": 0.99}, keys=["P_ani", "genomes"])
 
 
+def test_arguments_match_legacy_snapshot_missing_hash(tmp_path):
+    """A snapshot written before the --hash flag existed must still match a
+    current run with the default hash — upgrading the tool must not throw
+    away byte-identical sketch caches."""
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    legacy = {"k": 21, "sketch_size": 1000, "scale": 200, "genomes": ["a"]}
+    wd.store_arguments("sketch", legacy)
+    assert wd.arguments_match("sketch", {**legacy, "hash": "splitmix64"})
+    assert not wd.arguments_match("sketch", {**legacy, "hash": "murmur3"})
+
+
 def test_numpy_types_serializable(tmp_path):
     wd = WorkDirectory(str(tmp_path / "wd"))
     wd.store_arguments("x", {"a": np.int64(3), "b": np.float32(0.5), "c": np.array([1, 2])})
