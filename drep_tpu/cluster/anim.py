@@ -323,10 +323,132 @@ def secondary_gani(gs, indices, bdb=None, processes: int = 1, **_):
     return ani, cov
 
 
+# ---- goANI: prodigal + nsimscan (open-source gANI replacement) --------------
+
+# nsimscan tabular output headers vary across releases; columns are located
+# by name from these alias sets (same strategy as parse_gani_file above —
+# the reference, too, parses by header name because orders differ)
+_NSIMSCAN_COLS = {
+    "query": ("q_id", "qid", "query", "qry_id", "qry"),
+    "subject": ("s_id", "sid", "subject", "sbj_id", "sbj"),
+    "al_len": ("al_len", "alen", "length", "aln_len"),
+    "pident": ("p_inden", "p_ident", "pident", "identity", "p_identity"),
+}
+
+
+def parse_nsimscan_table(path: str) -> list[tuple[str, str, int, float]]:
+    """nsimscan tab output -> [(query_gene, subject_gene, al_len, pident)].
+
+    The first non-empty line must be a header naming the four required
+    columns (any alias, any order, case-insensitive); rows failing to parse
+    numerically are skipped (nsimscan appends summary lines in some modes).
+    """
+    with open(path) as f:
+        lines = [ln.split("\t") for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        return []
+    header = [h.strip().lower() for h in lines[0]]
+    col: dict[str, int] = {}
+    for want, aliases in _NSIMSCAN_COLS.items():
+        for a in aliases:
+            if a in header:
+                col[want] = header.index(a)
+                break
+    missing = [c for c in _NSIMSCAN_COLS if c not in col]
+    if missing:
+        raise RuntimeError(
+            f"unrecognized nsimscan header {header} in {path}: missing {missing}"
+        )
+    out: list[tuple[str, str, int, float]] = []
+    for row in lines[1:]:
+        if len(row) <= max(col.values()):
+            continue
+        try:
+            out.append(
+                (
+                    row[col["query"]].strip(),
+                    row[col["subject"]].strip(),
+                    int(float(row[col["al_len"]])),
+                    float(row[col["pident"]]),
+                )
+            )
+        except ValueError:
+            continue  # summary/comment row
+    return out
+
+
+def goani_ani_af(
+    hits: list[tuple[str, str, int, float]], qry_gene_lengths: dict[str, int]
+) -> tuple[float, float]:
+    """(ani, af) for one direction from nsimscan gene hits.
+
+    Per query gene the single best hit (largest al_len * pident) is kept —
+    the reference's process_goani_files keeps one reciprocal-best per gene
+    for the same reason gANI does: paralogs must not double-count. ANI is
+    the alignment-length-weighted mean identity over kept hits; AF is the
+    kept aligned length over the total query gene length.
+    """
+    best: dict[str, tuple[int, float]] = {}
+    for q, _s, al, pid in hits:
+        score = al * pid
+        if q not in best or score > best[q][0] * best[q][1]:
+            best[q] = (al, pid)
+    total_aln = sum(al for al, _ in best.values())
+    total_len = sum(qry_gene_lengths.values())
+    if total_aln == 0 or total_len == 0:
+        return 0.0, 0.0
+    ani = sum(al * pid for al, pid in best.values()) / total_aln / 100.0
+    return min(ani, 1.0), min(total_aln / total_len, 1.0)
+
+
+def _gene_lengths(genes_fna: str) -> dict[str, int]:
+    from drep_tpu.utils.fasta import read_fasta_headers_lengths
+
+    return dict(read_fasta_headers_lengths(genes_fna))
+
+
+def _nsimscan_pair(args) -> tuple[int, int, float, float]:
+    i, j, genes_i, genes_j, lens_i, tmp = args
+    out = os.path.join(tmp, f"ns{i}_{j}.tab")
+    # TABX: tab-separated with header (the output mode the reference's
+    # goANI path consumes; exact flag set unverifiable — mount empty)
+    _run(["nsimscan", "--om", "TABX", genes_i, genes_j, out])
+    ani, af = goani_ani_af(parse_nsimscan_table(out), lens_i)
+    return i, j, ani, af
+
+
 @register_secondary("goANI")
 def secondary_goani(gs, indices, bdb=None, processes: int = 1, **_):
-    """Open-source gANI replacement (prodigal + nsimscan in the reference)."""
-    raise NotImplementedError(
-        "goANI subprocess path is not implemented in this build — use "
-        "--S_algorithm jax_ani (TPU-native) or gANI/ANImf"
-    )
+    """Open-source gANI replacement: prodigal gene calls + nsimscan
+    all-vs-all gene alignment (reference goANI path)."""
+    _require("nsimscan")
+    if bdb is None:
+        raise ValueError("goANI needs Bdb (paths to the FASTA files)")
+    loc = {r.genome: r.location for r in bdb.itertuples()}
+    names = [gs.names[i] for i in indices]
+    m = len(names)
+    ani = np.zeros((m, m), np.float32)
+    cov = np.zeros((m, m), np.float32)
+    with tempfile.TemporaryDirectory() as tmp:
+        with ThreadPoolExecutor(max_workers=max(processes, 1)) as pool:
+            genes = list(
+                pool.map(
+                    lambda tg: _prodigal_genes(loc[tg[1]], tmp, stem=f"genome_{tg[0]}"),
+                    enumerate(names),
+                )
+            )
+            lens = [_gene_lengths(g) for g in genes]
+            # directional: gene hits of i's genes against j's gene set give
+            # ani/AF (i->j); both directions run (like gANI's two columns)
+            jobs = [
+                (i, j, genes[i], genes[j], lens[i], tmp)
+                for i in range(m)
+                for j in range(m)
+                if i != j
+            ]
+            for i, j, a, f in pool.map(_nsimscan_pair, jobs):
+                ani[i, j] = a
+                cov[i, j] = f
+    np.fill_diagonal(ani, 1.0)
+    np.fill_diagonal(cov, 1.0)
+    return ani, cov

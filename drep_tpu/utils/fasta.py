@@ -56,6 +56,28 @@ def read_fasta_contigs(path: str) -> list[bytes]:
     return contigs
 
 
+def read_fasta_headers_lengths(path: str) -> list[tuple[str, int]]:
+    """[(record_id, sequence_length)] per record — record_id is the first
+    whitespace-delimited token of the header (the id nsimscan/prodigal
+    reports in hit tables)."""
+    out: list[tuple[str, int]] = []
+    name: str | None = None
+    length = 0
+    with _open_maybe_gzip(path) as f:
+        data = f.read()
+    for line in data.split(b"\n"):
+        if line.startswith(b">"):
+            if name is not None:
+                out.append((name, length))
+            name = line[1:].split()[0].decode() if line[1:].split() else ""
+            length = 0
+        else:
+            length += len(line.strip())
+    if name is not None:
+        out.append((name, length))
+    return out
+
+
 def read_fasta_concat(path: str, separator: bytes = b"N") -> bytes:
     """All contigs joined by one `N` (k-mer windows never span contigs,
     because windows containing non-ACGT are masked out downstream)."""

@@ -137,6 +137,84 @@ def test_missing_binary_raises_informative(sketches, bdb, monkeypatch):
         engine(sketches, [0, 1], bdb=bdb)
 
 
-def test_goani_not_implemented(sketches, bdb):
-    with pytest.raises(NotImplementedError, match="jax_ani"):
+def test_goani_missing_binary_raises_informative(sketches, bdb, monkeypatch):
+    # dispatch works; without the binary the error names nsimscan, not a stub
+    import drep_tpu.cluster.external as ext
+
+    monkeypatch.setattr(ext.shutil, "which", lambda _: None)
+    with pytest.raises(RuntimeError, match="nsimscan"):
         get_secondary("goANI")(sketches, [0, 1], bdb=bdb)
+
+
+# ---- goANI parser + scoring (binary-free) -----------------------------------
+
+NSIMSCAN_TABLE = (
+    "Q_id\tS_id\tAL_LEN\tP_INDEN\n"
+    "gene1\tsubjA\t900\t99.0\n"
+    "gene1\tsubjB\t300\t99.9\n"  # worse al_len*pident than the 900bp hit
+    "gene2\tsubjC\t600\t97.0\n"
+    "# summary line that must be skipped\tx\ty\tz\n"
+)
+
+
+def test_parse_nsimscan_table(tmp_path):
+    from drep_tpu.cluster.anim import parse_nsimscan_table
+
+    p = tmp_path / "ns.tab"
+    p.write_text(NSIMSCAN_TABLE)
+    hits = parse_nsimscan_table(str(p))
+    assert hits == [
+        ("gene1", "subjA", 900, 99.0),
+        ("gene1", "subjB", 300, 99.9),
+        ("gene2", "subjC", 600, 97.0),
+    ]
+
+
+def test_parse_nsimscan_column_order_independent(tmp_path):
+    from drep_tpu.cluster.anim import parse_nsimscan_table
+
+    p = tmp_path / "ns.tab"
+    p.write_text("p_ident\tquery\tlength\tsubject\n98.5\tg1\t450\ts1\n")
+    assert parse_nsimscan_table(str(p)) == [("g1", "s1", 450, 98.5)]
+
+
+def test_parse_nsimscan_bad_header_raises(tmp_path):
+    from drep_tpu.cluster.anim import parse_nsimscan_table
+
+    p = tmp_path / "ns.tab"
+    p.write_text("foo\tbar\n1\t2\n")
+    with pytest.raises(RuntimeError, match="missing"):
+        parse_nsimscan_table(str(p))
+
+
+def test_goani_ani_af_best_hit_per_gene():
+    from drep_tpu.cluster.anim import goani_ani_af, parse_nsimscan_table
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "ns.tab")
+        with open(p, "w") as f:
+            f.write(NSIMSCAN_TABLE)
+        hits = parse_nsimscan_table(p)
+    lens = {"gene1": 1000, "gene2": 800, "gene3": 500}  # gene3: no hit
+    ani, af = goani_ani_af(hits, lens)
+    # best hits: gene1->subjA (900bp @99), gene2->subjC (600bp @97)
+    want_ani = (900 * 99.0 + 600 * 97.0) / (900 + 600) / 100.0
+    want_af = (900 + 600) / (1000 + 800 + 500)
+    assert ani == pytest.approx(want_ani)
+    assert af == pytest.approx(want_af)
+
+
+def test_goani_ani_af_empty():
+    from drep_tpu.cluster.anim import goani_ani_af
+
+    assert goani_ani_af([], {"g": 100}) == (0.0, 0.0)
+    assert goani_ani_af([("g", "s", 10, 99.0)], {}) == (0.0, 0.0)
+
+
+def test_read_fasta_headers_lengths(tmp_path):
+    from drep_tpu.utils.fasta import read_fasta_headers_lengths
+
+    p = tmp_path / "genes.fna"
+    p.write_text(">gene1 # 1 # 900 # meta\nACGTACGT\nACGT\n>gene2\nACG\n")
+    assert read_fasta_headers_lengths(str(p)) == [("gene1", 12), ("gene2", 3)]
