@@ -37,37 +37,46 @@ def evaluate_warnings(
     winners = set(wdb["genome"])
     cluster_of = cdb.set_index("genome")["secondary_cluster"]
 
+    # every filter below is a vectorized mask; only the (few) surviving rows
+    # are string-formatted. The itertuples loops this replaces walked the
+    # FULL sparse Mdb/Ndb — millions of Python iterations at 100k genomes.
     if mdb is not None and len(mdb):
         close = mdb[
-            (mdb["genome1"] != mdb["genome2"])
+            (mdb["genome1"] < mdb["genome2"])
             & mdb["genome1"].isin(winners)
             & mdb["genome2"].isin(winners)
             & (mdb["dist"] <= kw["warn_dist"])
         ]
-        for row in close.itertuples():
-            if row.genome1 < row.genome2:
-                warnings.append(
-                    f"Primary: winners {row.genome1} and {row.genome2} have Mash "
-                    f"distance {row.dist:.4f} (<= warn_dist {kw['warn_dist']})"
-                )
+        warnings += [
+            f"Primary: winners {g1} and {g2} have Mash "
+            f"distance {d:.4f} (<= warn_dist {kw['warn_dist']})"
+            for g1, g2, d in zip(close["genome1"], close["genome2"], close["dist"])
+        ]
 
     if ndb is not None and len(ndb):
-        for row in ndb.itertuples():
-            a, b = row.querry, row.reference
-            if a >= b or a not in winners or b not in winners:
-                continue
-            if cluster_of.get(a) != cluster_of.get(b) and row.ani >= kw["warn_sim"]:
-                warnings.append(
-                    f"Secondary: winners {a} and {b} are in different secondary "
-                    f"clusters but have ANI {row.ani:.4f} (>= warn_sim {kw['warn_sim']})"
-                )
-        low_aln = ndb[(ndb["alignment_coverage"] > 0) & (ndb["alignment_coverage"] <= kw["warn_aln"])]
-        for row in low_aln.itertuples():
-            if row.querry < row.reference:
-                warnings.append(
-                    f"Coverage: {row.querry} vs {row.reference} aligned only "
-                    f"{row.alignment_coverage:.3f} (<= warn_aln {kw['warn_aln']})"
-                )
+        sub = ndb[
+            (ndb["querry"] < ndb["reference"])
+            & ndb["querry"].isin(winners)
+            & ndb["reference"].isin(winners)
+            & (ndb["ani"] >= kw["warn_sim"])
+        ]
+        split = sub["querry"].map(cluster_of).to_numpy() != sub["reference"].map(cluster_of).to_numpy()
+        sub = sub[split]
+        warnings += [
+            f"Secondary: winners {a} and {b} are in different secondary "
+            f"clusters but have ANI {ani:.4f} (>= warn_sim {kw['warn_sim']})"
+            for a, b, ani in zip(sub["querry"], sub["reference"], sub["ani"])
+        ]
+        low = ndb[
+            (ndb["querry"] < ndb["reference"])
+            & (ndb["alignment_coverage"] > 0)
+            & (ndb["alignment_coverage"] <= kw["warn_aln"])
+        ]
+        warnings += [
+            f"Coverage: {q} vs {r} aligned only "
+            f"{c:.3f} (<= warn_aln {kw['warn_aln']})"
+            for q, r, c in zip(low["querry"], low["reference"], low["alignment_coverage"])
+        ]
     return warnings
 
 
