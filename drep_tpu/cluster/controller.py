@@ -134,6 +134,28 @@ def _streaming_mdb(edges, names: list[str]) -> pd.DataFrame:
     return pd.DataFrame({"genome1": g1, "genome2": g2, "dist": d, "similarity": 1.0 - d})
 
 
+def _resolve_estimator_for_run(n: int, kw: dict[str, Any]) -> str:
+    """The estimator the run will ACTUALLY use, mirroring
+    `_primary_clusters`' branch order exactly (SkipMash -> multiround ->
+    streaming -> dense engine). Recorded in the resume snapshot; a naive
+    `resolve_primary_estimator(n)` alone would claim 'matmul' for a 40k-
+    genome run that in fact streams with sort tiles, producing spurious
+    boundary warnings on resume."""
+    if kw["SkipMash"] or n == 1:
+        return "skipmash"
+    if kw["multiround_primary_clustering"] and n > kw["primary_chunksize"]:
+        # per-chunk resolution: chunks are primary_chunksize genomes
+        per_chunk = engines.resolve_primary_estimator(
+            min(n, kw["primary_chunksize"]), kw["mesh_shape"], kw["primary_estimator"]
+        )
+        return f"multiround_{per_chunk}"
+    if kw["streaming_primary"] or (
+        kw["primary_algorithm"] == "jax_mash" and n >= kw["streaming_threshold"]
+    ):
+        return "streaming_sort"  # streaming always runs sort tiles
+    return engines.resolve_primary_estimator(n, kw["mesh_shape"], kw["primary_estimator"])
+
+
 def _primary_clusters(
     gs: GenomeSketches, bdb: pd.DataFrame, kw: dict[str, Any], wd: WorkDirectory | None = None
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, pd.DataFrame | None, int]:
@@ -155,11 +177,24 @@ def _primary_clusters(
         from drep_tpu.ops.minhash import pack_sketches
         from drep_tpu.parallel.streaming import streaming_primary_clusters
 
+        if not kw["streaming_primary"]:
+            logger.warning(
+                "%d genomes >= --streaming_threshold %d: primary stage auto-switches "
+                "to the out-of-core streaming path (pass --streaming_primary to opt "
+                "in explicitly, or raise the threshold to keep the dense path)",
+                n, kw["streaming_threshold"],
+            )
         if kw["clusterAlg"] != "single":
             logger.warning(
                 "streaming primary computes single-linkage (connected components "
                 "at 1-P_ani); --clusterAlg %s applies only to secondary clustering",
                 kw["clusterAlg"],
+            )
+        if kw["primary_estimator"] not in ("auto", "sort"):
+            logger.warning(
+                "streaming primary always uses the sort (union-bottom-s) tile "
+                "estimator; --primary_estimator %s is ignored on this path",
+                kw["primary_estimator"],
             )
         ckpt = wd.get_dir(os.path.join("data", "streaming_primary")) if wd is not None else None
         packed = pack_sketches(gs.bottom, gs.names, gs.sketch_size)
@@ -234,7 +269,24 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     snapshot["warn_dist"] = _warn_dist(kw)
     snapshot["genomes"] = sorted(bdb["genome"])
 
-    if wd.hasDb("Cdb") and wd.arguments_match("cluster", snapshot):
+    # the concrete estimator 'auto' resolves to HERE (it depends on N and on
+    # this host's device count). Stored for boundary detection, excluded
+    # from the match keys — a changed resolution must warn, not recompute
+    # (the families agree within estimator variance; SURVEY.md §7 step 3).
+    snapshot["primary_estimator_resolved"] = _resolve_estimator_for_run(len(bdb), kw)
+    match_keys = [k for k in snapshot if k != "primary_estimator_resolved"]
+
+    if wd.hasDb("Cdb") and wd.arguments_match("cluster", snapshot, keys=match_keys):
+        stored = wd.get_arguments("cluster") or {}
+        stored_resolved = stored.get("primary_estimator_resolved")
+        if stored_resolved is not None and stored_resolved != snapshot["primary_estimator_resolved"]:
+            logger.warning(
+                "resuming a workdir whose primary estimator resolved to %r, but this "
+                "run would resolve to %r (N or device count crossed an auto-selection "
+                "boundary). The cached Mdb is kept — its per-pair values differ from a "
+                "fresh run within estimator variance; delete Cdb/Mdb to recompute.",
+                stored_resolved, snapshot["primary_estimator_resolved"],
+            )
         logger.info("resuming: Cdb present with matching cluster arguments — skipping recompute")
         return wd.get_db("Cdb")
 
@@ -286,10 +338,14 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
 
         greedy = kw["greedy_secondary_clustering"]
         batched_fn = None if greedy else dispatch.get_secondary_batched(kw["S_algorithm"])
-        # warn_dist shapes only the Mdb retention, never secondary results —
-        # keep it out of the checkpoint key so changing the warning
-        # threshold does not throw away the whole ANI stage
-        sec_snapshot = {k: v for k, v in snapshot.items() if k != "warn_dist"}
+        # warn_dist shapes only the Mdb retention, never secondary results;
+        # the resolved primary estimator never touches ANI numerics — keep
+        # both out of the checkpoint key so neither a warning-threshold
+        # change nor a device-count change throws away the whole ANI stage
+        sec_snapshot = {
+            k: v for k, v in snapshot.items()
+            if k not in ("warn_dist", "primary_estimator_resolved")
+        }
         ckpt = SecondaryCheckpoint(
             wd.get_dir(os.path.join("data", "secondary_checkpoints")),
             sec_snapshot, primary, gs.names,

@@ -44,6 +44,23 @@ def _mesh_or_none(mesh_shape: int | None, n: int):
 MATMUL_MIN_GENOMES = 512
 
 
+def resolve_primary_estimator(n: int, mesh_shape: int | None = None, estimator: str = "auto") -> str:
+    """The concrete estimator :func:`mash_distance_matrix` will run for `n`
+    genomes on THIS host ('ring_sort' | 'matmul' | 'sort').
+
+    Recorded into the cluster resume snapshot: 'auto' silently switches
+    family with N (and with device count), and the families agree only in
+    expectation — per-pair Mdb values differ within estimator variance. A
+    resumed workdir whose stored resolution differs gets a loud warning
+    (cluster/controller.py) instead of silently mixing numerics.
+    """
+    if _mesh_or_none(mesh_shape, n) is not None:
+        return "ring_sort"
+    if estimator == "matmul" or (estimator == "auto" and n >= MATMUL_MIN_GENOMES):
+        return "matmul"
+    return "sort"
+
+
 def mash_distance_matrix(
     packed,
     k: int,

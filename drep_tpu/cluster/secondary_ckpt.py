@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
+import uuid
 from typing import Any
 
 import numpy as np
@@ -34,13 +34,17 @@ class SecondaryCheckpoint:
         if ckpt_dir is None:
             return
         meta = {
+            # format 2 = npz payloads (format 1 was pickle — loading pickles
+            # from a shared/NFS workdir is arbitrary code execution, so the
+            # bump clears any v1 .pkl shards wholesale)
+            "format": 2,
             "snapshot": json.loads(json.dumps(snapshot, sort_keys=True, default=str)),
             "fingerprint": content_fingerprint(names, np.asarray(primary, dtype=np.int64)),
         }
-        open_checkpoint_dir(ckpt_dir, meta, clear_suffixes=(".pkl",))
+        open_checkpoint_dir(ckpt_dir, meta, clear_suffixes=(".npz", ".pkl"))
 
     def _loc(self, pc: int) -> str:
-        return os.path.join(self.dir, f"pc_{pc:06d}.pkl")
+        return os.path.join(self.dir, f"pc_{pc:06d}.npz")
 
     def load(self, pc: int):
         """(ndb, labels, link) for a finished cluster, or None."""
@@ -50,9 +54,10 @@ class SecondaryCheckpoint:
         if not os.path.exists(loc):
             return None
         try:
-            with open(loc, "rb") as f:
-                payload = pickle.load(f)
-            result = payload["ndb"], payload["labels"], payload["link"]
+            with np.load(loc, allow_pickle=False) as z:
+                cols = [str(c) for c in z["ndb_columns"]]
+                ndb = pd.DataFrame({c: z[f"ndb_col_{c}"] for c in cols})
+                result = ndb, z["labels"], z["link"]
             self.n_resumed += 1  # only after the payload fully validates
             return result
         except Exception:
@@ -69,9 +74,18 @@ class SecondaryCheckpoint:
         if self.dir is None:
             return
         loc = self._loc(pc)
-        tmp = loc + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump({"ndb": ndb, "labels": labels, "link": link}, f)
+        arrays: dict[str, np.ndarray] = {
+            "labels": np.asarray(labels),
+            "link": np.asarray(link),
+            "ndb_columns": np.array(list(ndb.columns), dtype=str),
+        }
+        for c in ndb.columns:
+            col = ndb[c].to_numpy()
+            if col.dtype == object:
+                col = col.astype(str)  # unicode arrays need no pickle
+            arrays[f"ndb_col_{c}"] = col
+        tmp = f"{loc}.tmp-{uuid.uuid4().hex}.npz"
+        np.savez_compressed(tmp, **arrays)
         os.replace(tmp, loc)  # atomic: no torn checkpoints
 
     def finish(self, n_total: int) -> None:

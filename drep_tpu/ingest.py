@@ -33,7 +33,10 @@ DEFAULT_SCALE = 200  # FracMinHash scale for the jax_ani secondary
 @dataclass
 class GenomeSketches:
     names: list[str]
-    gdb: pd.DataFrame  # genome, length, N50, contigs, n_kmers
+    # genome, length, N50, contigs, n_kmers. NB: n_kmers is the EXACT distinct
+    # count for small genomes but the FracMinHash estimate |scaled|*scale on
+    # the fast path — consumers (rep-ordering heuristics) tolerate the mix
+    gdb: pd.DataFrame
     bottom: list[np.ndarray]  # uint64 bottom-k sketches (sorted)
     scaled: list[np.ndarray]  # uint64 scaled sketches (sorted, ragged)
     k: int

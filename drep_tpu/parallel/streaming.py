@@ -170,9 +170,14 @@ def streaming_mash_edges(
                 n_resumed += 1
                 continue
             except Exception:  # truncated/corrupt shard (disk trouble,
-                # pre-atomic writer): recompute it
+                # pre-atomic writer): recompute it. The remove itself may
+                # fail (EACCES, flaky NFS) — recompute regardless, matching
+                # SecondaryCheckpoint.load
                 logger.warning("streaming primary: corrupt shard %s — recomputing", shard)
-                os.remove(shard)
+                import contextlib
+
+                with contextlib.suppress(OSError):
+                    os.remove(shard)
 
         if ids_on is None:
             ids_on = [jax.device_put(ids, dev) for dev in devices]

@@ -54,7 +54,7 @@ def test_primary_partition_change_invalidates(tmp_path):
 def test_corrupt_checkpoint_recomputed(tmp_path):
     ck = _mk(tmp_path)
     ck.save(1, *_payload())
-    pkl = glob.glob(str(tmp_path / "ckpt" / "pc_*.pkl"))[0]
+    pkl = glob.glob(str(tmp_path / "ckpt" / "pc_*.npz"))[0]
     with open(pkl, "wb") as f:
         f.write(b"garbage")
     ck2 = _mk(tmp_path)
@@ -75,7 +75,7 @@ def test_pipeline_resumes_secondary(tmp_path, genome_paths, monkeypatch):
 
     wd_loc = str(tmp_path / "wd")
     compare_wrapper(wd_loc, genome_paths, skip_plots=True)
-    pkls = glob.glob(os.path.join(wd_loc, "data", "secondary_checkpoints", "pc_*.pkl"))
+    pkls = glob.glob(os.path.join(wd_loc, "data", "secondary_checkpoints", "pc_*.npz"))
     assert len(pkls) == 2  # two multi-member primary clusters in the fixture
 
     # simulate a crash after secondary: remove Cdb/Ndb so the stage reruns,
