@@ -38,15 +38,49 @@ def _load_clustering(wd: WorkDirectory) -> dict | None:
         return pickle.load(f)
 
 
+def _cluster_thresholds(wd: WorkDirectory) -> tuple[float | None, float | None]:
+    """(primary 1-P_ani, secondary 1-S_ani) from the stored cluster args."""
+    args = wd.get_arguments("cluster") or {}
+    p = args.get("P_ani")
+    s = args.get("S_ani")
+    return (
+        (1.0 - float(p)) if p is not None else None,
+        (1.0 - float(s)) if s is not None else None,
+    )
+
+
+def _fancy_dendrogram(ax, link, names, threshold: float | None, xlabel: str, title: str):
+    """Dendrogram with the clustering cutoff drawn in — the reference's
+    fancy_dendrogram contract (drep/d_analyze.py upstream; mount empty):
+    the reader must see WHERE the tree was cut, not just the tree."""
+    sch.dendrogram(link, labels=names, orientation="left", ax=ax)
+    if threshold is not None:
+        ax.axvline(threshold, color="tab:red", linestyle="--", linewidth=1)
+        ax.annotate(
+            f"cut = {threshold:.3g}",
+            xy=(threshold, 1.0),
+            xycoords=("data", "axes fraction"),
+            xytext=(3, -2),
+            textcoords="offset points",
+            color="tab:red",
+            fontsize=8,
+            va="top",
+        )
+    ax.set_xlabel(xlabel)
+    ax.set_title(title)
+
+
 def plot_primary_dendrogram(wd: WorkDirectory) -> str | None:
     cf = _load_clustering(wd)
     if cf is None or cf.get("primary_linkage") is None or len(cf["primary_linkage"]) == 0:
         return None
     out = os.path.join(wd.get_loc("figures"), "Primary_clustering_dendrogram.pdf")
+    threshold, _ = _cluster_thresholds(wd)
     fig, ax = plt.subplots(figsize=(10, max(4, len(cf["primary_names"]) * 0.25)))
-    sch.dendrogram(cf["primary_linkage"], labels=cf["primary_names"], orientation="left", ax=ax)
-    ax.set_xlabel("Mash distance")
-    ax.set_title("Primary clustering (MinHash)")
+    _fancy_dendrogram(
+        ax, cf["primary_linkage"], cf["primary_names"], threshold,
+        "Mash distance", "Primary clustering (MinHash)",
+    )
     fig.tight_layout()
     fig.savefig(out)
     plt.close(fig)
@@ -60,15 +94,17 @@ def plot_secondary_dendrograms(wd: WorkDirectory) -> str | None:
     out = os.path.join(wd.get_loc("figures"), "Secondary_clustering_dendrograms.pdf")
     from matplotlib.backends.backend_pdf import PdfPages
 
+    _, threshold = _cluster_thresholds(wd)
     with PdfPages(out) as pdf:
         for pc, entry in sorted(cf["secondary"].items()):
             link, names = entry["linkage"], entry["names"]
             if link is None or len(link) == 0:
                 continue
             fig, ax = plt.subplots(figsize=(8, max(3, len(names) * 0.3)))
-            sch.dendrogram(link, labels=names, orientation="left", ax=ax)
-            ax.set_xlabel("1 - ANI")
-            ax.set_title(f"Secondary clustering, primary cluster {pc}")
+            _fancy_dendrogram(
+                ax, link, names, threshold,
+                "1 - ANI", f"Secondary clustering, primary cluster {pc}",
+            )
             fig.tight_layout()
             pdf.savefig(fig)
             plt.close(fig)
