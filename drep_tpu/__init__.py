@@ -17,7 +17,7 @@ SURVEY.md §0):
   (Bdb/Mdb/Ndb/Cdb/Sdb/Wdb) persisted through :class:`WorkDirectory`.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from drep_tpu.utils.logger import setup_logger  # noqa: F401
 from drep_tpu.workdir import WorkDirectory  # noqa: F401
