@@ -44,17 +44,22 @@ class _DrepSketch(ctypes.Structure):
 
 
 def _build_library() -> str | None:
-    """Compile ingest.cc -> cached .so keyed on source hash; None on failure."""
-    with open(_SOURCE, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    build_dir = os.path.join(_HERE, "_build")
-    so_path = os.path.join(build_dir, f"libdrep_native_{digest}.so")
-    if os.path.exists(so_path):
-        return so_path
-    os.makedirs(build_dir, exist_ok=True)
-    tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SOURCE, "-o", tmp, "-lz"]
+    """Compile ingest.cc -> cached .so keyed on source hash; None on failure.
+
+    EVERYTHING here may fail benignly — including makedirs when the package
+    sits in a read-only site-packages — and must degrade to the numpy path,
+    never abort ingest (the module contract)."""
+    tmp = None
     try:
+        with open(_SOURCE, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        build_dir = os.path.join(_HERE, "_build")
+        so_path = os.path.join(build_dir, f"libdrep_native_{digest}.so")
+        if os.path.exists(so_path):
+            return so_path
+        os.makedirs(build_dir, exist_ok=True)
+        tmp = so_path + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SOURCE, "-o", tmp, "-lz"]
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         if res.returncode != 0:
             get_logger().debug("native build failed: %s", res.stderr[-1000:])
@@ -65,7 +70,7 @@ def _build_library() -> str | None:
         get_logger().debug("native build unavailable: %s", e)
         return None
     finally:
-        if os.path.exists(tmp):
+        if tmp is not None and os.path.exists(tmp):
             os.unlink(tmp)
 
 
