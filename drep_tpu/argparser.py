@@ -87,6 +87,12 @@ def build_parser() -> argparse.ArgumentParser:
                               "<wd>/log/jax_trace). perf_counters.json is always written")
 
         if with_filter:
+            tax = p.add_argument_group("TAXONOMY")
+            tax.add_argument("--run_tax", action="store_true",
+                             help="assign per-genome taxonomy with centrifuge (Tdb)")
+            tax.add_argument("--cent_index", default=None,
+                             help="centrifuge index prefix (required with --run_tax)")
+
             filt = p.add_argument_group("FILTERING")
             filt.add_argument("-l", "--length", type=int, default=50_000)
             filt.add_argument("-comp", "--completeness", type=float, default=75.0)

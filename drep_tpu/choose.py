@@ -137,7 +137,11 @@ def d_choose_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.DataF
         extra = pd.read_csv(kwargs["extra_weight_table"], sep=None, engine="python")
 
     sdb_full = score_genomes(cdb, stats, quality, ndb, extra_weights=extra, **kwargs)
-    sdb = sdb_full[["genome", "score"]]
+    sdb = sdb_full[["genome", "score"]].copy()
+    # the reference ABORTS dereplicate without quality info; we proceed with
+    # the quality terms scoring 0 (documented delta) — but the Sdb must say
+    # so, or a downstream reader would take the scores as quality-informed
+    sdb["quality_informed"] = quality is not None
     wd.store_db(schemas.validate(sdb, "Sdb"), "Sdb")
 
     wdb = pick_winners(sdb_full)

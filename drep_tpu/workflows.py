@@ -89,10 +89,22 @@ def dereplicate_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs)
     from drep_tpu.utils.profiling import trace
 
     wd, bdb = _init(wd_loc, genomes or [])
+    if kwargs.get("run_tax"):
+        from drep_tpu.bonus import validate_bonus_args
+
+        validate_bonus_args(kwargs)  # fail fast, before hours of clustering
     filtered = d_filter_wrapper(wd, bdb, genomeInfo=kwargs.pop("genomeInfo", None), **kwargs)
     with trace(_trace_dir(wd, kwargs.pop("profile", None))):
         d_cluster_wrapper(wd, filtered, **kwargs)
     wdb = d_choose_wrapper(wd, filtered, **kwargs)
+    if kwargs.get("run_tax"):
+        from drep_tpu.bonus import d_bonus_wrapper
+
+        d_bonus_wrapper(
+            wd, filtered,
+            cent_index=kwargs.get("cent_index"),
+            processes=kwargs.get("processes", 1),
+        )
     d_evaluate_wrapper(wd, **kwargs)
     if not kwargs.get("skip_plots", False):
         from drep_tpu.analyze import plot_all

@@ -1,0 +1,89 @@
+"""Bonus stage (centrifuge taxonomy): parser + reduction, binary-free.
+
+Like the nucmer/gANI/nsimscan parsers, the report parsing is pure Python
+tested against synthetic centrifuge output, so the contract holds on
+machines without the binary (this image has none).
+"""
+
+import pandas as pd
+import pytest
+
+from drep_tpu.bonus import genome_taxonomy, parse_centrifuge_report
+
+REPORT = (
+    "name\ttaxID\ttaxRank\tgenomeSize\tnumReads\tnumUniqueReads\tabundance\n"
+    "Escherichia coli\t562\tspecies\t4641652\t900\t700\t0.7\n"
+    "Salmonella enterica\t28901\tspecies\t4857450\t400\t200\t0.2\n"
+    "Enterobacteriaceae\t543\tfamily\t0\t1300\t100\t0.1\n"
+)
+
+
+def test_parse_centrifuge_report(tmp_path):
+    p = tmp_path / "rep.tsv"
+    p.write_text(REPORT)
+    rows = parse_centrifuge_report(str(p))
+    assert [r["name"] for r in rows] == [
+        "Escherichia coli", "Salmonella enterica", "Enterobacteriaceae",
+    ]
+    assert rows[0] == {
+        "name": "Escherichia coli", "taxid": 562, "numreads": 900, "numunique": 700,
+    }
+
+
+def test_parse_centrifuge_bad_header_raises(tmp_path):
+    p = tmp_path / "rep.tsv"
+    p.write_text("foo\tbar\n1\t2\n")
+    with pytest.raises(RuntimeError, match="missing"):
+        parse_centrifuge_report(str(p))
+
+
+def test_genome_taxonomy_picks_top_unique(tmp_path):
+    p = tmp_path / "rep.tsv"
+    p.write_text(REPORT)
+    tax, taxid, frac = genome_taxonomy(parse_centrifuge_report(str(p)))
+    assert (tax, taxid) == ("Escherichia coli", 562)
+    assert frac == pytest.approx(700 / 1000)
+
+
+def test_genome_taxonomy_empty():
+    assert genome_taxonomy([]) == ("unclassified", 0, 0.0)
+
+
+def test_bonus_requires_binary_and_index(tmp_path, bdb, monkeypatch):
+    from drep_tpu.bonus import d_bonus_wrapper
+    from drep_tpu.workdir import WorkDirectory
+
+    import drep_tpu.cluster.external as ext
+
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    monkeypatch.setattr(ext.shutil, "which", lambda _: None)
+    with pytest.raises(RuntimeError, match="centrifuge"):
+        d_bonus_wrapper(wd, bdb, cent_index="idx")
+    monkeypatch.setattr(ext.shutil, "which", lambda _: "/usr/bin/true")
+    with pytest.raises(ValueError, match="cent_index"):
+        d_bonus_wrapper(wd, bdb, cent_index=None)
+
+
+def test_bonus_wrapper_with_stubbed_runner(tmp_path, bdb, monkeypatch):
+    """Full wrapper flow with the subprocess stubbed to write a synthetic
+    report — Tdb lands in the workdir with one row per genome."""
+    import drep_tpu.bonus as bonus
+    from drep_tpu.workdir import WorkDirectory
+
+    import drep_tpu.cluster.external as ext
+
+    monkeypatch.setattr(ext.shutil, "which", lambda _: "/usr/bin/true")
+
+    def fake_run(cmd, cwd=None):
+        report = cmd[cmd.index("--report-file") + 1]
+        with open(report, "w") as f:
+            f.write(REPORT)
+        return ""
+
+    monkeypatch.setattr(bonus, "run_subprocess", fake_run)
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    tdb = bonus.d_bonus_wrapper(wd, bdb, cent_index="idx")
+    assert len(tdb) == len(bdb)
+    assert set(tdb["taxonomy"]) == {"Escherichia coli"}
+    stored = pd.read_csv(tmp_path / "wd" / "data_tables" / "Tdb.csv")
+    assert list(stored.columns) == ["genome", "taxonomy", "taxID", "fraction"]

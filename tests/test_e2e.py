@@ -154,6 +154,8 @@ def test_dereplicate_winners(tmp_path, genome_paths):
     # full dereplicate table set present
     for table in ("Sdb", "Wdb", "Cdb"):
         assert os.path.exists(os.path.join(wd, "data_tables", f"{table}.csv"))
+    sdb = pd.read_csv(os.path.join(wd, "data_tables", "Sdb.csv"))
+    assert sdb["quality_informed"].all()  # genomeInfo was provided
 
 
 def test_dereplicate_length_filter(tmp_path, genome_paths):
@@ -164,6 +166,10 @@ def test_dereplicate_length_filter(tmp_path, genome_paths):
     bdb = pd.read_csv(os.path.join(wd, "data_tables", "Bdb.csv"))
     # only A/B/C are >= 115kb
     assert set(bdb["genome"]) == {"genome_A.fasta", "genome_B.fasta", "genome_C.fasta"}
+    # no quality info was available: the Sdb must say its scores are
+    # quality-blind (the reference would have aborted outright)
+    sdb = pd.read_csv(os.path.join(wd, "data_tables", "Sdb.csv"))
+    assert not sdb["quality_informed"].any()
 
 
 def test_evaluate_warnings_file(compare_wd):
