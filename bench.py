@@ -229,6 +229,9 @@ def bench_e2e(n: int) -> dict:
 
 
 def main() -> None:
+    from drep_tpu.utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--stages", default="all", help="comma list: primary,secondary,e2e")
     ap.add_argument("--e2e_n", type=int, default=10_000)

@@ -107,7 +107,9 @@ def _centrifuge_one(args) -> tuple[str, str, int, float]:
         tmp = f"{report}.tmp{os.getpid()}"
         run_subprocess(
             [
-                "centrifuge", "-f", "-x", index, "-U", fasta,
+                # --mm memory-maps the index so concurrent jobs share ONE
+                # copy instead of loading processes * multi-GB each
+                "centrifuge", "-f", "--mm", "-x", index, "-U", fasta,
                 "-S", stem + ".hits.tsv", "--report-file", tmp,
                 "-p", str(max(threads, 1)),
             ]

@@ -146,14 +146,17 @@ def _resolve_estimator_for_run(n: int, kw: dict[str, Any]) -> str:
     if kw["multiround_primary_clustering"] and n > kw["primary_chunksize"]:
         # per-chunk resolution: chunks are primary_chunksize genomes
         per_chunk = engines.resolve_primary_estimator(
-            min(n, kw["primary_chunksize"]), kw["mesh_shape"], kw["primary_estimator"]
+            min(n, kw["primary_chunksize"]), kw["mesh_shape"],
+            kw["primary_estimator"], kw["MASH_sketch"],
         )
         return f"multiround_{per_chunk}"
     if kw["streaming_primary"] or (
         kw["primary_algorithm"] == "jax_mash" and n >= kw["streaming_threshold"]
     ):
         return "streaming_sort"  # streaming always runs sort tiles
-    return engines.resolve_primary_estimator(n, kw["mesh_shape"], kw["primary_estimator"])
+    return engines.resolve_primary_estimator(
+        n, kw["mesh_shape"], kw["primary_estimator"], kw["MASH_sketch"]
+    )
 
 
 def _primary_clusters(
@@ -350,12 +353,17 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
             wd.get_dir(os.path.join("data", "secondary_checkpoints")),
             sec_snapshot, primary, gs.names,
         )
+        # one O(n) pass — a per-cluster membership scan would be
+        # O(n_clusters * n), 35M Python iterations at 10k genomes
+        members: dict[int, list[int]] = {}
+        for i, pc in enumerate(primary):
+            members.setdefault(int(pc), []).append(i)
         multi = []
         for pc in range(1, n_primary + 1):
-            indices = [i for i in range(n) if primary[i] == pc]
+            indices = members.get(pc, [])
             if len(indices) == 1:
                 secondary_names[gs.names[indices[0]]] = f"{pc}_1"
-            else:
+            elif indices:
                 multi.append((pc, indices))
 
         results: dict[int, tuple[pd.DataFrame, np.ndarray, np.ndarray]] = {}

@@ -85,7 +85,9 @@ class SecondaryCheckpoint:
                 col = col.astype(str)  # unicode arrays need no pickle
             arrays[f"ndb_col_{c}"] = col
         tmp = f"{loc}.tmp-{uuid.uuid4().hex}.npz"
-        np.savez_compressed(tmp, **arrays)
+        # uncompressed: thousands of small per-cluster files per run made
+        # zlib a measured hot spot; the payloads are tiny either way
+        np.savez(tmp, **arrays)
         os.replace(tmp, loc)  # atomic: no torn checkpoints
 
     def finish(self, n_total: int) -> None:

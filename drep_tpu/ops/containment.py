@@ -39,22 +39,29 @@ def pack_scaled_sketches(
 ) -> PackedSketches:
     """Ragged uint64 scaled sketches -> padded int32 id matrix [N, S].
 
-    S = max sketch length rounded up to `pad_multiple` (lane-friendly).
+    S = max sketch length rounded up to a power of two (>= `pad_multiple`):
+    lane-friendly AND compile-stable — a linear pad multiple gave every
+    batch its own width and thus its own XLA compilation (see
+    :func:`_pow2_bucket`).
     """
     if not sketches:
         raise ValueError("no sketches to pack")
     vocab = np.unique(np.concatenate(sketches))
     if vocab.size >= np.iinfo(np.int32).max:
         raise ValueError("id space overflow: >2^31 distinct sketch hashes")
-    width = max(max(len(s) for s in sketches), 1)
-    width = -(-width // pad_multiple) * pad_multiple
+    width = _pow2_bucket(max(max(len(s) for s in sketches), 1), pad_multiple)
     n = len(sketches)
     ids = np.full((n, width), PAD_ID, dtype=np.int32)
-    counts = np.zeros(n, dtype=np.int32)
-    for i, s in enumerate(sketches):
-        ids[i, : len(s)] = np.searchsorted(vocab, s).astype(np.int32)
-        counts[i] = len(s)
-    return PackedSketches(ids=ids, counts=counts, names=list(names))
+    lens = np.array([len(s) for s in sketches], dtype=np.int64)
+    # ONE searchsorted over the concatenation — a per-row loop was a
+    # measured hot spot at thousands of clusters/batches per run
+    flat = np.concatenate(sketches)
+    ranks = np.searchsorted(vocab, flat).astype(np.int32)
+    rows = np.repeat(np.arange(n), lens)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]])
+    cols = np.arange(len(flat)) - np.repeat(offs, lens)
+    ids[rows, cols] = ranks
+    return PackedSketches(ids=ids, counts=lens.astype(np.int32), names=list(names))
 
 
 def _pair_intersection(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -84,9 +91,20 @@ def containment_ani_tile(a_ids, a_counts, b_ids, b_counts, *, k: int = 21):
     return tile(a_ids, a_counts, b_ids, b_counts)
 
 
-# budget for the dense indicator matrix [m, V] in bf16 (elements, ~256 MB)
-MATMUL_BUDGET_ELEMS = 1 << 27
-_VOCAB_BUCKET = 8192  # round V up: buckets compilations across clusters
+# budget for the dense indicator matrix [m, V] in bf16 (elements, ~512 MB —
+# small next to 16 GB HBM, and the matmul at this size is sub-millisecond;
+# the budget exists to bound the indicator scatter, not the MXU)
+MATMUL_BUDGET_ELEMS = 1 << 28
+_VOCAB_BUCKET_MIN = 8192
+
+
+def _pow2_bucket(x: int, minimum: int) -> int:
+    """Round up to a power of two (>= minimum). Shape buckets are pow2, not
+    linear: every distinct (rows, width, vocab) triple is a fresh XLA
+    compilation at ~5-10 s on TPU, which dominated end-to-end wall-clock
+    when thousands of per-cluster batches each got their own shapes. Pow2
+    wastes <=2x MXU work (microseconds) to cap compiles at a handful."""
+    return max(minimum, 1 << (max(x, 1) - 1).bit_length())
 
 # cap on tile*tile*row_width elements for batched-gather tiles: oversized
 # gathers have been observed to hard-crash the TPU runtime (not OOM — a
@@ -108,7 +126,7 @@ def matmul_vocab_pad(packed: PackedSketches) -> int:
     """
     valid = packed.ids != PAD_ID
     vmax = int(packed.ids[valid].max()) + 1 if valid.any() else 1
-    return -(-vmax // _VOCAB_BUCKET) * _VOCAB_BUCKET
+    return _pow2_bucket(vmax, _VOCAB_BUCKET_MIN)
 
 
 @functools.partial(jax.jit, static_argnames=("v_pad",))
@@ -147,14 +165,14 @@ def ani_cov_from_intersections(
     return ani, cov
 
 
-ROW_BUCKET = 64  # row-count quantum: caps XLA compilations across clusters
+ROW_BUCKET_MIN = 64  # smallest row bucket (pow2 above; see _pow2_bucket)
 
 
 def matmul_rows_pad(n: int) -> int:
     """Row count the MXU path actually allocates for n genomes — THE
     definition the dispatch budget check must use (kept next to the kernel
     so the two cannot drift)."""
-    return -(-n // ROW_BUCKET) * ROW_BUCKET
+    return _pow2_bucket(n, ROW_BUCKET_MIN)
 
 
 def all_vs_all_containment_matmul(
@@ -165,16 +183,17 @@ def all_vs_all_containment_matmul(
     path (verified in tests). Pass a precomputed `v_pad` (from
     :func:`matmul_vocab_pad`) to avoid rescanning packed.ids.
 
-    Rows are padded to a _ROW_BUCKET multiple before the jit call: the
-    secondary stage runs once per primary cluster, and without bucketing
-    every distinct cluster size would trigger a fresh XLA compilation
-    (tens of seconds each on TPU). Sketch width is already bucketed by
+    Rows are padded to a pow2 bucket before the jit call: the secondary
+    stage runs once per primary cluster/batch, and without bucketing every
+    distinct cluster size would trigger a fresh XLA compilation (~5-10 s
+    each on TPU). Sketch width is already bucketed by
     pack_scaled_sketches, the vocab by matmul_vocab_pad."""
     if v_pad is None:
         v_pad = matmul_vocab_pad(packed)
     m = packed.n
-    # pad_packed_rows rounds to a ROW_BUCKET multiple == matmul_rows_pad(m)
-    ids, _ = pad_packed_rows(packed.ids, packed.counts, ROW_BUCKET)
+    # padding to the matmul_rows_pad target itself (>= m) gives that exact
+    # row count — the same number the dispatch budget check used
+    ids, _ = pad_packed_rows(packed.ids, packed.counts, matmul_rows_pad(m))
     inter = np.asarray(_intersect_matmul(jnp.asarray(ids), v_pad=v_pad))[:m, :m]
     return ani_cov_from_intersections(inter, packed.counts, k)
 

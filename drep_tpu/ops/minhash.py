@@ -60,12 +60,16 @@ def pack_sketches(sketches: list[np.ndarray], names: list[str], sketch_size: int
         raise ValueError("id space overflow: >2^31 distinct sketch hashes")
     n = len(trimmed)
     ids = np.full((n, sketch_size), PAD_ID, dtype=np.int32)
-    counts = np.zeros(n, dtype=np.int32)
-    for i, s in enumerate(trimmed):
-        # searchsorted over the sorted vocab is the monotone rank map
-        ids[i, : len(s)] = np.searchsorted(vocab, s).astype(np.int32)
-        counts[i] = len(s)
-    return PackedSketches(ids=ids, counts=counts, names=list(names))
+    lens = np.array([len(s) for s in trimmed], dtype=np.int64)
+    # one searchsorted over the concatenation (the monotone rank map);
+    # per-row calls were a measured hot spot at 10k+ genomes
+    flat = np.concatenate(trimmed) if trimmed else np.empty(0, np.uint64)
+    ranks = np.searchsorted(vocab, flat).astype(np.int32)
+    rows = np.repeat(np.arange(n), lens)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]) if n else np.empty(0, np.int64)
+    cols = np.arange(len(flat)) - np.repeat(offs, lens)
+    ids[rows, cols] = ranks
+    return PackedSketches(ids=ids, counts=lens.astype(np.int32), names=list(names))
 
 
 def pad_packed_rows(ids: np.ndarray, counts: np.ndarray, multiple: int):

@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache, on by default.
+
+On tunneled/remote-compile TPU setups a single XLA compile costs 5-40 s
+of wall-clock — measured to DOMINATE end-to-end runs (a 2000-genome
+compare spent 201 of 213 s compiling). The jax persistent cache removes
+that cost for every repeated (shape, program) pair across processes and
+sessions; with it warm, the same compare runs in ~8 s. Respects an
+explicit JAX_COMPILATION_CACHE_DIR; otherwise defaults to
+``~/.cache/drep_tpu/xla``. Best-effort: unwritable cache dirs degrade to
+no caching, never to a failed run.
+"""
+
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def enable_persistent_cache() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # explicit user choice wins
+    try:
+        import jax
+
+        path = os.path.join(os.path.expanduser("~"), ".cache", "drep_tpu", "xla")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:  # pragma: no cover — cache is never load-bearing
+        pass

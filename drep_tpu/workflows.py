@@ -21,7 +21,9 @@ from drep_tpu.workdir import WorkDirectory
 def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]:
     # multi-host bring-up must precede any backend use (no-op single-host)
     from drep_tpu.parallel.mesh import initialize_distributed
+    from drep_tpu.utils.xla_cache import enable_persistent_cache
 
+    enable_persistent_cache()
     initialize_distributed()
     wd = WorkDirectory(wd_loc)
     setup_logger(wd.get_dir("log"))

@@ -1,0 +1,88 @@
+"""Pallas union-bottom-s Mash kernel vs the jnp reference estimator.
+
+Exact equality is the contract: the kernel implements the SAME estimator
+(shared-within-bottom-s_use-of-union), so `shared` counts — and hence
+distances — must be bit-identical to ops/minhash.py::mash_distance_tile.
+CPU runs use interpret mode (SURVEY.md §4 rebuild note); the compiled
+kernel is pinned on hardware by bench.py.
+"""
+
+import numpy as np
+import pytest
+
+from drep_tpu.ops.minhash import PAD_ID, mash_distance_tile, pack_sketches
+from drep_tpu.ops.pallas_mash import mash_distance_tile_pallas
+
+
+def _sketch_set(rng, n, s, overlap=0.6):
+    base = np.unique(rng.integers(0, 2**62, size=8 * s * n, dtype=np.uint64))
+    rng.shuffle(base)
+    shared = base[:s]
+    out = []
+    for i in range(n):
+        own = base[s * (i + 1) : s * (i + 2)]
+        mix = int(s * overlap * rng.random())
+        out.append(np.sort(np.unique(np.concatenate([shared[:mix], own[: s - mix]]))[:s]))
+    return out
+
+
+@pytest.mark.parametrize("n,s", [(12, 64), (9, 100)])
+def test_pallas_mash_equals_jnp_tile(rng, n, s):
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    want_d, want_j = mash_distance_tile(
+        packed.ids, packed.counts, packed.ids, packed.counts, k=21
+    )
+    got_d, got_j = mash_distance_tile_pallas(
+        packed.ids, packed.counts, packed.ids, packed.counts, k=21
+    )
+    np.testing.assert_allclose(got_j, np.asarray(want_j), atol=0)  # exact
+    np.testing.assert_allclose(got_d, np.asarray(want_d), atol=1e-7)
+
+
+def test_pallas_mash_ragged_counts(rng):
+    """Short rows (counts < width) change s_use per pair — the kernel must
+    honor min(|A|, |B|, s) exactly, including zero-count padded rows."""
+    s = 64
+    sketches = _sketch_set(rng, 6, s)
+    sketches[2] = sketches[2][: s // 3]
+    sketches[4] = sketches[4][: s // 2]
+    packed = pack_sketches(sketches, [f"g{i}" for i in range(6)], s)
+    assert packed.counts.min() < s  # genuinely ragged
+    want_d, _ = mash_distance_tile(
+        packed.ids, packed.counts, packed.ids, packed.counts, k=21
+    )
+    got_d, _ = mash_distance_tile_pallas(
+        packed.ids, packed.counts, packed.ids, packed.counts, k=21
+    )
+    np.testing.assert_allclose(got_d, np.asarray(want_d), atol=1e-7)
+
+
+def test_all_vs_all_pallas_symmetric_grid(rng):
+    """The wrapped half-grid full-matrix path must equal the plain tiled
+    all-vs-all (same estimator, ~2x less kernel work)."""
+    from drep_tpu.ops.minhash import all_vs_all_mash
+    from drep_tpu.ops.pallas_mash import all_vs_all_mash_pallas
+
+    n, s = 10, 64
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    want_d, want_j = all_vs_all_mash(packed, k=21, tile=8)
+    got_d, got_j = all_vs_all_mash_pallas(packed, k=21)
+    np.testing.assert_allclose(got_d, want_d, atol=1e-7)
+    np.testing.assert_allclose(got_j, want_j, atol=1e-7)
+
+
+def test_pallas_mash_rectangular_blocks(rng):
+    s = 64
+    a = pack_sketches(_sketch_set(rng, 5, s), [f"a{i}" for i in range(5)], s)
+    b = pack_sketches(_sketch_set(rng, 7, s), [f"b{i}" for i in range(7)], s)
+    # one shared id space: re-pack together, then split
+    both = pack_sketches(
+        _sketch_set(rng, 12, s), [f"g{i}" for i in range(12)], s
+    )
+    a_ids, b_ids = both.ids[:5], both.ids[5:]
+    a_cnt, b_cnt = both.counts[:5], both.counts[5:]
+    want_d, _ = mash_distance_tile(a_ids, a_cnt, b_ids, b_cnt, k=21)
+    got_d, _ = mash_distance_tile_pallas(a_ids, a_cnt, b_ids, b_cnt, k=21)
+    assert got_d.shape == (5, 7)
+    np.testing.assert_allclose(got_d, np.asarray(want_d), atol=1e-7)
+    del a, b  # only the shared-vocab split is meaningful
