@@ -112,7 +112,9 @@ def mash_distance_matrix(
     if estimator in ("auto", "sort") and pallas_mash_supported(packed.sketch_size):
         # single-chip TPU: the VMEM-resident Pallas kernel computes the
         # reference-faithful sort estimator faster than the MXU matmul
-        # family (~5 vs ~2.1 M pairs/s/chip at width 1024)
+        # family (BENCH_r02 end-to-end: 2.70 vs 2.18 M pairs/s/chip at
+        # width 1024, n=2048; the raw-kernel gap is larger — host
+        # thresholding amortizes it)
         dist, _jac = all_vs_all_mash_pallas(packed, k=k)
         return dist
     if estimator == "matmul" or (estimator == "auto" and packed.n >= MATMUL_MIN_GENOMES):
@@ -144,6 +146,15 @@ def primary_jax_mash(
     return dist, 1.0 - dist
 
 
+# measured per-element cost ratio of the VPU bitonic merge vs the MXU
+# indicator matmul (BENCH_r02: 0.80M pairs/s at s2=2048 merge = 25 ps per
+# merged-element-stage, vs 1.17M pairs/s at v_pad=131072 matmul = 6.5 ps
+# per vocab column) — the beyond-budget dispatch weighs merge work
+# (2*s2*log2(2*s2) units/pair) against chunked-matmul work (v_pad
+# columns/pair) with this penalty on the merge side
+MERGE_VS_MATMUL_ELEM_COST = 4.0
+
+
 def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: int = 128):
     """Directional (ani, cov) with automatic path selection.
 
@@ -151,9 +162,12 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
     1. MXU indicator-matmul — ~340x faster than the gather path and exact;
        used whenever the [m, vocab] bf16 indicator fits the budget.
     2. ring-sharded mesh path (multi-device, beyond-budget clusters).
-    3. Pallas bitonic-merge kernel (ops/pallas_merge.py) — matmul-speed but
-       vocabulary-independent, so it owns the big-cluster/big-vocab regime
-       the matmul budget excludes (TPU only).
+    3. beyond-budget single chip — BOTH remaining kernels extend to any
+       width/vocab by range partitioning (ops/rangepart.py), so the cheaper
+       one wins by the cost model above: vocab-chunked MXU matmul
+       (cost/pair ∝ v_pad) vs range-partitioned Pallas merge (cost/pair ∝
+       s2·log s2, vocabulary-independent — owns the diverse-cluster regime
+       where the vocabulary far outgrows the sketch width).
     4. tiled searchsorted fallback (CPU; gathers are fine off-TPU).
     """
     import jax
@@ -161,6 +175,7 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
     from drep_tpu.ops.containment import (
         MATMUL_BUDGET_ELEMS,
         all_vs_all_containment_matmul,
+        all_vs_all_containment_matmul_chunked,
         matmul_rows_pad,
         matmul_vocab_pad,
     )
@@ -174,9 +189,15 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
 
         return sharded_containment_allpairs(packed, k=k, mesh=mesh)
     if jax.devices()[0].platform == "tpu":
-        from drep_tpu.ops.pallas_merge import all_vs_all_containment_pallas
+        from drep_tpu.ops.merge import next_pow2
 
-        return all_vs_all_containment_pallas(packed, k=k)
+        s2 = max(128, next_pow2(packed.sketch_size))
+        merge_units = 2 * s2 * ((2 * s2).bit_length() - 1)
+        if MERGE_VS_MATMUL_ELEM_COST * merge_units < v_pad:
+            from drep_tpu.ops.pallas_merge import all_vs_all_containment_pallas
+
+            return all_vs_all_containment_pallas(packed, k=k)
+        return all_vs_all_containment_matmul_chunked(packed, k=k, v_pad=v_pad)
     return all_vs_all_containment(packed, k=k, tile=tile)
 
 

@@ -198,6 +198,45 @@ def all_vs_all_containment_matmul(
     return ani_cov_from_intersections(inter, packed.counts, k)
 
 
+def matmul_vocab_chunk(m_pad: int) -> int:
+    """Widest pow2 vocabulary chunk whose [m_pad, chunk+1] bf16 indicator
+    fits MATMUL_BUDGET_ELEMS (>= _VOCAB_BUCKET_MIN)."""
+    fit = max(MATMUL_BUDGET_ELEMS // max(m_pad, 1) - 1, 1)
+    return max(_VOCAB_BUCKET_MIN, 1 << (fit.bit_length() - 1))
+
+
+def all_vs_all_containment_matmul_chunked(
+    packed: PackedSketches, k: int = 21, v_pad: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """MXU path for vocabularies past the single-indicator budget.
+
+    Intersection counts are additive over disjoint hash ranges, so the
+    vocabulary splits into pow2 chunks each fitting the [m_pad, chunk]
+    indicator budget; every chunk rebases its ids to origin, runs the SAME
+    jit'd indicator matmul, and the int32 counts sum. This is the
+    production-width secondary engine (4 Mb genomes at scale=200 are
+    ~20k-wide sketches with multi-million-id vocabularies — SURVEY.md §7
+    hard part (c)): exact like the one-shot matmul (bf16 0/1 inputs, f32
+    accumulation, counts <= sketch width << 2^24), with total scatter work
+    still one pass over packed.ids (chunks repack narrow — see
+    ops/rangepart.py::partition_by_vocab_chunk).
+    """
+    from drep_tpu.ops.rangepart import partition_by_vocab_chunk
+
+    if v_pad is None:
+        v_pad = matmul_vocab_pad(packed)
+    m = packed.n
+    m_pad = matmul_rows_pad(m)
+    v_chunk = matmul_vocab_chunk(m_pad)
+    inter = np.zeros((m, m), dtype=np.int32)
+    for _origin, bucket in partition_by_vocab_chunk(packed.ids, v_chunk):
+        ids_r, _ = pad_packed_rows(bucket, packed.counts, m_pad)
+        inter += np.asarray(_intersect_matmul(jnp.asarray(ids_r), v_pad=v_chunk))[
+            :m, :m
+        ]
+    return ani_cov_from_intersections(inter, packed.counts, k)
+
+
 def all_vs_all_containment(
     packed: PackedSketches, k: int = 21, tile: int = 128
 ) -> tuple[np.ndarray, np.ndarray]:

@@ -26,6 +26,24 @@ def next_pow2(n: int) -> int:
     return 1 << max(n - 1, 0).bit_length() if n > 1 else 1
 
 
+# cap on tile*tile*(2*next_pow2(width)) elements for one jnp sort-merge tile:
+# the merge materializes s32 temps of exactly that shape, and several live at
+# once — 2^28 elements is ~1 GB per temp, which measured ~3-4 GB peak on v5e
+# (16 GB HBM). Uncapped tiles at production widths hard-OOM the chip (an
+# uncapped 128-tile at sketch width 32768 wants ~4.3 GB PER temp). The ONE
+# budget rule for every jnp-merge tiling loop (parallel/streaming.py and the
+# pallas_merge over-width fallback) — kept here so the callers cannot drift.
+SORT_TILE_BUDGET_ELEMS = 1 << 28
+
+
+def cap_merge_tile(tile: int, width: int) -> int:
+    """Largest pow2 tile (>= 8, <= `tile`) whose [tile, tile, 2*next_pow2
+    (width)] merge temporaries fit SORT_TILE_BUDGET_ELEMS."""
+    merged = 2 * max(128, next_pow2(width))
+    cap = int((SORT_TILE_BUDGET_ELEMS / merged) ** 0.5)
+    return max(8, min(tile, 1 << (cap.bit_length() - 1)))
+
+
 def merge_sorted_rows(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Sorted merge of two ascending rows along the last axis.
 

@@ -139,10 +139,11 @@ def _mash_shared_grid_symmetric(a_rev, na, b, nb, *, s_orig: int, interpret: boo
 
 def all_vs_all_mash_pallas(packed, k: int = 21) -> tuple[np.ndarray, np.ndarray]:
     """Full [N, N] (distance, jaccard) for one packed sketch set — the
-    single-chip TPU primary engine (measured ~5 M pairs/s/chip at width
-    1024 vs 2.1 M for the MXU common-threshold estimator, AND it computes
-    the reference-faithful union-bottom-s estimator, not an alternative
-    family). Same output contract as ops/minhash.py::all_vs_all_mash."""
+    single-chip TPU primary engine (BENCH_r02 end-to-end: 2.70 M
+    pairs/s/chip at width 1024, n=2048, vs 2.18 M for the MXU
+    common-threshold estimator, AND it computes the reference-faithful
+    union-bottom-s estimator, not an alternative family). Same output
+    contract as ops/minhash.py::all_vs_all_mash."""
     from drep_tpu.ops.pallas_merge import _unwrap_symmetric
 
     n = packed.n

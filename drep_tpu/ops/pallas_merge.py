@@ -20,8 +20,16 @@ the sorted merge, and adjacent duplicates are exactly the intersection.
 
 TPU block constraints pin the pair-tile's last dim to 128 (the lane width),
 so the B tile is fixed at 128 rows and VMEM budget caps the mergeable
-sketch width (PALLAS_MAX_WIDTH); wider sketches take the jnp formulation of
-the same merge (XLA spills its temporaries to HBM instead of failing).
+sketch width (PALLAS_MAX_WIDTH). Wider sketches — the PRODUCTION regime:
+4 Mb genomes at default scale=200 are ~20k-wide — are range-partitioned
+(ops/rangepart.py): intersection counts are additive over disjoint hash
+ranges, so each bucket repacks to <= PALLAS_MAX_WIDTH, runs this same
+VMEM-resident kernel, and the counts sum. Total merge work SHRINKS
+(R buckets of S/R cost S*log(2S/R) < S*log(2S)), and nothing ever exceeds
+the VMEM working set. The jnp formulation of the merge remains as the
+non-TPU fallback, with its HBM temporaries capped by the shared budget
+rule (ops/merge.py::cap_merge_tile — an uncapped 128-tile at width 32768
+would materialize ~4.3 GB per temp).
 
 CPU/test execution uses `interpret=True` (the reference has no fake
 backend; we follow SURVEY.md §4's rebuild note instead).
@@ -185,15 +193,40 @@ def _pad_rows(ids: np.ndarray, multiple: int) -> np.ndarray:
     return np.pad(ids, ((0, nt - n), (0, 0)), constant_values=PAD_ID)
 
 
+def _intersect_jnp_tiled(a: np.ndarray, b: np.ndarray, jnp_tile: int) -> np.ndarray:
+    """Capped host-tiled jnp merge — the non-TPU over-width fallback. The
+    tile obeys the shared sort-merge HBM budget (cap_merge_tile), never the
+    raw request: an uncapped tile at production widths OOMs the chip."""
+    from drep_tpu.ops.merge import cap_merge_tile
+
+    tile = cap_merge_tile(jnp_tile, a.shape[1])
+    a = _pad_rows(a, tile)
+    b = _pad_rows(b, tile)
+    inter = np.zeros((a.shape[0], b.shape[0]), dtype=np.int32)
+    for i0 in range(0, a.shape[0], tile):
+        for j0 in range(0, b.shape[0], tile):
+            inter[i0 : i0 + tile, j0 : j0 + tile] = np.asarray(
+                _intersect_tile_jnp(a[i0 : i0 + tile], b[j0 : j0 + tile])
+            )
+    return inter
+
+
 def intersect_counts_pallas(
-    a_ids: np.ndarray, b_ids: np.ndarray, jnp_tile: int = 128
+    a_ids: np.ndarray,
+    b_ids: np.ndarray,
+    jnp_tile: int = 128,
+    force: str | None = None,
 ) -> np.ndarray:
     """Pairwise |A_i ∩ B_j| for sorted PAD_ID-padded int32 id rows.
 
     Returns int32 [na, nb]. Rows are padded to tile multiples and widths to
     a shared power of two on the host; the Pallas kernel is fixed-shape.
-    Widths beyond PALLAS_MAX_WIDTH stream through the jnp merge in
-    host-tiled blocks instead.
+    Widths beyond PALLAS_MAX_WIDTH range-partition into narrow buckets and
+    re-enter the kernel (counts are additive over disjoint hash ranges); on
+    non-TPU backends they stream through the budget-capped jnp merge
+    instead (range-bucketing under interpret=True would run the kernel in
+    Python per grid cell). `force` ('range' | 'jnp') pins the path so tests
+    exercise both on CPU.
     """
     na, nb = a_ids.shape[0], b_ids.shape[0]
     s2 = max(128, next_pow2(max(a_ids.shape[1], b_ids.shape[1])))
@@ -213,28 +246,36 @@ def intersect_counts_pallas(
         )
         return np.asarray(inter)[:na, :nb]
 
-    a = _pad_rows(a, jnp_tile)
-    b = _pad_rows(b, jnp_tile)
-    inter = np.zeros((a.shape[0], b.shape[0]), dtype=np.int32)
-    for i0 in range(0, a.shape[0], jnp_tile):
-        for j0 in range(0, b.shape[0], jnp_tile):
-            inter[i0 : i0 + jnp_tile, j0 : j0 + jnp_tile] = np.asarray(
-                _intersect_tile_jnp(
-                    a[i0 : i0 + jnp_tile], b[j0 : j0 + jnp_tile]
-                )
-            )
-    return inter[:na, :nb]
+    if force == "range" or (force is None and not _use_interpret()):
+        from drep_tpu.ops.rangepart import partition_by_range
+
+        inter = np.zeros((na, nb), dtype=np.int32)
+        for _origin, (a_r, b_r) in partition_by_range([a, b], PALLAS_MAX_WIDTH):
+            inter += intersect_counts_pallas(a_r[:na], b_r[:nb], jnp_tile=jnp_tile)
+        return inter
+
+    return _intersect_jnp_tiled(a, b, jnp_tile)[:na, :nb]
 
 
-def intersect_counts_pallas_self(ids: np.ndarray, jnp_tile: int = 128) -> np.ndarray:
+def intersect_counts_pallas_self(
+    ids: np.ndarray, jnp_tile: int = 128, force: str | None = None
+) -> np.ndarray:
     """|A_i ∩ A_j| for all pairs within one sketch set. Symmetric, so the
     Pallas path runs the wrapped half-grid (~2x less work than the general
-    rectangular call)."""
+    rectangular call); over-width sets range-partition and re-enter the
+    half-grid per bucket (same row order every bucket, so symmetry holds)."""
     n = ids.shape[0]
     s2 = max(128, next_pow2(ids.shape[1]))
     a = _pad_cols_pow2(np.ascontiguousarray(ids), s2)
     if s2 > PALLAS_MAX_WIDTH:
-        return intersect_counts_pallas(ids, ids, jnp_tile=jnp_tile)
+        if force == "range" or (force is None and not _use_interpret()):
+            from drep_tpu.ops.rangepart import partition_by_range
+
+            inter = np.zeros((n, n), dtype=np.int32)
+            for _origin, (bucket,) in partition_by_range([a], PALLAS_MAX_WIDTH):
+                inter += intersect_counts_pallas_self(bucket, jnp_tile=jnp_tile)
+            return inter
+        return _intersect_jnp_tiled(a, a, jnp_tile)[:n, :n]
     a = _pad_rows(a, TILE_A)
     compact = _intersect_grid_symmetric(
         np.ascontiguousarray(a[:, ::-1]),

@@ -1,0 +1,173 @@
+"""Host-side range partitioning of sorted sketch-id rows.
+
+Intersection counts are exactly additive over disjoint hash ranges:
+|A ∩ B| = Σ_r |A∩[b_r,b_{r+1}) ∩ B∩[b_r,b_{r+1})|. That one identity
+extends BOTH fixed-budget device kernels to production sketch widths
+(4 Mb genomes at the default scale=200 give ~20k-wide scaled sketches,
+far past any single-call VMEM or indicator budget — SURVEY.md §7 hard
+part (c); reference mount empty, no counterpart to cite):
+
+- the VMEM-resident Pallas bitonic merge (ops/pallas_merge.py) caps the
+  mergeable width at PALLAS_MAX_WIDTH — partition ids by range so every
+  bucket repacks to a narrow matrix, merge per bucket, sum counts;
+- the MXU indicator matmul (ops/containment.py) caps m·vocab — partition
+  the *vocabulary* into equal chunks, rebase each bucket's ids to the
+  chunk origin, matmul per chunk, sum counts.
+
+Rows hold DISTINCT sorted ids (sketches are sets), so a bucket covering
+`w` consecutive id values can contribute at most `w` entries per row —
+the adaptive splitter below always terminates.
+
+All work here is numpy on host: one bincount pass for the per-bucket
+histogram, one flat gather/scatter per bucket for the repack (the same
+vectorized-repack idiom as ops/minhash.py::pack_sketches — per-row
+Python loops were a measured hot spot at production batch counts).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Iterator
+
+import numpy as np
+
+from drep_tpu.ops.merge import next_pow2
+from drep_tpu.ops.minhash import PAD_ID
+
+MIN_BUCKET_WIDTH = 128  # lane width — never repack below one full lane row
+
+
+def _vocab_extent(mats: list[np.ndarray]) -> int:
+    """1 + max real id across all matrices (0 if everything is padding)."""
+    vmax = -1
+    for m in mats:
+        real = m[m != PAD_ID]
+        if real.size:
+            vmax = max(vmax, int(real.max()))
+    return vmax + 1
+
+
+def bucket_histogram(ids: np.ndarray, chunk: int, n_buckets: int) -> np.ndarray:
+    """Per-row element counts for equal-width id ranges.
+
+    ids [N, S] sorted PAD-padded; range r covers [r*chunk, (r+1)*chunk).
+    Returns int64 [N, n_buckets]. One flat bincount, no per-row loops.
+    """
+    n = ids.shape[0]
+    # pads go to an explicit trash slot — PAD_ID//chunk alone could land in
+    # a real bucket when the vocab extent is within n_buckets of 2^31
+    bucket = np.where(
+        ids == PAD_ID, n_buckets, np.minimum(ids.astype(np.int64) // chunk, n_buckets)
+    )
+    flat = np.arange(n, dtype=np.int64)[:, None] * (n_buckets + 1) + bucket
+    hist = np.bincount(flat.ravel(), minlength=n * (n_buckets + 1))
+    return hist.reshape(n, n_buckets + 1)[:, :n_buckets]
+
+
+def repack_bucket(
+    ids: np.ndarray,
+    starts: np.ndarray,
+    cnt: np.ndarray,
+    width: int,
+    rebase: int = 0,
+) -> np.ndarray:
+    """Extract one range bucket into a fresh [N, width] PAD-padded matrix.
+
+    `starts[i]`/`cnt[i]` delimit row i's (contiguous — rows are sorted)
+    slice belonging to the bucket; `rebase` is subtracted from real values
+    (the matmul path rebases each vocab chunk to origin 0).
+    """
+    n = ids.shape[0]
+    out = np.full((n, width), PAD_ID, dtype=np.int32)
+    total = int(cnt.sum())
+    if total == 0:
+        return out
+    rows = np.repeat(np.arange(n), cnt)
+    offs = np.concatenate([[0], np.cumsum(cnt)[:-1]])
+    local = np.arange(total) - np.repeat(offs, cnt)
+    src_col = np.repeat(starts, cnt) + local
+    out[rows, local] = ids[rows, src_col] - rebase
+    return out
+
+
+def partition_by_range(
+    mats: list[np.ndarray],
+    max_count: int,
+    rebase: bool = False,
+) -> Iterator[tuple[int, list[np.ndarray]]]:
+    """Split sorted PAD-padded id matrices into shared disjoint id-range
+    buckets, each repacked to width <= max_count.
+
+    Yields (chunk_origin, [bucket matrix per input]) for every non-empty
+    bucket; widths are pow2-bucketed (>= MIN_BUCKET_WIDTH, one XLA
+    compilation per distinct width, cf. containment._pow2_bucket rationale).
+    All inputs share one boundary set, so cross-matrix intersections stay
+    exact. Empty-range buckets are skipped — hash ids are dense ranks, so
+    with uniform hashes the count histogram is tight around mean density.
+
+    The splitter starts at the optimistic bucket count (longest row /
+    max_count) and doubles until every per-row bucket count fits; ranges of
+    width <= max_count trivially fit (rows hold distinct ids), so the loop
+    is bounded by log2(vocab/max_count) extra histogram passes.
+    """
+    if max_count < MIN_BUCKET_WIDTH:
+        raise ValueError(f"max_count {max_count} below lane width {MIN_BUCKET_WIDTH}")
+    vocab = _vocab_extent(mats)
+    if vocab == 0:
+        return
+    longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
+    n_buckets = max(1, next_pow2(-(-longest // max_count)))
+    while True:
+        chunk = -(-vocab // n_buckets)
+        hists = [bucket_histogram(m, chunk, n_buckets) for m in mats]
+        worst = max(int(h.max()) for h in hists)
+        if worst <= max_count or chunk <= max_count:
+            break
+        n_buckets *= 2
+    starts = [
+        np.concatenate(
+            [np.zeros((h.shape[0], 1), np.int64), np.cumsum(h, axis=1)[:, :-1]], axis=1
+        )
+        for h in hists
+    ]
+    for r in range(n_buckets):
+        counts_r = [h[:, r] for h in hists]
+        w = max(int(c.max()) for c in counts_r)
+        if w == 0:
+            continue
+        width = max(MIN_BUCKET_WIDTH, next_pow2(w))
+        yield (
+            r * chunk,
+            [
+                repack_bucket(m, s[:, r], c, width, rebase=r * chunk if rebase else 0)
+                for m, s, c in zip(mats, starts, counts_r)
+            ],
+        )
+
+
+def partition_by_vocab_chunk(
+    ids: np.ndarray, v_chunk: int
+) -> Iterator[tuple[int, np.ndarray]]:
+    """Fixed-width vocabulary chunking for the indicator-matmul path.
+
+    Yields (chunk_origin, rebased bucket matrix) per non-empty chunk of
+    `v_chunk` consecutive id values; rebased ids lie in [0, v_chunk). The
+    bucket's repack width is its max per-row count (pow2-bucketed), NOT
+    v_chunk — the indicator scatter reads the narrow matrix, so total
+    scatter work across chunks stays one pass over the original ids.
+    """
+    vocab = _vocab_extent([ids])
+    if vocab == 0:
+        return
+    n_buckets = -(-vocab // v_chunk)
+    hist = bucket_histogram(ids, v_chunk, n_buckets)
+    starts = np.concatenate(
+        [np.zeros((hist.shape[0], 1), np.int64), np.cumsum(hist, axis=1)[:, :-1]],
+        axis=1,
+    )
+    for r in range(n_buckets):
+        cnt = hist[:, r]
+        w = int(cnt.max())
+        if w == 0:
+            continue
+        width = max(MIN_BUCKET_WIDTH, next_pow2(w))
+        yield r * v_chunk, repack_bucket(ids, starts[:, r], cnt, width, rebase=r * v_chunk)

@@ -37,20 +37,11 @@ from drep_tpu.utils.logger import get_logger
 
 DEFAULT_BLOCK = 1024
 
-# cap on block*block*(2*next_pow2(sketch_width)) elements for one sort-merge
-# tile: the merge materializes s32 temps of exactly that shape, and several
-# live at once — 2^28 elements is ~1 GB per temp, which measured ~3-4 GB
-# peak on v5e (16 GB HBM). An uncapped 1024-block at sketch 1024 wants
-# ~8 GB PER temp and hard-OOMs the chip.
-SORT_TILE_BUDGET_ELEMS = 1 << 28
-
-
-def _cap_block_for_width(block: int, sketch_width: int) -> int:
-    from drep_tpu.ops.merge import next_pow2  # the merge's own padding rule
-
-    merged = 2 * max(128, next_pow2(sketch_width))
-    cap = int((SORT_TILE_BUDGET_ELEMS / merged) ** 0.5)
-    return max(8, min(block, 1 << (cap.bit_length() - 1)))
+# the sort-merge HBM-temp budget rule lives beside the merge itself
+# (ops/merge.py::cap_merge_tile) and is shared with the pallas_merge
+# over-width fallback; re-exported here for the existing callers/tests
+from drep_tpu.ops.merge import SORT_TILE_BUDGET_ELEMS  # noqa: E402,F401
+from drep_tpu.ops.merge import cap_merge_tile as _cap_block_for_width  # noqa: E402
 
 
 def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
@@ -107,9 +98,10 @@ def streaming_mash_edges(
     n = packed.n
     block = max(1, min(block, max(8, n)))
     # on TPU the VMEM-resident Pallas union-bottom-s kernel computes tiles
-    # ~9x faster than the jnp merge (which bounces [T,T,2S] temps through
-    # HBM) — measured 5.0 vs 0.54 M pairs/s/chip at width 1024. The jnp
-    # path stays for CPU and over-wide sketches, with its HBM-temp cap.
+    # several times faster than the jnp merge (which bounces [T,T,2S] temps
+    # through HBM) — BENCH_r02 end-to-end: 2.70 M pairs/s/chip at width
+    # 1024 vs 0.54 for raw jnp-merge tiles. The jnp path stays for CPU and
+    # over-wide sketches, with its HBM-temp cap.
     from drep_tpu.ops.pallas_mash import TILE as _PTILE, pallas_mash_supported
 
     if use_pallas is None:  # override exists so CPU tests can force the

@@ -1,0 +1,173 @@
+"""Range-partitioned intersection paths vs numpy oracles.
+
+The production regime (SURVEY.md §7 hard part (c)): 4 Mb genomes at the
+default scale=200 give ~20k-wide scaled sketches — past the single-call
+VMEM (PALLAS_MAX_WIDTH) and indicator (MATMUL_BUDGET_ELEMS) budgets. Both
+device kernels extend by range partitioning (ops/rangepart.py); these
+tests pin (a) the partition machinery itself, (b) exact oracle equality
+of the range-partitioned Pallas merge and the vocab-chunked MXU matmul,
+and (c) that the jnp over-width fallback obeys the shared HBM-temp cap.
+"""
+
+import numpy as np
+import pytest
+
+from drep_tpu.ops.merge import cap_merge_tile, next_pow2
+from drep_tpu.ops.minhash import PAD_ID
+from drep_tpu.ops.rangepart import (
+    MIN_BUCKET_WIDTH,
+    partition_by_range,
+    partition_by_vocab_chunk,
+)
+
+
+def _sorted_rows(rng, n, max_len, vocab):
+    """Sorted unique PAD-padded rows over a given id vocabulary size.
+    Row 0 is pinned to max_len so the matrix width is deterministic."""
+    lens = rng.integers(0, max_len + 1, size=n)
+    lens[0] = max_len
+    rows = [
+        np.unique(rng.choice(vocab, size=m, replace=False).astype(np.int32))
+        for m in lens
+    ]
+    width = max(max((len(r) for r in rows), default=1), 1)
+    ids = np.full((n, width), PAD_ID, dtype=np.int32)
+    for i, r in enumerate(rows):
+        ids[i, : len(r)] = r
+    return ids
+
+
+def _oracle_inter(a_ids, b_ids):
+    out = np.zeros((a_ids.shape[0], b_ids.shape[0]), dtype=np.int32)
+    for i in range(a_ids.shape[0]):
+        ai = a_ids[i][a_ids[i] != PAD_ID]
+        for j in range(b_ids.shape[0]):
+            bj = b_ids[j][b_ids[j] != PAD_ID]
+            out[i, j] = len(np.intersect1d(ai, bj))
+    return out
+
+
+def test_partition_reconstructs_rows(rng):
+    ids = _sorted_rows(rng, 12, 700, 20_000)
+    seen = [np.empty(0, np.int32)] * 12
+    prev_origin = -1
+    for origin, (bucket,) in partition_by_range([ids], MIN_BUCKET_WIDTH):
+        assert origin > prev_origin  # buckets arrive in disjoint id order
+        prev_origin = origin
+        assert bucket.shape[1] >= MIN_BUCKET_WIDTH
+        assert bucket.shape[1] == next_pow2(bucket.shape[1])  # pow2-bucketed
+        real_per_row = (bucket != PAD_ID).sum(axis=1).max()
+        assert real_per_row <= MIN_BUCKET_WIDTH
+        for i in range(12):
+            vals = bucket[i][bucket[i] != PAD_ID]
+            assert (np.diff(vals) > 0).all()  # each bucket row stays sorted
+            seen[i] = np.concatenate([seen[i], vals])
+    for i in range(12):
+        np.testing.assert_array_equal(seen[i], ids[i][ids[i] != PAD_ID])
+
+
+def test_partition_shared_boundaries_across_matrices(rng):
+    a = _sorted_rows(rng, 6, 500, 30_000)
+    b = _sorted_rows(rng, 4, 500, 30_000)
+    inter = np.zeros((6, 4), np.int32)
+    for _origin, (ar, br) in partition_by_range([a, b], 256):
+        inter += _oracle_inter(ar, br)
+    np.testing.assert_array_equal(inter, _oracle_inter(a, b))
+
+
+def test_partition_rejects_sub_lane_budget():
+    with pytest.raises(ValueError):
+        list(partition_by_range([np.zeros((1, 4), np.int32)], 64))
+
+
+def test_vocab_chunks_rebase_and_reconstruct(rng):
+    ids = _sorted_rows(rng, 8, 400, 50_000)
+    v_chunk = 8192
+    seen = [np.empty(0, np.int64)] * 8
+    for origin, bucket in partition_by_vocab_chunk(ids, v_chunk):
+        assert origin % v_chunk == 0
+        real = bucket[bucket != PAD_ID]
+        assert real.size and real.min() >= 0 and real.max() < v_chunk
+        for i in range(8):
+            vals = bucket[i][bucket[i] != PAD_ID].astype(np.int64) + origin
+            seen[i] = np.concatenate([seen[i], vals])
+    for i in range(8):
+        np.testing.assert_array_equal(seen[i], ids[i][ids[i] != PAD_ID].astype(np.int64))
+
+
+def test_range_partitioned_pallas_matches_oracle(rng):
+    """Over-width rectangular intersection through the forced range path
+    (interpret-mode Pallas on CPU) — exact oracle equality."""
+    from drep_tpu.ops.pallas_merge import PALLAS_MAX_WIDTH, intersect_counts_pallas
+
+    a = _sorted_rows(rng, 7, PALLAS_MAX_WIDTH + 600, 3 * PALLAS_MAX_WIDTH)
+    b = _sorted_rows(rng, 5, PALLAS_MAX_WIDTH + 600, 3 * PALLAS_MAX_WIDTH)
+    assert max(a.shape[1], b.shape[1]) > PALLAS_MAX_WIDTH  # over-width for real
+    got = intersect_counts_pallas(a, b, force="range")
+    np.testing.assert_array_equal(got, _oracle_inter(a, b))
+
+
+def test_range_partitioned_self_matches_rectangular(rng):
+    from drep_tpu.ops.pallas_merge import (
+        PALLAS_MAX_WIDTH,
+        intersect_counts_pallas,
+        intersect_counts_pallas_self,
+    )
+
+    ids = _sorted_rows(rng, 9, PALLAS_MAX_WIDTH + 500, 3 * PALLAS_MAX_WIDTH)
+    got = intersect_counts_pallas_self(ids, force="range")
+    want = intersect_counts_pallas(ids, ids, force="range")
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got, got.T)
+
+
+def test_jnp_fallback_is_capped_and_exact(rng):
+    """The over-width jnp fallback must obey the shared HBM-temp budget
+    (VERDICT r2 weak #1: a fixed 128-tile at width 32768 materializes
+    ~4.3 GB per merge temporary) and stay exact."""
+    from drep_tpu.ops.merge import SORT_TILE_BUDGET_ELEMS
+    from drep_tpu.ops.pallas_merge import PALLAS_MAX_WIDTH, intersect_counts_pallas
+
+    # the production shape: width 32768 -> tile must drop to 64
+    tile = cap_merge_tile(128, 32768)
+    assert tile * tile * 2 * next_pow2(32768) <= SORT_TILE_BUDGET_ELEMS
+    assert tile == 64
+    assert 128 * 128 * 2 * next_pow2(32768) > SORT_TILE_BUDGET_ELEMS
+
+    ids = _sorted_rows(rng, 5, PALLAS_MAX_WIDTH + 300, 3 * PALLAS_MAX_WIDTH)
+    got = intersect_counts_pallas(ids, ids, force="jnp")
+    np.testing.assert_array_equal(got, _oracle_inter(ids, ids))
+
+
+def test_chunked_matmul_matches_one_shot(rng):
+    """The vocab-chunked MXU path must exactly equal the single-indicator
+    matmul (and therefore the searchsorted path it is tested against)."""
+    from drep_tpu.ops.containment import (
+        all_vs_all_containment_matmul,
+        all_vs_all_containment_matmul_chunked,
+        matmul_vocab_pad,
+        pack_scaled_sketches,
+    )
+
+    # vocab must span several 8192-wide chunks for the chunking to engage
+    sketches = [
+        np.unique(
+            rng.integers(0, 1 << 40, size=int(rng.integers(50, 800))).astype(np.uint64)
+        )
+        for _ in range(33)
+    ]
+    packed = pack_scaled_sketches(sketches, [f"g{i}" for i in range(33)])
+    v_pad = matmul_vocab_pad(packed)
+    assert v_pad > 8192  # multi-chunk for the chunked path below
+
+    import drep_tpu.ops.containment as cont
+
+    orig = cont.MATMUL_BUDGET_ELEMS
+    cont.MATMUL_BUDGET_ELEMS = 1 << 15  # force v_chunk to the 8192 floor
+    try:
+        ani_c, cov_c = all_vs_all_containment_matmul_chunked(packed, k=21)
+    finally:
+        cont.MATMUL_BUDGET_ELEMS = orig
+    ani_1, cov_1 = all_vs_all_containment_matmul(packed, k=21)
+    np.testing.assert_array_equal(cov_c, cov_1)
+    np.testing.assert_array_equal(ani_c, ani_1)
