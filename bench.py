@@ -9,19 +9,35 @@ unique genome pairs (N*(N-1)/2) / wall-clock of the all-vs-all Mash-distance
 computation on one chip, at N=2048 genomes, sketch 1024 (reference default
 sketch is 1000, padded to a lane-friendly 1024).
 
-`stages` extends the round-1 single-number bench to the full BASELINE
-measurement plan (VERDICT round 1 items 2/6):
-- primary:            jax_mash all-vs-all (the headline number)
-- secondary_matmul:   jax_ani MXU indicator-matmul containment path
-- secondary_pallas:   the Pallas bitonic-merge kernel COMPILED on TPU, with
-                      an exact-equality check against the matmul path at the
-                      same production shape (skipped off-TPU: interpret mode
-                      measures nothing)
-- e2e_10k:            wall-clock to Cdb for a synthetic 10k-genome compare
-                      through the streaming primary + batched secondary path
-                      (sketches pre-planted in a workdir cache — FASTA ingest
-                      for 10k * 4 Mb of sequence is a host-IO benchmark, not
-                      a chip benchmark)
+`stages` covers the BASELINE measurement plan:
+- primary:              jax_mash all-vs-all (the headline number)
+- secondary_matmul:     jax_ani MXU indicator-matmul containment path
+- secondary_pallas:     the Pallas bitonic-merge kernel COMPILED on TPU, with
+                        an exact-equality check against the matmul path at
+                        the same shape (skipped off-TPU: interpret mode
+                        measures nothing)
+- secondary_production: PRODUCTION shape — m=512 genomes at ~20k-wide scaled
+                        sketches (4 Mb at default scale=200 -> width 32768
+                        packed) over a multi-million-id vocabulary. Runs the
+                        range-partitioned paths (vocab-chunked MXU matmul AND
+                        range-bucketed Pallas merge), cross-checks them for
+                        exact equality plus a sampled searchsorted oracle,
+                        and reports which one the engine dispatch picks.
+- e2e_10k / e2e_50k:    wall-clock to Cdb for synthetic compares through the
+                        streaming primary + batched secondary path (sketches
+                        pre-planted in a workdir cache — FASTA ingest for
+                        50k * 4 Mb of sequence is a host-IO benchmark, not a
+                        chip benchmark). e2e_50k also records peak host RSS
+                        and the retained sparse-edge count — the 100k
+                        north-star claim extrapolates from THIS measurement,
+                        not from the 10k one.
+
+Roofline counters (SURVEY.md §5.1 rebuild note): matmul stages report
+`tflops` and `mfu` against the v5e bf16 peak; merge/sort stages report HBM
+traffic (`hbm_gbps`, `membw_frac`) AND compare-exchange element throughput
+(`vpu_eops_per_sec`, `vpu_frac`) against a documented VPU estimate — the
+merge kernel's working set lives in VMEM, so HBM fractions are tiny by
+design and VPU utilization is the binding roofline.
 
 `vs_baseline`: BASELINE.json `published` is empty (no published reference
 number exists — SURVEY.md §6), so the honest denominator everywhere is the
@@ -34,6 +50,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import resource
 import tempfile
 import time
 
@@ -45,10 +62,27 @@ K = 21
 TILE = 512
 NORTH_STAR_PAIRS_PER_SEC_PER_CHIP = (100_000 * 99_999 / 2) / 1800.0 / 16.0
 
-# secondary-stage production shape: one large primary cluster
+# secondary-stage shape: one large primary cluster (budget-friendly width)
 SEC_M = 512
 SEC_WIDTH = 2048
 SEC_VOCAB = 120_000
+
+# production secondary shape: 4 Mb genomes at default scale=200 give ~20k
+# scaled hashes -> packed width 32768; 8 related subclusters with mostly
+# private hash space push the vocabulary to multi-million ids
+PROD_M = 512
+PROD_SHARED = 10_000  # hashes shared within a subcluster (~95% kept/member)
+PROD_OWN = 10_000  # private hashes per genome
+PROD_SUBCLUSTERS = 8
+
+# v5e single-chip peaks for the roofline fields. int8 matmul (the indicator
+# kernels run int8 0/1 inputs with int32 accumulation) and HBM BW are the
+# published chip numbers (cf. jax-ml scaling-book hardware table); the VPU
+# figure is an ESTIMATE (8x128 lanes x 4 ALUs x ~940 MHz ~= 3.9e12
+# elementwise ops/s) used only to normalize merge-kernel throughput.
+V5E_INT8_OPS = 394e12
+V5E_HBM_BYTES_PER_S = 819e9
+V5E_VPU_EOPS = 3.9e12
 
 
 def _best_of(fn, reps: int = 3) -> float:
@@ -63,8 +97,39 @@ def _best_of(fn, reps: int = 3) -> float:
     return dt
 
 
+def _rate_fields(pairs: float, dt: float) -> dict:
+    value = pairs / dt
+    return {
+        "seconds": round(dt, 4),
+        "pairs_per_sec_per_chip": round(value, 1),
+        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+    }
+
+
+def _matmul_roofline(flops: float, dt: float) -> dict:
+    return {
+        "tflops": round(flops / dt / 1e12, 2),
+        "mfu": round(flops / dt / V5E_INT8_OPS, 4),
+    }
+
+
+def _merge_roofline(pairs: float, s2: int, hbm_bytes: float, dt: float) -> dict:
+    """Merge-kernel roofline: compare-exchange element ops (merged width x
+    log2 stages x ~4 vector ops per stage: two rolls, compare, select) plus
+    the actual HBM tile traffic."""
+    stages = (2 * s2).bit_length() - 1
+    eops = pairs * 2 * s2 * stages * 4
+    return {
+        "vpu_eops_per_sec": round(eops / dt / 1e9, 1),  # Geops/s
+        "vpu_frac": round(eops / dt / V5E_VPU_EOPS, 4),
+        "hbm_gbps": round(hbm_bytes / dt / 1e9, 2),
+        "membw_frac": round(hbm_bytes / dt / V5E_HBM_BYTES_PER_S, 5),
+    }
+
+
 def bench_primary() -> dict:
     from drep_tpu.cluster.engines import mash_distance_matrix
+    from drep_tpu.ops.merge import next_pow2
     from drep_tpu.ops.minhash import PackedSketches
 
     rng = np.random.default_rng(0)
@@ -79,13 +144,17 @@ def bench_primary() -> dict:
     mash_distance_matrix(packed, k=K, tile=TILE)  # compile warmup at full shape
     dt = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
     pairs = N_GENOMES * (N_GENOMES - 1) / 2
-    value = pairs / dt  # single-chip: per-chip by construction
+    s2 = max(128, next_pow2(SKETCH_SIZE))
+    # HBM per 128x128 pair tile: two [128, s2] s32 reads + [128, 128] write,
+    # over the wrapped symmetric grid (~half the full tile count)
+    t = N_GENOMES // 128
+    n_tiles = t * (t // 2 + 1)
+    hbm = n_tiles * (2 * 128 * s2 * 4 + 128 * 128 * 4)
     return {
         "n_genomes": N_GENOMES,
         "sketch": SKETCH_SIZE,
-        "seconds": round(dt, 4),
-        "pairs_per_sec_per_chip": round(value, 1),
-        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+        **_rate_fields(pairs, dt),
+        **_merge_roofline(pairs, s2, hbm, dt),
     }
 
 
@@ -104,24 +173,26 @@ def _secondary_pack():
 
 
 def bench_secondary_matmul(packed) -> dict:
-    from drep_tpu.ops.containment import all_vs_all_containment_matmul
+    from drep_tpu.ops.containment import (
+        all_vs_all_containment_matmul,
+        matmul_rows_pad,
+        matmul_vocab_pad,
+    )
 
     all_vs_all_containment_matmul(packed, k=K)  # warmup
     dt = _best_of(lambda: all_vs_all_containment_matmul(packed, k=K))
     pairs = SEC_M * (SEC_M - 1) / 2
-    value = pairs / dt
+    flops = 2.0 * matmul_rows_pad(SEC_M) ** 2 * matmul_vocab_pad(packed)
     return {
         "n_genomes": SEC_M,
         "sketch": SEC_WIDTH,
-        "seconds": round(dt, 4),
-        "pairs_per_sec_per_chip": round(value, 1),
-        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+        **_rate_fields(pairs, dt),
+        **_matmul_roofline(flops, dt),
     }
 
 
 def bench_secondary_pallas(packed) -> dict:
-    """Compiled Pallas kernel rate + exact equality vs the MXU matmul path
-    (VERDICT item 6: pin the compiled kernel on hardware)."""
+    """Compiled Pallas kernel rate + exact equality vs the MXU matmul path."""
     import jax
 
     if jax.devices()[0].platform != "tpu":
@@ -130,6 +201,7 @@ def bench_secondary_pallas(packed) -> dict:
     import jax.numpy as jnp
 
     from drep_tpu.ops.containment import _intersect_matmul, matmul_vocab_pad
+    from drep_tpu.ops.merge import next_pow2
     from drep_tpu.ops.pallas_merge import intersect_counts_pallas_self
 
     inter_p = intersect_counts_pallas_self(packed.ids)  # warmup + result
@@ -138,15 +210,136 @@ def bench_secondary_pallas(packed) -> dict:
     inter_m = np.asarray(_intersect_matmul(jnp.asarray(packed.ids), v_pad=v_pad))
     equal = bool(np.array_equal(inter_p, np.asarray(inter_m)))
     pairs = SEC_M * (SEC_M - 1) / 2
-    value = pairs / dt
+    s2 = max(128, next_pow2(SEC_WIDTH))
+    t = -(-SEC_M // 128)
+    hbm = t * (t // 2 + 1) * (2 * 128 * s2 * 4 + 128 * 128 * 4)
     return {
         "n_genomes": SEC_M,
         "sketch": SEC_WIDTH,
-        "seconds": round(dt, 4),
-        "pairs_per_sec_per_chip": round(value, 1),
         "equal_to_matmul": equal,
-        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+        **_rate_fields(pairs, dt),
+        **_merge_roofline(pairs, s2, hbm, dt),
     }
+
+
+def _production_pack(adversarial: bool = True):
+    """m=512 scaled sketches at production width (~20k ids/row -> packed
+    32768). `adversarial`: 8 subclusters with mostly-private hash space ->
+    ~5.2M-id vocabulary (the chunked/range regime — a worst case: real
+    primary clusters are Mash-similar, so their sketches overlap).
+    Otherwise the REALISTIC high-overlap cluster: every member keeps ~95%
+    of one shared ~20k pool plus ~700 private hashes -> vocab ~370k, the
+    one-shot indicator regime."""
+    from drep_tpu.ops.containment import pack_scaled_sketches
+
+    rng = np.random.default_rng(7)
+    sketches = []
+    if adversarial:
+        per = PROD_M // PROD_SUBCLUSTERS
+        for _c in range(PROD_SUBCLUSTERS):
+            pool = np.unique(
+                rng.integers(0, 2**62, size=int(PROD_SHARED * 1.05), dtype=np.uint64)
+            )
+            for _g in range(per):
+                keep = rng.random(len(pool)) < 0.95
+                own = np.unique(rng.integers(0, 2**62, size=PROD_OWN, dtype=np.uint64))
+                sketches.append(np.unique(np.concatenate([pool[keep], own])))
+    else:
+        pool = np.unique(
+            rng.integers(0, 2**62, size=2 * PROD_SHARED, dtype=np.uint64)
+        )
+        for _g in range(PROD_M):
+            keep = rng.random(len(pool)) < 0.95
+            own = np.unique(rng.integers(0, 2**62, size=PROD_OWN // 14, dtype=np.uint64))
+            sketches.append(np.unique(np.concatenate([pool[keep], own])))
+    return pack_scaled_sketches(sketches, [f"g{i}" for i in range(len(sketches))])
+
+
+def bench_secondary_production() -> dict:
+    """The production-width secondary regime (VERDICT r2 next-round #1):
+    both range-partitioned paths at m=512 / width 32768 / multi-M vocab,
+    exact cross-equality + sampled searchsorted oracle, no OOM."""
+    import jax
+
+    from drep_tpu.cluster.engines import beyond_budget_secondary_path
+    from drep_tpu.ops.containment import (
+        MATMUL_BUDGET_ELEMS,
+        all_vs_all_containment_matmul_chunked,
+        matmul_rows_pad,
+        matmul_vocab_chunk,
+        matmul_vocab_pad,
+        vocab_extent,
+    )
+    from drep_tpu.ops.merge import next_pow2
+    from drep_tpu.ops.minhash import PAD_ID
+
+    packed = _production_pack()
+    m = packed.n
+    width = packed.sketch_size
+    v_pad = matmul_vocab_pad(packed)
+    pairs = m * (m - 1) / 2
+    out: dict = {
+        "n_genomes": m,
+        "sketch": width,
+        "v_pad": v_pad,
+        "one_shot_fits": bool(matmul_rows_pad(m) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS),
+    }
+
+    ani_c, cov_c = all_vs_all_containment_matmul_chunked(packed, k=K)  # warmup
+    dt_m = _best_of(lambda: all_vs_all_containment_matmul_chunked(packed, k=K), reps=2)
+    v_chunk = matmul_vocab_chunk(matmul_rows_pad(m))
+    n_chunks = -(-vocab_extent(packed.ids) // v_chunk)
+    flops = 2.0 * matmul_rows_pad(m) ** 2 * n_chunks * v_chunk
+    out["matmul_chunked"] = {**_rate_fields(pairs, dt_m), **_matmul_roofline(flops, dt_m)}
+
+    if jax.devices()[0].platform == "tpu":
+        from drep_tpu.ops.containment import ani_cov_from_intersections
+        from drep_tpu.ops.pallas_merge import intersect_counts_pallas_self
+
+        inter_p = intersect_counts_pallas_self(packed.ids)  # warmup + result
+        dt_p = _best_of(lambda: intersect_counts_pallas_self(packed.ids), reps=2)
+        s2 = max(128, next_pow2(width))
+        # range partitioning re-reads each bucket tile: model HBM as the
+        # full-width traffic (buckets sum to the original row content)
+        t = -(-m // 128)
+        hbm = t * (t // 2 + 1) * (2 * 128 * s2 * 4 + 128 * 128 * 4)
+        out["pallas_range"] = {**_rate_fields(pairs, dt_p), **_merge_roofline(pairs, s2, hbm, dt_p)}
+        ani_p, _cov_p = ani_cov_from_intersections(inter_p, packed.counts, K)
+        out["paths_equal"] = bool(np.array_equal(ani_p, ani_c))
+
+    # sampled searchsorted oracle: 6 query rows against a column stride
+    rng = np.random.default_rng(11)
+    rows = rng.choice(m, size=6, replace=False)
+    ok = True
+    for i in rows:
+        ai = packed.ids[i][packed.ids[i] != PAD_ID]
+        for j in range(0, m, 37):
+            bj = packed.ids[j][packed.ids[j] != PAD_ID]
+            want = len(np.intersect1d(ai, bj)) / max(len(ai), 1)
+            ok &= abs(cov_c[i, j] - want) < 1e-6
+    out["oracle_ok"] = bool(ok)
+
+    out["dispatch_picks"] = beyond_budget_secondary_path(width, v_pad)
+
+    # the REALISTIC production cluster: same m/width, high-overlap vocab
+    # (Mash-similar genomes share most scaled hashes), one-shot regime —
+    # what the engine dispatch actually runs per typical primary cluster
+    from drep_tpu.cluster.engines import containment_matrices
+
+    packed_r = _production_pack(adversarial=False)
+    v_pad_r = matmul_vocab_pad(packed_r)
+    containment_matrices(packed_r, K)  # warmup
+    dt_r = _best_of(lambda: containment_matrices(packed_r, K), reps=2)
+    flops_r = 2.0 * matmul_rows_pad(packed_r.n) ** 2 * v_pad_r
+    out["realistic_highoverlap"] = {
+        "v_pad": v_pad_r,
+        "one_shot_fits": bool(
+            matmul_rows_pad(packed_r.n) * (v_pad_r + 1) <= MATMUL_BUDGET_ELEMS
+        ),
+        **_rate_fields(packed_r.n * (packed_r.n - 1) / 2, dt_r),
+        **_matmul_roofline(flops_r, dt_r),
+    }
+    return out
 
 
 def _plant_sketches(n: int, rng: np.random.Generator):
@@ -192,7 +385,9 @@ def bench_e2e(n: int) -> dict:
     """Wall-clock to Cdb: streaming primary + batched secondary on planted
     sketches. The sketch cache is pre-stored in the workdir (the supported
     resume path), so the measurement starts at the cluster stage — the
-    BASELINE "wall-clock to Cdb" clause — not at host FASTA IO."""
+    BASELINE "wall-clock to Cdb" clause — not at host FASTA IO. Records
+    peak host RSS (process lifetime max) and the retained sparse-edge
+    count so the large-n memory behavior is observed, not extrapolated."""
     import pandas as pd
 
     import jax
@@ -215,6 +410,7 @@ def bench_e2e(n: int) -> dict:
         t0 = time.perf_counter()
         cdb = d_cluster_wrapper(wd, bdb, streaming_primary=True)
         dt = time.perf_counter() - t0
+        retained_edges = int(len(wd.get_db("Mdb"))) if wd.hasDb("Mdb") else -1
     pairs = n * (n - 1) / 2
     n_chips = len(jax.local_devices())
     value = pairs / dt / n_chips
@@ -223,6 +419,8 @@ def bench_e2e(n: int) -> dict:
         "seconds": round(dt, 2),
         "primary_clusters": int(cdb["primary_cluster"].max()),
         "secondary_clusters": int(cdb["secondary_cluster"].nunique()),
+        "retained_edges": retained_edges,
+        "peak_host_rss_gb": round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2),
         "pairs_per_sec_per_chip": round(value, 1),
         "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
     }
@@ -233,10 +431,19 @@ def main() -> None:
 
     enable_persistent_cache()
     ap = argparse.ArgumentParser()
-    ap.add_argument("--stages", default="all", help="comma list: primary,secondary,e2e")
+    ap.add_argument(
+        "--stages",
+        default="all",
+        help="comma list: primary,secondary,production,e2e,scale",
+    )
     ap.add_argument("--e2e_n", type=int, default=10_000)
+    ap.add_argument("--scale_n", type=int, default=50_000)
     args = ap.parse_args()
-    want = set(args.stages.split(",")) if args.stages != "all" else {"primary", "secondary", "e2e"}
+    want = (
+        set(args.stages.split(","))
+        if args.stages != "all"
+        else {"primary", "secondary", "production", "e2e", "scale"}
+    )
 
     stages: dict = {}
     if "primary" in want:
@@ -248,11 +455,21 @@ def main() -> None:
             stages["secondary_pallas"] = bench_secondary_pallas(packed)
         except Exception as e:  # a broken stage must not kill the headline
             stages["secondary_error"] = repr(e)
+    if "production" in want:
+        try:
+            stages["secondary_production"] = bench_secondary_production()
+        except Exception as e:
+            stages["production_error"] = repr(e)
     if "e2e" in want:
         try:
-            stages["e2e_10k"] = bench_e2e(args.e2e_n)
+            stages[f"e2e_{args.e2e_n // 1000}k"] = bench_e2e(args.e2e_n)
         except Exception as e:
             stages["e2e_error"] = repr(e)
+    if "scale" in want:
+        try:
+            stages[f"e2e_{args.scale_n // 1000}k"] = bench_e2e(args.scale_n)
+        except Exception as e:
+            stages["scale_error"] = repr(e)
 
     head = stages.get("primary", {})
     print(

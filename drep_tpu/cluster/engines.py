@@ -146,13 +146,28 @@ def primary_jax_mash(
     return dist, 1.0 - dist
 
 
-# measured per-element cost ratio of the VPU bitonic merge vs the MXU
-# indicator matmul (BENCH_r02: 0.80M pairs/s at s2=2048 merge = 25 ps per
-# merged-element-stage, vs 1.17M pairs/s at v_pad=131072 matmul = 6.5 ps
-# per vocab column) — the beyond-budget dispatch weighs merge work
-# (2*s2*log2(2*s2) units/pair) against chunked-matmul work (v_pad
-# columns/pair) with this penalty on the merge side
-MERGE_VS_MATMUL_ELEM_COST = 4.0
+# measured per-element cost ratio of the VPU bitonic merge vs the int8 MXU
+# indicator matmul, at the m=512 / width-32768 / 8.4M-vocab production
+# shape on a tunneled v5e (r3 session: chunked matmul 1.56 s -> 1.4 ps per
+# pair-vocab-column, range merge 3.06 s -> 21 ps per pair-merge-unit).
+# The beyond-budget dispatch weighs merge work (2*s2*log2(2*s2)
+# units/pair) against chunked-matmul work (v_pad columns/pair) with this
+# penalty on the merge side; the merge only wins when the vocabulary
+# outgrows 15x the merge units (very diverse clusters)
+MERGE_VS_MATMUL_ELEM_COST = 15.0
+
+
+def beyond_budget_secondary_path(sketch_width: int, v_pad: int) -> str:
+    """Which single-chip kernel owns a beyond-one-shot-budget cluster —
+    THE dispatch rule (containment_matrices applies it; the bench reports
+    it), so the benchmark can never drift from what the engine runs."""
+    from drep_tpu.ops.merge import next_pow2
+
+    s2 = max(128, next_pow2(sketch_width))
+    merge_units = 2 * s2 * ((2 * s2).bit_length() - 1)
+    if MERGE_VS_MATMUL_ELEM_COST * merge_units < v_pad:
+        return "pallas_range"
+    return "matmul_chunked"
 
 
 def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: int = 128):
@@ -189,15 +204,11 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
 
         return sharded_containment_allpairs(packed, k=k, mesh=mesh)
     if jax.devices()[0].platform == "tpu":
-        from drep_tpu.ops.merge import next_pow2
-
-        s2 = max(128, next_pow2(packed.sketch_size))
-        merge_units = 2 * s2 * ((2 * s2).bit_length() - 1)
-        if MERGE_VS_MATMUL_ELEM_COST * merge_units < v_pad:
+        if beyond_budget_secondary_path(packed.sketch_size, v_pad) == "pallas_range":
             from drep_tpu.ops.pallas_merge import all_vs_all_containment_pallas
 
             return all_vs_all_containment_pallas(packed, k=k)
-        return all_vs_all_containment_matmul_chunked(packed, k=k, v_pad=v_pad)
+        return all_vs_all_containment_matmul_chunked(packed, k=k)
     return all_vs_all_containment(packed, k=k, tile=tile)
 
 

@@ -91,10 +91,13 @@ def containment_ani_tile(a_ids, a_counts, b_ids, b_counts, *, k: int = 21):
     return tile(a_ids, a_counts, b_ids, b_counts)
 
 
-# budget for the dense indicator matrix [m, V] in bf16 (elements, ~512 MB —
-# small next to 16 GB HBM, and the matmul at this size is sub-millisecond;
-# the budget exists to bound the indicator scatter, not the MXU)
-MATMUL_BUDGET_ELEMS = 1 << 28
+# budget for the dense indicator matrix [m, V] in int8 (elements, ~512 MB —
+# small next to 16 GB HBM; int8 halved the per-element cost of the old bf16
+# indicator, so the budget doubled with it. It exists to bound the
+# indicator's HBM footprint + zero-fill, not the MXU: a realistic 512-genome
+# production cluster at width 32768 has a ~400k-id vocabulary and must stay
+# on the one-shot path)
+MATMUL_BUDGET_ELEMS = 1 << 29
 _VOCAB_BUCKET_MIN = 8192
 
 
@@ -134,8 +137,10 @@ def _intersect_matmul(ids, *, v_pad: int):
     """Intersection counts as an MXU matmul of 0/1 indicator rows.
 
     inter[i,j] = |A_i ∩ A_j| = <ind_i, ind_j> over the id vocabulary —
-    bf16 0/1 inputs with f32 accumulation are exact up to 2^24. This is
-    where the systolic array earns its keep: one [m, V] x [V, m] matmul
+    int8 0/1 inputs with int32 accumulation are EXACT at any count (and
+    the v5e int8 MXU runs 2x its bf16 rate; measured 24% faster end to
+    end at the production chunk shape, scatter included). This is where
+    the systolic array earns its keep: one [m, V] x [V, m] matmul
     replaces m^2 searchsorted passes. Returns int32 counts: the device
     ships ONE integer matrix and the cov/ani elementwise math runs on host
     (host<->device links can be the bottleneck on tunneled TPU setups).
@@ -144,10 +149,9 @@ def _intersect_matmul(ids, *, v_pad: int):
     rows = jax.lax.broadcasted_iota(jnp.int32, (m, s), 0)
     valid = ids != PAD_ID
     cols = jnp.where(valid, ids, v_pad)  # pads land in a trash column
-    ind = jnp.zeros((m, v_pad + 1), jnp.bfloat16).at[rows, cols].set(1.0)
+    ind = jnp.zeros((m, v_pad + 1), jnp.int8).at[rows, cols].set(1)
     ind = ind[:, :v_pad]
-    inter = jnp.dot(ind, ind.T, preferred_element_type=jnp.float32)
-    return inter.astype(jnp.int32)
+    return jnp.dot(ind, ind.T, preferred_element_type=jnp.int32)
 
 
 def ani_cov_from_intersections(
@@ -205,35 +209,75 @@ def matmul_vocab_chunk(m_pad: int) -> int:
     return max(_VOCAB_BUCKET_MIN, 1 << (fit.bit_length() - 1))
 
 
+def vocab_extent(ids: np.ndarray) -> int:
+    """1 + max real id (0 when everything is padding) — the raw vocabulary
+    size before pow2 bucketing. THE extent rule for the chunked path: the
+    chunk geometry and the bench's FLOP model both derive from it, so it
+    lives in exactly one place."""
+    valid = ids != PAD_ID
+    return int(ids[valid].max()) + 1 if valid.any() else 0
+
+
+def _stacked_vocab_chunks(ids: np.ndarray, v_chunk: int, m_pad: int) -> np.ndarray:
+    """[R, m_pad, W] stacked rebased vocab-chunk matrices, ready for ONE
+    host->device transfer.
+
+    Chunk r holds each row's ids within [r*v_chunk, (r+1)*v_chunk),
+    rebased to the chunk origin, repacked to the shared pow2 width W (max
+    per-chunk per-row count). Narrow repack keeps total indicator-scatter
+    work at one pass over the real ids — scattering full-width rows per
+    chunk instead measured 4.7x slower at the 512x32768 production shape;
+    so did 20 separate per-chunk transfers on a tunneled v5e link (link
+    latency serialized), hence the single stacked tensor.
+    """
+    from drep_tpu.ops.rangepart import MIN_BUCKET_WIDTH, bucket_starts, repack_bucket
+
+    extent = vocab_extent(ids)
+    if extent == 0:
+        return np.full((0, m_pad, MIN_BUCKET_WIDTH), PAD_ID, np.int32)
+    n_chunks = -(-extent // v_chunk)
+    starts = bucket_starts(ids, v_chunk, n_chunks)
+    hist = np.diff(starts, axis=1)
+    from drep_tpu.ops.merge import next_pow2
+
+    width = max(MIN_BUCKET_WIDTH, next_pow2(int(hist.max())))
+    out = np.full((n_chunks, m_pad, width), PAD_ID, np.int32)
+    for r in range(n_chunks):
+        out[r, : ids.shape[0]] = repack_bucket(
+            ids, starts[:, r], hist[:, r], width, rebase=r * v_chunk
+        )
+    return out
+
+
 def all_vs_all_containment_matmul_chunked(
-    packed: PackedSketches, k: int = 21, v_pad: int | None = None
+    packed: PackedSketches, k: int = 21
 ) -> tuple[np.ndarray, np.ndarray]:
     """MXU path for vocabularies past the single-indicator budget.
 
     Intersection counts are additive over disjoint hash ranges, so the
     vocabulary splits into pow2 chunks each fitting the [m_pad, chunk]
-    indicator budget; every chunk rebases its ids to origin, runs the SAME
-    jit'd indicator matmul, and the int32 counts sum. This is the
-    production-width secondary engine (4 Mb genomes at scale=200 are
-    ~20k-wide sketches with multi-million-id vocabularies — SURVEY.md §7
-    hard part (c)): exact like the one-shot matmul (bf16 0/1 inputs, f32
-    accumulation, counts <= sketch width << 2^24), with total scatter work
-    still one pass over packed.ids (chunks repack narrow — see
-    ops/rangepart.py::partition_by_vocab_chunk).
+    indicator budget; chunks cross the link as ONE stacked tensor, every
+    chunk runs the same jit'd indicator matmul on its device-side slice,
+    and the int32 partial counts accumulate ON DEVICE (one result
+    transfer at the end — chunk dispatches stay async, so link latency
+    overlaps compute). This is the production-width secondary engine
+    (4 Mb genomes at scale=200 are ~20k-wide sketches with multi-million-
+    id vocabularies — SURVEY.md §7 hard part (c)): exact like the
+    one-shot matmul (int8 0/1 inputs, int32 accumulation — exact at any
+    count).
     """
-    from drep_tpu.ops.rangepart import partition_by_vocab_chunk
-
-    if v_pad is None:
-        v_pad = matmul_vocab_pad(packed)
     m = packed.n
     m_pad = matmul_rows_pad(m)
     v_chunk = matmul_vocab_chunk(m_pad)
-    inter = np.zeros((m, m), dtype=np.int32)
-    for _origin, bucket in partition_by_vocab_chunk(packed.ids, v_chunk):
-        ids_r, _ = pad_packed_rows(bucket, packed.counts, m_pad)
-        inter += np.asarray(_intersect_matmul(jnp.asarray(ids_r), v_pad=v_chunk))[
-            :m, :m
-        ]
+    stacked = jnp.asarray(_stacked_vocab_chunks(packed.ids, v_chunk, m_pad))
+    acc = None
+    for r in range(stacked.shape[0]):
+        part = _intersect_matmul(stacked[r], v_pad=v_chunk)
+        acc = part if acc is None else acc + part
+    if acc is None:
+        inter = np.zeros((m, m), dtype=np.int32)
+    else:
+        inter = np.asarray(acc)[:m, :m]
     return ani_cov_from_intersections(inter, packed.counts, k)
 
 

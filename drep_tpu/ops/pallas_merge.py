@@ -249,10 +249,26 @@ def intersect_counts_pallas(
     if force == "range" or (force is None and not _use_interpret()):
         from drep_tpu.ops.rangepart import partition_by_range
 
-        inter = np.zeros((na, nb), dtype=np.int32)
+        # accumulate bucket grids ON DEVICE, transfer once — per-bucket
+        # host syncs serialize on link latency (tunneled-TPU measurement in
+        # containment.all_vs_all_containment_matmul_chunked)
+        interpret = _use_interpret()
+        acc = None
         for _origin, (a_r, b_r) in partition_by_range([a, b], PALLAS_MAX_WIDTH):
-            inter += intersect_counts_pallas(a_r[:na], b_r[:nb], jnp_tile=jnp_tile)
-        return inter
+            s2_r = max(128, next_pow2(a_r.shape[1]))
+            ar = _pad_rows(_pad_cols_pow2(a_r, s2_r), TILE_A)
+            br = _pad_rows(_pad_cols_pow2(b_r, s2_r), TILE_B)
+            part = _intersect_grid(
+                np.ascontiguousarray(ar[:, ::-1]),
+                br,
+                tile_a=TILE_A,
+                tile_b=TILE_B,
+                interpret=interpret,
+            )
+            acc = part if acc is None else acc + part
+        if acc is None:
+            return np.zeros((na, nb), dtype=np.int32)
+        return np.asarray(acc)[:na, :nb]
 
     return _intersect_jnp_tiled(a, b, jnp_tile)[:na, :nb]
 
@@ -271,10 +287,24 @@ def intersect_counts_pallas_self(
         if force == "range" or (force is None and not _use_interpret()):
             from drep_tpu.ops.rangepart import partition_by_range
 
-            inter = np.zeros((n, n), dtype=np.int32)
+            # every bucket shares the wrapped-compact output layout (same
+            # rows, same tile), so the half-grids accumulate ON DEVICE and
+            # one transfer + one unwrap closes the sum
+            interpret = _use_interpret()
+            acc = None
             for _origin, (bucket,) in partition_by_range([a], PALLAS_MAX_WIDTH):
-                inter += intersect_counts_pallas_self(bucket, jnp_tile=jnp_tile)
-            return inter
+                s2_r = max(128, next_pow2(bucket.shape[1]))
+                ar = _pad_rows(_pad_cols_pow2(bucket, s2_r), TILE_A)
+                part = _intersect_grid_symmetric(
+                    np.ascontiguousarray(ar[:, ::-1]),
+                    ar,
+                    tile=TILE_A,
+                    interpret=interpret,
+                )
+                acc = part if acc is None else acc + part
+            if acc is None:
+                return np.zeros((n, n), dtype=np.int32)
+            return _unwrap_symmetric(np.asarray(acc), TILE_A)[:n, :n]
         return _intersect_jnp_tiled(a, a, jnp_tile)[:n, :n]
     a = _pad_rows(a, TILE_A)
     compact = _intersect_grid_symmetric(

@@ -14,11 +14,7 @@ import pytest
 
 from drep_tpu.ops.merge import cap_merge_tile, next_pow2
 from drep_tpu.ops.minhash import PAD_ID
-from drep_tpu.ops.rangepart import (
-    MIN_BUCKET_WIDTH,
-    partition_by_range,
-    partition_by_vocab_chunk,
-)
+from drep_tpu.ops.rangepart import MIN_BUCKET_WIDTH, partition_by_range
 
 
 def _sorted_rows(rng, n, max_len, vocab):
@@ -80,16 +76,22 @@ def test_partition_rejects_sub_lane_budget():
         list(partition_by_range([np.zeros((1, 4), np.int32)], 64))
 
 
-def test_vocab_chunks_rebase_and_reconstruct(rng):
+def test_stacked_vocab_chunks_rebase_and_reconstruct(rng):
+    """Every chunk of the stacked tensor holds exactly its id range,
+    rebased to origin; chunks together reconstruct the original rows."""
+    from drep_tpu.ops.containment import _stacked_vocab_chunks
+
     ids = _sorted_rows(rng, 8, 400, 50_000)
     v_chunk = 8192
+    stacked = _stacked_vocab_chunks(ids, v_chunk, m_pad=16)
+    assert stacked.shape[1] == 16 and (stacked[:, 8:] == PAD_ID).all()
     seen = [np.empty(0, np.int64)] * 8
-    for origin, bucket in partition_by_vocab_chunk(ids, v_chunk):
-        assert origin % v_chunk == 0
-        real = bucket[bucket != PAD_ID]
-        assert real.size and real.min() >= 0 and real.max() < v_chunk
+    for r in range(stacked.shape[0]):
+        real = stacked[r][stacked[r] != PAD_ID]
+        if real.size:
+            assert real.min() >= 0 and real.max() < v_chunk
         for i in range(8):
-            vals = bucket[i][bucket[i] != PAD_ID].astype(np.int64) + origin
+            vals = stacked[r, i][stacked[r, i] != PAD_ID].astype(np.int64) + r * v_chunk
             seen[i] = np.concatenate([seen[i], vals])
     for i in range(8):
         np.testing.assert_array_equal(seen[i], ids[i][ids[i] != PAD_ID].astype(np.int64))
