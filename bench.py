@@ -268,10 +268,10 @@ def bench_secondary_production() -> dict:
         matmul_rows_pad,
         matmul_vocab_chunk,
         matmul_vocab_pad,
-        vocab_extent,
     )
     from drep_tpu.ops.merge import next_pow2
     from drep_tpu.ops.minhash import PAD_ID
+    from drep_tpu.ops.rangepart import vocab_extent
 
     packed = _production_pack()
     m = packed.n

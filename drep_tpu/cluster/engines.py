@@ -171,11 +171,12 @@ def beyond_budget_secondary_path(sketch_width: int, v_pad: int) -> str:
 
 
 def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: int = 128):
-    """Directional (ani, cov) with automatic path selection.
+    """(symmetric max-containment ani, directional cov) with automatic
+    path selection.
 
     Preference order (measured on v5e):
     1. MXU indicator-matmul — ~340x faster than the gather path and exact;
-       used whenever the [m, vocab] bf16 indicator fits the budget.
+       used whenever the [m, vocab] int8 indicator fits the budget.
     2. ring-sharded mesh path (multi-device, beyond-budget clusters).
     3. beyond-budget single chip — BOTH remaining kernels extend to any
        width/vocab by range partitioning (ops/rangepart.py), so the cheaper
@@ -220,10 +221,9 @@ def secondary_jax_ani(
     mesh_shape: int | None = None,
     **_,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Directional containment (ani, cov) matrices for a genome subset.
-
-    `indices` index into gs.names; matrices are [m, m] in that order.
-    """
+    """(symmetric max-containment ani, directional cov) for a genome
+    subset. `indices` index into gs.names; matrices are [m, m] in that
+    order."""
     sketches = [gs.scaled[i] for i in indices]
     names = [gs.names[i] for i in indices]
     packed = pack_scaled_sketches(sketches, names)

@@ -22,7 +22,8 @@ import pandas as pd
 from drep_tpu.ingest import GenomeSketches
 from drep_tpu.ops.containment import (
     cap_gather_tile,
-    containment_ani_tile,
+    containment_cov_tile,
+    containment_to_ani,
     pack_scaled_sketches,
 )
 from drep_tpu.ops.minhash import PAD_ID
@@ -70,27 +71,25 @@ def greedy_secondary_cluster(
         b_ids, b_counts = _pad_pack(ids, counts, rows, block)
 
         # block vs existing reps (padded to a block multiple for shape reuse);
-        # both directions, because the coverage gate — like the default
-        # all-pairs path — requires cov >= cov_thresh in BOTH directions
+        # both coverage directions — the gate, like the default all-pairs
+        # path, requires cov >= cov_thresh in BOTH, and the ANI estimate is
+        # max-containment (see ops/containment.py module docstring)
         rep_pad = max(-(-len(reps) // block) * block, block)
         r_ids, r_counts = _pad_pack(ids, counts, reps, rep_pad)
-        ani_vs_reps = np.zeros((block, rep_pad), np.float32)
         cov_vs_reps = np.zeros((block, rep_pad), np.float32)
         cov_rev_reps = np.zeros((block, rep_pad), np.float32)
         for r0 in range(0, rep_pad, block):
-            a, c = containment_ani_tile(
-                b_ids, b_counts, r_ids[r0 : r0 + block], r_counts[r0 : r0 + block], k=gs.k
+            c = containment_cov_tile(
+                b_ids, b_counts, r_ids[r0 : r0 + block], k=gs.k
             )
-            _, c_rev = containment_ani_tile(
-                r_ids[r0 : r0 + block], r_counts[r0 : r0 + block], b_ids, b_counts, k=gs.k
+            c_rev = containment_cov_tile(
+                r_ids[r0 : r0 + block], r_counts[r0 : r0 + block], b_ids, k=gs.k
             )
-            ani_vs_reps[:, r0 : r0 + block] = np.asarray(a)
             cov_vs_reps[:, r0 : r0 + block] = np.asarray(c)
             cov_rev_reps[:, r0 : r0 + block] = np.asarray(c_rev).T
 
         # block vs itself (for genomes that become reps mid-block)
-        a_blk, c_blk = containment_ani_tile(b_ids, b_counts, b_ids, b_counts, k=gs.k)
-        a_blk, c_blk = np.asarray(a_blk), np.asarray(c_blk)
+        c_blk = np.asarray(containment_cov_tile(b_ids, b_counts, b_ids, k=gs.k))
 
         # assignment: sequential over genomes (a genome can become a rep
         # mid-block) but VECTORIZED over reps — the O(reps) inner work is
@@ -98,9 +97,9 @@ def greedy_secondary_cluster(
         n_pre = len(reps)  # reps existing before this block (all < b0)
         in_block: list[int] = []  # block-local positions of mid-block reps
         for t, pos in enumerate(rows):
-            ani_row = np.concatenate([ani_vs_reps[t, :n_pre], a_blk[t, in_block]])
             cov_row = np.concatenate([cov_vs_reps[t, :n_pre], c_blk[t, in_block]])
             cov_rev = np.concatenate([cov_rev_reps[t, :n_pre], c_blk[in_block, t]])
+            ani_row = containment_to_ani(np.maximum(cov_row, cov_rev), gs.k)
             if len(ani_row):
                 rep_pos_arr = np.array(reps, dtype=np.int64)
                 ndb_rows.append(

@@ -13,14 +13,17 @@ containment estimator computed entirely on device:
   ids in row B's (O(S log S), static shapes, vmapped over pair tiles).
 
 ANI model: containment C = |A∩B|/|A| estimates (1-p)^k under the iid
-substitution model, so ``ANI = C^(1/k)`` (the standard containment-ANI
-transform, cf. Mash screen / sourmash). C itself doubles as the
-alignment-fraction proxy used for the reference's ``cov_thresh`` gating
-(pairs with coverage < cov_thresh get similarity zeroed, as in the
+substitution model, so ``ANI = max(C(A,B), C(B,A))^(1/k)`` — MAX
+containment (cf. sourmash ANI). The max matters under genome-size
+asymmetry: when B carries content A lacks, the smaller side's containment
+reflects the substitution divergence while the larger side's is diluted by
+the extra content; fastANI's fragment-identity ANI tracks the former, so
+concordance requires the max. The resulting ani matrix is symmetric —
+exactly the reference's ANIn contract (one nucmer run, shared ani, two
+coverages). C itself stays DIRECTIONAL as the alignment-fraction proxy for
+the reference's two-sided ``cov_thresh`` gate (pairs with coverage <
+cov_thresh in either direction get similarity zeroed, as in the
 reference's Ndb post-processing).
-
-Directionality matches fastANI's query->reference rows: ani(A->B) uses
-C(A,B); clustering uses the symmetrized mean like the reference's pivot.
 """
 
 from __future__ import annotations
@@ -72,23 +75,40 @@ def _pair_intersection(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(hit.astype(jnp.int32))
 
 
+def containment_to_ani(c, k: int, xp=np):
+    """Elementwise containment -> ANI transform (c^(1/k); 0 stays 0). ONE
+    formula for every engine path and the greedy row math (`xp` selects
+    jnp on device, np on host) so the estimators cannot drift."""
+    return xp.where(c > 0.0, xp.exp(xp.log(xp.maximum(c, 1e-30)) / k), 0.0).astype(
+        xp.float32
+    )
+
+
+def max_containment_ani(cov: np.ndarray, k: int) -> np.ndarray:
+    """Symmetric ANI matrix from directional containment (see module
+    docstring for why MAX): ani[i,j] = max(cov[i,j], cov[j,i])^(1/k),
+    diagonal pinned to 1."""
+    ani = containment_to_ani(np.maximum(cov, cov.T), k)
+    np.fill_diagonal(ani, 1.0)
+    return ani
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
-def containment_ani_tile(a_ids, a_counts, b_ids, b_counts, *, k: int = 21):
-    """Directional ANI + coverage tiles between sketch blocks.
+def containment_cov_tile(a_ids, a_counts, b_ids, *, k: int = 21):
+    """Directional coverage tile between sketch blocks: cov[i,j] =
+    C(A_i, B_j) = |A∩B|/|A| (query side i). ANI derives from the FULL cov
+    matrix afterwards (max_containment_ani needs both directions, which a
+    single rectangular tile does not hold). `k` rides along only to keep
+    one cache key shape with the other tile kernels."""
+    del k
 
-    Returns (ani[Ta,Tb], cov[Ta,Tb]) where row i is query A_i against
-    reference B_j: cov = C(A_i, B_j) = |A∩B|/|A|, ani = C^(1/k).
-    """
-
-    def one_pair(a, na, b, nb):
+    def one_pair(a, na, b):
         inter = _pair_intersection(a, b)
-        cov = jnp.where(na > 0, inter / jnp.maximum(na, 1), 0.0)
-        ani = jnp.where(cov > 0.0, jnp.exp(jnp.log(jnp.maximum(cov, 1e-30)) / k), 0.0)
-        return ani.astype(jnp.float32), cov.astype(jnp.float32)
+        return jnp.where(na > 0, inter / jnp.maximum(na, 1), 0.0).astype(jnp.float32)
 
-    row = jax.vmap(one_pair, in_axes=(None, None, 0, 0))
-    tile = jax.vmap(row, in_axes=(0, 0, None, None))
-    return tile(a_ids, a_counts, b_ids, b_counts)
+    row = jax.vmap(one_pair, in_axes=(None, None, 0))
+    tile = jax.vmap(row, in_axes=(0, 0, None))
+    return tile(a_ids, a_counts, b_ids)
 
 
 # budget for the dense indicator matrix [m, V] in int8 (elements, ~512 MB —
@@ -127,9 +147,9 @@ def matmul_vocab_pad(packed: PackedSketches) -> int:
     The budget check and the kernel must use the SAME padded width — the
     raw vocab can be far below the bucket size.
     """
-    valid = packed.ids != PAD_ID
-    vmax = int(packed.ids[valid].max()) + 1 if valid.any() else 1
-    return _pow2_bucket(vmax, _VOCAB_BUCKET_MIN)
+    from drep_tpu.ops.rangepart import vocab_extent
+
+    return _pow2_bucket(max(vocab_extent(packed.ids), 1), _VOCAB_BUCKET_MIN)
 
 
 @functools.partial(jax.jit, static_argnames=("v_pad",))
@@ -157,14 +177,11 @@ def _intersect_matmul(ids, *, v_pad: int):
 def ani_cov_from_intersections(
     inter: np.ndarray, counts: np.ndarray, k: int
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Host: directional (ani, cov) from intersection counts.
-    cov = |A∩B|/|A|, ani = cov^(1/k), diagonals pinned to 1."""
+    """Host: (symmetric max-containment ani, directional cov) from
+    intersection counts. cov = |A∩B|/|A|; diagonals pinned to 1."""
     na = np.maximum(counts.astype(np.float32), 1.0)
-    cov = inter.astype(np.float32) / na[:, None]
-    ani = np.where(cov > 0.0, np.exp(np.log(np.maximum(cov, 1e-30)) / k), 0.0)
-    ani = ani.astype(np.float32)
-    cov = cov.astype(np.float32)
-    np.fill_diagonal(ani, 1.0)
+    cov = (inter.astype(np.float32) / na[:, None]).astype(np.float32)
+    ani = max_containment_ani(cov, k)
     np.fill_diagonal(cov, 1.0)
     return ani, cov
 
@@ -203,19 +220,12 @@ def all_vs_all_containment_matmul(
 
 
 def matmul_vocab_chunk(m_pad: int) -> int:
-    """Widest pow2 vocabulary chunk whose [m_pad, chunk+1] bf16 indicator
+    """Widest pow2 vocabulary chunk whose [m_pad, chunk+1] int8 indicator
     fits MATMUL_BUDGET_ELEMS (>= _VOCAB_BUCKET_MIN)."""
     fit = max(MATMUL_BUDGET_ELEMS // max(m_pad, 1) - 1, 1)
     return max(_VOCAB_BUCKET_MIN, 1 << (fit.bit_length() - 1))
 
 
-def vocab_extent(ids: np.ndarray) -> int:
-    """1 + max real id (0 when everything is padding) — the raw vocabulary
-    size before pow2 bucketing. THE extent rule for the chunked path: the
-    chunk geometry and the bench's FLOP model both derive from it, so it
-    lives in exactly one place."""
-    valid = ids != PAD_ID
-    return int(ids[valid].max()) + 1 if valid.any() else 0
 
 
 def _stacked_vocab_chunks(ids: np.ndarray, v_chunk: int, m_pad: int) -> np.ndarray:
@@ -230,7 +240,12 @@ def _stacked_vocab_chunks(ids: np.ndarray, v_chunk: int, m_pad: int) -> np.ndarr
     so did 20 separate per-chunk transfers on a tunneled v5e link (link
     latency serialized), hence the single stacked tensor.
     """
-    from drep_tpu.ops.rangepart import MIN_BUCKET_WIDTH, bucket_starts, repack_bucket
+    from drep_tpu.ops.rangepart import (
+        MIN_BUCKET_WIDTH,
+        bucket_starts,
+        repack_bucket,
+        vocab_extent,
+    )
 
     extent = vocab_extent(ids)
     if extent == 0:
@@ -284,31 +299,25 @@ def all_vs_all_containment_matmul_chunked(
 def all_vs_all_containment(
     packed: PackedSketches, k: int = 21, tile: int = 128
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Full directional [N, N] (ani, cov) matrices via fixed-shape tiles.
-
-    ani[i, j] = ANI of query i against reference j (NOT symmetric when
-    genome sizes differ — symmetrize downstream as the pipeline requires).
-    """
+    """Full [N, N] (symmetric max-containment ani, directional cov) via
+    fixed-shape coverage tiles; the ANI transform runs once on the full
+    coverage matrix (it needs both directions of every pair)."""
     n = packed.n
     tile = cap_gather_tile(packed.sketch_size, tile)
     ids, counts = pad_packed_rows(packed.ids, packed.counts, tile)
     nt = ids.shape[0]
 
-    ani = np.zeros((nt, nt), dtype=np.float32)
     cov = np.zeros((nt, nt), dtype=np.float32)
     for i0 in range(0, nt, tile):
         for j0 in range(0, nt, tile):
-            a, c = containment_ani_tile(
+            c = containment_cov_tile(
                 ids[i0 : i0 + tile],
                 counts[i0 : i0 + tile],
                 ids[j0 : j0 + tile],
-                counts[j0 : j0 + tile],
                 k=k,
             )
-            ani[i0 : i0 + tile, j0 : j0 + tile] = np.asarray(a)
             cov[i0 : i0 + tile, j0 : j0 + tile] = np.asarray(c)
-    ani = ani[:n, :n]
     cov = cov[:n, :n]
-    np.fill_diagonal(ani, 1.0)
+    ani = max_containment_ani(cov, k)
     np.fill_diagonal(cov, 1.0)
     return ani, cov

@@ -319,11 +319,10 @@ def intersect_counts_pallas_self(
 def all_vs_all_containment_pallas(
     packed: PackedSketches, k: int = 21
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Directional ([N,N] ani, [N,N] cov) via the merge kernel.
-
-    Same contract as ops/containment.py's other all_vs_all_* paths:
-    cov[i,j] = |A_i ∩ A_j| / |A_i|, ani = cov^(1/k), diagonal pinned to 1.
-    """
+    """([N,N] symmetric max-containment ani, [N,N] directional cov) via
+    the merge kernel — same contract as ops/containment.py's other
+    all_vs_all_* paths: cov[i,j] = |A_i ∩ A_j| / |A_i|, ani =
+    max(cov, cov.T)^(1/k), diagonals pinned to 1."""
     from drep_tpu.ops.containment import ani_cov_from_intersections
 
     inter = intersect_counts_pallas_self(packed.ids)

@@ -37,14 +37,16 @@ from drep_tpu.ops.minhash import PAD_ID
 MIN_BUCKET_WIDTH = 128  # lane width — never repack below one full lane row
 
 
+def vocab_extent(ids: np.ndarray) -> int:
+    """1 + max real id (0 when everything is padding) — THE extent rule:
+    the range partitioner, the matmul vocab bucketing, the chunk geometry,
+    and the bench's FLOP model all derive from this one definition."""
+    valid = ids != PAD_ID
+    return int(ids[valid].max()) + 1 if valid.any() else 0
+
+
 def _vocab_extent(mats: list[np.ndarray]) -> int:
-    """1 + max real id across all matrices (0 if everything is padding)."""
-    vmax = -1
-    for m in mats:
-        real = m[m != PAD_ID]
-        if real.size:
-            vmax = max(vmax, int(real.max()))
-    return vmax + 1
+    return max((vocab_extent(m) for m in mats), default=0)
 
 
 def bucket_starts(ids: np.ndarray, chunk: int, n_buckets: int) -> np.ndarray:
@@ -120,6 +122,11 @@ def partition_by_range(
     """
     if max_count < MIN_BUCKET_WIDTH:
         raise ValueError(f"max_count {max_count} below lane width {MIN_BUCKET_WIDTH}")
+    if max_count & (max_count - 1):
+        # widths are pow2-bucketed, so a non-pow2 bound would be silently
+        # exceeded (next_pow2(1400) = 2048 > 1500) — VMEM-sized callers
+        # must get exactly the bound they budgeted for
+        raise ValueError(f"max_count {max_count} must be a power of two")
     vocab = _vocab_extent(mats)
     if vocab == 0:
         return

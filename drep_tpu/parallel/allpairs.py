@@ -24,7 +24,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from drep_tpu.ops.containment import containment_ani_tile
+from drep_tpu.ops.containment import containment_cov_tile, max_containment_ani
 from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
 from drep_tpu.parallel.mesh import AXIS, make_mesh
 
@@ -83,14 +83,18 @@ def _mash_tile(k: int):
 
 def _containment_tile(k: int):
     def tile(a_ids, a_counts, b_ids, b_counts):
-        return containment_ani_tile(a_ids, a_counts, b_ids, b_counts, k=k)
+        del b_counts  # cov = |A∩B|/|A| needs only the query side
+        return containment_cov_tile(a_ids, a_counts, b_ids, k=k)
 
     return tile
 
 
+# containment ships ONE output stripe (cov); ani derives from the gathered
+# full matrix on host (max_containment_ani needs both directions of every
+# pair, which no single ring stripe holds) — and halves the result traffic
 _TILE_KINDS: dict[str, tuple[Callable[[int], Callable], int]] = {
     "mash": (_mash_tile, 1),
-    "containment": (_containment_tile, 2),
+    "containment": (_containment_tile, 1),
 }
 
 
@@ -176,8 +180,9 @@ def sharded_mash_allpairs(packed: PackedSketches, k: int = 21, mesh=None) -> np.
 def sharded_containment_allpairs(
     packed: PackedSketches, k: int = 21, mesh=None
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Directional ([N,N] ani, [N,N] cov), ring-sharded over the mesh."""
-    ani, cov = ring_allpairs(packed, "containment", k, mesh=mesh)
-    np.fill_diagonal(ani, 1.0)
+    """([N,N] symmetric max-containment ani, [N,N] directional cov),
+    ring-sharded over the mesh."""
+    (cov,) = ring_allpairs(packed, "containment", k, mesh=mesh)
+    ani = max_containment_ani(cov, k)
     np.fill_diagonal(cov, 1.0)
     return ani, cov

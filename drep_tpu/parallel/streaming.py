@@ -38,10 +38,9 @@ from drep_tpu.utils.logger import get_logger
 DEFAULT_BLOCK = 1024
 
 # the sort-merge HBM-temp budget rule lives beside the merge itself
-# (ops/merge.py::cap_merge_tile) and is shared with the pallas_merge
-# over-width fallback; re-exported here for the existing callers/tests
-from drep_tpu.ops.merge import SORT_TILE_BUDGET_ELEMS  # noqa: E402,F401
-from drep_tpu.ops.merge import cap_merge_tile as _cap_block_for_width  # noqa: E402
+# (ops/merge.py::cap_merge_tile), shared with the pallas_merge over-width
+# fallback
+from drep_tpu.ops.merge import cap_merge_tile  # noqa: E402
 
 
 def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
@@ -109,7 +108,7 @@ def streaming_mash_edges(
     if use_pallas:
         block = max(_PTILE, -(-block // _PTILE) * _PTILE)  # grid needs 128-multiples
     else:
-        block = _cap_block_for_width(block, packed.sketch_size)
+        block = cap_merge_tile(block, packed.sketch_size)
     ids, counts = pad_packed_rows(packed.ids, packed.counts, block)
     nt = ids.shape[0]
     n_blocks = nt // block

@@ -37,8 +37,10 @@ def test_searchsorted_matches_oracle(rng):
         for j in range(len(sketches)):
             want_cov = 1.0 if i == j else oracle_containment(sketches[i], sketches[j])
             assert abs(cov[i, j] - want_cov) < 1e-6, (i, j)
-            want_ani = 1.0 if i == j else (want_cov ** (1 / 21) if want_cov > 0 else 0.0)
+            cmax = max(want_cov, 1.0 if i == j else oracle_containment(sketches[j], sketches[i]))
+            want_ani = 1.0 if i == j else (cmax ** (1 / 21) if cmax > 0 else 0.0)
             assert abs(ani[i, j] - want_ani) < 1e-5
+    np.testing.assert_array_equal(ani, ani.T)  # max-containment ANI is symmetric
 
 
 def test_matmul_path_equals_searchsorted(rng):
@@ -65,6 +67,29 @@ def test_ani_tracks_mutation_rate(rng):
         ani, cov = all_vs_all_containment_matmul(packed, k=21)
         measured = (ani[0, 1] + ani[1, 0]) / 2
         assert abs(measured - (1 - p)) < 0.004, (p, measured)
+
+
+def test_size_asymmetry_uses_max_containment(rng):
+    """A genome CONTAINED in a twice-larger one (plus 1% divergence) must
+    measure ANI ~0.99 — not the size-ratio-diluted value the mean of the
+    two containments would give. This is the fastANI-divergence regime the
+    max-containment transform exists for (fragment-identity ANI ignores
+    the larger genome's extra content; so must we)."""
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    small = bases[rng.integers(0, 4, size=150_000)]
+    extra = bases[rng.integers(0, 4, size=150_000)]
+    mut = small.copy()
+    pos = np.nonzero(rng.random(len(small)) < 0.01)[0]
+    mut[pos] = bases[(np.searchsorted(bases, mut[pos]) + rng.integers(1, 4, len(pos))) % 4]
+    big = np.concatenate([mut, extra])
+    h_small = kmers.scaled_sketch(kmers.kmer_hashes(small.tobytes(), 21), scale=50)
+    h_big = kmers.scaled_sketch(kmers.kmer_hashes(big.tobytes(), 21), scale=50)
+    packed = pack_scaled_sketches([h_small, h_big], ["small", "big"], pad_multiple=128)
+    ani, cov = all_vs_all_containment_matmul(packed, k=21)
+    assert ani[0, 1] == ani[1, 0]
+    assert abs(ani[0, 1] - 0.99) < 0.004, ani[0, 1]
+    # the coverages stay directional: the big genome is only half-covered
+    assert cov[0, 1] > 0.7 and cov[1, 0] < 0.55, (cov[0, 1], cov[1, 0])
 
 
 def test_empty_sketch_row(rng):
