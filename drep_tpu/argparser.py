@@ -65,8 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
                                "primary-cluster boundaries and merge co-clustering groups")
         clus.add_argument("--streaming_primary", action="store_true",
                           help="out-of-core primary clustering: thresholded edge stream "
-                               "with per-block checkpoints and union-find components "
-                               "(single linkage); auto-enabled beyond --streaming_threshold")
+                               "with per-block checkpoints, clustered per --clusterAlg "
+                               "(average via sparse UPGMA on the retained edge graph, or "
+                               "single via connected components); auto-enabled beyond "
+                               "--streaming_threshold")
         clus.add_argument("--streaming_block", type=int, default=1024)
         clus.add_argument("--streaming_threshold", type=int, default=30_000,
                           help="genome count beyond which the primary stage streams "

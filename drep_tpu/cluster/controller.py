@@ -78,7 +78,7 @@ _RESUME_KEYS = [
     "greedy_secondary_clustering",
     "run_tertiary_clustering",
     "streaming_primary",
-    "streaming_threshold",  # auto-enables streaming, which changes linkage
+    "streaming_threshold",  # auto-enables streaming (sparse-graph linkage)
     "warn_dist",  # shapes the sparse Mdb's retention threshold
     "genomes",
 ]
@@ -187,12 +187,6 @@ def _primary_clusters(
                 "in explicitly, or raise the threshold to keep the dense path)",
                 n, kw["streaming_threshold"],
             )
-        if kw["clusterAlg"] != "single":
-            logger.warning(
-                "streaming primary computes single-linkage (connected components "
-                "at 1-P_ani); --clusterAlg %s applies only to secondary clustering",
-                kw["clusterAlg"],
-            )
         if kw["primary_estimator"] not in ("auto", "sort"):
             logger.warning(
                 "streaming primary always uses the sort (union-bottom-s) tile "
@@ -201,6 +195,10 @@ def _primary_clusters(
             )
         ckpt = wd.get_dir(os.path.join("data", "streaming_primary")) if wd is not None else None
         packed = pack_sketches(gs.bottom, gs.names, gs.sketch_size)
+        # --clusterAlg carries into the streaming path: average (default)
+        # runs sparse UPGMA over the retained edge graph, single runs
+        # connected components; anything else raises with guidance — no
+        # silent linkage-family switch at the streaming threshold
         labels, edges, pairs_computed = streaming_primary_clusters(
             packed,
             gs.k,
@@ -208,6 +206,7 @@ def _primary_clusters(
             block=kw["streaming_block"],
             checkpoint_dir=ckpt,
             keep_dist=_warn_dist(kw),  # evaluate-stage visibility
+            cluster_alg=kw["clusterAlg"],
         )
         return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names), pairs_computed
     engine = dispatch.get_primary(kw["primary_algorithm"])

@@ -98,3 +98,117 @@ def single_linkage_device(dist, cutoff: float) -> np.ndarray:
     adj = jnp.asarray(dist) <= cutoff
     labels = np.asarray(_connected_components_labels(adj))
     return _renumber_first_appearance(labels)
+
+
+def sparse_average_linkage(
+    n: int,
+    ii: np.ndarray,
+    jj: np.ndarray,
+    dd: np.ndarray,
+    cutoff: float,
+    keep: float,
+) -> tuple[np.ndarray, int]:
+    """Average-linkage (UPGMA) flat clusters at `cutoff` from a SPARSE edge
+    set — the streaming primary's linkage (VERDICT r2 item 5: the 30k+
+    regime previously fell back to single-linkage silently).
+
+    Edges (ii[e], jj[e], dd[e]) are every pair with distance <= `keep`
+    (the streaming retention bound, max(1-P_ani, warn_dist)); any pair NOT
+    in the edge set therefore has distance > keep. UPGMA needs the average
+    over ALL cross pairs of two clusters, so unobserved pairs enter the
+    average at their LOWER BOUND `keep`. Consequences, both one-sided:
+
+    - a rejected merge is always correctly rejected (the true average can
+      only exceed the bound), so clusters are never under-merged relative
+      to full-matrix UPGMA;
+    - an accepted merge whose average involved NO unobserved pairs is
+      exact. Merges that did involve unobserved pairs may over-merge (true
+      distances > keep could pull the true average above the cutoff).
+
+    Returns (labels 1..C by first appearance, number of accepted merges
+    that involved unobserved pairs). A zero second value CERTIFIES the
+    partition equals scipy full-matrix ``linkage(method='average')`` +
+    ``fcluster(t=cutoff, criterion='distance')`` up to merge-tie ordering
+    (tested). With the default warn_dist=0.25 retention band vs the 0.1
+    cutoff, pulling an average from >0.25 to <=0.1 needs many very-tight
+    known pairs against few unobserved ones — rare for genome clusters,
+    and counted loudly when it happens.
+
+    Host algorithm (lazy-heap agglomerative): O(E log E) heap traffic for
+    E retained edges — at the 100k-genome scale this path serves, E is
+    O(N * cluster_size), millions, not N^2. Only edge-connected cluster
+    pairs ever become merge candidates: a pair with NO observed cross edge
+    has average >= keep > cutoff by construction.
+    """
+    import heapq
+
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), 0
+    # symmetric neighbor maps: nbr[a][b] == nbr[b][a] == (sum_obs, cnt_obs)
+    nbr: dict[int, dict[int, tuple[float, int]]] = {i: {} for i in range(n)}
+    for a, b, d in zip(ii.tolist(), jj.tolist(), dd.tolist()):
+        if a == b:
+            continue
+        cur = nbr[a].get(b)
+        if cur is None or d < cur[0]:  # duplicates collapse to their min
+            nbr[a][b] = nbr[b][a] = (float(d), 1)
+
+    size = {i: 1 for i in range(n)}
+    members: dict[int, list[int]] = {i: [i] for i in range(n)}
+    alive = set(range(n))
+
+    def bound(a: int, b: int, s: float, c: int) -> float:
+        total = size[a] * size[b]
+        return (s + (total - c) * keep) / total
+
+    heap: list[tuple[float, int, int, float, int]] = []
+    for a in range(n):
+        for b, (s, c) in nbr[a].items():
+            if a < b:
+                heapq.heappush(heap, (bound(a, b, s, c), a, b, s, c))
+
+    next_id = n
+    approx_merges = 0
+    while heap:
+        avg, a, b, s, c = heapq.heappop(heap)
+        if avg > cutoff:
+            break  # heap min is the global min over valid candidates
+        if a not in alive or b not in alive:
+            continue
+        if nbr[a].get(b) != (s, c):
+            continue  # stale entry (the pair's stats changed since push)
+        if c < size[a] * size[b]:
+            approx_merges += 1
+        cid = next_id
+        next_id += 1
+        merged: dict[int, tuple[float, int]] = {}
+        for src in (a, b):
+            for x, (sx, cx) in nbr[src].items():
+                if x == a or x == b:
+                    continue
+                del nbr[x][src]
+                prev = merged.get(x)
+                merged[x] = (prev[0] + sx, prev[1] + cx) if prev else (sx, cx)
+        del nbr[a], nbr[b]
+        alive.discard(a)
+        alive.discard(b)
+        alive.add(cid)
+        size[cid] = size[a] + size[b]
+        # small-to-large extend: O(N log N) total list moves across all
+        # merges (a fresh concat per merge would be O(N^2) when a big
+        # cluster assembles one genome at a time)
+        ma, mb = members.pop(a), members.pop(b)
+        if len(ma) < len(mb):
+            ma, mb = mb, ma
+        ma.extend(mb)
+        members[cid] = ma
+        nbr[cid] = merged
+        for x, (sx, cx) in merged.items():
+            nbr[x][cid] = (sx, cx)
+            heapq.heappush(heap, (bound(cid, x, sx, cx), cid, x, sx, cx))
+
+    labels = np.zeros(n, dtype=np.int64)
+    for cid in alive:
+        for node in members[cid]:
+            labels[node] = cid
+    return _renumber_first_appearance(labels), approx_merges

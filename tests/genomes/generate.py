@@ -66,6 +66,10 @@ def mutate_indels(
     is_del = rng.random(n_events) < 0.5
     parts, prev = [], 0
     for p, ln, d in zip(pos, lens, is_del):
+        if p < prev:
+            # event inside an earlier deletion's span: skip it (rewinding
+            # prev would silently un-delete those bases)
+            continue
         parts.append(seq[prev:p])
         if d:
             prev = min(p + ln, len(seq))  # delete ln bases

@@ -62,3 +62,114 @@ def test_all_distant_all_singletons():
 
 def test_first_appearance_numbering():
     assert _renumber_first_appearance(np.array([5, 5, 2, 9, 2])).tolist() == [1, 1, 2, 3, 2]
+
+
+# ---- sparse average linkage (the streaming primary's UPGMA) -----------------
+
+
+def _edges_below(d: np.ndarray, keep: float):
+    ii, jj = np.nonzero(np.triu(d <= keep, 1))
+    return ii, jj, d[ii, jj]
+
+
+def _scipy_average_labels(d: np.ndarray, cutoff: float) -> np.ndarray:
+    link = sch.linkage(ssd.squareform(d, checks=False), method="average")
+    return _renumber_first_appearance(sch.fcluster(link, t=cutoff, criterion="distance"))
+
+
+def _blocky_dist(rng, sizes, within=(0.0, 0.08), between=(0.12, 0.6)):
+    """Planted blocks: tight within, spread between — the genome-cluster
+    shape the streaming path exists for."""
+    n = sum(sizes)
+    d = rng.uniform(*between, size=(n, n))
+    o = 0
+    for s in sizes:
+        d[o : o + s, o : o + s] = rng.uniform(*within, size=(s, s))
+        o += s
+    d = (d + d.T) / 2
+    np.fill_diagonal(d, 0.0)
+    return d
+
+
+def test_sparse_average_equals_scipy_full_retention(rng):
+    """With every pair retained (keep >= max dist), sparse UPGMA must equal
+    scipy full-matrix average linkage exactly."""
+    from drep_tpu.ops.linkage import sparse_average_linkage
+
+    for sizes in ([4, 7, 5], [1, 9, 3, 6], [2, 2]):
+        d = _blocky_dist(rng, sizes)
+        ii, jj, dd = _edges_below(d, keep=1.0)
+        labels, approx = sparse_average_linkage(len(d), ii, jj, dd, 0.10, 1.0)
+        assert approx == 0
+        assert np.array_equal(labels, _scipy_average_labels(d, 0.10)), sizes
+
+
+def test_sparse_average_equals_scipy_banded_retention(rng):
+    """With the realistic retention band (keep=0.25 vs cutoff 0.10), merges
+    never touch unobserved pairs on blocky data, so the partition still
+    equals scipy exactly and the exactness certificate holds."""
+    from drep_tpu.ops.linkage import sparse_average_linkage
+
+    for seed_sizes in ([6, 8, 4, 10], [3, 12, 5]):
+        d = _blocky_dist(rng, seed_sizes, between=(0.3, 0.9))
+        ii, jj, dd = _edges_below(d, keep=0.25)
+        labels, approx = sparse_average_linkage(len(d), ii, jj, dd, 0.10, 0.25)
+        assert approx == 0
+        assert np.array_equal(labels, _scipy_average_labels(d, 0.10)), seed_sizes
+
+
+def test_sparse_average_differs_from_single_linkage(rng):
+    """The case the silent fallback got wrong: a near-threshold bridge that
+    single-linkage follows but average linkage rejects."""
+    from drep_tpu.ops.linkage import sparse_average_linkage
+    from drep_tpu.parallel.streaming import connected_components
+
+    # two tight pairs bridged by ONE 0.09 edge; the other three cross
+    # distances are ~0.2, so the cross-cluster average is ~0.17 > 0.10
+    d = np.array(
+        [
+            [0.00, 0.02, 0.09, 0.20],
+            [0.02, 0.00, 0.20, 0.21],
+            [0.09, 0.20, 0.00, 0.03],
+            [0.20, 0.21, 0.03, 0.00],
+        ]
+    )
+    ii, jj, dd = _edges_below(d, keep=0.25)
+    labels, approx = sparse_average_linkage(4, ii, jj, dd, 0.10, 0.25)
+    assert approx == 0
+    assert np.array_equal(labels, _scipy_average_labels(d, 0.10))
+    assert labels.tolist() == [1, 1, 2, 2]  # average keeps the pairs apart
+    in_cluster = dd <= 0.10
+    single = connected_components(4, ii[in_cluster], jj[in_cluster])
+    assert single.tolist() == [1, 1, 1, 1]  # single-linkage bridges them
+
+
+def test_sparse_average_conservative_on_unobserved(rng):
+    """Unobserved pairs enter at the retention bound: a merge that the
+    bound keeps above the cutoff is rejected even though the observed
+    edges alone would average below it."""
+    from drep_tpu.ops.linkage import sparse_average_linkage
+
+    # clusters {0,1} and {2,3}: one observed cross edge at 0.02, the other
+    # three cross pairs unobserved (> keep=0.25). Observed-only average
+    # would be 0.02 <= 0.10 and wrongly merge; the bound gives
+    # (0.02 + 3*0.25)/4 = 0.19 > 0.10.
+    ii = np.array([0, 2, 0])
+    jj = np.array([1, 3, 2])
+    dd = np.array([0.01, 0.01, 0.02])
+    labels, _ = sparse_average_linkage(4, ii, jj, dd, 0.10, 0.25)
+    assert labels.tolist() == [1, 1, 2, 2]
+
+
+def test_streaming_rejects_unsupported_cluster_alg(rng):
+    from drep_tpu.ops.minhash import PackedSketches
+    from drep_tpu.parallel.streaming import streaming_primary_clusters
+
+    ids = np.sort(rng.integers(0, 1000, size=(4, 64), dtype=np.int32), axis=1)
+    packed = PackedSketches(
+        ids=ids, counts=np.full(4, 64, np.int32), names=list("abcd")
+    )
+    import pytest
+
+    with pytest.raises(ValueError, match="average or single"):
+        streaming_primary_clusters(packed, 21, 0.9, cluster_alg="complete")
