@@ -342,6 +342,74 @@ def bench_secondary_production() -> dict:
     return out
 
 
+INGEST_N = 96  # enough that process-pool startup amortizes
+INGEST_N_NUMPY = 8  # the numpy path is ~25x slower; sample it
+INGEST_MB = 4  # 4 Mb genomes — the production MAG size
+
+
+def bench_ingest() -> dict:
+    """Host ingest wall (SURVEY.md §7 hard part (f)): FASTA -> sketches,
+    native C++ vs numpy, serial vs process pool — the numbers the 100k
+    ingest extrapolation cites. Written fresh to tmp so the page cache is
+    the same warm state a real run sees after its first pass."""
+    import os
+
+    from drep_tpu.ingest import make_bdb, sketch_genomes
+
+    rng = np.random.default_rng(5)
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    with tempfile.TemporaryDirectory() as td:
+        paths = []
+        for i in range(INGEST_N):
+            seq = bases[rng.integers(0, 4, size=INGEST_MB * 1_000_000)]
+            p = os.path.join(td, f"g{i:03d}.fasta")
+            with open(p, "w") as f:
+                f.write(f">g{i}\n")
+                s = seq.tobytes().decode()
+                for o in range(0, len(s), 80):
+                    f.write(s[o : o + 80] + "\n")
+            paths.append(p)
+
+        out: dict = {
+            "n_genomes": INGEST_N,
+            "genome_mb": INGEST_MB,
+            # pool scaling is meaningless on a 1-core container (this
+            # image); the per-core rate is the portable number
+            "host_cores": os.cpu_count(),
+        }
+        import drep_tpu.native as native_mod
+
+        have_native = native_mod.sketch_fasta_native(paths[0], K, 64, 200, "splitmix64") is not None
+        modes = [("native_p1", 1, False), ("native_p8", 8, False)] if have_native else []
+        modes.append(("numpy_p1", 1, True))
+        for label, procs, force_numpy in modes:
+            subset = paths[: INGEST_N_NUMPY if force_numpy else INGEST_N]
+            bdb = make_bdb(subset)
+            if force_numpy:
+                orig = native_mod.sketch_fasta_native
+                native_mod.sketch_fasta_native = lambda *a, **k: None
+            try:
+                t0 = time.perf_counter()
+                sketch_genomes(bdb, processes=procs)
+                dt = time.perf_counter() - t0
+            finally:
+                if force_numpy:
+                    native_mod.sketch_fasta_native = orig
+            out[label] = {
+                "n": len(subset),
+                "seconds": round(dt, 3),
+                "genomes_per_sec": round(len(subset) / dt, 2),
+                "mb_per_sec": round(len(subset) * INGEST_MB / dt, 1),
+            }
+        best = max(
+            (v["genomes_per_sec"] for k, v in out.items() if isinstance(v, dict) and k.startswith("native")),
+            default=None,
+        )
+        if best:
+            out["extrapolated_100k_minutes_per_core"] = round(100_000 / best / 60, 1)
+        return out
+
+
 def _plant_sketches(n: int, rng: np.random.Generator):
     """Synthetic GenomeSketches with planted cluster structure: cluster
     members share ~90% of bottom-sketch hashes (well inside 1-P_ani) and
@@ -426,15 +494,48 @@ def bench_e2e(n: int) -> dict:
     }
 
 
+def _require_devices(timeout_s: float = 600.0) -> None:
+    """Fail loudly (one JSON error line) when backend init hangs — the
+    tunneled TPU client has been observed to block forever inside
+    make_c_api_client when the tunnel wedges; a bench that hangs silently
+    wastes the whole measurement window."""
+    import threading
+
+    import jax
+
+    got: list = []
+    t = threading.Thread(target=lambda: got.append(jax.devices()), daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not got:
+        import os
+
+        print(
+            json.dumps(
+                {
+                    "metric": "genome-pairs/sec/chip",
+                    "value": None,
+                    "unit": "pairs/s",
+                    "vs_baseline": None,
+                    "error": f"jax backend init did not return within {timeout_s:.0f}s "
+                    "(wedged TPU tunnel?) — no measurements taken",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(2)
+
+
 def main() -> None:
     from drep_tpu.utils.xla_cache import enable_persistent_cache
 
     enable_persistent_cache()
+    _require_devices()
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--stages",
         default="all",
-        help="comma list: primary,secondary,production,e2e,scale",
+        help="comma list: primary,secondary,production,ingest,e2e,scale",
     )
     ap.add_argument("--e2e_n", type=int, default=10_000)
     ap.add_argument("--scale_n", type=int, default=50_000)
@@ -442,7 +543,7 @@ def main() -> None:
     want = (
         set(args.stages.split(","))
         if args.stages != "all"
-        else {"primary", "secondary", "production", "e2e", "scale"}
+        else {"primary", "secondary", "production", "ingest", "e2e", "scale"}
     )
 
     stages: dict = {}
@@ -460,6 +561,11 @@ def main() -> None:
             stages["secondary_production"] = bench_secondary_production()
         except Exception as e:
             stages["production_error"] = repr(e)
+    if "ingest" in want:
+        try:
+            stages["ingest"] = bench_ingest()
+        except Exception as e:
+            stages["ingest_error"] = repr(e)
     if "e2e" in want:
         try:
             stages[f"e2e_{args.e2e_n // 1000}k"] = bench_e2e(args.e2e_n)

@@ -83,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
         tpu.add_argument("--mesh_shape", type=int, default=None,
                          help="shard all-pairs tiles over this many devices (default: all)")
         tpu.add_argument("--skip_plots", action="store_true")
+        tpu.add_argument("--no_overlap_ingest", dest="overlap_ingest",
+                         action="store_false", default=True,
+                         help="disable overlapping the streaming kernel's XLA "
+                              "compile with host ingest (results are identical "
+                              "either way; this exists for debugging)")
         tpu.add_argument("--profile", nargs="?", const="auto", default=None,
                          help="record a jax.profiler trace of the compare stage "
                               "(optionally to the given directory; default "

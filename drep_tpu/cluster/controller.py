@@ -59,6 +59,7 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "streaming_primary": False,
     "streaming_block": 1024,
     "streaming_threshold": 30_000,
+    "overlap_ingest": True,
 }
 
 _RESUME_KEYS = [
@@ -292,15 +293,45 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         logger.info("resuming: Cdb present with matching cluster arguments — skipping recompute")
         return wd.get_db("Cdb")
 
-    gs = sketch_genomes(
-        bdb,
-        k=kw["kmer_size"],
-        sketch_size=kw["MASH_sketch"],
-        scale=kw["scale"],
-        processes=kw["processes"],
-        wd=wd,
-        hash_name=kw["hash"],
-    )
+    warmup_thread = None
+    if (
+        kw["overlap_ingest"]
+        and kw["processes"] == 1  # ingest with processes>1 FORKS a pool;
+        # forking while this thread is inside XLA's multithreaded C++
+        # compiler can deadlock the children (classic fork-under-locks) —
+        # serial ingest is the only configuration where the overlap is safe
+        and snapshot["primary_estimator_resolved"] == "streaming_sort"
+    ):
+        # overlap the streaming tile kernel's cold XLA compile (~20-40 s)
+        # with host ingest — the one ingest/compute overlap that is exact
+        # and free (parallel/streaming.py module docstring has the
+        # analysis); bit-identical results, warmup computes throwaway data
+        import threading
+
+        from drep_tpu.parallel.streaming import warmup_streaming_compile
+
+        warmup_thread = threading.Thread(
+            target=warmup_streaming_compile,
+            args=(kw["MASH_sketch"],),
+            kwargs={"block": kw["streaming_block"], "k": kw["kmer_size"]},
+        )
+        warmup_thread.start()
+    try:
+        gs = sketch_genomes(
+            bdb,
+            k=kw["kmer_size"],
+            sketch_size=kw["MASH_sketch"],
+            scale=kw["scale"],
+            processes=kw["processes"],
+            wd=wd,
+            hash_name=kw["hash"],
+        )
+    finally:
+        if warmup_thread is not None:
+            # joined even when ingest raises — a dangling thread inside
+            # XLA's C++ compile aborts interpreter teardown and masks the
+            # real error; by now ingest has absorbed the compile anyway
+            warmup_thread.join()
     n = len(gs.names)
     logger.info("clustering %d genomes (primary=%s, secondary=%s)", n, kw["primary_algorithm"], kw["S_algorithm"])
 

@@ -22,7 +22,8 @@ import numpy as np
 import pandas as pd
 
 from drep_tpu.ops import kmers
-from drep_tpu.utils.fasta import fasta_stats, n50, read_fasta_contigs
+from drep_tpu.sketch_worker import sketch_one as _sketch_one
+from drep_tpu.utils.fasta import fasta_stats
 from drep_tpu.utils.logger import get_logger
 from drep_tpu.workdir import WorkDirectory
 
@@ -42,32 +43,6 @@ class GenomeSketches:
     k: int
     sketch_size: int
     scale: int
-
-
-def _sketch_one(args) -> tuple[str, dict]:
-    name, path, k, sketch_size, scale, hash_name = args
-
-    from drep_tpu.native import sketch_fasta_native
-
-    native = sketch_fasta_native(path, k, sketch_size, scale, hash_name)
-    if native is not None:
-        return name, native
-
-    contigs = read_fasta_contigs(path)
-    lengths = np.array([len(c) for c in contigs], dtype=np.int64)
-    raw = np.concatenate(
-        [kmers.hash_kmers(kmers.packed_kmers(c, k), k, hash_name) for c in contigs]
-        or [np.empty(0, np.uint64)]
-    )
-    bottom, scaled, n_kmers = kmers.sketches_from_raw(raw, sketch_size, scale)
-    return name, {
-        "length": int(lengths.sum()) if len(lengths) else 0,
-        "N50": n50(lengths),
-        "contigs": len(contigs),
-        "n_kmers": n_kmers,
-        "bottom": bottom,
-        "scaled": scaled,
-    }
 
 
 def sketch_args_snapshot(

@@ -25,6 +25,23 @@ module is the TPU-native supersession (SURVEY.md §7 step 8 / §5.4):
   merge touches an unobserved pair, and loudly counted when one does);
   'single' runs host union-find connected components, which at a distance
   cutoff is EXACTLY single-linkage fcluster(t=cutoff).
+
+Ingest/compute overlap (SURVEY.md §2c PP row, §7 hard part (f)): the tile
+loop deliberately does NOT consume genome blocks as they are sketched.
+The estimator compares int32 ids whose order must agree across every pair
+(bottom-s of the union), and the dense rank remap that guarantees this
+(ops/minhash.py::pack_sketches) needs the full sketch set — the exact
+alternative, per-tile local remaps, would preserve order within each tile
+but re-transfer packed ids per tile: ~8 MB x ~4800 tiles ≈ 38 GB across
+the link at 100k genomes vs ~400 MB once for the global pack. With the
+native ingest at ~92 MB/s/core (measured, bench `ingest` stage — ~78
+core-minutes per 100k genomes, so minutes of wall on a real multi-core
+TPU-VM host with `-p`), ingest is small next to the tile compute, and the
+one overlap that is exact AND free is taken instead:
+:func:`warmup_streaming_compile` runs the ~20-40 s cold XLA compile of
+the tile kernel on a background thread while the host ingests
+(cluster/controller.py wires it; results are bit-identical by
+construction — the warmup computes throwaway data at the real shapes).
 """
 
 from __future__ import annotations
@@ -75,6 +92,81 @@ def _real_pairs_in_tile(i0: int, j0: int, block: int, n: int) -> int:
     return ra * rb
 
 
+def _pallas_tile_layout(ids: np.ndarray, counts: np.ndarray):
+    """(ids_pal, ids_rev, counts_col) — the exact host layout
+    _mash_shared_grid consumes (pow2 PAD-padded columns, reversed
+    contiguous copy, column-vector counts). ONE recipe shared by the edge
+    loop and warmup_streaming_compile so the warmed jit cache key cannot
+    drift from the real run's signature."""
+    from drep_tpu.ops.merge import next_pow2
+    from drep_tpu.ops.minhash import PAD_ID
+
+    width = ids.shape[1]
+    s2 = max(128, next_pow2(width))
+    ids_pal = (
+        np.pad(ids, ((0, 0), (0, s2 - width)), constant_values=PAD_ID)
+        if s2 != width
+        else ids
+    )
+    return (
+        ids_pal,
+        np.ascontiguousarray(ids_pal[:, ::-1]),
+        np.ascontiguousarray(counts[:, None]),
+    )
+
+
+def _effective_block(block: int, sketch_width: int, use_pallas: bool) -> int:
+    """The tile block the edge loop will actually run: 128-multiples for
+    the Pallas grid, HBM-temp-capped for the jnp merge. One rule shared
+    with warmup_streaming_compile so the warmed compile cache key always
+    matches the real run's shapes."""
+    if use_pallas:
+        from drep_tpu.ops.pallas_mash import TILE as _PTILE
+
+        return max(_PTILE, -(-block // _PTILE) * _PTILE)
+    return cap_merge_tile(block, sketch_width)
+
+
+def warmup_streaming_compile(
+    sketch_width: int,
+    block: int = DEFAULT_BLOCK,
+    k: int = 21,
+    use_pallas: bool | None = None,
+) -> None:
+    """Compile the streaming tile kernel at the exact shapes a run will
+    use, on throwaway data — fire on a background thread while host ingest
+    runs, and the ~20-40 s cold XLA compile costs zero wall-clock (the
+    one exact-and-free ingest/compute overlap; module docstring has the
+    analysis of why tile-level overlap is rejected). Safe concurrently
+    with the real run: a same-signature jit call just waits on the
+    compile-cache lock."""
+    import jax
+
+    from drep_tpu.ops.pallas_mash import pallas_mash_supported
+
+    if use_pallas is None:
+        use_pallas = pallas_mash_supported(sketch_width)
+    block = _effective_block(block, sketch_width, use_pallas)
+    ids = np.tile(np.arange(sketch_width, dtype=np.int32), (block, 1))
+    counts = np.full(block, sketch_width, dtype=np.int32)
+    if use_pallas:
+        from drep_tpu.ops.pallas_mash import _mash_shared_grid
+        from drep_tpu.ops.pallas_merge import _use_interpret
+
+        ids_pal, ids_rev, counts_col = _pallas_tile_layout(ids, counts)
+        out = _mash_shared_grid(
+            ids_rev,
+            counts_col,
+            ids_pal,
+            counts_col,
+            s_orig=sketch_width,
+            interpret=_use_interpret(),
+        )
+    else:
+        out, _ = mash_distance_tile(ids, counts, ids, counts, k=k)
+    jax.block_until_ready(out)
+
+
 def streaming_mash_edges(
     packed: PackedSketches,
     k: int,
@@ -102,30 +194,17 @@ def streaming_mash_edges(
     # through HBM) — BENCH_r02 end-to-end: 2.70 M pairs/s/chip at width
     # 1024 vs 0.54 for raw jnp-merge tiles. The jnp path stays for CPU and
     # over-wide sketches, with its HBM-temp cap.
-    from drep_tpu.ops.pallas_mash import TILE as _PTILE, pallas_mash_supported
+    from drep_tpu.ops.pallas_mash import pallas_mash_supported
 
     if use_pallas is None:  # override exists so CPU tests can force the
         use_pallas = pallas_mash_supported(packed.sketch_size)  # interpret path
-    if use_pallas:
-        block = max(_PTILE, -(-block // _PTILE) * _PTILE)  # grid needs 128-multiples
-    else:
-        block = cap_merge_tile(block, packed.sketch_size)
+    block = _effective_block(block, packed.sketch_size, use_pallas)
     ids, counts = pad_packed_rows(packed.ids, packed.counts, block)
     nt = ids.shape[0]
     n_blocks = nt // block
     width = ids.shape[1]  # the estimator's `s` (pre-pow2-pad sketch width)
     if use_pallas:
-        from drep_tpu.ops.merge import next_pow2
-        from drep_tpu.ops.minhash import PAD_ID
-
-        s2 = max(128, next_pow2(width))
-        ids_pal = (
-            np.pad(ids, ((0, 0), (0, s2 - width)), constant_values=PAD_ID)
-            if s2 != width
-            else ids
-        )
-        ids_rev = np.ascontiguousarray(ids_pal[:, ::-1])
-        counts_col = np.ascontiguousarray(counts[:, None])
+        ids_pal, ids_rev, counts_col = _pallas_tile_layout(ids, counts)
     # local devices only: on a multi-host pod jax.devices() includes remote
     # chips, and device_put to a non-addressable device raises. Row-block
     # stripes are instead divided across processes (bi % pc == pid below)
@@ -365,6 +444,19 @@ def streaming_primary_clusters(
         )
     cutoff = 1.0 - p_ani
     keep = max(cutoff, keep_dist)
+    if cluster_alg == "average" and keep <= cutoff:
+        # UPGMA's discriminating information IS the retention band beyond
+        # the cutoff: with keep == cutoff every candidate's bound is
+        # <= cutoff and the partition silently degenerates to connected
+        # components (exactly the single-linkage over-merge this linkage
+        # exists to prevent). Widen to the default warn_dist ratio.
+        keep = min(1.0, 2.5 * cutoff)
+        get_logger().warning(
+            "streaming average linkage needs edge retention beyond the "
+            "%.3f cutoff to discriminate merges (--warn_dist was <= the "
+            "cutoff); widening retention to %.3f",
+            cutoff, keep,
+        )
     ii, jj, dd, pairs_computed = streaming_mash_edges(
         packed, k, keep, block=block, checkpoint_dir=checkpoint_dir
     )

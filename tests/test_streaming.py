@@ -189,3 +189,38 @@ def test_streaming_unsupported_alg_errors_via_controller(tmp_path, genome_paths)
             str(tmp_path / "wd"), genome_paths,
             streaming_primary=True, clusterAlg="complete", skip_plots=True,
         )
+
+
+def test_overlap_ingest_identical_results(tmp_path, genome_paths):
+    """The compile-warmup overlap must not change results: identical Cdb
+    with --no_overlap_ingest (it computes throwaway data by construction;
+    this pins it)."""
+    from drep_tpu.workflows import compare_wrapper
+
+    on = compare_wrapper(
+        str(tmp_path / "wd_on"), genome_paths,
+        streaming_primary=True, overlap_ingest=True, skip_plots=True,
+    )
+    off = compare_wrapper(
+        str(tmp_path / "wd_off"), genome_paths,
+        streaming_primary=True, overlap_ingest=False, skip_plots=True,
+    )
+    on = on.sort_values("genome").reset_index(drop=True)
+    off = off.sort_values("genome").reset_index(drop=True)
+    assert on[["genome", "primary_cluster", "secondary_cluster"]].equals(
+        off[["genome", "primary_cluster", "secondary_cluster"]]
+    )
+
+
+def test_streaming_average_widens_zero_retention():
+    """keep_dist <= cutoff would leave UPGMA no information beyond the
+    cutoff (bound degenerates to connected components); the path must
+    widen retention instead — identical partition to an explicit band."""
+    packed = _random_packed()
+    l0, _, _ = streaming_primary_clusters(
+        packed, k=21, p_ani=0.9, block=16, keep_dist=0.0, cluster_alg="average"
+    )
+    l1, _, _ = streaming_primary_clusters(
+        packed, k=21, p_ani=0.9, block=16, keep_dist=0.25, cluster_alg="average"
+    )
+    assert _canon(l0) == _canon(l1)
