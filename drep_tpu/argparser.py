@@ -107,6 +107,10 @@ def build_parser() -> argparse.ArgumentParser:
             filt.add_argument("--ignoreGenomeQuality", action="store_true")
             filt.add_argument("--genomeInfo", default=None,
                               help="CSV with genome,completeness,contamination")
+            filt.add_argument("--checkM_method", default="lineage_wf",
+                              choices=["lineage_wf", "taxonomy_wf"],
+                              help="CheckM workflow when quality comes from "
+                                   "checkm (reference d_filter option)")
 
         if with_scoring:
             sc = p.add_argument_group("SCORING")

@@ -28,6 +28,7 @@ FILTER_DEFAULTS: dict[str, Any] = {
     "completeness": 75.0,
     "contamination": 25.0,
     "ignoreGenomeQuality": False,
+    "checkM_method": "lineage_wf",  # reference --checkM_method (or taxonomy_wf)
 }
 
 
@@ -44,14 +45,24 @@ def load_genome_info(source) -> pd.DataFrame:
     return df.rename(columns={k: v for k, v in renames.items() if k in df.columns})
 
 
-def run_checkm_wrapper(bdb: pd.DataFrame, out_dir: str, processes: int = 1) -> pd.DataFrame:
+def run_checkm_wrapper(
+    bdb: pd.DataFrame,
+    out_dir: str,
+    processes: int = 1,
+    checkm_method: str = "lineage_wf",
+) -> pd.DataFrame:
     """CheckM completeness/contamination via subprocess (reference L0 path).
 
-    Reference parity: d_filter.py::run_checkM_wrapper. Only used when
-    `checkm` exists on $PATH; otherwise callers should pass --genomeInfo.
+    Reference parity: d_filter.py::run_checkM_wrapper, including the
+    --checkM_method choice (lineage_wf default; taxonomy_wf runs the
+    domain-level workflow `checkm taxonomy_wf domain Bacteria`). Only used
+    when `checkm` exists on $PATH; otherwise callers should pass
+    --genomeInfo.
     """
     if shutil.which("checkm") is None:
         raise RuntimeError("checkm not found on $PATH — supply --genomeInfo instead")
+    if checkm_method not in ("lineage_wf", "taxonomy_wf"):
+        raise ValueError(f"unknown checkM_method {checkm_method!r}")
     genome_dir = os.path.join(out_dir, "checkm_genomes")
     os.makedirs(genome_dir, exist_ok=True)
     # checkm selects bins by extension (-x) and reports Bin Id without the
@@ -65,8 +76,14 @@ def run_checkm_wrapper(bdb: pd.DataFrame, out_dir: str, processes: int = 1) -> p
             shutil.copy(row.location, dst)
     res_dir = os.path.join(out_dir, "checkm_out")
     tab = os.path.join(out_dir, "checkm.tsv")
+    method_args = (
+        ["lineage_wf", genome_dir, res_dir]
+        if checkm_method == "lineage_wf"
+        # the reference's taxonomy_wf path pins the domain-level marker set
+        else ["taxonomy_wf", "domain", "Bacteria", genome_dir, res_dir]
+    )
     cmd = [
-        "checkm", "lineage_wf", genome_dir, res_dir,
+        "checkm", *method_args,
         "-x", "fa", "-t", str(processes), "--tab_table", "-f", tab,
     ]
     res = subprocess.run(cmd, capture_output=True, text=True)
@@ -119,7 +136,12 @@ def d_filter_wrapper(
             raise ValueError(f"genomeInfo missing columns {missing}")
     elif not kw["ignoreGenomeQuality"]:
         if shutil.which("checkm") is not None:
-            quality = run_checkm_wrapper(bdb, wd.get_dir(os.path.join("data", "checkM")), kwargs.get("processes", 1))
+            quality = run_checkm_wrapper(
+                bdb,
+                wd.get_dir(os.path.join("data", "checkM")),
+                kwargs.get("processes", 1),
+                checkm_method=kw["checkM_method"],
+            )
         else:
             user_warning(
                 "no --genomeInfo given and checkm not on $PATH — genome quality "
