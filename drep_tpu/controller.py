@@ -60,7 +60,24 @@ class Controller:
             logger.info("  external %-14s %s", name, status)
 
 
+def _honor_jax_platforms_env() -> None:
+    """Apply JAX_PLATFORMS through the config API as well as the env var:
+    plugin-registered platforms (e.g. a tunneled TPU) can wrap backend
+    lookup and still attempt their own client init under the env var
+    alone — observed to block CLI startup forever when the tunnel is
+    unreachable; the config route is authoritative and skips unrequested
+    plugins. Shared by both CLI entries (python -m drep_tpu and the
+    drep-tpu console script)."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
 def main(argv: list[str] | None = None) -> None:
+    _honor_jax_platforms_env()
     Controller().parseArguments(parse_args(argv))
 
 
