@@ -82,3 +82,20 @@ def test_dendrogram_draws_threshold_line(plotted_wd):
     assert any(abs(x - p_cut) < 1e-9 for x in xs), "no vertical line at the cutoff"
     assert any("cut" in t.get_text() for t in ax.texts)
     plt.close(fig)
+
+
+def test_streaming_run_plots_without_dense_linkage(tmp_path, genome_paths):
+    """A streaming-primary workdir has no dense primary linkage/distance
+    (sparse Mdb, empty plink) — the analyze stage must still produce the
+    secondary figures and skip the primary dendrogram gracefully."""
+    from drep_tpu.workflows import compare_wrapper
+
+    compare_wrapper(
+        str(tmp_path / "wd"), genome_paths, streaming_primary=True,
+    )
+    figdir = tmp_path / "wd" / "figures"
+    import os
+
+    written = set(os.listdir(figdir))
+    assert "Secondary_clustering_dendrograms.pdf" in written
+    assert "Clustering_scatterplots.pdf" in written
