@@ -21,12 +21,21 @@ import pandas as pd
 
 from drep_tpu.ingest import GenomeSketches
 from drep_tpu.ops.containment import (
+    VocabChunkGeometry,
     cap_gather_tile,
     containment_cov_tile,
     containment_to_ani,
     pack_scaled_sketches,
+    rect_from_chunks,
 )
 from drep_tpu.ops.minhash import PAD_ID
+
+
+def _cov_from_inter(inter: np.ndarray, denom: np.ndarray) -> np.ndarray:
+    """cov = inter / denom with zero-count rows/cols pinned to 0 (matches
+    the gather tile's where(n>0, ...) contract)."""
+    d = np.maximum(denom.astype(np.float32), 1.0)
+    return np.where(denom > 0, inter / d, 0.0).astype(np.float32)
 
 
 def _pad_pack(ids: np.ndarray, counts: np.ndarray, rows: list[int], pad_to: int):
@@ -57,13 +66,35 @@ def greedy_secondary_cluster(
 
     packed = pack_scaled_sketches([gs.scaled[indices[t]] for t in order], [gs.names[indices[t]] for t in order])
     ids, counts = packed.ids, packed.counts
-    # cap the [block, block, S] gather working set (shared TPU-crash guard)
-    block = cap_gather_tile(ids.shape[1], block)
+    import jax
+
+    use_matmul = jax.devices()[0].platform == "tpu"
+    if not use_matmul:
+        # cap the [block, block, S] gather working set (TPU-crash guard —
+        # the matmul path has its own vocabulary-chunk budget instead)
+        block = cap_gather_tile(ids.shape[1], block)
 
     labels_ordered = np.zeros(m, dtype=np.int64)
     reps: list[int] = []  # positions (in `order` space) of representatives
     ndb_rows: list[dict] = []
     name_arr = np.array(packed.names)  # invariant across blocks
+
+    if use_matmul:
+        import jax.numpy as jnp
+
+        # chunk geometry fixed ONCE from the full cluster: any row subset
+        # repacks in O(rows), and the append-only representative set lives
+        # as device-resident per-chunk tensors that only receive NEW rows
+        # (host->device traffic O(total reps), not O(reps x blocks)).
+        # The rep side is consumed in FIXED row tiles: stable jit shapes
+        # (no recompile as reps grow) and a bounded [tile, v_chunk]
+        # indicator regardless of how many representatives accumulate.
+        rep_tile = 4 * block
+        geom = VocabChunkGeometry(ids, max_rows_per_call=max(rep_tile, block))
+        rep_chunks_dev = [
+            jnp.asarray(np.full((0, w), PAD_ID, np.int32)) for w in geom.widths
+        ]
+        n_shipped = 0  # reps already resident on device
 
     for b0 in range(0, m, block):
         rows = list(range(b0, min(b0 + block, m)))
@@ -73,23 +104,62 @@ def greedy_secondary_cluster(
         # block vs existing reps (padded to a block multiple for shape reuse);
         # both coverage directions — the gate, like the default all-pairs
         # path, requires cov >= cov_thresh in BOTH, and the ANI estimate is
-        # max-containment (see ops/containment.py module docstring)
-        rep_pad = max(-(-len(reps) // block) * block, block)
-        r_ids, r_counts = _pad_pack(ids, counts, reps, rep_pad)
-        cov_vs_reps = np.zeros((block, rep_pad), np.float32)
-        cov_rev_reps = np.zeros((block, rep_pad), np.float32)
-        for r0 in range(0, rep_pad, block):
-            c = containment_cov_tile(
-                b_ids, b_counts, r_ids[r0 : r0 + block], k=gs.k
-            )
-            c_rev = containment_cov_tile(
-                r_ids[r0 : r0 + block], r_counts[r0 : r0 + block], b_ids, k=gs.k
-            )
-            cov_vs_reps[:, r0 : r0 + block] = np.asarray(c)
-            cov_rev_reps[:, r0 : r0 + block] = np.asarray(c_rev).T
+        # max-containment (see ops/containment.py module docstring).
+        # One intersection-count matrix yields BOTH directions (the sets
+        # are symmetric; only the denominators differ): on TPU it comes
+        # from the rectangular chunked MXU matmul (gather tiles serialize
+        # on the scalar unit there); off-TPU the gather tiles are fine.
+        if use_matmul:
+            rep_pad = max(-(-len(reps) // rep_tile) * rep_tile, rep_tile)
+            if n_shipped < len(reps):
+                new_chunks = geom.rows_chunks(np.array(reps[n_shipped:]))
+                rep_chunks_dev = [
+                    jnp.concatenate([old, jnp.asarray(nc)]) if old.shape[0] else jnp.asarray(nc)
+                    for old, nc in zip(rep_chunks_dev, new_chunks)
+                ]
+                n_shipped = len(reps)
+            r_counts = np.zeros(rep_pad, np.int32)
+            r_counts[: len(reps)] = counts[reps]
+            # the block's chunk tensors go to device ONCE and serve both
+            # the vs-reps tiles and the self comparison
+            blk_dev = [
+                jnp.asarray(np.pad(bc, ((0, block - nb), (0, 0)), constant_values=PAD_ID))
+                for bc in geom.rows_chunks(np.array(rows))
+            ]
+            inter = np.empty((block, rep_pad), np.float32)
+            for t0 in range(0, rep_pad, rep_tile):
+                tile_chunks = [
+                    jnp.pad(
+                        rc[t0 : t0 + rep_tile],
+                        ((0, rep_tile - max(min(rc.shape[0] - t0, rep_tile), 0)), (0, 0)),
+                        constant_values=PAD_ID,
+                    )
+                    for rc in rep_chunks_dev
+                ]
+                inter[:, t0 : t0 + rep_tile] = rect_from_chunks(
+                    blk_dev, tile_chunks, geom.v_chunk
+                )
+            cov_vs_reps = _cov_from_inter(inter, b_counts[:, None])
+            cov_rev_reps = _cov_from_inter(inter, r_counts[None, :])
+            inter_self = rect_from_chunks(blk_dev, blk_dev, geom.v_chunk).astype(np.float32)
+            c_blk = _cov_from_inter(inter_self, b_counts[:, None])
+        else:
+            rep_pad = max(-(-len(reps) // block) * block, block)
+            r_ids, r_counts = _pad_pack(ids, counts, reps, rep_pad)
+            cov_vs_reps = np.zeros((block, rep_pad), np.float32)
+            cov_rev_reps = np.zeros((block, rep_pad), np.float32)
+            for r0 in range(0, rep_pad, block):
+                c = containment_cov_tile(
+                    b_ids, b_counts, r_ids[r0 : r0 + block], k=gs.k
+                )
+                c_rev = containment_cov_tile(
+                    r_ids[r0 : r0 + block], r_counts[r0 : r0 + block], b_ids, k=gs.k
+                )
+                cov_vs_reps[:, r0 : r0 + block] = np.asarray(c)
+                cov_rev_reps[:, r0 : r0 + block] = np.asarray(c_rev).T
 
-        # block vs itself (for genomes that become reps mid-block)
-        c_blk = np.asarray(containment_cov_tile(b_ids, b_counts, b_ids, k=gs.k))
+            # block vs itself (for genomes that become reps mid-block)
+            c_blk = np.asarray(containment_cov_tile(b_ids, b_counts, b_ids, k=gs.k))
 
         # assignment: sequential over genomes (a genome can become a rep
         # mid-block) but VECTORIZED over reps — the O(reps) inner work is

@@ -165,12 +165,7 @@ def _intersect_matmul(ids, *, v_pad: int):
     ships ONE integer matrix and the cov/ani elementwise math runs on host
     (host<->device links can be the bottleneck on tunneled TPU setups).
     """
-    m, s = ids.shape
-    rows = jax.lax.broadcasted_iota(jnp.int32, (m, s), 0)
-    valid = ids != PAD_ID
-    cols = jnp.where(valid, ids, v_pad)  # pads land in a trash column
-    ind = jnp.zeros((m, v_pad + 1), jnp.int8).at[rows, cols].set(1)
-    ind = ind[:, :v_pad]
+    ind = _indicator(ids, v_pad)
     return jnp.dot(ind, ind.T, preferred_element_type=jnp.int32)
 
 
@@ -226,6 +221,113 @@ def matmul_vocab_chunk(m_pad: int) -> int:
     return max(_VOCAB_BUCKET_MIN, 1 << (fit.bit_length() - 1))
 
 
+
+
+def _indicator(ids, v_pad: int):
+    """[m, v_pad] int8 0/1 indicator from PAD-padded id rows — THE scatter
+    every MXU intersection kernel shares (pads land in a trash column that
+    the slice discards)."""
+    m, s = ids.shape
+    rows = jax.lax.broadcasted_iota(jnp.int32, (m, s), 0)
+    cols = jnp.where(ids != PAD_ID, ids, v_pad)
+    return jnp.zeros((m, v_pad + 1), jnp.int8).at[rows, cols].set(1)[:, :v_pad]
+
+
+@functools.partial(jax.jit, static_argnames=("v_pad",))
+def _intersect_matmul_rect(a_ids, b_ids, *, v_pad: int):
+    """Rectangular intersection counts |A_i ∩ B_j| — two int8 indicator
+    scatters, one MXU matmul contracting the vocabulary axis. The greedy
+    path's block-vs-representatives comparisons run here on TPU instead of
+    through gather tiles (batched gathers serialize on the scalar unit —
+    the measured ~70x penalty noted in ops/minhash.py)."""
+    return jax.lax.dot_general(
+        _indicator(a_ids, v_pad), _indicator(b_ids, v_pad),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+
+
+class VocabChunkGeometry:
+    """Per-cluster vocabulary-chunk layout for incremental rectangular
+    intersections (the greedy path's working set).
+
+    The chunk boundaries, per-chunk widths, and every row's chunk slices
+    are fixed up front from the FULL cluster id matrix, so any subset of
+    rows can be repacked into aligned chunk tensors in O(rows) host work —
+    and an append-only subset (the greedy representative set) can live as
+    device-resident per-chunk tensors that only ever receive NEW rows
+    (host->device traffic O(total reps), not O(reps x blocks); rebuilding
+    and re-shipping the whole rep set each block was the measured waste
+    this class removes).
+    """
+
+    def __init__(self, ids: np.ndarray, max_rows_per_call: int):
+        from drep_tpu.ops.rangepart import MIN_BUCKET_WIDTH, bucket_starts, vocab_extent
+
+        self.ids = ids
+        extent = vocab_extent(ids)
+        # budget covers BOTH operands of a rectangular call at the stated
+        # row bound — callers must tile anything larger (greedy tiles its
+        # representative side at a fixed row count for exactly this)
+        fit = max(MATMUL_BUDGET_ELEMS // max(2 * matmul_rows_pad(max_rows_per_call), 1) - 1, 1)
+        self.v_chunk = max(_VOCAB_BUCKET_MIN, 1 << (fit.bit_length() - 1))
+        self.n_chunks = max(1, -(-extent // self.v_chunk))
+        self.starts = bucket_starts(ids, self.v_chunk, self.n_chunks)
+        hist = np.diff(self.starts, axis=1)
+        # per-chunk width = max count over ALL cluster rows: any subset
+        # fits, so chunk tensors never need re-widening
+        self.widths = [
+            _pow2_bucket(int(hist[:, c].max()), MIN_BUCKET_WIDTH)
+            for c in range(self.n_chunks)
+        ]
+        self.hist = hist
+
+    def rows_chunks(self, rows: list[int] | np.ndarray) -> list[np.ndarray]:
+        """[len(rows), W_c] rebased chunk tensor per chunk, for any subset."""
+        from drep_tpu.ops.rangepart import repack_bucket
+
+        sub = self.ids[rows] if len(rows) else self.ids[:0]
+        out = []
+        for c in range(self.n_chunks):
+            out.append(
+                repack_bucket(
+                    sub,
+                    self.starts[rows, c] if len(rows) else np.zeros(0, np.int64),
+                    self.hist[rows, c] if len(rows) else np.zeros(0, np.int64),
+                    self.widths[c],
+                    rebase=c * self.v_chunk,
+                )
+            )
+        return out
+
+
+def rect_from_chunks(a_chunks, b_chunks, v_chunk: int) -> np.ndarray:
+    """Σ_c |A∩B| over aligned chunk tensors (device arrays or numpy);
+    partials accumulate on device, one transfer returns int32 [na, nb]."""
+    acc = None
+    for a_c, b_c in zip(a_chunks, b_chunks):
+        part = _intersect_matmul_rect(jnp.asarray(a_c), jnp.asarray(b_c), v_pad=v_chunk)
+        acc = part if acc is None else acc + part
+    return np.asarray(acc)
+
+
+def intersect_counts_matmul_rect(a_ids: np.ndarray, b_ids: np.ndarray) -> np.ndarray:
+    """|A_i ∩ B_j| for sorted PAD-padded id rows sharing one id space,
+    chunking the vocabulary when the joint indicator exceeds the budget
+    (same additivity as the self path; one shared geometry keeps the
+    chunks aligned across both sides). Returns int32 [na, nb]."""
+    na, nb = a_ids.shape[0], b_ids.shape[0]
+    if na == 0 or nb == 0:
+        return np.zeros((na, nb), np.int32)
+    joint = np.full(
+        (na + nb, max(a_ids.shape[1], b_ids.shape[1])), PAD_ID, np.int32
+    )
+    joint[:na, : a_ids.shape[1]] = a_ids
+    joint[na:, : b_ids.shape[1]] = b_ids
+    geom = VocabChunkGeometry(joint, max_rows_per_call=max(na, nb))
+    a_chunks = geom.rows_chunks(np.arange(na))
+    b_chunks = geom.rows_chunks(np.arange(na, na + nb))
+    return rect_from_chunks(a_chunks, b_chunks, geom.v_chunk)
 
 
 def _stacked_vocab_chunks(ids: np.ndarray, v_chunk: int, m_pad: int) -> np.ndarray:

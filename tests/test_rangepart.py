@@ -173,3 +173,78 @@ def test_chunked_matmul_matches_one_shot(rng):
     ani_1, cov_1 = all_vs_all_containment_matmul(packed, k=21)
     np.testing.assert_array_equal(cov_c, cov_1)
     np.testing.assert_array_equal(ani_c, ani_1)
+
+
+def test_rect_matmul_matches_oracle(rng):
+    """Rectangular chunked intersection counts (the greedy path's TPU
+    route) vs the numpy oracle, across the chunking boundary."""
+    from drep_tpu.ops.containment import intersect_counts_matmul_rect
+
+    a = _sorted_rows(rng, 7, 500, 40_000)
+    b = _sorted_rows(rng, 12, 500, 40_000)
+    import drep_tpu.ops.containment as cont
+
+    got = intersect_counts_matmul_rect(a, b)
+    np.testing.assert_array_equal(got, _oracle_inter(a, b))
+
+    orig = cont.MATMUL_BUDGET_ELEMS
+    cont.MATMUL_BUDGET_ELEMS = 1 << 15  # force multi-chunk
+    try:
+        got_chunked = intersect_counts_matmul_rect(a, b)
+    finally:
+        cont.MATMUL_BUDGET_ELEMS = orig
+    np.testing.assert_array_equal(got_chunked, _oracle_inter(a, b))
+
+
+def test_greedy_matmul_path_equals_gather_path(rng, monkeypatch):
+    """Greedy clustering must produce identical Ndb/labels through the
+    rectangular-matmul route (TPU) and the gather tiles (CPU default)."""
+    import jax
+
+    import drep_tpu.cluster.greedy as greedy_mod
+    from drep_tpu.cluster.greedy import greedy_secondary_cluster
+    from drep_tpu.ingest import DEFAULT_SCALE, GenomeSketches
+
+    import pandas as pd
+
+    n = 40
+    sketches = []
+    pool = np.unique(rng.integers(0, 1 << 40, size=4000, dtype=np.uint64))
+    for i in range(n):
+        keep = pool[rng.random(len(pool)) < (0.9 if i % 2 else 0.5)]
+        own = np.unique(rng.integers(0, 1 << 40, size=200, dtype=np.uint64))
+        sketches.append(np.unique(np.concatenate([keep, own])))
+    gdb = pd.DataFrame(
+        {
+            "genome": [f"g{i}" for i in range(n)],
+            "length": 1_000_000,
+            "N50": 10_000,
+            "contigs": 10,
+            "n_kmers": [len(s) * 50 for s in sketches],
+        }
+    )
+    gs = GenomeSketches(
+        names=list(gdb["genome"]), gdb=gdb, bottom=[], scaled=sketches,
+        k=21, sketch_size=1000, scale=DEFAULT_SCALE,
+    )
+    bdb = pd.DataFrame({"genome": gs.names, "location": gs.names})
+    kw = {"S_ani": 0.95, "cov_thresh": 0.1}
+
+    ndb_g, labels_g = greedy_secondary_cluster(gs, bdb, list(range(n)), 1, kw, block=16)
+
+    real_platform = jax.devices()[0].platform
+    if real_platform == "tpu":  # first run already took the matmul path
+        pytest.skip("gather-vs-matmul comparison needs a non-tpu default")
+
+    class FakeDev:
+        platform = "tpu"
+
+    monkeypatch.setattr(jax, "devices", lambda *a: [FakeDev()] if not a else [FakeDev()])
+    try:
+        ndb_m, labels_m = greedy_secondary_cluster(gs, bdb, list(range(n)), 1, kw, block=16)
+    finally:
+        monkeypatch.undo()
+    np.testing.assert_array_equal(labels_g, labels_m)
+    pd.testing.assert_frame_equal(
+        ndb_g.reset_index(drop=True), ndb_m.reset_index(drop=True)
+    )
