@@ -410,6 +410,64 @@ def bench_ingest() -> dict:
         return out
 
 
+GREEDY_M = 1024  # one large primary cluster through the greedy engine
+GREEDY_SUBCLUSTERS = 16
+
+
+def bench_greedy() -> dict:
+    """The greedy-incremental secondary engine (BASELINE config 5's path)
+    at production sketch width: m=1024 genomes in 16 planted subclusters,
+    ~20k-wide scaled sketches. Measures genomes/s through the full
+    assignment loop (device comparisons + host sequential logic) and
+    checks the recovered representative structure."""
+    import pandas as pd
+
+    from drep_tpu.cluster.greedy import greedy_secondary_cluster
+    from drep_tpu.ingest import DEFAULT_SCALE, GenomeSketches
+
+    rng = np.random.default_rng(13)
+    per = GREEDY_M // GREEDY_SUBCLUSTERS
+    sketches = []
+    for _c in range(GREEDY_SUBCLUSTERS):
+        pool = np.unique(
+            rng.integers(0, 2**62, size=int(2 * PROD_SHARED * 1.05), dtype=np.uint64)
+        )
+        for _g in range(per):
+            keep = pool[rng.random(len(pool)) < 0.95]
+            own = np.unique(rng.integers(0, 2**62, size=PROD_OWN // 14, dtype=np.uint64))
+            sketches.append(np.unique(np.concatenate([keep, own])))
+    gdb = pd.DataFrame(
+        {
+            "genome": [f"g{i}" for i in range(GREEDY_M)],
+            "length": 4_000_000,
+            "N50": 50_000,
+            "contigs": 100,
+            "n_kmers": [len(s) * DEFAULT_SCALE for s in sketches],
+        }
+    )
+    gs = GenomeSketches(
+        names=list(gdb["genome"]), gdb=gdb, bottom=[], scaled=sketches,
+        k=K, sketch_size=1000, scale=DEFAULT_SCALE,
+    )
+    bdb = pd.DataFrame({"genome": gs.names, "location": gs.names})
+    kw = {"S_ani": 0.95, "cov_thresh": 0.1}
+    indices = list(range(GREEDY_M))
+
+    greedy_secondary_cluster(gs, bdb, indices, 1, kw)  # warmup/compiles
+    t0 = time.perf_counter()
+    ndb, labels = greedy_secondary_cluster(gs, bdb, indices, 1, kw)
+    dt = time.perf_counter() - t0
+    return {
+        "n_genomes": GREEDY_M,
+        "sketch_width": int(max(len(s) for s in sketches)),
+        "n_reps": int(labels.max()),
+        "comparisons": int(len(ndb)),
+        "seconds": round(dt, 3),
+        "genomes_per_sec": round(GREEDY_M / dt, 1),
+        "subclusters_recovered": bool(labels.max() <= 2 * GREEDY_SUBCLUSTERS),
+    }
+
+
 def _plant_sketches(n: int, rng: np.random.Generator):
     """Synthetic GenomeSketches with planted cluster structure: cluster
     members share ~90% of bottom-sketch hashes (well inside 1-P_ani) and
@@ -562,7 +620,7 @@ def main() -> None:
     ap.add_argument(
         "--stages",
         default="all",
-        help="comma list: primary,secondary,production,ingest,e2e,scale",
+        help="comma list: primary,secondary,production,ingest,greedy,e2e,scale",
     )
     ap.add_argument("--e2e_n", type=int, default=10_000)
     ap.add_argument("--scale_n", type=int, default=50_000)
@@ -570,7 +628,7 @@ def main() -> None:
     want = (
         set(args.stages.split(","))
         if args.stages != "all"
-        else {"primary", "secondary", "production", "ingest", "e2e", "scale"}
+        else {"primary", "secondary", "production", "ingest", "greedy", "e2e", "scale"}
     )
 
     stages: dict = {}
@@ -593,6 +651,11 @@ def main() -> None:
             stages["ingest"] = bench_ingest()
         except Exception as e:
             stages["ingest_error"] = repr(e)
+    if "greedy" in want:
+        try:
+            stages["greedy_secondary"] = bench_greedy()
+        except Exception as e:
+            stages["greedy_error"] = repr(e)
     if "e2e" in want:
         try:
             stages[f"e2e_{args.e2e_n // 1000}k"] = bench_e2e(args.e2e_n)

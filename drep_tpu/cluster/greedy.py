@@ -5,11 +5,15 @@ controller.py; SURVEY.md §3.2 — "compare each genome only to existing
 cluster representatives; new rep if all < S_ani"; reference mount empty).
 Reduces the per-primary-cluster cost from O(m^2) comparisons to O(m·reps).
 
-TPU-shaped execution: genomes are processed in blocks. One device call
-computes the [block, reps] containment tile plus the [block, block]
-within-block tile; the strictly-sequential assignment logic (a genome can
-become a rep mid-block) then runs on host over those precomputed numbers —
-so the device sees large fixed-shape batches, never a per-genome launch.
+TPU-shaped execution: genomes are processed in blocks. One device pass
+computes the [block, reps] containment numbers plus the [block, block]
+within-block numbers; the strictly-sequential assignment logic (a genome
+can become a rep mid-block) then runs on host over those precomputed
+values — the device sees large fixed-shape batches, never a per-genome
+launch. On TPU the comparisons run as rectangular int8 indicator matmuls
+over a per-cluster vocabulary-chunk geometry, with the representative set
+device-resident and append-only (ops/containment.py::VocabChunkGeometry);
+off-TPU they run as searchsorted gather tiles (gathers are fine there).
 """
 
 from __future__ import annotations
