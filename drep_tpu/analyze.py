@@ -52,8 +52,11 @@ def _cluster_thresholds(wd: WorkDirectory) -> tuple[float | None, float | None]:
 def _fancy_dendrogram(ax, link, names, threshold: float | None, xlabel: str, title: str):
     """Dendrogram with the clustering cutoff drawn in — the reference's
     fancy_dendrogram contract (drep/d_analyze.py upstream; mount empty):
-    the reader must see WHERE the tree was cut, not just the tree."""
-    sch.dendrogram(link, labels=names, orientation="left", ax=ax)
+    the reader must see WHERE the tree was cut, not just the tree.
+    `names=None` suppresses leaf labels (the large-N readable form)."""
+    sch.dendrogram(
+        link, labels=names, no_labels=names is None, orientation="left", ax=ax
+    )
     if threshold is not None:
         ax.axvline(threshold, color="tab:red", linestyle="--", linewidth=1)
         ax.annotate(
@@ -70,17 +73,36 @@ def _fancy_dendrogram(ax, link, names, threshold: float | None, xlabel: str, tit
     ax.set_title(title)
 
 
+# past this many leaves a labeled dendrogram is unreadable AND the figure
+# height (0.25 in/leaf) exceeds matplotlib's raster limits — draw the tree
+# shape at fixed height without labels instead
+DENDROGRAM_LABEL_MAX = 1_000
+# one PDF page per multi-genome cluster: at the 100k scale (~35k clusters)
+# an uncapped loop is hours of matplotlib and a multi-GB file — plot the
+# LARGEST clusters (the ones worth inspecting) and say what was skipped
+SECONDARY_PAGES_MAX = 300
+
+
 def plot_primary_dendrogram(wd: WorkDirectory) -> str | None:
     cf = _load_clustering(wd)
     if cf is None or cf.get("primary_linkage") is None or len(cf["primary_linkage"]) == 0:
         return None
     out = os.path.join(wd.get_loc("figures"), "Primary_clustering_dendrogram.pdf")
     threshold, _ = _cluster_thresholds(wd)
-    fig, ax = plt.subplots(figsize=(10, max(4, len(cf["primary_names"]) * 0.25)))
-    _fancy_dendrogram(
-        ax, cf["primary_linkage"], cf["primary_names"], threshold,
-        "Mash distance", "Primary clustering (MinHash)",
-    )
+    names = cf["primary_names"]
+    if len(names) > DENDROGRAM_LABEL_MAX:
+        fig, ax = plt.subplots(figsize=(10, 8))
+        _fancy_dendrogram(
+            ax, cf["primary_linkage"], None, threshold,
+            "Mash distance",
+            f"Primary clustering (MinHash, {len(names)} genomes — labels omitted)",
+        )
+    else:
+        fig, ax = plt.subplots(figsize=(10, max(4, len(names) * 0.25)))
+        _fancy_dendrogram(
+            ax, cf["primary_linkage"], names, threshold,
+            "Mash distance", "Primary clustering (MinHash)",
+        )
     fig.tight_layout()
     fig.savefig(out)
     plt.close(fig)
@@ -95,16 +117,39 @@ def plot_secondary_dendrograms(wd: WorkDirectory) -> str | None:
     from matplotlib.backends.backend_pdf import PdfPages
 
     _, threshold = _cluster_thresholds(wd)
+    entries = [
+        (pc, e) for pc, e in sorted(cf["secondary"].items())
+        if e["linkage"] is not None and len(e["linkage"])
+    ]
+    if len(entries) > SECONDARY_PAGES_MAX:
+        entries.sort(key=lambda t: -len(t[1]["names"]))
+        get_logger().warning(
+            "secondary dendrograms: plotting the %d largest of %d clusters "
+            "(one PDF page each — an uncapped loop at this scale is hours of "
+            "plotting); the full clustering is in Cdb/Ndb",
+            SECONDARY_PAGES_MAX, len(entries),
+        )
+        entries = sorted(entries[:SECONDARY_PAGES_MAX])
     with PdfPages(out) as pdf:
-        for pc, entry in sorted(cf["secondary"].items()):
+        for pc, entry in entries:
             link, names = entry["linkage"], entry["names"]
-            if link is None or len(link) == 0:
-                continue
-            fig, ax = plt.subplots(figsize=(8, max(3, len(names) * 0.3)))
-            _fancy_dendrogram(
-                ax, link, names, threshold,
-                "1 - ANI", f"Secondary clustering, primary cluster {pc}",
-            )
+            if len(names) > DENDROGRAM_LABEL_MAX:
+                # same large-N treatment as the primary plot: a labeled
+                # multi-thousand-leaf page is unreadable and its 0.3 in/leaf
+                # height blows matplotlib's raster limits
+                fig, ax = plt.subplots(figsize=(8, 6))
+                _fancy_dendrogram(
+                    ax, link, None, threshold,
+                    "1 - ANI",
+                    f"Secondary clustering, primary cluster {pc} "
+                    f"({len(names)} genomes — labels omitted)",
+                )
+            else:
+                fig, ax = plt.subplots(figsize=(8, max(3, len(names) * 0.3)))
+                _fancy_dendrogram(
+                    ax, link, names, threshold,
+                    "1 - ANI", f"Secondary clustering, primary cluster {pc}",
+                )
             fig.tight_layout()
             pdf.savefig(fig)
             plt.close(fig)

@@ -99,3 +99,25 @@ def test_streaming_run_plots_without_dense_linkage(tmp_path, genome_paths):
     written = set(os.listdir(figdir))
     assert "Secondary_clustering_dendrograms.pdf" in written
     assert "Clustering_scatterplots.pdf" in written
+
+
+def test_large_n_plot_caps(tmp_path, genome_paths, monkeypatch, caplog):
+    """At 100k scale an uncapped plot loop is hours of matplotlib: past the
+    caps, the primary dendrogram drops labels and the secondary PDF keeps
+    only the largest clusters (with a loud note)."""
+    import drep_tpu.analyze as an
+    from drep_tpu.workflows import compare_wrapper
+
+    monkeypatch.setattr(an, "DENDROGRAM_LABEL_MAX", 2)
+    monkeypatch.setattr(an, "SECONDARY_PAGES_MAX", 1)
+    compare_wrapper(str(tmp_path / "wd"), genome_paths)
+    figdir = tmp_path / "wd" / "figures"
+    import os
+
+    written = set(os.listdir(figdir))
+    assert "Primary_clustering_dendrogram.pdf" in written
+    assert "Secondary_clustering_dendrograms.pdf" in written
+    # the pipeline logger does not propagate (caplog-invisible): the
+    # truncation warning is asserted via the workdir log file instead
+    log = (tmp_path / "wd" / "log" / "logger.log").read_text()
+    assert "largest" in log
