@@ -15,6 +15,7 @@ the pack matmul, so processes, not threads). The optional C++ ingest
 
 from __future__ import annotations
 
+import os
 from concurrent.futures import ProcessPoolExecutor
 from dataclasses import dataclass
 
@@ -58,6 +59,65 @@ def sketch_args_snapshot(
     }
 
 
+# genomes per ingest checkpoint shard: a mid-ingest kill at the 100k scale
+# (hours of host sketching) must not restart from zero — finished genomes
+# flush to shard files as they accumulate and a rerun resumes from them
+INGEST_SHARD = 512
+
+_SHARD_SCALARS = ("length", "N50", "contigs", "n_kmers")
+
+
+def _pack_ragged(arrs: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
+    """Ragged uint64 arrays -> (flat concat, int64 offsets) — the ONE
+    serialization layout shared by the whole-run sketch cache and the
+    mid-run shard store (so the two can never drift)."""
+    flat = np.concatenate(arrs) if arrs else np.empty(0, np.uint64)
+    return flat, np.cumsum([0] + [len(a) for a in arrs]).astype(np.int64)
+
+
+def _unpack_ragged(flat: np.ndarray, offs: np.ndarray, n: int) -> list[np.ndarray]:
+    return [flat[offs[i] : offs[i + 1]] for i in range(n)]
+
+
+def _save_sketch_shard(path: str, batch: dict[str, dict]) -> None:
+    import io
+
+    from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
+    names = list(batch)
+    payload: dict[str, np.ndarray] = {
+        "names": np.array(names, dtype=object).astype(str)
+    }
+    for key in _SHARD_SCALARS:
+        payload[key] = np.array([batch[g][key] for g in names], dtype=np.int64)
+    for key in ("bottom", "scaled"):
+        payload[key], payload[f"{key}_offsets"] = _pack_ragged(
+            [batch[g][key] for g in names]
+        )
+    # serialize in memory and write through the atomic helper: its tmp
+    # suffix does NOT end in .npz, so a crash artifact can never be picked
+    # up by the resume glob as a (corrupt-looking) shard
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **payload)
+    atomic_write_bytes(path, buf.getvalue())
+
+
+def _load_sketch_shard(path: str) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    with np.load(path, allow_pickle=False) as z:
+        names = [str(x) for x in z["names"]]
+        scalars = {key: z[key] for key in _SHARD_SCALARS}
+        bottom = _unpack_ragged(z["bottom"], z["bottom_offsets"], len(names))
+        scaled = _unpack_ragged(z["scaled"], z["scaled_offsets"], len(names))
+        for i, g in enumerate(names):
+            out[g] = {
+                **{key: int(scalars[key][i]) for key in _SHARD_SCALARS},
+                "bottom": bottom[i].copy(),
+                "scaled": scaled[i].copy(),
+            }
+    return out
+
+
 def sketch_genomes(
     bdb: pd.DataFrame,
     k: int = kmers.DEFAULT_K,
@@ -67,7 +127,13 @@ def sketch_genomes(
     wd: WorkDirectory | None = None,
     hash_name: str = "splitmix64",
 ) -> GenomeSketches:
-    """Sketch every genome in Bdb; cache/restore via the work directory."""
+    """Sketch every genome in Bdb; cache/restore via the work directory
+    (whole-run cache, plus mid-run shard checkpoints every INGEST_SHARD
+    genomes so a killed ingest resumes where it stopped)."""
+    import glob
+    import shutil
+    import uuid
+
     logger = get_logger()
     args_snapshot = sketch_args_snapshot(bdb["genome"], k, sketch_size, scale, hash_name)
 
@@ -77,14 +143,52 @@ def sketch_genomes(
 
     jobs = [(row.genome, row.location, k, sketch_size, scale, hash_name) for row in bdb.itertuples()]
     results: dict[str, dict] = {}
-    if processes > 1 and len(jobs) > 1:
+    shard_dir = None
+    if wd is not None:
+        from drep_tpu.utils.ckptmeta import content_fingerprint, open_checkpoint_dir
+
+        shard_dir = wd.get_dir(os.path.join("data", "sketch_shards"))
+        meta = {
+            "kind": "sketch_shards",
+            "k": k, "sketch_size": sketch_size, "scale": scale, "hash": hash_name,
+            "genomes": content_fingerprint(args_snapshot["genomes"]),
+        }
+        if open_checkpoint_dir(shard_dir, meta, clear_suffixes=(".npz",)):
+            for f in sorted(glob.glob(os.path.join(shard_dir, "*.npz"))):
+                try:
+                    results.update(_load_sketch_shard(f))
+                except Exception:
+                    logger.warning("ingest: corrupt sketch shard %s — recomputing its genomes", f)
+                    os.remove(f)
+            if results:
+                logger.info(
+                    "ingest: resumed %d/%d sketched genomes from shards",
+                    len(results), len(jobs),
+                )
+
+    todo = [j for j in jobs if j[0] not in results]
+    pending: dict[str, dict] = {}
+
+    def flush(force: bool = False) -> None:
+        if shard_dir is not None and pending and (force or len(pending) >= INGEST_SHARD):
+            _save_sketch_shard(
+                os.path.join(shard_dir, f"shard_{uuid.uuid4().hex}.npz"), pending
+            )
+            pending.clear()
+
+    if processes > 1 and len(todo) > 1:
         with ProcessPoolExecutor(max_workers=processes) as pool:
-            for name, res in pool.map(_sketch_one, jobs):
+            for name, res in pool.map(_sketch_one, todo):
                 results[name] = res
+                pending[name] = res
+                flush()
     else:
-        for job in jobs:
+        for job in todo:
             name, res = _sketch_one(job)
             results[name] = res
+            pending[name] = res
+            flush()
+    flush(force=True)
 
     names = list(bdb["genome"])
     gdb = pd.DataFrame(
@@ -108,18 +212,22 @@ def sketch_genomes(
     if wd is not None:
         _save(wd, out)
         wd.store_arguments("sketch", args_snapshot)
+        # the assembled cache supersedes the shards — drop them rather
+        # than double the on-disk footprint (~16 GB at 100k genomes)
+        if shard_dir is not None:
+            shutil.rmtree(shard_dir, ignore_errors=True)
     return out
 
 
 def _save(wd: WorkDirectory, gs: GenomeSketches) -> None:
-    bcat = np.concatenate(gs.bottom) if gs.bottom else np.empty(0, np.uint64)
-    scat = np.concatenate(gs.scaled) if gs.scaled else np.empty(0, np.uint64)
+    bottom, bottom_offsets = _pack_ragged(gs.bottom)
+    scaled, scaled_offsets = _pack_ragged(gs.scaled)
     wd.store_arrays(
         "sketches",
-        bottom=bcat,
-        bottom_offsets=np.cumsum([0] + [len(s) for s in gs.bottom]).astype(np.int64),
-        scaled=scat,
-        scaled_offsets=np.cumsum([0] + [len(s) for s in gs.scaled]).astype(np.int64),
+        bottom=bottom,
+        bottom_offsets=bottom_offsets,
+        scaled=scaled,
+        scaled_offsets=scaled_offsets,
         names=np.array(gs.names, dtype=object).astype(str),
     )
     wd.store_db(gs.gdb, "Gdb")
@@ -128,9 +236,8 @@ def _save(wd: WorkDirectory, gs: GenomeSketches) -> None:
 def _load(wd: WorkDirectory, k: int, sketch_size: int, scale: int) -> GenomeSketches:
     arrs = wd.get_arrays("sketches")
     names = [str(x) for x in arrs["names"]]
-    bo, so = arrs["bottom_offsets"], arrs["scaled_offsets"]
-    bottom = [arrs["bottom"][bo[i] : bo[i + 1]] for i in range(len(names))]
-    scaled = [arrs["scaled"][so[i] : so[i + 1]] for i in range(len(names))]
+    bottom = _unpack_ragged(arrs["bottom"], arrs["bottom_offsets"], len(names))
+    scaled = _unpack_ragged(arrs["scaled"], arrs["scaled_offsets"], len(names))
     return GenomeSketches(
         names=names,
         gdb=wd.get_db("Gdb"),
@@ -144,8 +251,6 @@ def _load(wd: WorkDirectory, k: int, sketch_size: int, scale: int) -> GenomeSket
 
 def make_bdb(genome_paths: list[str]) -> pd.DataFrame:
     """Genome list -> Bdb (genome name = basename, reference convention)."""
-    import os
-
     names = [os.path.basename(p) for p in genome_paths]
     if len(set(names)) != len(names):
         raise ValueError("duplicate genome basenames in input list")

@@ -1,0 +1,79 @@
+"""Mid-ingest kill/resume via sketch shard checkpoints.
+
+A killed 100k-genome ingest (hours of host sketching) must resume from
+the genomes already sketched, not restart: finished genomes flush to
+shard files every INGEST_SHARD completions, and a rerun loads them and
+sketches only the remainder.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import drep_tpu.ingest as ingest_mod
+from drep_tpu.ingest import make_bdb, sketch_genomes
+from drep_tpu.workdir import WorkDirectory
+
+
+@pytest.fixture()
+def counting_sketch(monkeypatch):
+    """Wrap the worker with a call counter and an optional kill switch."""
+    calls = {"n": 0, "die_after": None}
+    real = ingest_mod._sketch_one
+
+    def wrapped(job):
+        if calls["die_after"] is not None and calls["n"] >= calls["die_after"]:
+            raise RuntimeError("simulated kill")
+        calls["n"] += 1
+        return real(job)
+
+    monkeypatch.setattr(ingest_mod, "_sketch_one", wrapped)
+    return calls
+
+
+def test_killed_ingest_resumes_from_shards(tmp_path, genome_paths, counting_sketch, monkeypatch):
+    monkeypatch.setattr(ingest_mod, "INGEST_SHARD", 2)  # flush every 2 genomes
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    bdb = make_bdb(genome_paths)  # 5 genomes
+
+    counting_sketch["die_after"] = 4
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        sketch_genomes(bdb, wd=wd)
+    assert counting_sketch["n"] == 4  # 4 sketched, 2 shards (2+2) flushed
+
+    counting_sketch["die_after"] = None
+    counting_sketch["n"] = 0
+    gs = sketch_genomes(bdb, wd=wd)
+    assert counting_sketch["n"] == 1  # only the 5th genome was recomputed
+    assert gs.names == list(bdb["genome"])
+
+    # results identical to a fresh, uninterrupted run
+    wd2 = WorkDirectory(str(tmp_path / "wd2"))
+    fresh = sketch_genomes(bdb, wd=wd2)
+    for a, b in zip(gs.bottom, fresh.bottom):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(gs.scaled, fresh.scaled):
+        np.testing.assert_array_equal(a, b)
+    pd.testing.assert_frame_equal(gs.gdb, fresh.gdb)
+
+    # the assembled cache supersedes the shards (disk footprint)
+    import glob
+    import os
+
+    assert not glob.glob(os.path.join(str(tmp_path / "wd"), "data", "sketch_shards", "*.npz"))
+
+
+def test_changed_args_invalidate_sketch_shards(tmp_path, genome_paths, counting_sketch, monkeypatch):
+    monkeypatch.setattr(ingest_mod, "INGEST_SHARD", 2)
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    bdb = make_bdb(genome_paths)
+
+    counting_sketch["die_after"] = 4
+    with pytest.raises(RuntimeError):
+        sketch_genomes(bdb, wd=wd)
+
+    # different sketching arguments: stale shards must NOT be resumed
+    counting_sketch["die_after"] = None
+    counting_sketch["n"] = 0
+    sketch_genomes(bdb, wd=wd, scale=100)
+    assert counting_sketch["n"] == len(bdb)
