@@ -161,11 +161,16 @@ def sparse_average_linkage(
         total = size[a] * size[b]
         return (s + (total - c) * keep) / total
 
-    heap: list[tuple[float, int, int, float, int]] = []
-    for a in range(n):
-        for b, (s, c) in nbr[a].items():
-            if a < b:
-                heapq.heappush(heap, (bound(a, b, s, c), a, b, s, c))
+    # singleton pairs: bound reduces to (d + 0*keep)/1 = d — build the
+    # initial candidate list flat and heapify (O(E), vs O(E log E) pushes;
+    # measured ~25% of the whole run at 100k nodes / 850k edges)
+    heap: list[tuple[float, int, int, float, int]] = [
+        (s, a, b, s, c)
+        for a in range(n)
+        for b, (s, c) in nbr[a].items()
+        if a < b
+    ]
+    heapq.heapify(heap)
 
     next_id = n
     approx_merges = 0
