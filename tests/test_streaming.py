@@ -224,3 +224,20 @@ def test_streaming_average_widens_zero_retention():
         packed, k=21, p_ani=0.9, block=16, keep_dist=0.25, cluster_alg="average"
     )
     assert _canon(l0) == _canon(l1)
+
+
+def test_streaming_plus_greedy_north_star_combo(tmp_path, genome_paths):
+    """The 100k north-star configuration — streaming primary + greedy
+    secondary — must compose and recover the fixture clustering."""
+    from drep_tpu.workflows import compare_wrapper
+
+    cdb = compare_wrapper(
+        str(tmp_path / "wd"), genome_paths,
+        streaming_primary=True, greedy_secondary_clustering=True,
+        skip_plots=True,
+    )
+    c = cdb.set_index("genome")["secondary_cluster"]
+    assert c["genome_A.fasta"] == c["genome_B.fasta"]
+    assert c["genome_A.fasta"] != c["genome_C.fasta"]
+    assert c["genome_D.fasta"] == c["genome_E.fasta"]
+    assert cdb["secondary_cluster"].nunique() == 3
