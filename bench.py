@@ -579,7 +579,7 @@ def bench_e2e(n: int) -> dict:
     }
 
 
-def _require_devices(timeout_s: float = 600.0) -> None:
+def _require_devices(timeout_s: float = 240.0) -> None:
     """Fail loudly (one JSON error line) when backend init hangs — the
     tunneled TPU client has been observed to block forever inside
     make_c_api_client when the tunnel wedges; a bench that hangs silently
