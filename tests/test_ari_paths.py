@@ -86,6 +86,12 @@ PATHS = {
     "greedy": {"greedy_secondary_clustering": True},
     "multiround": {"multiround_primary_clustering": True, "primary_chunksize": 64},
     "streaming": {"streaming_primary": True, "streaming_block": 64},
+    # the 100k north-star configuration: both scale paths composed
+    "streaming_greedy": {
+        "streaming_primary": True,
+        "streaming_block": 64,
+        "greedy_secondary_clustering": True,
+    },
 }
 
 
