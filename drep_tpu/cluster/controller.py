@@ -296,10 +296,9 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     warmup_thread = None
     if (
         kw["overlap_ingest"]
-        and kw["processes"] == 1  # ingest with processes>1 FORKS a pool;
-        # forking while this thread is inside XLA's multithreaded C++
-        # compiler can deadlock the children (classic fork-under-locks) —
-        # serial ingest is the only configuration where the overlap is safe
+        # ingest pool workers are SPAWNED (ingest.py::sketch_genomes), so
+        # running them while this thread sits inside XLA's multithreaded
+        # compiler is safe — spawn children inherit no locks
         and snapshot["primary_estimator_resolved"] == "streaming_sort"
     ):
         # overlap the streaming tile kernel's cold XLA compile (~20-40 s)

@@ -15,6 +15,7 @@ the pack matmul, so processes, not threads). The optional C++ ingest
 
 from __future__ import annotations
 
+import multiprocessing
 import os
 from concurrent.futures import ProcessPoolExecutor
 from dataclasses import dataclass
@@ -177,7 +178,14 @@ def sketch_genomes(
             pending.clear()
 
     if processes > 1 and len(todo) > 1:
-        with ProcessPoolExecutor(max_workers=processes) as pool:
+        # spawn, not fork: by the time ingest runs inside a pipeline the
+        # JAX backend is usually initialized and multithreaded, and a
+        # forked child can deadlock on locks held at fork time (CPython
+        # itself warns on fork-after-threads). The worker module chain is
+        # deliberately jax-free and lean (sketch_worker.py), so spawn
+        # startup stays ~0.7 s/worker.
+        ctx = multiprocessing.get_context("spawn")
+        with ProcessPoolExecutor(max_workers=processes, mp_context=ctx) as pool:
             for name, res in pool.map(_sketch_one, todo):
                 results[name] = res
                 pending[name] = res

@@ -77,3 +77,18 @@ def test_changed_args_invalidate_sketch_shards(tmp_path, genome_paths, counting_
     counting_sketch["n"] = 0
     sketch_genomes(bdb, wd=wd, scale=100)
     assert counting_sketch["n"] == len(bdb)
+
+
+def test_pooled_ingest_matches_serial(genome_paths):
+    """The process-pool path (spawn context — fork after JAX backend init
+    can deadlock on inherited locks) returns results identical to the
+    serial path."""
+    bdb = make_bdb(genome_paths)
+    serial = sketch_genomes(bdb)
+    pooled = sketch_genomes(bdb, processes=2)
+    assert pooled.names == serial.names
+    for a, b in zip(pooled.bottom, serial.bottom):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(pooled.scaled, serial.scaled):
+        np.testing.assert_array_equal(a, b)
+    pd.testing.assert_frame_equal(pooled.gdb, serial.gdb)
