@@ -611,7 +611,29 @@ def _require_devices(timeout_s: float = 240.0) -> None:
         os._exit(2)
 
 
+def _emit(stages: dict) -> None:
+    """The one JSON line the driver records. Callable from the watchdog,
+    so a mid-run tunnel wedge still reports every stage measured so far."""
+    head = stages.get("primary", {})
+    print(
+        json.dumps(
+            {
+                "metric": "genome-pairs/sec/chip",
+                "value": head.get("pairs_per_sec_per_chip"),
+                "unit": "pairs/s",
+                "vs_baseline": head.get("vs_baseline"),
+                "stages": stages,
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
+    import os
+    import sys
+    import threading
+
     from drep_tpu.utils.xla_cache import enable_persistent_cache
 
     enable_persistent_cache()
@@ -631,54 +653,87 @@ def main() -> None:
         else {"primary", "secondary", "production", "ingest", "greedy", "e2e", "scale"}
     )
 
+    # (label, budget_seconds, thunk). Budgets are ~4x the longest wall
+    # ever measured for the stage on the tunneled chip, because the
+    # tunnel has been observed to wedge MID-RUN (not just at init): a
+    # device call simply never returns, CPU goes idle, and without a
+    # deadline the whole measurement window produces zero output.
     stages: dict = {}
+    plan: list[tuple[str, float, object]] = []
     if "primary" in want:
-        stages["primary"] = bench_primary()
+        plan.append(("primary", 600, lambda: stages.__setitem__("primary", bench_primary())))
     if "secondary" in want:
-        try:
+
+        def _secondary():
             packed = _secondary_pack()
             stages["secondary_matmul"] = bench_secondary_matmul(packed)
             stages["secondary_pallas"] = bench_secondary_pallas(packed)
-        except Exception as e:  # a broken stage must not kill the headline
-            stages["secondary_error"] = repr(e)
-    if "production" in want:
-        try:
-            stages["secondary_production"] = bench_secondary_production()
-        except Exception as e:
-            stages["production_error"] = repr(e)
-    if "ingest" in want:
-        try:
-            stages["ingest"] = bench_ingest()
-        except Exception as e:
-            stages["ingest_error"] = repr(e)
-    if "greedy" in want:
-        try:
-            stages["greedy_secondary"] = bench_greedy()
-        except Exception as e:
-            stages["greedy_error"] = repr(e)
-    if "e2e" in want:
-        try:
-            stages[f"e2e_{args.e2e_n // 1000}k"] = bench_e2e(args.e2e_n)
-        except Exception as e:
-            stages["e2e_error"] = repr(e)
-    if "scale" in want:
-        try:
-            stages[f"e2e_{args.scale_n // 1000}k"] = bench_e2e(args.scale_n)
-        except Exception as e:
-            stages["scale_error"] = repr(e)
 
-    head = stages.get("primary", {})
-    print(
-        json.dumps(
-            {
-                "metric": "genome-pairs/sec/chip",
-                "value": head.get("pairs_per_sec_per_chip"),
-                "unit": "pairs/s",
-                "vs_baseline": head.get("vs_baseline"),
-                "stages": stages,
-            }
+        plan.append(("secondary", 600, _secondary))
+    if "production" in want:
+        plan.append(
+            ("production", 1500, lambda: stages.__setitem__(
+                "secondary_production", bench_secondary_production()))
         )
-    )
+    if "ingest" in want:
+        plan.append(("ingest", 1200, lambda: stages.__setitem__("ingest", bench_ingest())))
+    if "greedy" in want:
+        plan.append(
+            ("greedy", 1200, lambda: stages.__setitem__("greedy_secondary", bench_greedy()))
+        )
+    if "e2e" in want:
+        plan.append(
+            ("e2e", 1200, lambda: stages.__setitem__(
+                f"e2e_{args.e2e_n // 1000}k", bench_e2e(args.e2e_n)))
+        )
+    if "scale" in want:
+        plan.append(
+            ("scale", 3000, lambda: stages.__setitem__(
+                f"e2e_{args.scale_n // 1000}k", bench_e2e(args.scale_n)))
+        )
+
+    for label, budget, thunk in plan:
+        t0 = time.perf_counter()
+        done = threading.Event()
+
+        def run(thunk=thunk, label=label):
+            try:
+                thunk()
+            except Exception as e:  # a broken stage must not kill the rest
+                stages[f"{label}_error"] = repr(e)
+            finally:
+                done.set()
+
+        worker = threading.Thread(target=run, daemon=True)
+        worker.start()
+        if not done.wait(budget):
+            # a wedged device call cannot be cancelled from Python; any
+            # later stage would block on the same dead tunnel. Emit what
+            # exists and exit nonzero so the run is visibly partial.
+            # snapshot: the wedged worker thread may still be mutating
+            # `stages` (e.g. between the two secondary sub-benches), and
+            # json.dumps over a resizing dict raises — which would skip
+            # the very output line this path exists to guarantee
+            snap = dict(stages)
+            snap[f"{label}_error"] = (
+                f"stage exceeded its {budget:.0f}s watchdog budget "
+                "(wedged TPU tunnel mid-run?) — remaining stages skipped"
+            )
+            print(f"bench: {label} WEDGED after {budget:.0f}s, bailing", file=sys.stderr, flush=True)
+            _emit(snap)
+            os._exit(3)
+        print(
+            f"bench: {label} done in {time.perf_counter() - t0:.1f}s",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    _emit(stages)
+    if "primary" in want and "primary" not in stages:
+        # headline failed by exception: the JSON line above still carries
+        # every other stage, but the run must read as broken (matching
+        # the pre-watchdog behavior where bench_primary ran bare)
+        sys.exit(1)
 
 
 if __name__ == "__main__":
