@@ -700,7 +700,10 @@ def main() -> None:
             try:
                 thunk()
             except Exception as e:  # a broken stage must not kill the rest
+                import traceback
+
                 stages[f"{label}_error"] = repr(e)
+                traceback.print_exc()  # the JSON repr alone is undebuggable
             finally:
                 done.set()
 

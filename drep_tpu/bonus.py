@@ -24,6 +24,7 @@ import pandas as pd
 from drep_tpu.cluster.external import require_binary, run_subprocess
 from drep_tpu.utils.logger import get_logger
 from drep_tpu.workdir import WorkDirectory
+from drep_tpu.errors import UserInputError
 
 # centrifuge report headers vary little, but parse by name anyway (the
 # strategy every external parser here uses — column ORDER is never trusted)
@@ -94,7 +95,7 @@ def validate_bonus_args(kwargs: dict) -> None:
         return
     require_binary("centrifuge", hint="drop --run_tax")
     if not kwargs.get("cent_index"):
-        raise ValueError("--run_tax needs --cent_index (a centrifuge index prefix)")
+        raise UserInputError("--run_tax needs --cent_index (a centrifuge index prefix)")
 
 
 def _centrifuge_one(args) -> tuple[str, str, int, float]:
@@ -129,7 +130,7 @@ def d_bonus_wrapper(
     """Run centrifuge over every genome in Bdb; store and return Tdb."""
     require_binary("centrifuge", hint="drop --run_tax")
     if not cent_index:
-        raise ValueError("--run_tax needs --cent_index (a centrifuge index prefix)")
+        raise UserInputError("--run_tax needs --cent_index (a centrifuge index prefix)")
     out_dir = wd.get_dir(os.path.join("data", "centrifuge"))
     # parallelism budget: EITHER many 1-thread processes OR one
     # `processes`-thread process — `processes` concurrent jobs each with

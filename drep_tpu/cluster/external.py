@@ -18,6 +18,7 @@ import numpy as np
 import pandas as pd
 
 from drep_tpu.cluster.dispatch import register_primary, register_secondary
+from drep_tpu.errors import UserInputError
 from drep_tpu.ingest import GenomeSketches
 from drep_tpu.utils.logger import get_logger
 
@@ -26,7 +27,7 @@ def require_binary(binary: str, hint: str = "jax_mash/jax_ani") -> str:
     """Resolve an external binary or fail with the TPU-native alternative."""
     path = shutil.which(binary)
     if path is None:
-        raise RuntimeError(
+        raise UserInputError(
             f"external binary {binary!r} not found on $PATH — use the TPU-native "
             f"engine ({hint}) or install {binary}"
         )

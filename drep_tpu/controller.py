@@ -78,7 +78,20 @@ def _honor_jax_platforms_env() -> None:
 
 def main(argv: list[str] | None = None) -> None:
     _honor_jax_platforms_env()
-    Controller().parseArguments(parse_args(argv))
+    from drep_tpu.errors import UserInputError
+
+    try:
+        Controller().parseArguments(parse_args(argv))
+    except UserInputError as e:
+        # user-input errors (bad paths, non-FASTA input, conflicting
+        # flags) end as one `!!!` line, not a traceback — the reference's
+        # user-facing-warning convention (SURVEY.md §5.5). Only the
+        # dedicated type is caught: an internal ValueError deep in
+        # clustering must keep its traceback.
+        import sys
+
+        get_logger().error("!!! %s", e)
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,7 @@ import pandas as pd
 from drep_tpu.utils.fasta import fasta_stats
 from drep_tpu.utils.logger import get_logger, user_warning
 from drep_tpu.workdir import WorkDirectory
+from drep_tpu.errors import UserInputError
 
 FILTER_DEFAULTS: dict[str, Any] = {
     "length": 50_000,
@@ -60,9 +61,9 @@ def run_checkm_wrapper(
     --genomeInfo.
     """
     if shutil.which("checkm") is None:
-        raise RuntimeError("checkm not found on $PATH — supply --genomeInfo instead")
+        raise UserInputError("checkm not found on $PATH — supply --genomeInfo instead")
     if checkm_method not in ("lineage_wf", "taxonomy_wf"):
-        raise ValueError(f"unknown checkM_method {checkm_method!r}")
+        raise UserInputError(f"unknown checkM_method {checkm_method!r}")
     genome_dir = os.path.join(out_dir, "checkm_genomes")
     os.makedirs(genome_dir, exist_ok=True)
     # checkm selects bins by extension (-x) and reports Bin Id without the
@@ -133,7 +134,7 @@ def d_filter_wrapper(
         quality = load_genome_info(genomeInfo)
         missing = [c for c in ("genome", "completeness", "contamination") if c not in quality.columns]
         if missing:
-            raise ValueError(f"genomeInfo missing columns {missing}")
+            raise UserInputError(f"genomeInfo missing columns {missing}")
     elif not kw["ignoreGenomeQuality"]:
         if shutil.which("checkm") is not None:
             quality = run_checkm_wrapper(
@@ -152,7 +153,7 @@ def d_filter_wrapper(
         q = quality.set_index("genome")
         in_q = stats["genome"].isin(q.index)
         if (~in_q).any():
-            raise ValueError(f"genomes missing from genomeInfo: {list(stats.loc[~in_q, 'genome'])}")
+            raise UserInputError(f"genomes missing from genomeInfo: {list(stats.loc[~in_q, 'genome'])}")
         comp = stats["genome"].map(q["completeness"])
         cont = stats["genome"].map(q["contamination"])
         qkeep = (comp >= kw["completeness"]) & (cont <= kw["contamination"])

@@ -23,6 +23,7 @@ from dataclasses import dataclass
 import numpy as np
 import pandas as pd
 
+from drep_tpu.errors import UserInputError
 from drep_tpu.ops import kmers
 from drep_tpu.sketch_worker import sketch_one as _sketch_one
 from drep_tpu.utils.fasta import fasta_stats
@@ -139,8 +140,17 @@ def sketch_genomes(
     args_snapshot = sketch_args_snapshot(bdb["genome"], k, sketch_size, scale, hash_name)
 
     if wd is not None and wd.has_arrays("sketches") and wd.arguments_match("sketch", args_snapshot):
-        logger.info("loading cached sketches from workdir")
-        return _load(wd, k, sketch_size, scale)
+        cached = _load(wd, k, sketch_size, scale)
+        if not (cached.gdb["n_kmers"] == 0).any():
+            logger.info("loading cached sketches from workdir")
+            return cached
+        # a cache written before zero-kmer validation existed can carry an
+        # unparseable genome; the args snapshot keys on NAMES, so a fixed
+        # file would never be re-read — drop the cache and re-sketch
+        logger.warning(
+            "ingest: cached sketches contain zero-kmer genomes (stale cache "
+            "from an unvalidated run?) — recomputing"
+        )
 
     jobs = [(row.genome, row.location, k, sketch_size, scale, hash_name) for row in bdb.itertuples()]
     results: dict[str, dict] = {}
@@ -157,10 +167,17 @@ def sketch_genomes(
         if open_checkpoint_dir(shard_dir, meta, clear_suffixes=(".npz",)):
             for f in sorted(glob.glob(os.path.join(shard_dir, "*.npz"))):
                 try:
-                    results.update(_load_sketch_shard(f))
+                    shard = _load_sketch_shard(f)
                 except Exception:
                     logger.warning("ingest: corrupt sketch shard %s — recomputing its genomes", f)
                     os.remove(f)
+                    continue
+                # drop zero-kmer entries written before validation existed:
+                # resuming one by name would re-raise the input error even
+                # after the user fixed the file (shard meta keys on names)
+                results.update(
+                    {g: r for g, r in shard.items() if r["n_kmers"] > 0}
+                )
             if results:
                 logger.info(
                     "ingest: resumed %d/%d sketched genomes from shards",
@@ -177,6 +194,15 @@ def sketch_genomes(
             )
             pending.clear()
 
+    def collect(name: str, res: dict) -> None:
+        results[name] = res
+        # never checkpoint an unparseable result: a persisted zero-kmer
+        # shard would be resumed by name on the next run and keep raising
+        # the validation error even after the user fixes the file
+        if res["n_kmers"] > 0:
+            pending[name] = res
+            flush()
+
     if processes > 1 and len(todo) > 1:
         # spawn, not fork: by the time ingest runs inside a pipeline the
         # JAX backend is usually initialized and multithreaded, and a
@@ -187,18 +213,20 @@ def sketch_genomes(
         ctx = multiprocessing.get_context("spawn")
         with ProcessPoolExecutor(max_workers=processes, mp_context=ctx) as pool:
             for name, res in pool.map(_sketch_one, todo):
-                results[name] = res
-                pending[name] = res
-                flush()
+                collect(name, res)
     else:
         for job in todo:
-            name, res = _sketch_one(job)
-            results[name] = res
-            pending[name] = res
-            flush()
+            collect(*_sketch_one(job))
     flush(force=True)
 
     names = list(bdb["genome"])
+    unparsed = [g for g in names if results[g]["n_kmers"] == 0]
+    if unparsed:
+        shown = ", ".join(unparsed[:10]) + (" ..." if len(unparsed) > 10 else "")
+        raise UserInputError(
+            f"no FASTA records with valid nucleotide {k}-mers in {len(unparsed)} "
+            f"input file(s) (not FASTA, empty, or shorter than k): {shown}"
+        )
     gdb = pd.DataFrame(
         {
             "genome": names,
@@ -258,10 +286,20 @@ def _load(wd: WorkDirectory, k: int, sketch_size: int, scale: int) -> GenomeSket
 
 
 def make_bdb(genome_paths: list[str]) -> pd.DataFrame:
-    """Genome list -> Bdb (genome name = basename, reference convention)."""
+    """Genome list -> Bdb (genome name = basename, reference convention).
+
+    Fails fast on unreadable paths: a missing file must surface as one
+    clean error naming it, before hours of sketching — not as a raw
+    traceback from whichever worker hits it first."""
     names = [os.path.basename(p) for p in genome_paths]
     if len(set(names)) != len(names):
-        raise ValueError("duplicate genome basenames in input list")
+        raise UserInputError("duplicate genome basenames in input list")
+    missing = [p for p in genome_paths if not os.path.isfile(p)]
+    if missing:
+        shown = ", ".join(missing[:10]) + (" ..." if len(missing) > 10 else "")
+        raise UserInputError(
+            f"{len(missing)} genome file(s) do not exist or are not files: {shown}"
+        )
     return pd.DataFrame({"genome": names, "location": [os.path.abspath(p) for p in genome_paths]})
 
 

@@ -16,6 +16,7 @@ from drep_tpu.filter import d_filter_wrapper
 from drep_tpu.ingest import make_bdb
 from drep_tpu.utils.logger import get_logger, setup_logger
 from drep_tpu.workdir import WorkDirectory
+from drep_tpu.errors import UserInputError
 
 
 def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]:
@@ -39,7 +40,7 @@ def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]
     elif wd.hasDb("Bdb"):
         bdb = wd.get_db("Bdb")  # resume from an existing workdir
     else:
-        raise ValueError("no genomes given and workdir has no stored Bdb")
+        raise UserInputError("no genomes given and workdir has no stored Bdb")
     return wd, bdb
 
 

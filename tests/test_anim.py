@@ -9,6 +9,8 @@ files, like the reference's process_deltafiles contract.
 import numpy as np
 import pytest
 
+from drep_tpu.errors import UserInputError
+
 from drep_tpu.cluster.anim import (
     DeltaAlignment,
     ani_cov_from_alignments,
@@ -133,7 +135,7 @@ def test_missing_binary_raises_informative(sketches, bdb, monkeypatch):
 
     monkeypatch.setattr(ext.shutil, "which", lambda _: None)
     engine = get_secondary("ANImf")
-    with pytest.raises(RuntimeError, match="nucmer"):
+    with pytest.raises(UserInputError, match="nucmer"):
         engine(sketches, [0, 1], bdb=bdb)
 
 
@@ -142,7 +144,7 @@ def test_goani_missing_binary_raises_informative(sketches, bdb, monkeypatch):
     import drep_tpu.cluster.external as ext
 
     monkeypatch.setattr(ext.shutil, "which", lambda _: None)
-    with pytest.raises(RuntimeError, match="nsimscan"):
+    with pytest.raises(UserInputError, match="nsimscan"):
         get_secondary("goANI")(sketches, [0, 1], bdb=bdb)
 
 

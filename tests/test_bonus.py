@@ -8,6 +8,8 @@ machines without the binary (this image has none).
 import pandas as pd
 import pytest
 
+from drep_tpu.errors import UserInputError
+
 from drep_tpu.bonus import genome_taxonomy, parse_centrifuge_report
 
 REPORT = (
@@ -57,7 +59,7 @@ def test_bonus_requires_binary_and_index(tmp_path, bdb, monkeypatch):
 
     wd = WorkDirectory(str(tmp_path / "wd"))
     monkeypatch.setattr(ext.shutil, "which", lambda _: None)
-    with pytest.raises(RuntimeError, match="centrifuge"):
+    with pytest.raises(UserInputError, match="centrifuge"):
         d_bonus_wrapper(wd, bdb, cent_index="idx")
     monkeypatch.setattr(ext.shutil, "which", lambda _: "/usr/bin/true")
     with pytest.raises(ValueError, match="cent_index"):

@@ -92,3 +92,40 @@ def test_pooled_ingest_matches_serial(genome_paths):
     for a, b in zip(pooled.scaled, serial.scaled):
         np.testing.assert_array_equal(a, b)
     pd.testing.assert_frame_equal(pooled.gdb, serial.gdb)
+
+
+def test_missing_genome_file_fails_fast():
+    """A bad path must die as one clean error before any sketching."""
+    with pytest.raises(ValueError, match="do not exist"):
+        make_bdb(["/nonexistent/g1.fasta", "/nonexistent/g2.fasta"])
+
+
+def test_non_fasta_input_is_an_error(tmp_path):
+    """A file with no FASTA records must not become a silent zero-length
+    genome that clusters happily (observed: 'not a fasta' text produced a
+    1-genome Cdb)."""
+    p = tmp_path / "bad.txt"
+    p.write_text("not a fasta\n")
+    with pytest.raises(ValueError, match="no FASTA records with valid nucleotide"):
+        sketch_genomes(make_bdb([str(p)]))
+
+
+def test_cli_reports_clean_error_for_bad_input(tmp_path):
+    """CLI: user-input errors end as one `!!!` line + exit 1, no traceback."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    p = tmp_path / "bad.txt"
+    p.write_text("junk\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    repo_root = Path(__file__).resolve().parents[1]
+    r = subprocess.run(
+        [sys.executable, "-m", "drep_tpu", "compare", str(tmp_path / "wd"), "-g", str(p)],
+        capture_output=True, text=True, env=env, cwd=str(repo_root),
+    )
+    assert r.returncode == 1
+    combined = r.stdout + r.stderr
+    assert "!!!" in combined
+    assert "Traceback" not in combined
