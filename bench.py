@@ -658,6 +658,14 @@ def main() -> None:
     # tunnel has been observed to wedge MID-RUN (not just at init): a
     # device call simply never returns, CPU goes idle, and without a
     # deadline the whole measurement window produces zero output.
+    #
+    # Stage ORDER is by measurement value, not pipeline order: one
+    # observed wedge struck during the production stage's first big
+    # compile (v_pad 2^19 indicator matmul — the widest new shape of the
+    # run), killing every stage queued behind it. The headline and the
+    # end-to-end numbers therefore run before the compile-heavy
+    # production/greedy shapes, and ingest (host-only, no device calls)
+    # slots in between.
     stages: dict = {}
     plan: list[tuple[str, float, object]] = []
     if "primary" in want:
@@ -670,17 +678,6 @@ def main() -> None:
             stages["secondary_pallas"] = bench_secondary_pallas(packed)
 
         plan.append(("secondary", 600, _secondary))
-    if "production" in want:
-        plan.append(
-            ("production", 1500, lambda: stages.__setitem__(
-                "secondary_production", bench_secondary_production()))
-        )
-    if "ingest" in want:
-        plan.append(("ingest", 1200, lambda: stages.__setitem__("ingest", bench_ingest())))
-    if "greedy" in want:
-        plan.append(
-            ("greedy", 1200, lambda: stages.__setitem__("greedy_secondary", bench_greedy()))
-        )
     if "e2e" in want:
         plan.append(
             ("e2e", 1200, lambda: stages.__setitem__(
@@ -690,6 +687,17 @@ def main() -> None:
         plan.append(
             ("scale", 3000, lambda: stages.__setitem__(
                 f"e2e_{args.scale_n // 1000}k", bench_e2e(args.scale_n)))
+        )
+    if "ingest" in want:
+        plan.append(("ingest", 1200, lambda: stages.__setitem__("ingest", bench_ingest())))
+    if "greedy" in want:
+        plan.append(
+            ("greedy", 1200, lambda: stages.__setitem__("greedy_secondary", bench_greedy()))
+        )
+    if "production" in want:
+        plan.append(
+            ("production", 1500, lambda: stages.__setitem__(
+                "secondary_production", bench_secondary_production()))
         )
 
     for label, budget, thunk in plan:

@@ -62,7 +62,7 @@ def test_bonus_requires_binary_and_index(tmp_path, bdb, monkeypatch):
     with pytest.raises(UserInputError, match="centrifuge"):
         d_bonus_wrapper(wd, bdb, cent_index="idx")
     monkeypatch.setattr(ext.shutil, "which", lambda _: "/usr/bin/true")
-    with pytest.raises(ValueError, match="cent_index"):
+    with pytest.raises(UserInputError, match="cent_index"):
         d_bonus_wrapper(wd, bdb, cent_index=None)
 
 

@@ -10,6 +10,7 @@ import numpy as np
 import pandas as pd
 import pytest
 
+from drep_tpu.errors import UserInputError
 import drep_tpu.ingest as ingest_mod
 from drep_tpu.ingest import make_bdb, sketch_genomes
 from drep_tpu.workdir import WorkDirectory
@@ -96,7 +97,7 @@ def test_pooled_ingest_matches_serial(genome_paths):
 
 def test_missing_genome_file_fails_fast():
     """A bad path must die as one clean error before any sketching."""
-    with pytest.raises(ValueError, match="do not exist"):
+    with pytest.raises(UserInputError, match="do not exist"):
         make_bdb(["/nonexistent/g1.fasta", "/nonexistent/g2.fasta"])
 
 
@@ -106,7 +107,7 @@ def test_non_fasta_input_is_an_error(tmp_path):
     1-genome Cdb)."""
     p = tmp_path / "bad.txt"
     p.write_text("not a fasta\n")
-    with pytest.raises(ValueError, match="no FASTA records with valid nucleotide"):
+    with pytest.raises(UserInputError, match="no FASTA records with valid nucleotide"):
         sketch_genomes(make_bdb([str(p)]))
 
 
