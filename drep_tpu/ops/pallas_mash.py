@@ -40,55 +40,103 @@ from drep_tpu.ops.pallas_merge import PALLAS_MAX_WIDTH, _merge_bitonic, _use_int
 TILE = 128  # both tile dims: the pair tile's last dim must be lane-width
 
 
+def rows_per_iter(s2: int) -> int:
+    """A-rows merged per kernel loop iteration (1, 2, or 4). >1 batches R
+    broadcast-merge blocks into one [R, TB, 2*S2] VPU pass, amortizing the
+    per-iteration fixed work (concat, loop bookkeeping) over R rows at R x
+    the VMEM working set. Default 1 until a measurement on real hardware
+    shows a win (the merge/prefix stages dominate and scale with elements,
+    so the expected gain is the fixed-cost fraction only).
+
+    Clamped so R * 2*S2 never exceeds 2 * (2*PALLAS_MAX_WIDTH) merged
+    lanes per sublane block — the request that compiles at R=1/max width
+    must not fail Mosaic allocation when the knob multiplies it."""
+    import os
+
+    r = int(os.environ.get("DREP_TPU_MASH_ROWS_PER_ITER", "1"))
+    if r not in (1, 2, 4):
+        raise ValueError("DREP_TPU_MASH_ROWS_PER_ITER must be 1, 2, or 4")
+    return min(r, max(1, (2 * PALLAS_MAX_WIDTH) // max(s2, 1)))
+
+
 def _prefix_sum_lanes(x: jnp.ndarray, length: int) -> jnp.ndarray:
     """Inclusive prefix sum along lanes via Hillis-Steele roll+mask stages
     (log2(length) passes, all VPU work on the VMEM-resident block)."""
-    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    axis = x.ndim - 1
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
     d = 1
     while d < length:
-        shifted = pltpu.roll(x, d, 1)
+        shifted = pltpu.roll(x, d, axis)
         x = jnp.where(col >= d, x + shifted, x)
         d *= 2
     return x
 
 
-def _mash_shared_kernel(s_orig: int, a_rev_ref, na_ref, b_ref, nb_ref, out_ref):
+def _mash_shared_kernel(s_orig: int, r_iter: int, a_rev_ref, na_ref, b_ref, nb_ref, out_ref):
     """a_rev_ref [TA, S2] DESCENDING rows; b_ref [TB, S2] ascending rows;
     na_ref [TA, 1] / nb_ref [TB, 1] valid-entry counts; out_ref [TA, TB]
-    int32 `shared` counts under the union-bottom-s rule."""
+    int32 `shared` counts under the union-bottom-s rule. Processes
+    `r_iter` A rows per loop iteration (see rows_per_iter)."""
     ta = a_rev_ref.shape[0]
     tb, s2 = b_ref.shape
     length = 2 * s2
     b_block = b_ref[:]
     nb_col = nb_ref[:]  # [TB, 1]
-    col = jax.lax.broadcasted_iota(jnp.int32, (tb, length), 1)
 
-    def body(i, _):
-        a_row = a_rev_ref[i, :]
+    if r_iter == 1:
+        col = jax.lax.broadcasted_iota(jnp.int32, (tb, length), 1)
+
+        def body(i, _):
+            a_row = a_rev_ref[i, :]
+            x = jnp.concatenate(
+                [b_block, jnp.broadcast_to(a_row[None, :], (tb, s2))], axis=1
+            )
+            x = _merge_bitonic(x, length)
+            is_real = x != PAD_ID
+            prev = pltpu.roll(x, 1, 1)
+            dup = (x == prev) & is_real & (col > 0)
+            start = is_real & ~dup
+            rank = _prefix_sum_lanes(start.astype(jnp.int32), length)
+            s_use = jnp.minimum(jnp.minimum(na_ref[i, 0], nb_col), s_orig)  # [TB, 1]
+            counted = dup & (rank <= s_use)
+            out_ref[i, :] = jnp.sum(counted.astype(jnp.int32), axis=1)
+            return 0
+
+        jax.lax.fori_loop(0, ta, body, 0)
+        return
+
+    col3 = jax.lax.broadcasted_iota(jnp.int32, (r_iter, tb, length), 2)
+    b3 = jnp.broadcast_to(b_block[None], (r_iter, tb, s2))
+
+    def body_r(i, _):
+        a_rows = a_rev_ref[pl.ds(i * r_iter, r_iter), :]  # [R, S2]
         x = jnp.concatenate(
-            [b_block, jnp.broadcast_to(a_row[None, :], (tb, s2))], axis=1
+            [b3, jnp.broadcast_to(a_rows[:, None, :], (r_iter, tb, s2))], axis=2
         )
         x = _merge_bitonic(x, length)
         is_real = x != PAD_ID
-        prev = pltpu.roll(x, 1, 1)
-        dup = (x == prev) & is_real & (col > 0)
+        prev = pltpu.roll(x, 1, 2)
+        dup = (x == prev) & is_real & (col3 > 0)
         start = is_real & ~dup
         rank = _prefix_sum_lanes(start.astype(jnp.int32), length)
-        s_use = jnp.minimum(jnp.minimum(na_ref[i, 0], nb_col), s_orig)  # [TB, 1]
+        na_rows = na_ref[pl.ds(i * r_iter, r_iter), :]  # [R, 1]
+        s_use = jnp.minimum(
+            jnp.minimum(na_rows[:, :, None], nb_col[None]), s_orig
+        )  # [R, TB, 1]
         counted = dup & (rank <= s_use)
-        out_ref[i, :] = jnp.sum(counted.astype(jnp.int32), axis=1)
+        out_ref[pl.ds(i * r_iter, r_iter), :] = jnp.sum(counted.astype(jnp.int32), axis=2)
         return 0
 
-    jax.lax.fori_loop(0, ta, body, 0)
+    jax.lax.fori_loop(0, ta // r_iter, body_r, 0)
 
 
-@functools.partial(jax.jit, static_argnames=("s_orig", "interpret"))
-def _mash_shared_grid(a_rev, na, b, nb, *, s_orig: int, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("s_orig", "r_iter", "interpret"))
+def _mash_shared_grid(a_rev, na, b, nb, *, s_orig: int, r_iter: int, interpret: bool):
     ta_n, s2 = a_rev.shape
     tb_n = b.shape[0]
     grid = (ta_n // TILE, tb_n // TILE)
     return pl.pallas_call(
-        functools.partial(_mash_shared_kernel, s_orig),
+        functools.partial(_mash_shared_kernel, s_orig, r_iter),
         grid=grid,
         in_specs=[
             pl.BlockSpec((TILE, s2), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
@@ -104,8 +152,8 @@ def _mash_shared_grid(a_rev, na, b, nb, *, s_orig: int, interpret: bool):
     )(a_rev, na, b, nb)
 
 
-@functools.partial(jax.jit, static_argnames=("s_orig", "interpret"))
-def _mash_shared_grid_symmetric(a_rev, na, b, nb, *, s_orig: int, interpret: bool):
+@functools.partial(jax.jit, static_argnames=("s_orig", "r_iter", "interpret"))
+def _mash_shared_grid_symmetric(a_rev, na, b, nb, *, s_orig: int, r_iter: int, interpret: bool):
     """Self-comparison: shared counts are symmetric in (A, B), so the
     (T, T//2+1) wrapped grid — cell (i, jj) computes tile (i, (i+jj)%T) —
     covers every unordered tile pair at ~2x less kernel work (the same
@@ -117,7 +165,7 @@ def _mash_shared_grid_symmetric(a_rev, na, b, nb, *, s_orig: int, interpret: boo
     th = t // 2 + 1
     grid = (t, th)
     return pl.pallas_call(
-        functools.partial(_mash_shared_kernel, s_orig),
+        functools.partial(_mash_shared_kernel, s_orig, r_iter),
         grid=grid,
         in_specs=[
             pl.BlockSpec((TILE, s2), lambda i, jj: (i, 0), memory_space=pltpu.VMEM),
@@ -158,7 +206,7 @@ def all_vs_all_mash_pallas(packed, k: int = 21) -> tuple[np.ndarray, np.ndarray]
     compact = np.asarray(
         _mash_shared_grid_symmetric(
             np.ascontiguousarray(a[:, ::-1]), cc, a, cc,
-            s_orig=width, interpret=_use_interpret(),
+            s_orig=width, r_iter=rows_per_iter(s2), interpret=_use_interpret(),
         )
     )
     shared = _unwrap_symmetric(compact, TILE)[:n, :n]
@@ -230,7 +278,7 @@ def mash_distance_tile_pallas(a_ids, a_counts, b_ids, b_counts, *, k: int = 21):
     shared = np.asarray(
         _mash_shared_grid(
             np.ascontiguousarray(a[:, ::-1]), na_col, b, nb_col,
-            s_orig=s_orig, interpret=_use_interpret(),
+            s_orig=s_orig, r_iter=rows_per_iter(s2), interpret=_use_interpret(),
         )
     )[:na, :nb]
     return shared_counts_to_distance(shared, a_counts, b_counts, s_orig, k)

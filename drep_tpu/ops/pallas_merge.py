@@ -55,13 +55,15 @@ PALLAS_MAX_WIDTH = 2048
 
 
 def _merge_bitonic(x: jnp.ndarray, length: int) -> jnp.ndarray:
-    """Bitonic merge of a [rows, length] bitonic batch, via roll + masked
-    min/max (Mosaic-friendly: no sub-lane reshapes)."""
-    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    """Bitonic merge of a [..., length] bitonic batch along the last
+    (lane) axis, via roll + masked min/max (Mosaic-friendly: no sub-lane
+    reshapes)."""
+    axis = x.ndim - 1
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
     d = length // 2
     while d >= 1:
-        left = pltpu.roll(x, length - d, 1)  # partner for the low half: x[p + d]
-        right = pltpu.roll(x, d, 1)  # partner for the high half: x[p - d]
+        left = pltpu.roll(x, length - d, axis)  # partner for the low half: x[p + d]
+        right = pltpu.roll(x, d, axis)  # partner for the high half: x[p - d]
         low_half = (col % (2 * d)) < d
         x = jnp.where(low_half, jnp.minimum(x, left), jnp.maximum(x, right))
         d //= 2

@@ -150,7 +150,7 @@ def warmup_streaming_compile(
     ids = np.tile(np.arange(sketch_width, dtype=np.int32), (block, 1))
     counts = np.full(block, sketch_width, dtype=np.int32)
     if use_pallas:
-        from drep_tpu.ops.pallas_mash import _mash_shared_grid
+        from drep_tpu.ops.pallas_mash import _mash_shared_grid, rows_per_iter
         from drep_tpu.ops.pallas_merge import _use_interpret
 
         ids_pal, ids_rev, counts_col = _pallas_tile_layout(ids, counts)
@@ -160,6 +160,7 @@ def warmup_streaming_compile(
             ids_pal,
             counts_col,
             s_orig=sketch_width,
+            r_iter=rows_per_iter(ids_pal.shape[1]),
             interpret=_use_interpret(),
         )
     else:
@@ -290,7 +291,7 @@ def streaming_mash_edges(
             j0 = bj * block
             di = t % len(devices)
             if use_pallas:
-                from drep_tpu.ops.pallas_mash import _mash_shared_grid
+                from drep_tpu.ops.pallas_mash import _mash_shared_grid, rows_per_iter
                 from drep_tpu.ops.pallas_merge import _use_interpret
 
                 out = _mash_shared_grid(
@@ -299,6 +300,7 @@ def streaming_mash_edges(
                     ids_on[di][j0 : j0 + block],
                     counts_on[di][j0 : j0 + block],
                     s_orig=width,
+                    r_iter=rows_per_iter(ids_on[di].shape[1]),
                     interpret=_use_interpret(),
                 )
             else:

@@ -86,3 +86,29 @@ def test_pallas_mash_rectangular_blocks(rng):
     assert got_d.shape == (5, 7)
     np.testing.assert_allclose(got_d, np.asarray(want_d), atol=1e-7)
     del a, b  # only the shared-vocab split is meaningful
+
+
+@pytest.mark.parametrize("r_iter", [2, 4])
+def test_rows_per_iter_batching_equals_default(rng, monkeypatch, r_iter):
+    """The row-batched kernel variant (R a-rows merged per loop iteration,
+    DREP_TPU_MASH_ROWS_PER_ITER) is a pure perf knob: results must be
+    bit-identical to the default R=1 path on both grid layouts."""
+    from drep_tpu.ops.minhash import all_vs_all_mash
+    from drep_tpu.ops.pallas_mash import all_vs_all_mash_pallas
+
+    n, s = 9, 64
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    want_d, want_j = all_vs_all_mash(packed, k=21, tile=8)
+    monkeypatch.setenv("DREP_TPU_MASH_ROWS_PER_ITER", str(r_iter))
+    got_d, got_j = all_vs_all_mash_pallas(packed, k=21)
+    np.testing.assert_allclose(got_d, want_d, atol=1e-7)
+    np.testing.assert_allclose(got_j, want_j, atol=1e-7)
+
+    both = pack_sketches(_sketch_set(rng, 12, s), [f"g{i}" for i in range(12)], s)
+    a_ids, b_ids = both.ids[:5], both.ids[5:]
+    a_cnt, b_cnt = both.counts[:5], both.counts[5:]
+    monkeypatch.setenv("DREP_TPU_MASH_ROWS_PER_ITER", "1")
+    want_rd, _ = mash_distance_tile_pallas(a_ids, a_cnt, b_ids, b_cnt, k=21)
+    monkeypatch.setenv("DREP_TPU_MASH_ROWS_PER_ITER", str(r_iter))
+    got_rd, _ = mash_distance_tile_pallas(a_ids, a_cnt, b_ids, b_cnt, k=21)
+    np.testing.assert_array_equal(got_rd, want_rd)
