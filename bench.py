@@ -580,21 +580,42 @@ def bench_e2e(n: int) -> dict:
 
 
 def _require_devices(timeout_s: float = 240.0) -> None:
-    """Fail loudly (one JSON error line) when backend init hangs — the
-    tunneled TPU client has been observed to block forever inside
-    make_c_api_client when the tunnel wedges; a bench that hangs silently
-    wastes the whole measurement window."""
+    """Fail loudly (one JSON error line) when the backend is unusable —
+    the tunneled TPU client has been observed to (a) block forever inside
+    make_c_api_client at init AND (b) enumerate devices fine while the
+    first actual EXECUTION hangs (observed: device list returned, then the
+    first dispatched op never completed and the whole window produced no
+    output). The probe therefore runs a tiny op end to end, not just
+    jax.devices()."""
     import threading
 
     import jax
+    import jax.numpy as jnp
 
     got: list = []
-    t = threading.Thread(target=lambda: got.append(jax.devices()), daemon=True)
+    failed: list = []
+
+    def probe():
+        try:
+            jax.devices()
+            x = jnp.ones((128, 128), jnp.float32)
+            jax.block_until_ready(x @ x)
+            got.append(True)
+        except Exception as e:  # a raising backend must not read as a timeout
+            failed.append(repr(e))
+
+    t = threading.Thread(target=probe, daemon=True)
     t.start()
     t.join(timeout_s)
     if not got:
         import os
 
+        err = (
+            f"jax backend probe raised: {failed[0]}"
+            if failed
+            else f"jax backend init/execution probe did not complete within "
+            f"{timeout_s:.0f}s (wedged TPU tunnel?) — no measurements taken"
+        )
         print(
             json.dumps(
                 {
@@ -602,8 +623,7 @@ def _require_devices(timeout_s: float = 240.0) -> None:
                     "value": None,
                     "unit": "pairs/s",
                     "vs_baseline": None,
-                    "error": f"jax backend init did not return within {timeout_s:.0f}s "
-                    "(wedged TPU tunnel?) — no measurements taken",
+                    "error": err,
                 }
             ),
             flush=True,

@@ -56,7 +56,10 @@ def rows_per_iter(s2: int) -> int:
     r = int(os.environ.get("DREP_TPU_MASH_ROWS_PER_ITER", "1"))
     if r not in (1, 2, 4):
         raise ValueError("DREP_TPU_MASH_ROWS_PER_ITER must be 1, 2, or 4")
-    return min(r, max(1, (2 * PALLAS_MAX_WIDTH) // max(s2, 1)))
+    bound = max(1, (2 * PALLAS_MAX_WIDTH) // max(s2, 1))
+    # power of two: the kernel loop runs TILE // r iterations, so r must
+    # divide TILE or trailing rows would silently stay unwritten
+    return min(r, 1 << (bound.bit_length() - 1))
 
 
 def _prefix_sum_lanes(x: jnp.ndarray, length: int) -> jnp.ndarray:
@@ -70,6 +73,24 @@ def _prefix_sum_lanes(x: jnp.ndarray, length: int) -> jnp.ndarray:
         x = jnp.where(col >= d, x + shifted, x)
         d *= 2
     return x
+
+
+def _shared_counts(x: jnp.ndarray, length: int, col: jnp.ndarray, s_use: jnp.ndarray) -> jnp.ndarray:
+    """THE union-bottom-s estimator body, rank-agnostic (last axis = merged
+    lanes): bitonic-merge the [..., length] bitonic batch, mark duplicates
+    (== intersection), rank distinct union members, count duplicates whose
+    rank is within the per-pair bottom-s cutoff. One definition shared by
+    the r_iter==1 (2-D) and row-batched (3-D) kernel loops so the two can
+    never drift."""
+    axis = x.ndim - 1
+    x = _merge_bitonic(x, length)
+    is_real = x != PAD_ID
+    prev = pltpu.roll(x, 1, axis)
+    dup = (x == prev) & is_real & (col > 0)
+    start = is_real & ~dup
+    rank = _prefix_sum_lanes(start.astype(jnp.int32), length)
+    counted = dup & (rank <= s_use)
+    return jnp.sum(counted.astype(jnp.int32), axis=axis)
 
 
 def _mash_shared_kernel(s_orig: int, r_iter: int, a_rev_ref, na_ref, b_ref, nb_ref, out_ref):
@@ -91,15 +112,8 @@ def _mash_shared_kernel(s_orig: int, r_iter: int, a_rev_ref, na_ref, b_ref, nb_r
             x = jnp.concatenate(
                 [b_block, jnp.broadcast_to(a_row[None, :], (tb, s2))], axis=1
             )
-            x = _merge_bitonic(x, length)
-            is_real = x != PAD_ID
-            prev = pltpu.roll(x, 1, 1)
-            dup = (x == prev) & is_real & (col > 0)
-            start = is_real & ~dup
-            rank = _prefix_sum_lanes(start.astype(jnp.int32), length)
             s_use = jnp.minimum(jnp.minimum(na_ref[i, 0], nb_col), s_orig)  # [TB, 1]
-            counted = dup & (rank <= s_use)
-            out_ref[i, :] = jnp.sum(counted.astype(jnp.int32), axis=1)
+            out_ref[i, :] = _shared_counts(x, length, col, s_use)
             return 0
 
         jax.lax.fori_loop(0, ta, body, 0)
@@ -113,18 +127,11 @@ def _mash_shared_kernel(s_orig: int, r_iter: int, a_rev_ref, na_ref, b_ref, nb_r
         x = jnp.concatenate(
             [b3, jnp.broadcast_to(a_rows[:, None, :], (r_iter, tb, s2))], axis=2
         )
-        x = _merge_bitonic(x, length)
-        is_real = x != PAD_ID
-        prev = pltpu.roll(x, 1, 2)
-        dup = (x == prev) & is_real & (col3 > 0)
-        start = is_real & ~dup
-        rank = _prefix_sum_lanes(start.astype(jnp.int32), length)
         na_rows = na_ref[pl.ds(i * r_iter, r_iter), :]  # [R, 1]
         s_use = jnp.minimum(
             jnp.minimum(na_rows[:, :, None], nb_col[None]), s_orig
         )  # [R, TB, 1]
-        counted = dup & (rank <= s_use)
-        out_ref[pl.ds(i * r_iter, r_iter), :] = jnp.sum(counted.astype(jnp.int32), axis=2)
+        out_ref[pl.ds(i * r_iter, r_iter), :] = _shared_counts(x, length, col3, s_use)
         return 0
 
     jax.lax.fori_loop(0, ta // r_iter, body_r, 0)

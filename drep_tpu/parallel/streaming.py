@@ -205,7 +205,13 @@ def streaming_mash_edges(
     n_blocks = nt // block
     width = ids.shape[1]  # the estimator's `s` (pre-pow2-pad sketch width)
     if use_pallas:
+        from drep_tpu.ops.pallas_mash import rows_per_iter
+
         ids_pal, ids_rev, counts_col = _pallas_tile_layout(ids, counts)
+        # env read + clamp ONCE per run: per-tile re-reads would let a
+        # mid-run env change flip the jit signature and recompile between
+        # tiles (thousands of dispatches per run)
+        r_iter = rows_per_iter(ids_pal.shape[1])
     # local devices only: on a multi-host pod jax.devices() includes remote
     # chips, and device_put to a non-addressable device raises. Row-block
     # stripes are instead divided across processes (bi % pc == pid below)
@@ -291,7 +297,7 @@ def streaming_mash_edges(
             j0 = bj * block
             di = t % len(devices)
             if use_pallas:
-                from drep_tpu.ops.pallas_mash import _mash_shared_grid, rows_per_iter
+                from drep_tpu.ops.pallas_mash import _mash_shared_grid
                 from drep_tpu.ops.pallas_merge import _use_interpret
 
                 out = _mash_shared_grid(
@@ -300,7 +306,7 @@ def streaming_mash_edges(
                     ids_on[di][j0 : j0 + block],
                     counts_on[di][j0 : j0 + block],
                     s_orig=width,
-                    r_iter=rows_per_iter(ids_on[di].shape[1]),
+                    r_iter=r_iter,
                     interpret=_use_interpret(),
                 )
             else:
