@@ -23,7 +23,7 @@ import numpy as np
 from drep_tpu.utils.logger import get_logger
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SOURCE = os.path.join(_HERE, "ingest.cc")
+_SOURCES = [os.path.join(_HERE, "ingest.cc"), os.path.join(_HERE, "linkage.cc")]
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -51,15 +51,18 @@ def _build_library() -> str | None:
     never abort ingest (the module contract)."""
     tmp = None
     try:
-        with open(_SOURCE, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        h = hashlib.sha256()
+        for src in _SOURCES:
+            with open(src, "rb") as f:
+                h.update(f.read())
+        digest = h.hexdigest()[:16]
         build_dir = os.path.join(_HERE, "_build")
         so_path = os.path.join(build_dir, f"libdrep_native_{digest}.so")
         if os.path.exists(so_path):
             return so_path
         os.makedirs(build_dir, exist_ok=True)
         tmp = so_path + f".tmp{os.getpid()}"
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SOURCE, "-o", tmp, "-lz"]
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", *_SOURCES, "-o", tmp, "-lz"]
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         if res.returncode != 0:
             get_logger().debug("native build failed: %s", res.stderr[-1000:])
@@ -102,6 +105,18 @@ def get_library() -> ctypes.CDLL | None:
         ]
         lib.drep_sketch_free.restype = None
         lib.drep_sketch_free.argtypes = [ctypes.POINTER(_DrepSketch)]
+        lib.drep_sparse_upgma.restype = ctypes.c_int
+        lib.drep_sparse_upgma.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_double,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
         _lib = lib
     return _lib
 
@@ -157,3 +172,42 @@ def sketch_fasta_native(
         "bottom": bottom.astype(np.uint64),
         "scaled": scaled.astype(np.uint64),
     }
+
+
+def sparse_upgma_native(
+    n: int,
+    ii: np.ndarray,
+    jj: np.ndarray,
+    dd: np.ndarray,
+    cutoff: float,
+    keep: float,
+) -> tuple[np.ndarray, int] | None:
+    """Native sparse UPGMA (linkage.cc) — a bit-exact replica of
+    ops/linkage.py::sparse_average_linkage's partition (equality-tested).
+    Returns (raw labels, approx_merges) — the CALLER renumbers labels by
+    first appearance, same as the Python path — or None when the native
+    library is unavailable."""
+    lib = get_library()
+    if lib is None:
+        return None
+    ii = np.ascontiguousarray(ii, dtype=np.int64)
+    jj = np.ascontiguousarray(jj, dtype=np.int64)
+    dd = np.ascontiguousarray(dd, dtype=np.float64)
+    labels = np.zeros(n, dtype=np.int64)
+    approx = ctypes.c_int64(0)
+    p = lambda a, t: a.ctypes.data_as(ctypes.POINTER(t))  # noqa: E731
+    rc = lib.drep_sparse_upgma(
+        n, len(ii), p(ii, ctypes.c_int64), p(jj, ctypes.c_int64),
+        p(dd, ctypes.c_double), float(cutoff), float(keep),
+        p(labels, ctypes.c_int64), ctypes.byref(approx),
+    )
+    if rc == -2:
+        # caller bug (edge index out of range): loud on BOTH paths — the
+        # python reference would KeyError — never a silent wrong partition
+        raise ValueError(f"sparse UPGMA: edge index out of range for n={n}")
+    if rc != 0:
+        # any other native failure degrades to the python reference path
+        # (the module contract: native is an accelerator, never a gate)
+        get_logger().warning("native sparse UPGMA failed (rc=%d) — python fallback", rc)
+        return None
+    return labels, int(approx.value)

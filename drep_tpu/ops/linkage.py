@@ -139,11 +139,23 @@ def sparse_average_linkage(
     O(N * cluster_size), millions, not N^2. Only edge-connected cluster
     pairs ever become merge candidates: a pair with NO observed cross edge
     has average >= keep > cutoff by construction.
+
+    The hot path is the C++ replica (native/linkage.cc — same total order
+    over merge candidates, same float arithmetic, equality-tested
+    label-for-label); this Python formulation is the always-available
+    fallback and the semantic reference.
     """
     import heapq
 
     if n == 0:
         return np.zeros(0, dtype=np.int64), 0
+
+    from drep_tpu.native import sparse_upgma_native
+
+    native = sparse_upgma_native(n, ii, jj, dd, cutoff, keep)
+    if native is not None:
+        raw, approx_merges = native
+        return _renumber_first_appearance(raw), approx_merges
     # symmetric neighbor maps: nbr[a][b] == nbr[b][a] == (sum_obs, cnt_obs)
     nbr: dict[int, dict[int, tuple[float, int]]] = {i: {} for i in range(n)}
     for a, b, d in zip(ii.tolist(), jj.tolist(), dd.tolist()):

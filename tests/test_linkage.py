@@ -173,3 +173,90 @@ def test_streaming_rejects_unsupported_cluster_alg(rng):
 
     with pytest.raises(ValueError, match="average or single"):
         streaming_primary_clusters(packed, 21, 0.9, cluster_alg="complete")
+
+
+def _python_sparse_upgma(n, ii, jj, dd, cutoff, keep, monkeypatch):
+    """Pin the pure-Python reference path (native disabled)."""
+    from drep_tpu.ops.linkage import sparse_average_linkage
+
+    monkeypatch.setenv("DREP_TPU_NO_NATIVE", "1")
+    out = sparse_average_linkage(n, ii, jj, dd, cutoff, keep)
+    monkeypatch.delenv("DREP_TPU_NO_NATIVE")
+    return out
+
+
+def test_native_sparse_upgma_matches_python(rng, monkeypatch):
+    """native/linkage.cc is a bit-exact replica of the Python sparse UPGMA:
+    identical labels AND approx-merge counts on random graphs, blocky
+    graphs, banded retention, and graphs with heavy distance ties (the
+    regime where any ordering difference between the two heaps would
+    surface as a different partition)."""
+    import drep_tpu.native as native_mod
+    from drep_tpu.ops.linkage import sparse_average_linkage
+
+    if native_mod.get_library() is None:
+        import pytest
+
+        pytest.skip("no compiler: native path unavailable")
+
+    cases = []
+    for sizes in ([5, 8, 3], [1, 14, 6, 9], [2, 2, 2, 2, 2]):
+        d = _blocky_dist(rng, sizes)
+        cases.append((d, 0.10, 0.25))
+        cases.append((d, 0.10, 1.0))
+    # tie-rich: distances quantized to a coarse grid so many candidate
+    # averages collide exactly
+    for n_nodes in (12, 30, 64):
+        d = np.round(rng.uniform(0, 0.4, size=(n_nodes, n_nodes)), 2)
+        d = (d + d.T) / 2
+        np.fill_diagonal(d, 0.0)
+        cases.append((d, 0.10, 0.25))
+        cases.append((d, 0.15, 0.5))
+    for d, cutoff, keep in cases:
+        ii, jj, dd = _edges_below(d, keep=keep)
+        want_labels, want_approx = _python_sparse_upgma(
+            len(d), ii, jj, dd, cutoff, keep, monkeypatch
+        )
+        got_labels, got_approx = sparse_average_linkage(
+            len(d), ii, jj, dd, cutoff, keep
+        )
+        assert got_approx == want_approx
+        assert np.array_equal(got_labels, want_labels)
+
+
+def test_native_sparse_upgma_duplicate_edges(rng, monkeypatch):
+    """Duplicate input edges collapse to their min identically in both
+    implementations (first-writer-wins on exact ties)."""
+    import drep_tpu.native as native_mod
+    from drep_tpu.ops.linkage import sparse_average_linkage
+
+    if native_mod.get_library() is None:
+        import pytest
+
+        pytest.skip("no compiler: native path unavailable")
+    d = _blocky_dist(rng, [4, 6, 3])
+    ii, jj, dd = _edges_below(d, keep=0.3)
+    # duplicate every edge with jitter, and append exact-tie duplicates
+    ii2 = np.concatenate([ii, jj, ii])
+    jj2 = np.concatenate([jj, ii, jj])
+    dd2 = np.concatenate([dd, dd + 0.01, dd])
+    want = _python_sparse_upgma(len(d), ii2, jj2, dd2, 0.10, 0.3, monkeypatch)
+    got = sparse_average_linkage(len(d), ii2, jj2, dd2, 0.10, 0.3)
+    assert got[1] == want[1]
+    assert np.array_equal(got[0], want[0])
+
+
+def test_native_sparse_upgma_rejects_out_of_range(rng):
+    """An out-of-range edge index is a caller bug: loud on the native path
+    (the python reference would KeyError), never a silent wrong partition."""
+    import pytest
+
+    import drep_tpu.native as native_mod
+    from drep_tpu.ops.linkage import sparse_average_linkage
+
+    if native_mod.get_library() is None:
+        pytest.skip("no compiler: native path unavailable")
+    with pytest.raises(ValueError, match="out of range"):
+        sparse_average_linkage(
+            4, np.array([0, 4]), np.array([1, 2]), np.array([0.05, 0.05]), 0.1, 0.25
+        )
