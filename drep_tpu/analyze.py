@@ -177,6 +177,12 @@ def plot_cluster_scatter(wd: WorkDirectory) -> str | None:
     return out
 
 
+# past this many clusters the per-cluster score columns are unreadable AND
+# the per-cluster mask loop is O(clusters * genomes) — tens of minutes of
+# pandas at the 100k-dereplicate scale; summarize instead
+SCORING_CLUSTERS_MAX = 500
+
+
 def plot_scoring(wd: WorkDirectory) -> str | None:
     if not wd.hasDb("Sdb"):
         return None
@@ -185,19 +191,40 @@ def plot_scoring(wd: WorkDirectory) -> str | None:
     wdb = wd.get_db("Wdb") if wd.hasDb("Wdb") else None
     df = sdb.merge(cdb[["genome", "secondary_cluster"]], on="genome")
     out = os.path.join(wd.get_loc("figures"), "Cluster_scoring.pdf")
-    fig, ax = plt.subplots(figsize=(10, 5))
     order = sorted(df["secondary_cluster"].unique())
-    for i, cl in enumerate(order):
-        grp = df[df["secondary_cluster"] == cl]
-        ax.scatter([i] * len(grp), grp["score"], s=20, color="tab:blue", alpha=0.6)
-        if wdb is not None:
-            w = wdb[wdb["cluster"] == cl]
-            if len(w):
-                ax.scatter([i], w["score"], s=60, color="tab:red", marker="*")
-    ax.set_xticks(range(len(order)))
-    ax.set_xticklabels(order, rotation=90, fontsize=6)
-    ax.set_ylabel("score")
-    ax.set_title("Scores per secondary cluster (winner starred)")
+    if len(order) > SCORING_CLUSTERS_MAX:
+        get_logger().warning(
+            "cluster scoring: %d clusters — drawing the score distribution "
+            "instead of per-cluster columns (the full scores are in Sdb/Wdb)",
+            len(order),
+        )
+        fig, ax = plt.subplots(figsize=(10, 5))
+        # one shared edge set: independently-binned overlays are not
+        # visually comparable (winner bars would be ~5x narrower when
+        # winner scores cluster in the top of the range)
+        edges = np.histogram_bin_edges(df["score"], bins=60)
+        ax.hist(df["score"], bins=edges, color="tab:blue", alpha=0.7, label="all genomes")
+        if wdb is not None and len(wdb):
+            ax.hist(wdb["score"], bins=edges, color="tab:red", alpha=0.6, label="winners")
+        ax.set_xlabel("score")
+        ax.set_ylabel("genomes")
+        ax.legend()
+        ax.set_title(f"Score distribution over {len(order)} secondary clusters")
+    else:
+        fig, ax = plt.subplots(figsize=(10, 5))
+        # one groupby pass, not a per-cluster mask scan over the full frame
+        pos = {cl: i for i, cl in enumerate(order)}
+        for cl, grp in df.groupby("secondary_cluster"):
+            i = pos[cl]
+            ax.scatter([i] * len(grp), grp["score"], s=20, color="tab:blue", alpha=0.6)
+        if wdb is not None and len(wdb):
+            wx = wdb["cluster"].map(pos)
+            ok = wx.notna()
+            ax.scatter(wx[ok], wdb.loc[ok, "score"], s=60, color="tab:red", marker="*")
+        ax.set_xticks(range(len(order)))
+        ax.set_xticklabels(order, rotation=90, fontsize=6)
+        ax.set_ylabel("score")
+        ax.set_title("Scores per secondary cluster (winner starred)")
     fig.tight_layout()
     fig.savefig(out)
     plt.close(fig)

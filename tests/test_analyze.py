@@ -121,3 +121,49 @@ def test_large_n_plot_caps(tmp_path, genome_paths, monkeypatch, caplog):
     # truncation warning is asserted via the workdir log file instead
     log = (tmp_path / "wd" / "log" / "logger.log").read_text()
     assert "largest" in log
+
+
+def test_scoring_plot_caps_cluster_columns(tmp_path, monkeypatch):
+    """Past SCORING_CLUSTERS_MAX clusters the scoring figure switches to a
+    distribution summary (the per-cluster mask loop is O(C*N) — tens of
+    minutes at the 100k-dereplicate scale) and says so in the log."""
+    import numpy as np
+    import pandas as pd
+
+    import drep_tpu.analyze as analyze_mod
+    from drep_tpu.workdir import WorkDirectory
+
+    monkeypatch.setattr(analyze_mod, "SCORING_CLUSTERS_MAX", 10)
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    n = 40
+    genomes = [f"g{i}" for i in range(n)]
+    clusters = [f"1_{i % 20}" for i in range(n)]
+    wd.store_db(pd.DataFrame({"genome": genomes, "score": np.linspace(0, 5, n)}), "Sdb")
+    wd.store_db(
+        pd.DataFrame({"genome": genomes, "primary_cluster": 1, "secondary_cluster": clusters}),
+        "Cdb",
+    )
+    wd.store_db(
+        pd.DataFrame({"genome": genomes[:20], "cluster": clusters[:20], "score": 1.0}),
+        "Wdb",
+    )
+    import logging
+
+    records = []
+
+    class Grab(logging.Handler):
+        def emit(self, r):
+            records.append(r.getMessage())
+
+    from drep_tpu.utils.logger import get_logger
+
+    h = Grab()
+    get_logger().addHandler(h)
+    try:
+        out = analyze_mod.plot_scoring(wd)
+    finally:
+        get_logger().removeHandler(h)
+    assert out is not None and os.path.getsize(out) > 1000
+    # pin the branch: the cap must actually fire (a regression to the
+    # per-cluster scatter also renders a valid PDF)
+    assert any("score distribution" in m for m in records)
