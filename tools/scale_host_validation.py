@@ -1,0 +1,141 @@
+"""50k-genome HOST-path validation on CPU (no TPU required).
+
+Usage:  JAX_PLATFORMS=cpu python tools/scale_host_validation.py
+
+The tile compute (the TPU part) is skipped by forging the streaming
+row-block shard checkpoints from exact numpy union-bottom-s distances —
+the planted clusters are contiguous spans of <= 20 genomes, so every
+within-cluster pair lies in a 19-wide index window and every cross-pair
+is distance ~1 (independent 63-bit hash draws; 3+ shared hashes of 1000
+is needed to clear the 0.25 retention bound). The real pipeline then
+runs end to end: shard resume at 50k, native sparse UPGMA, batched
+secondary containment (~17k clusters, real CPU compute), Cdb assembly,
+and a full resume — with wall/RSS recorded.
+"""
+
+import json
+import os
+import resource
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pandas as pd
+
+# runnable as `python tools/scale_host_validation.py` from anywhere: bench.py
+# and the drep_tpu package live at the repo root, one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.argv = ["scale_host_validation"]
+import bench as B
+from drep_tpu.cluster.controller import d_cluster_wrapper
+from drep_tpu.ingest import DEFAULT_SCALE, _save, sketch_args_snapshot
+from drep_tpu.ops.merge import cap_merge_tile
+from drep_tpu.ops.minhash import mash_distance_from_jaccard, pack_sketches
+from drep_tpu.utils.ckptmeta import content_fingerprint, open_checkpoint_dir
+from drep_tpu.workdir import WorkDirectory
+
+N = 50_000
+K = 21
+WINDOW = 19  # max intra-cluster index span (clusters are contiguous, <= 20)
+KEEP = 0.25  # max(1 - P_ani, warn_dist) at default flags
+
+t0 = time.perf_counter()
+rng = np.random.default_rng(2)
+gs = B._plant_sketches(N, rng)
+print(f"planted {N} genomes in {time.perf_counter()-t0:.1f}s", flush=True)
+
+t0 = time.perf_counter()
+packed = pack_sketches(gs.bottom, gs.names, gs.sketch_size)
+print(f"packed in {time.perf_counter()-t0:.1f}s", flush=True)
+
+# exact union-bottom-s distances over the 19-wide window
+t0 = time.perf_counter()
+s = gs.sketch_size
+ii_l, jj_l, dd_l = [], [], []
+bottoms = gs.bottom
+for i in range(N):
+    a = bottoms[i]
+    for j in range(i + 1, min(i + 1 + WINDOW, N)):
+        b = bottoms[j]
+        inter = np.intersect1d(a, b)
+        if len(inter) < 3:  # cannot reach dist <= 0.25 at s=1000
+            continue
+        u_t = np.union1d(a, b)[s - 1]
+        shared = int((inter <= u_t).sum())
+        d = float(mash_distance_from_jaccard(np.float32(shared / s), K, xp=np))
+        if d <= KEEP:
+            ii_l.append(i)
+            jj_l.append(j)
+            dd_l.append(d)
+ii = np.array(ii_l, np.int64)
+jj = np.array(jj_l, np.int64)
+dd = np.array(dd_l, np.float32)
+print(f"edge oracle: {len(ii)} edges in {time.perf_counter()-t0:.1f}s", flush=True)
+
+with tempfile.TemporaryDirectory() as td:
+    wd = WorkDirectory(td)
+    bdb = pd.DataFrame(
+        {"genome": gs.names, "location": [f"/nonexistent/{g}" for g in gs.names]}
+    )
+    _save(wd, gs)
+    wd.store_arguments(
+        "sketch",
+        sketch_args_snapshot(bdb["genome"], K, gs.sketch_size, DEFAULT_SCALE, "splitmix64"),
+    )
+
+    # forge the streaming shard checkpoints (exact meta + per-row-block npz)
+    block = cap_merge_tile(1024, packed.ids.shape[1])  # CPU jnp path block rule
+    nt = -(-N // block) * block
+    n_blocks = nt // block
+    ckpt = wd.get_dir(os.path.join("data", "streaming_primary"))
+    meta = {
+        "n": N,
+        "block": block,
+        "k": K,
+        "cutoff": round(float(KEEP), 12),
+        "sketch_size": int(packed.sketch_size),
+        "n_blocks": n_blocks,
+        "fingerprint": content_fingerprint(packed.names, packed.counts, packed.ids),
+    }
+    # first call writes the meta (returns False); a second call must see it
+    # as resumable — proving the run's own meta computation will match
+    open_checkpoint_dir(ckpt, meta, clear_suffixes=(".npz",))
+    assert open_checkpoint_dir(ckpt, meta, clear_suffixes=(".npz",))
+    blk = ii // block
+    for bi in range(n_blocks):
+        sel = blk == bi
+        np.savez_compressed(
+            os.path.join(ckpt, f"row_{bi:05d}.npz.tmp.npz"),
+            ii=ii[sel], jj=jj[sel], dist=dd[sel],
+        )
+        os.replace(
+            os.path.join(ckpt, f"row_{bi:05d}.npz.tmp.npz"),
+            os.path.join(ckpt, f"row_{bi:05d}.npz"),
+        )
+    print(f"forged {n_blocks} shards (block={block})", flush=True)
+
+    t0 = time.perf_counter()
+    cdb = d_cluster_wrapper(wd, bdb, streaming_primary=True)
+    wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    cdb2 = d_cluster_wrapper(wd, bdb, streaming_primary=True)
+    resume_wall = time.perf_counter() - t0
+    key = ["genome", "primary_cluster", "secondary_cluster"]
+    out = {
+        "n": N,
+        "edges": int(len(ii)),
+        "host_wall_to_cdb_s": round(wall, 1),
+        "resume_s": round(resume_wall, 1),
+        "primary_clusters": int(cdb["primary_cluster"].max()),
+        "secondary_clusters": int(cdb["secondary_cluster"].nunique()),
+        "resume_match": bool(
+            cdb2.sort_values("genome")[key].reset_index(drop=True).equals(
+                cdb.sort_values("genome")[key].reset_index(drop=True)
+            )
+        ),
+        "peak_rss_gb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2
+        ),
+    }
+    print("RESULT " + json.dumps(out), flush=True)
