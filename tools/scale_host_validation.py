@@ -26,8 +26,10 @@ import pandas as pd
 # runnable as `python tools/scale_host_validation.py` from anywhere: bench.py
 # and the drep_tpu package live at the repo root, one level up
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-sys.argv = ["scale_host_validation"]
+_argv, sys.argv = sys.argv, ["scale_host_validation"]
 import bench as B
+
+sys.argv = _argv
 from drep_tpu.cluster.controller import d_cluster_wrapper
 from drep_tpu.ingest import DEFAULT_SCALE, _save, sketch_args_snapshot
 from drep_tpu.ops.merge import cap_merge_tile
@@ -35,7 +37,7 @@ from drep_tpu.ops.minhash import mash_distance_from_jaccard, pack_sketches
 from drep_tpu.utils.ckptmeta import content_fingerprint, open_checkpoint_dir
 from drep_tpu.workdir import WorkDirectory
 
-N = 50_000
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
 K = 21
 WINDOW = 19  # max intra-cluster index span (clusters are contiguous, <= 20)
 KEEP = 0.25  # max(1 - P_ani, warn_dist) at default flags
@@ -85,7 +87,8 @@ with tempfile.TemporaryDirectory() as td:
     )
 
     # forge the streaming shard checkpoints (exact meta + per-row-block npz)
-    block = cap_merge_tile(1024, packed.ids.shape[1])  # CPU jnp path block rule
+    # the real path's block rule INCLUDING its small-n clamp
+    block = cap_merge_tile(min(1024, max(8, N)), packed.ids.shape[1])
     nt = -(-N // block) * block
     n_blocks = nt // block
     ckpt = wd.get_dir(os.path.join("data", "streaming_primary"))
@@ -118,6 +121,16 @@ with tempfile.TemporaryDirectory() as td:
     t0 = time.perf_counter()
     cdb = d_cluster_wrapper(wd, bdb, streaming_primary=True)
     wall = time.perf_counter() - t0
+    # the measurement is only valid if the run RESUMED the forged shards: a
+    # meta mismatch silently clears them and recomputes tiles on CPU —
+    # reporting tile compute the number claims to exclude
+    import glob as _glob
+
+    n_shards_left = len(_glob.glob(os.path.join(ckpt, "row_*.npz")))
+    assert n_shards_left == n_blocks, (
+        f"forged shards were invalidated ({n_shards_left}/{n_blocks} remain) — "
+        "meta drifted from the streaming path; measurement void"
+    )
     t0 = time.perf_counter()
     cdb2 = d_cluster_wrapper(wd, bdb, streaming_primary=True)
     resume_wall = time.perf_counter() - t0
