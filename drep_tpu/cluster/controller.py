@@ -369,7 +369,21 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         from drep_tpu.cluster.secondary_ckpt import SecondaryCheckpoint
 
         greedy = kw["greedy_secondary_clustering"]
-        batched_fn = None if greedy else dispatch.get_secondary_batched(kw["S_algorithm"])
+        # the batched route stays available under greedy: small clusters
+        # get their (ani, cov) from ONE device call covering many
+        # clusters, then the greedy assignment runs host-side on those
+        # matrices with identical semantics (greedy.py::
+        # greedy_assign_from_matrices) — 35k per-cluster greedy engine
+        # invocations at the 100k scale were measured pathologically
+        # slower than the batch route. Restricted to jax_ani: the greedy
+        # engine hardcodes containment-ANI numerics, so a batched variant
+        # of any OTHER algorithm must not silently substitute its numbers
+        # for small clusters only
+        batched_fn = (
+            dispatch.get_secondary_batched(kw["S_algorithm"])
+            if not greedy or kw["S_algorithm"] == "jax_ani"
+            else None
+        )
         # warn_dist shapes only the Mdb retention, never secondary results;
         # the resolved primary estimator never touches ANI numerics — keep
         # both out of the checkpoint key so neither a warning-threshold
@@ -402,6 +416,8 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
             cached = ckpt.load(pc)
             if cached is not None:
                 results[pc] = cached  # resumed: 0 pairs counted
+            elif batched_fn is not None and m <= SMALL_CLUSTER_MAX:
+                small.append((pc, indices))  # one device call for many
             elif greedy:
                 from drep_tpu.cluster.greedy import greedy_secondary_cluster
 
@@ -410,8 +426,6 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                 counters.stages["secondary_compare"].pairs += len(ndb)  # actual comparisons made
                 results[pc] = (ndb, labels, np.empty((0, 4)))
                 ckpt.save(pc, *results[pc])
-            elif batched_fn is not None and m <= SMALL_CLUSTER_MAX:
-                small.append((pc, indices))  # one device call for many
             else:
                 with counters.stage("secondary_compare", pairs=m * (m - 1) // 2):
                     results[pc] = _secondary_for_cluster(gs, bdb, indices, pc, kw)
@@ -433,7 +447,13 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                     gs, [ix for _, ix in batch], mesh_shape=kw["mesh_shape"]
                 )
             for (pc, indices), (ani, cov) in zip(batch, outs, strict=True):
-                results[pc] = _secondary_postprocess(gs, indices, pc, kw, ani, cov)
+                if greedy:
+                    from drep_tpu.cluster.greedy import greedy_assign_from_matrices
+
+                    ndb, labels = greedy_assign_from_matrices(gs, indices, pc, kw, ani, cov)
+                    results[pc] = (ndb, labels, np.empty((0, 4)))
+                else:
+                    results[pc] = _secondary_postprocess(gs, indices, pc, kw, ani, cov)
                 ckpt.save(pc, *results[pc])
 
         for pc, indices in multi:  # assemble in cluster order (deterministic)

@@ -51,6 +51,77 @@ def _pad_pack(ids: np.ndarray, counts: np.ndarray, rows: list[int], pad_to: int)
     return out_ids, out_counts
 
 
+NDB_COLUMNS = [
+    "reference", "querry", "ani", "alignment_coverage",
+    "ref_coverage", "querry_coverage", "primary_cluster",
+]
+
+
+def _ndb_from_rows(ndb_rows: list[dict], pc: int) -> pd.DataFrame:
+    """THE greedy Ndb assembly, shared by both comparison sources."""
+    if ndb_rows:
+        ndb = pd.DataFrame(
+            {key: np.concatenate([r[key] for r in ndb_rows]) for key in ndb_rows[0]}
+        )
+        ndb["primary_cluster"] = pc
+        return ndb
+    return pd.DataFrame(columns=NDB_COLUMNS)
+
+
+def greedy_assign_from_matrices(
+    gs: GenomeSketches,
+    indices: list[int],
+    pc: int,
+    kw: dict[str, Any],
+    ani: np.ndarray,
+    cov: np.ndarray,
+) -> tuple[pd.DataFrame, np.ndarray]:
+    """Greedy representative assignment from PRECOMPUTED (ani, cov)
+    matrices — the small-cluster path when `--greedy_secondary_clustering`
+    is on. Semantics identical to :func:`greedy_secondary_cluster`
+    (largest-first visiting order, same two-sided coverage gate, same Ndb
+    rows: each genome vs the representatives existing when it was
+    visited); only the comparison source differs — one batched device call
+    covering MANY clusters already produced the matrices, instead of a
+    per-cluster engine invocation. At the 100k scale most primary clusters
+    are tiny, and a per-cluster greedy call apiece (device dispatches,
+    block padding to 128 rows for a 3-genome cluster) was measured
+    pathologically slower than the batch route — the exact fan-out cost
+    the batched path exists to avoid (cluster/controller.py
+    SMALL_CLUSTER_MAX rationale)."""
+    s_ani, cov_thresh = kw["S_ani"], kw["cov_thresh"]
+    m = len(indices)
+    n_kmers = [int(gs.gdb["n_kmers"].iloc[i]) for i in indices]
+    order = sorted(range(m), key=lambda t: -n_kmers[t])
+    names = [gs.names[i] for i in indices]
+    labels = np.zeros(m, dtype=np.int64)
+    reps: list[int] = []
+    ndb_rows: list[dict] = []
+    for t in order:
+        if reps:
+            r = np.asarray(reps)
+            cov_row = cov[t, r].astype(np.float64)
+            cov_rev = cov[r, t].astype(np.float64)
+            ani_row = ani[t, r].astype(np.float64)
+            ndb_rows.append(
+                {
+                    "reference": np.array([names[x] for x in reps]),
+                    "querry": np.repeat(names[t], len(reps)),
+                    "ani": ani_row,
+                    "alignment_coverage": cov_row,
+                    "ref_coverage": cov_rev,
+                    "querry_coverage": cov_row,
+                }
+            )
+            ok = (ani_row >= s_ani) & (cov_row >= cov_thresh) & (cov_rev >= cov_thresh)
+            if ok.any():
+                labels[t] = int(np.argmax(np.where(ok, ani_row, -1.0))) + 1
+                continue
+        reps.append(t)
+        labels[t] = len(reps)
+    return _ndb_from_rows(ndb_rows, pc), labels
+
+
 def greedy_secondary_cluster(
     gs: GenomeSketches,
     bdb: pd.DataFrame,
@@ -199,13 +270,4 @@ def greedy_secondary_cluster(
     labels = np.zeros(m, dtype=np.int64)
     for t in range(m):
         labels[order[t]] = labels_ordered[t]
-    if ndb_rows:
-        ndb = pd.DataFrame(
-            {key: np.concatenate([r[key] for r in ndb_rows]) for key in ndb_rows[0]}
-        )
-        ndb["primary_cluster"] = pc
-    else:
-        ndb = pd.DataFrame(
-            columns=["reference", "querry", "ani", "alignment_coverage", "ref_coverage", "querry_coverage", "primary_cluster"]
-        )
-    return ndb, labels
+    return _ndb_from_rows(ndb_rows, pc), labels

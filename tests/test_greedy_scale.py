@@ -62,3 +62,78 @@ def test_greedy_recovers_planted_clusters(synthetic):
     # generous ceiling: the vectorized path runs in a few seconds on CPU;
     # a Python pair-loop regression would take minutes
     assert dt < 60, f"greedy took {dt:.1f}s — pair-loop regression?"
+
+
+def test_greedy_from_matrices_equals_engine(synthetic):
+    """The small-cluster route (batched matrices + host greedy assignment)
+    must reproduce the per-cluster greedy engine exactly: same labels,
+    same Ndb comparison set and values."""
+    from drep_tpu.cluster.engines import secondary_jax_ani
+    from drep_tpu.cluster.greedy import greedy_assign_from_matrices
+
+    gs, _truth = synthetic
+    kw = {"S_ani": 0.95, "cov_thresh": 0.1}
+    # several small "primary clusters": slices of the synthetic set that mix
+    # genomes from different planted clusters (so reps + assignments both occur)
+    for lo, hi in [(0, 7), (35, 41), (100, 130), (393, 400)]:
+        indices = list(range(lo, hi))
+        want_ndb, want_labels = greedy_secondary_cluster(gs, None, indices, pc=9, kw=kw)
+        ani, cov = secondary_jax_ani(gs, indices)
+        got_ndb, got_labels = greedy_assign_from_matrices(gs, indices, 9, kw, ani, cov)
+        np.testing.assert_array_equal(got_labels, want_labels, err_msg=str((lo, hi)))
+        assert len(got_ndb) == len(want_ndb)
+        for col in ("reference", "querry"):
+            assert list(got_ndb[col]) == list(want_ndb[col])
+        for col in ("ani", "alignment_coverage", "ref_coverage", "querry_coverage"):
+            np.testing.assert_allclose(got_ndb[col], want_ndb[col], atol=1e-6, err_msg=col)
+
+
+def test_greedy_small_clusters_ride_the_batched_path(synthetic, monkeypatch):
+    """Controller routing: with greedy on, small clusters go through ONE
+    batched device call (35k per-cluster greedy invocations at the 100k
+    scale were pathologically slow), while the greedy engine is reserved
+    for big clusters."""
+    import drep_tpu.cluster.controller as ctrl
+    from drep_tpu.cluster import dispatch
+
+    gs, _ = synthetic
+    calls = {"batched": 0, "engine": 0}
+    real_batched = dispatch.get_secondary_batched("jax_ani")
+
+    def counting_batched(*a, **k):
+        calls["batched"] += 1
+        return real_batched(*a, **k)
+
+    monkeypatch.setitem(dispatch.SECONDARY_BATCHED, "jax_ani", counting_batched)
+    import drep_tpu.cluster.greedy as greedy_mod
+
+    real_engine = greedy_mod.greedy_secondary_cluster
+
+    def counting_engine(*a, **k):
+        calls["engine"] += 1
+        return real_engine(*a, **k)
+
+    monkeypatch.setattr(greedy_mod, "greedy_secondary_cluster", counting_engine)
+
+    import tempfile
+
+    import pandas as pd
+
+    from drep_tpu.workdir import WorkDirectory
+
+    with tempfile.TemporaryDirectory() as td:
+        wd = WorkDirectory(td)
+        bdb = pd.DataFrame({"genome": gs.names, "location": gs.names})
+        from drep_tpu.ingest import _save, sketch_args_snapshot
+
+        _save(wd, gs)
+        wd.store_arguments(
+            "sketch",
+            sketch_args_snapshot(bdb["genome"], gs.k, gs.sketch_size, gs.scale, "splitmix64"),
+        )
+        cdb = ctrl.d_cluster_wrapper(
+            wd, bdb, greedy_secondary_clustering=True, MASH_sketch=gs.sketch_size
+        )
+    assert calls["batched"] >= 1  # small clusters batched
+    assert calls["engine"] == 0  # no per-cluster greedy fan-out
+    assert cdb["secondary_cluster"].nunique() >= 20

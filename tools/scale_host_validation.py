@@ -37,7 +37,9 @@ from drep_tpu.ops.minhash import mash_distance_from_jaccard, pack_sketches
 from drep_tpu.utils.ckptmeta import content_fingerprint, open_checkpoint_dir
 from drep_tpu.workdir import WorkDirectory
 
-N = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000
+_pos = [a for a in sys.argv[1:] if not a.startswith("-")]
+N = int(_pos[0]) if _pos else 50_000
+GREEDY = "--greedy" in sys.argv  # the north-star combo: streaming + greedy
 K = 21
 WINDOW = 19  # max intra-cluster index span (clusters are contiguous, <= 20)
 KEEP = 0.25  # max(1 - P_ani, warn_dist) at default flags
@@ -118,8 +120,11 @@ with tempfile.TemporaryDirectory() as td:
         )
     print(f"forged {n_blocks} shards (block={block})", flush=True)
 
+    kw = {"streaming_primary": True}
+    if GREEDY:
+        kw["greedy_secondary_clustering"] = True
     t0 = time.perf_counter()
-    cdb = d_cluster_wrapper(wd, bdb, streaming_primary=True)
+    cdb = d_cluster_wrapper(wd, bdb, **kw)
     wall = time.perf_counter() - t0
     # the measurement is only valid if the run RESUMED the forged shards: a
     # meta mismatch silently clears them and recomputes tiles on CPU —
@@ -132,11 +137,12 @@ with tempfile.TemporaryDirectory() as td:
         "meta drifted from the streaming path; measurement void"
     )
     t0 = time.perf_counter()
-    cdb2 = d_cluster_wrapper(wd, bdb, streaming_primary=True)
+    cdb2 = d_cluster_wrapper(wd, bdb, **kw)
     resume_wall = time.perf_counter() - t0
     key = ["genome", "primary_cluster", "secondary_cluster"]
     out = {
         "n": N,
+        "greedy": GREEDY,
         "edges": int(len(ii)),
         "host_wall_to_cdb_s": round(wall, 1),
         "resume_s": round(resume_wall, 1),
