@@ -23,6 +23,7 @@ from typing import Any
 import numpy as np
 import pandas as pd
 
+from drep_tpu.cluster.pairs import NDB_COLUMNS
 from drep_tpu.ingest import GenomeSketches
 from drep_tpu.ops.containment import (
     VocabChunkGeometry,
@@ -49,12 +50,6 @@ def _pad_pack(ids: np.ndarray, counts: np.ndarray, rows: list[int], pad_to: int)
         out_ids[: len(rows)] = ids[rows]
         out_counts[: len(rows)] = counts[rows]
     return out_ids, out_counts
-
-
-NDB_COLUMNS = [
-    "reference", "querry", "ani", "alignment_coverage",
-    "ref_coverage", "querry_coverage", "primary_cluster",
-]
 
 
 def _ndb_from_rows(ndb_rows: list[dict], pc: int) -> pd.DataFrame:

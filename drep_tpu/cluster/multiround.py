@@ -65,8 +65,13 @@ def multiround_primary_clustering(
         idx = list(range(c0, min(c0 + chunk, n)))
         pairs_compared += len(idx) * (len(idx) - 1) // 2
         labels = _cluster_chunk(gs, idx, cutoff, method, mesh_shape, estimator)
-        for lab in range(1, int(labels.max()) + 1):
-            members = [idx[t] for t in range(len(idx)) if labels[t] == lab]
+        # one grouping pass — a per-label membership scan is
+        # O(clusters * chunk), ~170M Python iterations at the 100k scale
+        groups: dict[int, list[int]] = {}
+        for t, lab in enumerate(labels):
+            groups.setdefault(int(lab), []).append(idx[t])
+        for lab in sorted(groups):
+            members = groups[lab]
             rep = max(members, key=lambda i: int(nk[i]))
             reps.append(rep)
             for i in members:
