@@ -686,6 +686,13 @@ def main() -> None:
     # end-to-end numbers therefore run before the compile-heavy
     # production/greedy shapes, and ingest (host-only, no device calls)
     # slots in between.
+    # drop any stale partial from a previous killed run: a file that
+    # survives this run must belong to THIS run
+    try:
+        os.remove("BENCH_PARTIAL.json")
+    except OSError:
+        pass
+
     stages: dict = {}
     plan: list[tuple[str, float, object]] = []
     if "primary" in want:
@@ -758,8 +765,27 @@ def main() -> None:
             file=sys.stderr,
             flush=True,
         )
+        # incremental partial record: if the PROCESS is killed externally
+        # (driver timeout — distinct from the wedge path above, which
+        # emits), the completed measurements survive on disk for the next
+        # session instead of vanishing with stdout. Atomic replace so a
+        # kill mid-write can't destroy the previous stage's record.
+        try:
+            tmp = f"BENCH_PARTIAL.json.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"completed_through": label, "stages": dict(stages)}, f)
+            os.replace(tmp, "BENCH_PARTIAL.json")
+        except OSError:
+            pass
 
     _emit(stages)
+    # a COMPLETED run's results are in the emitted line (and the driver's
+    # record); remove the partial so a later killed run can never be
+    # misattributed this run's stages
+    try:
+        os.remove("BENCH_PARTIAL.json")
+    except OSError:
+        pass
     if "primary" in want and "primary" not in stages:
         # headline failed by exception: the JSON line above still carries
         # every other stage, but the run must read as broken (matching
