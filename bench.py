@@ -654,8 +654,22 @@ def main() -> None:
     import sys
     import threading
 
+    # drop any stale partial from a previous killed run FIRST — even this
+    # run's device probe can hang and get killed, and a file that survives
+    # this run must belong to THIS run
+    try:
+        os.remove("BENCH_PARTIAL.json")
+    except OSError:
+        pass
+
+    from drep_tpu.controller import _honor_jax_platforms_env
     from drep_tpu.utils.xla_cache import enable_persistent_cache
 
+    # env JAX_PLATFORMS alone does not stop a plugin-registered tunneled
+    # TPU from attempting its own client init inside jax.devices() (hangs
+    # forever on a wedged tunnel); the config API is authoritative —
+    # same guard as the CLI
+    _honor_jax_platforms_env()
     enable_persistent_cache()
     _require_devices()
     ap = argparse.ArgumentParser()
@@ -686,13 +700,6 @@ def main() -> None:
     # end-to-end numbers therefore run before the compile-heavy
     # production/greedy shapes, and ingest (host-only, no device calls)
     # slots in between.
-    # drop any stale partial from a previous killed run: a file that
-    # survives this run must belong to THIS run
-    try:
-        os.remove("BENCH_PARTIAL.json")
-    except OSError:
-        pass
-
     stages: dict = {}
     plan: list[tuple[str, float, object]] = []
     if "primary" in want:
@@ -759,6 +766,10 @@ def main() -> None:
             )
             print(f"bench: {label} WEDGED after {budget:.0f}s, bailing", file=sys.stderr, flush=True)
             _emit(snap)
+            try:  # the emitted line carries everything — same rule as the
+                os.remove("BENCH_PARTIAL.json")  # end-of-run cleanup
+            except OSError:
+                pass
             os._exit(3)
         print(
             f"bench: {label} done in {time.perf_counter() - t0:.1f}s",
@@ -770,13 +781,19 @@ def main() -> None:
         # emits), the completed measurements survive on disk for the next
         # session instead of vanishing with stdout. Atomic replace so a
         # kill mid-write can't destroy the previous stage's record.
+        tmp = f"BENCH_PARTIAL.json.tmp{os.getpid()}"
         try:
-            tmp = f"BENCH_PARTIAL.json.tmp{os.getpid()}"
             with open(tmp, "w") as f:
                 json.dump({"completed_through": label, "stages": dict(stages)}, f)
             os.replace(tmp, "BENCH_PARTIAL.json")
         except OSError:
             pass
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
 
     _emit(stages)
     # a COMPLETED run's results are in the emitted line (and the driver's
