@@ -649,18 +649,19 @@ def _emit(stages: dict) -> None:
     )
 
 
-def main() -> None:
+def _clear_partial() -> None:
     import os
-    import sys
-    import threading
 
-    # drop any stale partial from a previous killed run FIRST — even this
-    # run's device probe can hang and get killed, and a file that survives
-    # this run must belong to THIS run
     try:
         os.remove("BENCH_PARTIAL.json")
     except OSError:
         pass
+
+
+def main() -> None:
+    import os
+    import sys
+    import threading
 
     from drep_tpu.controller import _honor_jax_platforms_env
     from drep_tpu.utils.xla_cache import enable_persistent_cache
@@ -681,6 +682,11 @@ def main() -> None:
     ap.add_argument("--e2e_n", type=int, default=10_000)
     ap.add_argument("--scale_n", type=int, default=50_000)
     args = ap.parse_args()
+    # drop any stale partial from a previous killed run here — after
+    # argparse (--help / usage errors must not destroy a recovery record)
+    # but before the device probe, which can hang and get killed; a file
+    # that survives this run must belong to THIS run
+    _clear_partial()
     want = (
         set(args.stages.split(","))
         if args.stages != "all"
@@ -766,10 +772,7 @@ def main() -> None:
             )
             print(f"bench: {label} WEDGED after {budget:.0f}s, bailing", file=sys.stderr, flush=True)
             _emit(snap)
-            try:  # the emitted line carries everything — same rule as the
-                os.remove("BENCH_PARTIAL.json")  # end-of-run cleanup
-            except OSError:
-                pass
+            _clear_partial()  # the emitted line carries everything
             os._exit(3)
         print(
             f"bench: {label} done in {time.perf_counter() - t0:.1f}s",
@@ -799,10 +802,7 @@ def main() -> None:
     # a COMPLETED run's results are in the emitted line (and the driver's
     # record); remove the partial so a later killed run can never be
     # misattributed this run's stages
-    try:
-        os.remove("BENCH_PARTIAL.json")
-    except OSError:
-        pass
+    _clear_partial()
     if "primary" in want and "primary" not in stages:
         # headline failed by exception: the JSON line above still carries
         # every other stage, but the run must read as broken (matching

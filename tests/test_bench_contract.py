@@ -1,0 +1,37 @@
+"""The driver contract for bench.py: one JSON line on stdout, exit 0.
+
+Pinned as a subprocess test with ONLY `JAX_PLATFORMS=cpu` in the env —
+the env var must be honored through the config API, because a
+plugin-registered tunneled TPU otherwise attempts its own client init
+inside jax.devices() and blocks forever when the tunnel is wedged
+(observed; bench.py main() carries the guard).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_bench_emits_one_json_line_and_cleans_partials(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    # drop the tunnel pool config so the test never talks to (or hangs on)
+    # a real tunnel; the config-API guard itself is what keeps the cpu-only
+    # init from touching a registered plugin in production
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # tmp cwd: partial-record paths are cwd-relative, and the test must not
+    # touch a real BENCH_PARTIAL.json recovery record in the checkout
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--stages", "none"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path), timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout
+    doc = json.loads(lines[0])
+    assert doc["metric"] == "genome-pairs/sec/chip"
+    assert set(doc) >= {"value", "unit", "vs_baseline", "stages"}
+    assert not (tmp_path / "BENCH_PARTIAL.json").exists()
