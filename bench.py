@@ -141,6 +141,15 @@ def bench_primary() -> dict:
         ids=ids, counts=counts, names=[f"g{i}" for i in range(N_GENOMES)]
     )
 
+    import os
+
+    import jax
+
+    # pin the kernel-variant knob to its shipped default for the HEADLINE:
+    # a leftover operator export must not silently change what the
+    # recorded number measures (variants are reported separately below)
+    prev_r = os.environ.get("DREP_TPU_MASH_ROWS_PER_ITER")
+    os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = "1"
     mash_distance_matrix(packed, k=K, tile=TILE)  # compile warmup at full shape
     dt = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
     pairs = N_GENOMES * (N_GENOMES - 1) / 2
@@ -150,12 +159,40 @@ def bench_primary() -> dict:
     t = N_GENOMES // 128
     n_tiles = t * (t // 2 + 1)
     hbm = n_tiles * (2 * 128 * s2 * 4 + 128 * 128 * 4)
-    return {
+    out = {
         "n_genomes": N_GENOMES,
         "sketch": SKETCH_SIZE,
         **_rate_fields(pairs, dt),
         **_merge_roofline(pairs, s2, hbm, dt),
     }
+
+    # kernel-variant diagnostics: measure the row-batched mash kernel
+    # (DREP_TPU_MASH_ROWS_PER_ITER — correctness equality-tested in
+    # tests/test_pallas_mash.py) on the same workload. The headline above
+    # is the shipped default (r=1, pinned); these rates exist so the
+    # default can be flipped on evidence, not on a guess. Single TPU chip
+    # only: the multi-device mesh path never reads the knob (measuring it
+    # there would report meaningless ~1.0 speedups), and interpret mode
+    # measures nothing.
+    try:
+        if jax.devices()[0].platform == "tpu" and len(jax.local_devices()) == 1:
+            for r in (2, 4):
+                os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = str(r)
+                try:
+                    mash_distance_matrix(packed, k=K, tile=TILE)  # variant compile
+                    dt_r = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
+                    out[f"rows_per_iter_{r}"] = {
+                        "pairs_per_sec_per_chip": round(pairs / dt_r, 1),
+                        "speedup_vs_default": round(dt / dt_r, 3),
+                    }
+                except Exception as e:  # a failed DIAGNOSTIC must not cost the headline
+                    out[f"rows_per_iter_{r}"] = {"error": repr(e)}
+    finally:
+        if prev_r is None:
+            os.environ.pop("DREP_TPU_MASH_ROWS_PER_ITER", None)
+        else:
+            os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = prev_r
+    return out
 
 
 def _secondary_pack():
