@@ -194,16 +194,19 @@ def test_streaming_unsupported_alg_errors_via_controller(tmp_path, genome_paths)
 def test_overlap_ingest_identical_results(tmp_path, genome_paths):
     """The compile-warmup overlap must not change results: identical Cdb
     with --no_overlap_ingest (it computes throwaway data by construction;
-    this pins it)."""
+    this pins it). The overlapped run uses a SPAWNED ingest pool — the
+    combination the overlap guard used to forbid when ingest forked."""
     from drep_tpu.workflows import compare_wrapper
 
     on = compare_wrapper(
         str(tmp_path / "wd_on"), genome_paths,
         streaming_primary=True, overlap_ingest=True, skip_plots=True,
+        processes=2,
     )
     off = compare_wrapper(
         str(tmp_path / "wd_off"), genome_paths,
         streaming_primary=True, overlap_ingest=False, skip_plots=True,
+        processes=2,  # overlap must stay the ONLY variable between runs
     )
     on = on.sort_values("genome").reset_index(drop=True)
     off = off.sort_values("genome").reset_index(drop=True)
