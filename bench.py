@@ -653,6 +653,10 @@ def _require_devices(timeout_s: float = 240.0) -> None:
             else f"jax backend init/execution probe did not complete within "
             f"{timeout_s:.0f}s (wedged TPU tunnel?) — no measurements taken"
         )
+        try:
+            from drep_tpu import __version__ as version
+        except Exception:
+            version = None
         print(
             json.dumps(
                 {
@@ -660,6 +664,7 @@ def _require_devices(timeout_s: float = 240.0) -> None:
                     "value": None,
                     "unit": "pairs/s",
                     "vs_baseline": None,
+                    "drep_tpu_version": version,
                     "error": err,
                 }
             ),
@@ -671,6 +676,10 @@ def _require_devices(timeout_s: float = 240.0) -> None:
 def _emit(stages: dict) -> None:
     """The one JSON line the driver records. Callable from the watchdog,
     so a mid-run tunnel wedge still reports every stage measured so far."""
+    try:
+        from drep_tpu import __version__ as version
+    except Exception:  # provenance must never block the record
+        version = None
     head = stages.get("primary", {})
     print(
         json.dumps(
@@ -679,6 +688,7 @@ def _emit(stages: dict) -> None:
                 "value": head.get("pairs_per_sec_per_chip"),
                 "unit": "pairs/s",
                 "vs_baseline": head.get("vs_baseline"),
+                "drep_tpu_version": version,
                 "stages": stages,
             }
         ),
