@@ -149,32 +149,36 @@ def bench_primary() -> dict:
     # a leftover operator export must not silently change what the
     # recorded number measures (variants are reported separately below)
     prev_r = os.environ.get("DREP_TPU_MASH_ROWS_PER_ITER")
-    os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = "1"
-    mash_distance_matrix(packed, k=K, tile=TILE)  # compile warmup at full shape
-    dt = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
-    pairs = N_GENOMES * (N_GENOMES - 1) / 2
-    s2 = max(128, next_pow2(SKETCH_SIZE))
-    # HBM per 128x128 pair tile: two [128, s2] s32 reads + [128, 128] write,
-    # over the wrapped symmetric grid (~half the full tile count)
-    t = N_GENOMES // 128
-    n_tiles = t * (t // 2 + 1)
-    hbm = n_tiles * (2 * 128 * s2 * 4 + 128 * 128 * 4)
-    out = {
-        "n_genomes": N_GENOMES,
-        "sketch": SKETCH_SIZE,
-        **_rate_fields(pairs, dt),
-        **_merge_roofline(pairs, s2, hbm, dt),
-    }
-
-    # kernel-variant diagnostics: measure the row-batched mash kernel
-    # (DREP_TPU_MASH_ROWS_PER_ITER — correctness equality-tested in
-    # tests/test_pallas_mash.py) on the same workload. The headline above
-    # is the shipped default (r=1, pinned); these rates exist so the
-    # default can be flipped on evidence, not on a guess. Single TPU chip
-    # only: the multi-device mesh path never reads the knob (measuring it
-    # there would report meaningless ~1.0 speedups), and interpret mode
-    # measures nothing.
+    # try/finally opens IMMEDIATELY after saving prev_r: if the headline
+    # measurement itself raises (the stage watchdog swallows it and moves
+    # on), the operator's env value must not stay pinned to "1" for every
+    # later stage in the process
     try:
+        os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = "1"
+        mash_distance_matrix(packed, k=K, tile=TILE)  # compile warmup at full shape
+        dt = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
+        pairs = N_GENOMES * (N_GENOMES - 1) / 2
+        s2 = max(128, next_pow2(SKETCH_SIZE))
+        # HBM per 128x128 pair tile: two [128, s2] s32 reads + [128, 128]
+        # write, over the wrapped symmetric grid (~half the full tile count)
+        t = N_GENOMES // 128
+        n_tiles = t * (t // 2 + 1)
+        hbm = n_tiles * (2 * 128 * s2 * 4 + 128 * 128 * 4)
+        out = {
+            "n_genomes": N_GENOMES,
+            "sketch": SKETCH_SIZE,
+            **_rate_fields(pairs, dt),
+            **_merge_roofline(pairs, s2, hbm, dt),
+        }
+
+        # kernel-variant diagnostics: measure the row-batched mash kernel
+        # (DREP_TPU_MASH_ROWS_PER_ITER — correctness equality-tested in
+        # tests/test_pallas_mash.py) on the same workload. The headline
+        # above is the shipped default (r=1, pinned); these rates exist so
+        # the default can be flipped on evidence, not on a guess. Single
+        # TPU chip only: the multi-device mesh path never reads the knob
+        # (measuring it there would report meaningless ~1.0 speedups), and
+        # interpret mode measures nothing.
         if jax.devices()[0].platform == "tpu" and len(jax.local_devices()) == 1:
             for r in (2, 4):
                 os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = str(r)
@@ -719,7 +723,6 @@ def main() -> None:
     # same guard as the CLI
     _honor_jax_platforms_env()
     enable_persistent_cache()
-    _require_devices()
     ap = argparse.ArgumentParser()
     ap.add_argument(
         "--stages",
@@ -731,9 +734,11 @@ def main() -> None:
     args = ap.parse_args()
     # drop any stale partial from a previous killed run here — after
     # argparse (--help / usage errors must not destroy a recovery record)
-    # but before the device probe, which can hang and get killed; a file
-    # that survives this run must belong to THIS run
+    # but BEFORE the device probe: the probe can hang and get the process
+    # killed, and a previous run's partial surviving that kill would be
+    # misattributed to this run
     _clear_partial()
+    _require_devices()
     want = (
         set(args.stages.split(","))
         if args.stages != "all"

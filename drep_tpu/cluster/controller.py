@@ -441,7 +441,14 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
             batches[-1].append(item)
             rows += len(item[1])
         for batch in batches:
-            pairs_in_batch = sum(len(ix) * (len(ix) - 1) // 2 for _, ix in batch)
+            # under greedy the counter means "comparisons the greedy scan
+            # consumed" (len(ndb)) on BOTH routes, so the reported number
+            # does not depend on whether a cluster rode the batched or the
+            # per-cluster path; without greedy it is true all-pairs work
+            pairs_in_batch = (
+                0 if greedy
+                else sum(len(ix) * (len(ix) - 1) // 2 for _, ix in batch)
+            )
             with counters.stage("secondary_compare", pairs=pairs_in_batch):
                 outs = batched_fn(
                     gs, [ix for _, ix in batch], mesh_shape=kw["mesh_shape"]
@@ -451,6 +458,7 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                     from drep_tpu.cluster.greedy import greedy_assign_from_matrices
 
                     ndb, labels = greedy_assign_from_matrices(gs, indices, pc, kw, ani, cov)
+                    counters.stages["secondary_compare"].pairs += len(ndb)
                     results[pc] = (ndb, labels, np.empty((0, 4)))
                 else:
                     results[pc] = _secondary_postprocess(gs, indices, pc, kw, ani, cov)
