@@ -153,7 +153,12 @@ def primary_jax_mash(
 # The beyond-budget dispatch weighs merge work (2*s2*log2(2*s2)
 # units/pair) against chunked-matmul work (v_pad columns/pair) with this
 # penalty on the merge side; the merge only wins when the vocabulary
-# outgrows 15x the merge units (very diverse clusters)
+# outgrows 15x the merge units (very diverse clusters).
+# bench.py::bench_dispatch_crossover re-derives this constant from BOTH
+# kernels measured at 4 vocab/merge-unit ratios (~8x..100x, all honestly
+# reachable shapes) and reports `fitted_elem_cost` +
+# `shipped_matches_measured` in the BENCH record — update this value when
+# a recorded crossover table disagrees by >2x.
 MERGE_VS_MATMUL_ELEM_COST = 15.0
 
 
@@ -168,6 +173,17 @@ def beyond_budget_secondary_path(sketch_width: int, v_pad: int) -> str:
     if MERGE_VS_MATMUL_ELEM_COST * merge_units < v_pad:
         return "pallas_range"
     return "matmul_chunked"
+
+
+# observability: how many containment_matrices calls each kernel path
+# served this process — bench_e2e diffs it around a run to PROVE which
+# regime (one-shot vs beyond-budget) an end-to-end measurement exercised,
+# instead of inferring it from planted-vocabulary arithmetic
+SECONDARY_PATH_COUNTS: dict[str, int] = {}
+
+
+def _count_path(path: str) -> None:
+    SECONDARY_PATH_COUNTS[path] = SECONDARY_PATH_COUNTS.get(path, 0) + 1
 
 
 def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: int = 128):
@@ -198,18 +214,23 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
 
     v_pad = matmul_vocab_pad(packed)  # one scan; budget uses the REAL width
     if matmul_rows_pad(packed.n) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS:
+        _count_path("one_shot")
         return all_vs_all_containment_matmul(packed, k=k, v_pad=v_pad)
     mesh = _mesh_or_none(mesh_shape, packed.n)
     if mesh is not None:
         from drep_tpu.parallel.allpairs import sharded_containment_allpairs
 
+        _count_path("mesh_ring")
         return sharded_containment_allpairs(packed, k=k, mesh=mesh)
     if jax.devices()[0].platform == "tpu":
         if beyond_budget_secondary_path(packed.sketch_size, v_pad) == "pallas_range":
             from drep_tpu.ops.pallas_merge import all_vs_all_containment_pallas
 
+            _count_path("pallas_range")
             return all_vs_all_containment_pallas(packed, k=k)
+        _count_path("matmul_chunked")
         return all_vs_all_containment_matmul_chunked(packed, k=k)
+    _count_path("cpu_tiles")
     return all_vs_all_containment(packed, k=k, tile=tile)
 
 

@@ -1,0 +1,120 @@
+"""ARI acceptance at PRODUCTION sketch depth (VERDICT r3 missing #3).
+
+The 200-genome realistic oracle (test_ari_paths) runs 60 kb genomes ->
+~300-wide scaled sketches at scale=200. Production MAGs are Mb-class ->
+~17k-wide sketches, 60x the estimator depth: estimator variance, the
+cov_thresh gate, and the containment->ANI transform all behave differently
+there. This module plants the same realistic divergence structure (subs +
+indels + duplications + rearrangements + size asymmetry straddling the
+S_ani=0.95 cliff) on 3.5 Mb genomes, runs the REAL ingest (native C++ path
+when available) and the full compare pipeline, and asserts >=99% ARI at
+depth.
+
+The production-width KERNELS (vocab-chunked matmul / range merge) are tied
+in by exact equality on the same real sketches: the chunked kernel must
+reproduce the one-shot intersection counts bit-for-bit at this width, so
+the ARI measured through the pipeline transfers to the beyond-budget
+regime without needing 512 Mb-class genomes in a unit test.
+
+Numbers recorded in PARITY.md ("ARI at production depth").
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "genomes"))
+from generate import evolve, random_genome, write_fasta  # noqa: E402
+
+from test_ari_concordance import adjusted_rand_index  # noqa: E402
+
+N_ROOTS = 6
+N_SECONDARY = 2
+N_MEMBERS = 4
+GENOME_LEN = 3_500_000  # -> ~17.5k scaled hashes at scale=200, width 32768
+
+SIZE_FRACS = [0.0, 0.35, -0.2, 0.15]
+
+
+@pytest.fixture(scope="module")
+def planted_mb(tmp_path_factory):
+    rng = np.random.default_rng(44)
+    out = tmp_path_factory.mktemp("planted_mb")
+    paths, truth = [], []
+    for p in range(N_ROOTS):
+        root = random_genome(rng, GENOME_LEN)
+        for s in range(N_SECONDARY):
+            ancestor = evolve(
+                rng, root, 0.03,
+                indel_rate=1.5e-4, n_duplications=2, n_rearrangements=2,
+            )
+            for m in range(N_MEMBERS):
+                seq = evolve(
+                    rng, ancestor, 0.008,
+                    indel_rate=1e-4, n_duplications=1, n_rearrangements=1,
+                    size_frac=SIZE_FRACS[m],
+                )
+                name = f"p{p}s{s}m{m}"
+                path = str(out / f"{name}.fasta")
+                write_fasta(path, seq, n_contigs=40, name=name)
+                paths.append(path)
+                truth.append((p, s))
+    return paths, truth
+
+
+@pytest.mark.slow
+def test_ari_at_production_depth(tmp_path, planted_mb):
+    from drep_tpu.ingest import DEFAULT_SCALE, _load
+    from drep_tpu.workflows import compare_wrapper
+    from drep_tpu.workdir import WorkDirectory
+
+    paths, truth = planted_mb
+    wd_path = str(tmp_path / "wd")
+    cdb = compare_wrapper(wd_path, paths, skip_plots=True)
+    order = {os.path.basename(p): i for i, p in enumerate(paths)}
+    cdb = cdb.sort_values("genome", key=lambda s: s.map(order))
+
+    ari_p = adjusted_rand_index([p for p, _ in truth], list(cdb["primary_cluster"]))
+    ari_s = adjusted_rand_index(truth, list(cdb["secondary_cluster"]))
+
+    # depth: the pipeline's own cached sketches must be production-width
+    gs = _load(WorkDirectory(wd_path), 21, 1000, DEFAULT_SCALE)
+    widths = np.array([len(s) for s in gs.scaled])
+    print(
+        f"\nARI at production depth: primary={ari_p:.4f} secondary={ari_s:.4f} "
+        f"scaled width median={int(np.median(widths))} max={int(widths.max())}"
+    )
+    assert np.median(widths) >= 15_000, "not production sketch depth"
+    assert ari_p == 1.0, f"primary ARI {ari_p}"
+    assert ari_s >= 0.99, f"secondary ARI {ari_s}"
+
+
+@pytest.mark.slow
+def test_production_kernels_exact_on_real_depth_sketches(tmp_path, planted_mb):
+    """The beyond-budget chunked kernel reproduces the one-shot matmul and
+    the searchsorted oracle EXACTLY on real ingested Mb-class sketches —
+    the equality that transfers the pipeline ARI to the production-width
+    kernel regime."""
+    from drep_tpu.ingest import make_bdb, sketch_genomes
+    from drep_tpu.ops.containment import (
+        all_vs_all_containment,
+        all_vs_all_containment_matmul,
+        all_vs_all_containment_matmul_chunked,
+        pack_scaled_sketches,
+    )
+
+    paths, _truth = planted_mb
+    sub = paths[: 2 * N_SECONDARY * N_MEMBERS]  # two full roots, 16 genomes
+    gs = sketch_genomes(make_bdb(sub))
+    packed = pack_scaled_sketches(gs.scaled, gs.names)
+    assert packed.sketch_size >= 16_384, "not production packed width"
+
+    ani_one, cov_one = all_vs_all_containment_matmul(packed, k=gs.k)
+    ani_chk, cov_chk = all_vs_all_containment_matmul_chunked(packed, k=gs.k)
+    ani_ss, cov_ss = all_vs_all_containment(packed, k=gs.k)
+    np.testing.assert_array_equal(ani_one, ani_chk)
+    np.testing.assert_array_equal(cov_one, cov_chk)
+    np.testing.assert_allclose(ani_ss, ani_one, atol=1e-6)
+    np.testing.assert_allclose(cov_ss, cov_one, atol=1e-6)

@@ -1,0 +1,72 @@
+"""CPU-side correctness for the bench's production-width composition.
+
+VERDICT r3 weak #5: the e2e bench planted toy-width (1200) scaled sketches,
+so the end-to-end path never composed with the beyond-budget chunked/range
+secondary kernels. bench.py now takes a scaled-width knob and ships an
+`e2e_prod` stage (n=5k at s_scaled=20k on TPU); these tests pin — on the
+8-virtual-device CPU mesh — that the composition is CORRECT at reduced n:
+the planted clusters come back, resume rebuilds identical Cdb, and the
+secondary stage verifiably left the one-shot regime (engine path counter,
+not planted-vocabulary arithmetic).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+import bench  # noqa: E402
+
+
+def test_crossover_pack_invariants(rng):
+    m, width, fill, v = 32, 128, 100, 2000
+    packed = bench._crossover_pack(m, width, fill, v, rng)
+    assert packed.ids.shape == (m, width)
+    assert (packed.counts == fill).all()
+    real = packed.ids[packed.ids != np.int32(2**31 - 1)]
+    # extent is exactly v and every id in [0, v) appears (the dense-remap
+    # invariant the sweep's "honestly reachable" claim rests on)
+    assert real.max() == v - 1
+    assert len(np.unique(real)) == v
+    rows = np.sort(packed.ids[:, :fill], axis=1)
+    assert (np.diff(rows, axis=1) > 0).all(), "rows must be sorted unique"
+
+
+def test_crossover_pack_chunked_matches_oracle(rng):
+    from drep_tpu.ops.containment import all_vs_all_containment_matmul_chunked
+
+    m, width, fill, v = 24, 128, 96, 1500
+    packed = bench._crossover_pack(m, width, fill, v, rng)
+    ani, cov = all_vs_all_containment_matmul_chunked(packed, k=21)
+    for i in range(0, m, 5):
+        ai = packed.ids[i, :fill]
+        for j in range(0, m, 7):
+            bj = packed.ids[j, :fill]
+            want = len(np.intersect1d(ai, bj)) / fill
+            got = want if i == j else cov[i, j]
+            assert abs(cov[i, j] - (1.0 if i == j else want)) < 1e-6, (i, j, got)
+
+
+@pytest.mark.slow
+def test_e2e_prod_width_composition():
+    """bench_e2e at production scaled depth (20k -> packed width 32768),
+    reduced n: clusters recovered, resume identical, and the secondary
+    stage ran OUTSIDE the one-shot indicator regime."""
+    res = bench.bench_e2e(300, s_scaled=20_000)
+    assert res["s_scaled"] == 20_000
+    assert res["scaled_width_max"] > 16_384, "not production depth"
+    assert res["resume_clusters_match"] is True
+    # every planted primary cluster is internally ~0.9985 ANI and
+    # cross-cluster ~0: secondary must not split any primary cluster
+    assert res["secondary_clusters"] == res["primary_clusters"]
+    paths = res["secondary_paths"]
+    assert paths, "no containment_matrices calls recorded"
+    assert "one_shot" not in paths, (
+        f"production-width batches stayed in the one-shot regime: {paths} "
+        "— the stage is not exercising the beyond-budget kernels"
+    )
