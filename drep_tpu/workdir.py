@@ -35,6 +35,35 @@ LEGACY_SNAPSHOT_DEFAULTS: dict[str, Any] = {
 }
 
 
+def _atomic_write(loc: str, write_fn) -> None:
+    """Write via a uuid-unique temp file + os.replace.
+
+    Two reasons, both observed deployment shapes: (a) a kill mid-write must
+    not leave a torn table that a later RESUME trusts (the workdir IS the
+    checkpoint system); (b) on a shared-filesystem workdir every process of
+    a multi-host run stores the same replicated tables — concurrent
+    identical writes must land whole-file-or-not-at-all. uuid, not pid:
+    pids collide ACROSS hosts/containers of a pod (same hazard
+    utils/ckptmeta.py::atomic_write_bytes documents).
+
+    `np.savez_compressed` appends ``.npz`` to names without it, so the temp
+    name keeps the real suffix and inserts the qualifier before it.
+    """
+    import uuid
+
+    base, suffix = os.path.splitext(loc)
+    tmp = f"{base}.tmp{uuid.uuid4().hex}{suffix}"
+    try:
+        write_fn(tmp)
+        os.replace(tmp, loc)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def _json_default(o: Any):
     if isinstance(o, (np.integer,)):
         return int(o)
@@ -65,7 +94,7 @@ class WorkDirectory:
 
     def store_db(self, df: pd.DataFrame, name: str) -> None:
         loc = self._table_loc(name)
-        df.to_csv(loc, index=False)
+        _atomic_write(loc, lambda tmp: df.to_csv(tmp, index=False))
         get_logger().debug("stored table %s (%d rows) -> %s", name, len(df), loc)
 
     def get_db(self, name: str) -> pd.DataFrame:
@@ -82,7 +111,7 @@ class WorkDirectory:
         return os.path.join(self.location, "data", "arrays", f"{name}.npz")
 
     def store_arrays(self, name: str, **arrays: np.ndarray) -> None:
-        np.savez_compressed(self._array_loc(name), **arrays)
+        _atomic_write(self._array_loc(name), lambda tmp: np.savez_compressed(tmp, **arrays))
 
     def get_arrays(self, name: str) -> dict[str, np.ndarray]:
         with np.load(self._array_loc(name), allow_pickle=False) as z:
@@ -96,8 +125,11 @@ class WorkDirectory:
         return os.path.join(self.location, "log", f"{stage}_arguments.json")
 
     def store_arguments(self, stage: str, kwargs: dict[str, Any]) -> None:
-        with open(self._args_loc(stage), "w") as f:
-            json.dump(kwargs, f, indent=1, sort_keys=True, default=_json_default)
+        def write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                json.dump(kwargs, f, indent=1, sort_keys=True, default=_json_default)
+
+        _atomic_write(self._args_loc(stage), write)
 
     def get_arguments(self, stage: str) -> dict[str, Any] | None:
         loc = self._args_loc(stage)

@@ -85,8 +85,149 @@ def main() -> None:
     assert np.array_equal(jj1[o1], jj2[o2])
     assert np.array_equal(dd1[o1], dd2[o2])
 
+    _combo_shared_workdir(pid, nproc, outdir)
+
     with open(os.path.join(outdir, f"ok_{pid}"), "w") as f:
         f.write("ok")
+
+
+# 9 row blocks at the effective block of 8 (streaming clamps the requested
+# block to a multiple the kernels accept — _effective_block): every process
+# of 4 owns >= 2 interleaved stripes (3/2/2/2)
+COMBO_N = 68
+COMBO_BLOCK = 8
+COMBO_SIZES = [12, 9, 8, 7, 6, 6, 5, 4, 4, 3, 2, 1, 1]  # heavy-ish tail, sums to 68
+COMBO_S_BOTTOM = 48  # planted bottom-sketch width == the wrapper's MASH_sketch
+
+
+def plant_combo_sketches():
+    """Deterministic cluster-structured GenomeSketches — the SAME recipe in
+    every worker process and in the pytest process's single-process oracle
+    run (seeded, so all builds see identical sketches)."""
+    import pandas as pd
+
+    from drep_tpu.ingest import DEFAULT_SCALE, GenomeSketches
+
+    assert sum(COMBO_SIZES) == COMBO_N
+    rng = np.random.default_rng(21)
+    s_bottom, s_scaled = COMBO_S_BOTTOM, 300
+    names, bottoms, scaleds = [], [], []
+    gi = 0
+    for size in COMBO_SIZES:
+        pool_b = np.unique(rng.integers(0, 2**62, size=2 * s_bottom, dtype=np.uint64))
+        pool_s = np.unique(rng.integers(0, 2**62, size=int(1.2 * s_scaled), dtype=np.uint64))
+        for _ in range(size):
+            keep_b = pool_b[rng.random(len(pool_b)) < 0.90]
+            own_b = np.unique(rng.integers(0, 2**62, size=s_bottom // 6, dtype=np.uint64))
+            bottoms.append(np.sort(np.concatenate([keep_b, own_b]))[:s_bottom])
+            keep_s = pool_s[rng.random(len(pool_s)) < 0.97]
+            own_s = np.unique(rng.integers(0, 2**62, size=s_scaled // 25, dtype=np.uint64))
+            scaleds.append(np.sort(np.concatenate([keep_s, own_s])))
+            names.append(f"combo_{gi}.fasta")
+            gi += 1
+    gdb = pd.DataFrame(
+        {
+            "genome": names,
+            "length": np.full(COMBO_N, 1_000_000, np.int64),
+            "N50": np.full(COMBO_N, 50_000, np.int64),
+            "contigs": np.full(COMBO_N, 10, np.int64),
+            "n_kmers": np.full(COMBO_N, 970_000, np.int64),
+        }
+    )
+    return GenomeSketches(
+        names=names, gdb=gdb, bottom=bottoms, scaled=scaleds,
+        k=21, sketch_size=s_bottom, scale=DEFAULT_SCALE,
+    )
+
+
+def run_combo_wrapper(wd_path: str):
+    """The streaming+greedy north-star combo against a (possibly shared)
+    workdir; returns the Cdb. Used by the workers (shared workdir, 2-4
+    processes) AND by the pytest process (private workdir, 1 process)."""
+    import pandas as pd
+
+    from drep_tpu.cluster.controller import d_cluster_wrapper
+    from drep_tpu.ingest import DEFAULT_SCALE, _save, sketch_args_snapshot
+    from drep_tpu.workdir import WorkDirectory
+
+    gs = plant_combo_sketches()
+    wd = WorkDirectory(wd_path)
+    bdb = pd.DataFrame(
+        {"genome": gs.names, "location": [f"/nonexistent/{g}" for g in gs.names]}
+    )
+    _save(wd, gs)
+    wd.store_arguments(
+        "sketch",
+        sketch_args_snapshot(bdb["genome"], 21, gs.sketch_size, DEFAULT_SCALE, "splitmix64"),
+    )
+    cdb = d_cluster_wrapper(
+        wd, bdb,
+        streaming_primary=True,
+        streaming_block=COMBO_BLOCK,
+        greedy_secondary_clustering=True,
+        # the sketch-cache compatibility key includes the sketch size; the
+        # planted bottom sketches are 48-wide, so the wrapper must ask for
+        # 48 or it will miss the cache and try to read /nonexistent FASTAs
+        MASH_sketch=COMBO_S_BOTTOM,
+    )
+    return cdb
+
+
+def partition(cdb, column: str) -> set[frozenset]:
+    groups: dict = {}
+    for g, c in zip(cdb["genome"], cdb[column]):
+        groups.setdefault(c, set()).add(g)
+    return {frozenset(v) for v in groups.values()}
+
+
+def truth_partition() -> set[frozenset]:
+    out, gi = [], 0
+    for size in COMBO_SIZES:
+        out.append(frozenset(f"combo_{g}.fasta" for g in range(gi, gi + size)))
+        gi += size
+    return set(out)
+
+
+def _combo_shared_workdir(pid: int, nproc: int, outdir: str) -> None:
+    """The production multi-host deployment shape (SURVEY.md §5.8): every
+    process runs the streaming+greedy combo against ONE shared-filesystem
+    workdir. Stripe ownership must interleave (each process owns >= 2 row
+    blocks), the replicated table writes must coexist (atomic store_db),
+    and a table-dropped re-run must resume from the shared shards without
+    rewriting any of them."""
+    from jax.experimental import multihost_utils as mhu
+
+    n_blocks = -(-COMBO_N // COMBO_BLOCK)
+    my_stripes = [bi for bi in range(n_blocks) if bi % nproc == pid]
+    assert len(my_stripes) >= 2, (
+        f"pid {pid}/{nproc}: only {len(my_stripes)} stripes — the test is "
+        "not exercising interleaved multi-stripe ownership"
+    )
+
+    wd_path = os.path.join(outdir, "combo_wd")
+    cdb = run_combo_wrapper(wd_path)
+    assert partition(cdb, "secondary_cluster") == truth_partition(), "combo clusters"
+
+    shard_dir = os.path.join(wd_path, "data", "streaming_primary")
+    shards = sorted(f for f in os.listdir(shard_dir) if f.startswith("row_"))
+    assert len(shards) == n_blocks, (shards, n_blocks)
+    mtimes = {f: os.stat(os.path.join(shard_dir, f)).st_mtime_ns for f in shards}
+
+    # drop the assembled tables (kill between secondary and Cdb assembly);
+    # shard-level state stays. pid 0 deletes, everyone re-runs after the
+    # barrier — the resume must rebuild identical clusters from shards.
+    mhu.sync_global_devices("combo_tables_drop")
+    if pid == 0:
+        for tbl in ("Cdb", "Ndb", "Mdb"):
+            p = os.path.join(wd_path, "data_tables", f"{tbl}.csv")
+            assert os.path.exists(p), f"workdir layout changed? missing {p}"
+            os.remove(p)
+    mhu.sync_global_devices("combo_resume")
+    cdb2 = run_combo_wrapper(wd_path)
+    assert partition(cdb2, "secondary_cluster") == truth_partition(), "resume clusters"
+    mtimes2 = {f: os.stat(os.path.join(shard_dir, f)).st_mtime_ns for f in shards}
+    assert mtimes == mtimes2, "resume rewrote streaming shards instead of loading them"
+    mhu.sync_global_devices("combo_done")
 
 
 if __name__ == "__main__":

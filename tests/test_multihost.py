@@ -1,17 +1,23 @@
-"""Two real `jax.distributed` CPU processes must agree with single-process.
+"""Real `jax.distributed` CPU processes must agree with single-process.
 
 The reference has no multi-node story at all (SURVEY.md §2c); this is the
 rebuild's v5e-pod contract (SURVEY.md §5.8) tested the only way it can be
-without a pod: two OS processes, two forced-host CPU devices each, a real
-coordinator handshake, and the assertion that the mesh-sharded ring
-all-pairs and the striped streaming path both reproduce the dense
-single-process numbers exactly.
+without a pod: 2 and 4 OS processes, two forced-host CPU devices each, a
+real coordinator handshake, and the assertions that (a) the mesh-sharded
+ring all-pairs and the striped streaming path reproduce the dense
+single-process numbers exactly, and (b) the streaming+greedy north-star
+combo over one SHARED workdir — every process owning >= 2 interleaved
+row-block stripes — yields the same Cdb partition as a single-process run,
+and resumes from the shared shards without rewriting them.
 """
 
 import os
 import socket
 import subprocess
 import sys
+
+import pandas as pd
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_multihost_worker.py")
@@ -25,7 +31,18 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_distributed_matches_single(tmp_path):
+@pytest.fixture(scope="session")
+def single_cdb(tmp_path_factory):
+    """The single-process streaming+greedy oracle Cdb — computed once for
+    every nproc parametrization (the planted data is identical)."""
+    sys.path.insert(0, os.path.dirname(WORKER))
+    import _multihost_worker as w
+
+    return w.run_combo_wrapper(str(tmp_path_factory.mktemp("single_wd")))
+
+
+@pytest.mark.parametrize("nproc", [2, pytest.param(4, marks=pytest.mark.slow)])
+def test_distributed_matches_single(tmp_path, nproc, single_cdb):
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -33,22 +50,22 @@ def test_two_process_distributed_matches_single(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), "2", f"localhost:{port}", str(tmp_path)],
+            [sys.executable, WORKER, str(i), str(nproc), f"localhost:{port}", str(tmp_path)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             cwd=REPO,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=420)
+            out, _ = p.communicate(timeout=600)
             outs.append(out.decode(errors="replace"))
     finally:
         # a dead worker leaves its peer blocked in a collective — always
-        # reap both so a failure can't leak orphans holding the port
+        # reap all so a failure can't leak orphans holding the port
         for p in procs:
             if p.poll() is None:
                 p.kill()
@@ -56,3 +73,16 @@ def test_two_process_distributed_matches_single(tmp_path):
     for i, p in enumerate(procs):
         assert p.returncode == 0, f"worker {i} failed:\n{outs[i]}"
         assert (tmp_path / f"ok_{i}").exists(), f"worker {i} wrote no ok-file:\n{outs[i]}"
+
+    # the shared-workdir Cdb the pod produced must match a single-process
+    # run of the same planted data, as a cluster partition (labels may
+    # permute; membership may not)
+    import _multihost_worker as w
+
+    pod_cdb = pd.read_csv(tmp_path / "combo_wd" / "data_tables" / "Cdb.csv")
+    assert w.partition(pod_cdb, "secondary_cluster") == w.partition(
+        single_cdb, "secondary_cluster"
+    )
+    assert w.partition(pod_cdb, "primary_cluster") == w.partition(
+        single_cdb, "primary_cluster"
+    )
