@@ -36,14 +36,47 @@ def content_fingerprint(names: Iterable[str], *arrays: np.ndarray) -> str:
     return h.hexdigest()
 
 
+def atomic_write(path: str, write_fn, keep_suffix: bool = False) -> None:
+    """THE whole-file-or-nothing write primitive (kills mid-write must not
+    leave torn files a later resume trusts; replicated multi-host writers
+    of the same target must never interleave — uuid tmp names because pids
+    collide ACROSS hosts/containers of a pod). `write_fn(tmp)` produces
+    the content; a raising write_fn leaves no orphan tmp behind.
+
+    `keep_suffix` picks the tmp-name shape, and the two shapes serve
+    CONFLICTING invariants — choose deliberately:
+
+    - False (default): ``<path>.tmp-<uuid>`` — the tmp shares no suffix
+      with the target, so shard-store resume globs (``*.npz``) can never
+      pick up a crash artifact as a corrupt-looking shard (the ingest
+      shard store depends on this).
+    - True: ``<base>.tmp-<uuid><suffix>`` — required when write_fn derives
+      the real output name from the suffix (``np.savez_compressed``
+      appends ``.npz`` to names without it, which would orphan the
+      suffixless tmp). Only safe where nothing globs the target's suffix
+      (the workdir array store).
+    """
+    base, suffix = os.path.splitext(path)
+    tmp = (
+        f"{base}.tmp-{uuid.uuid4().hex}{suffix}"
+        if keep_suffix
+        else f"{path}.tmp-{uuid.uuid4().hex}"
+    )
+    try:
+        write_fn(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+
+
 def atomic_write_bytes(path: str, data: bytes) -> None:
-    # globally-unique tmp name: two writers of the same target (shared
-    # checkpoint dir on a pod — pids can collide ACROSS hosts/containers)
-    # must never interleave into one tmp file
-    tmp = f"{path}.tmp-{uuid.uuid4().hex}"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    def write(tmp: str) -> None:
+        with open(tmp, "wb") as f:
+            f.write(data)
+
+    atomic_write(path, write)
 
 
 def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tuple[str, ...]) -> bool:

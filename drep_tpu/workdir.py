@@ -36,32 +36,17 @@ LEGACY_SNAPSHOT_DEFAULTS: dict[str, Any] = {
 
 
 def _atomic_write(loc: str, write_fn) -> None:
-    """Write via a uuid-unique temp file + os.replace.
+    """Whole-file-or-nothing table/array/args writes: (a) a kill mid-write
+    must not leave a torn table that a later RESUME trusts (the workdir IS
+    the checkpoint system); (b) on a shared-filesystem workdir every
+    process of a multi-host run stores the same replicated tables —
+    concurrent identical writes must coexist. One shared primitive
+    (utils/ckptmeta.py::atomic_write); keep_suffix=True because
+    np.savez_compressed derives its output name from the ``.npz`` suffix,
+    and nothing globs the workdir's table/array suffixes."""
+    from drep_tpu.utils.ckptmeta import atomic_write
 
-    Two reasons, both observed deployment shapes: (a) a kill mid-write must
-    not leave a torn table that a later RESUME trusts (the workdir IS the
-    checkpoint system); (b) on a shared-filesystem workdir every process of
-    a multi-host run stores the same replicated tables — concurrent
-    identical writes must land whole-file-or-not-at-all. uuid, not pid:
-    pids collide ACROSS hosts/containers of a pod (same hazard
-    utils/ckptmeta.py::atomic_write_bytes documents).
-
-    `np.savez_compressed` appends ``.npz`` to names without it, so the temp
-    name keeps the real suffix and inserts the qualifier before it.
-    """
-    import uuid
-
-    base, suffix = os.path.splitext(loc)
-    tmp = f"{base}.tmp{uuid.uuid4().hex}{suffix}"
-    try:
-        write_fn(tmp)
-        os.replace(tmp, loc)
-    finally:
-        if os.path.exists(tmp):
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
+    atomic_write(loc, write_fn, keep_suffix=True)
 
 
 def _json_default(o: Any):

@@ -31,6 +31,7 @@ Two planting modes:
 """
 
 import json
+import logging
 import os
 import resource
 import sys
@@ -39,6 +40,11 @@ import time
 
 import numpy as np
 import pandas as pd
+
+# surface the pipeline's own INFO lines (primary cluster counts, shard
+# resume counts, per-stage perf) — without a handler the long
+# d_cluster_wrapper stretch between "forged" and RESULT is a blind spot
+logging.basicConfig(level=logging.INFO, format="%(asctime)s %(levelname)s %(message)s")
 
 # runnable as `python tools/scale_host_validation.py` from anywhere: bench.py
 # and the drep_tpu package live at the repo root, one level up
@@ -90,10 +96,13 @@ def plant_hard(n: int, rng: np.random.Generator):
     gi = 0
     for size in sizes:
         if size == BIG:
-            # bottom-999 shared pool from [0, 2^62); per-member unique tag
-            # from [2^62, 2^63) — strictly larger than every pool hash, so
+            # bottom-999 shared pool from [0, 2^62); per-member unique ODD
+            # tag 2^63 + 2m + 1 (top of uint64 range, above int64) —
+            # strictly larger than every pool hash, so
             # union-bottom-1000(A_i, A_j) = pool + min(tag_i, tag_j) and
-            # every pair shares exactly 999/1000
+            # every pair shares exactly 999/1000. Everything on this path
+            # must stay uint64: an int64 cast would wrap the tags negative
+            # and break the sorted-unique sketch contract
             pool = np.unique(rng.integers(0, 2**62, size=1200, dtype=np.uint64))[:999]
             tags = (2**62 + np.arange(size, dtype=np.uint64)) * np.uint64(2) + np.uint64(1)
             c_scaled = np.unique(rng.integers(0, 2**62, size=int(s_scaled * 1.3), dtype=np.uint64))
