@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import json
 import os
-import uuid
 from typing import Any
 
 import numpy as np
@@ -84,11 +83,17 @@ class SecondaryCheckpoint:
             if col.dtype == object:
                 col = col.astype(str)  # unicode arrays need no pickle
             arrays[f"ndb_col_{c}"] = col
-        tmp = f"{loc}.tmp-{uuid.uuid4().hex}.npz"
+        import io
+
+        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
         # uncompressed: thousands of small per-cluster files per run made
-        # zlib a measured hot spot; the payloads are tiny either way
-        np.savez(tmp, **arrays)
-        os.replace(tmp, loc)  # atomic: no torn checkpoints
+        # zlib a measured hot spot; the payloads are tiny either way.
+        # in-memory serialize + the shared atomic primitive (uuid tmp
+        # outside the .npz namespace, orphan cleanup on failure)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        atomic_write_bytes(loc, buf.getvalue())
 
     def finish(self, n_total: int) -> None:
         if self.dir is None:

@@ -152,21 +152,24 @@ def matmul_vocab_pad(packed: PackedSketches) -> int:
     return _pow2_bucket(max(vocab_extent(packed.ids), 1), _VOCAB_BUCKET_MIN)
 
 
-@functools.partial(jax.jit, static_argnames=("v_pad",))
+@functools.partial(jax.jit, static_argnames=("v_pad", "dtype"))
+def _intersect_matmul_jit(ids, *, v_pad: int, dtype):
+    ind = _indicator(ids, v_pad, dtype)
+    return _int_dot(ind, ind)
+
+
 def _intersect_matmul(ids, *, v_pad: int):
     """Intersection counts as an MXU matmul of 0/1 indicator rows.
 
     inter[i,j] = |A_i ∩ A_j| = <ind_i, ind_j> over the id vocabulary —
-    int8 0/1 inputs with int32 accumulation are EXACT at any count (and
-    the v5e int8 MXU runs 2x its bf16 rate; measured 24% faster end to
-    end at the production chunk shape, scatter included). This is where
+    exact integer counts on both backends (dtype dispatch and exactness
+    bounds in :func:`_indicator_dtype`). This is where
     the systolic array earns its keep: one [m, V] x [V, m] matmul
     replaces m^2 searchsorted passes. Returns int32 counts: the device
     ships ONE integer matrix and the cov/ani elementwise math runs on host
     (host<->device links can be the bottleneck on tunneled TPU setups).
     """
-    ind = _indicator(ids, v_pad)
-    return jnp.dot(ind, ind.T, preferred_element_type=jnp.int32)
+    return _intersect_matmul_jit(ids, v_pad=v_pad, dtype=_indicator_dtype(ids.shape[1]))
 
 
 def ani_cov_from_intersections(
@@ -223,28 +226,82 @@ def matmul_vocab_chunk(m_pad: int) -> int:
 
 
 
-def _indicator(ids, v_pad: int):
-    """[m, v_pad] int8 0/1 indicator from PAD-padded id rows — THE scatter
+def _indicator_dtype(width: int):
+    """Indicator element dtype: int8 on EVERY backend.
+
+    TPU: the v5e int8 MXU runs 2x its bf16 rate (measured 24% faster end
+    to end than bf16 at the production chunk shape, scatter included);
+    int32 accumulation is exact at any count.
+
+    CPU: int8 also wins — a negative result worth recording. A GEMM-only
+    microbenchmark shows XLA:CPU's f32 GEMM 5.4x FASTER than its int8 GEMM
+    on a pre-built [256, 65536] indicator, which suggested dispatching f32
+    off-TPU; but the kernel the engine actually runs fuses the indicator
+    SCATTER with the dot, and the f32 indicator's 4x bytes make the fused
+    kernel 4-7x slower than int8 at every shape measured (17M..268M
+    elements, r4 session). Don't re-split this by platform without timing
+    the fused kernel, not the GEMM.
+
+    `DREP_TPU_INDICATOR_DTYPE` overrides for experiments; the float32
+    override is exact only while counts (bounded by the packed row width)
+    stay below 2^24, checked here (a real raise, not an assert — -O must
+    not turn an exactness violation into silent wrong counts).
+    """
+    import os
+
+    forced = os.environ.get("DREP_TPU_INDICATOR_DTYPE")
+    if forced in (None, "", "int8"):
+        return jnp.int8
+    if forced == "float32":
+        if width >= (1 << 24):
+            raise ValueError(
+                f"packed width {width} overflows exact f32 indicator accumulation"
+            )
+        return jnp.float32
+    # an unknown value must not silently measure the int8 path
+    raise ValueError(
+        f"DREP_TPU_INDICATOR_DTYPE={forced!r}: expected 'int8' or 'float32'"
+    )
+
+
+def _indicator(ids, v_pad: int, dtype):
+    """[m, v_pad] 0/1 indicator from PAD-padded id rows — THE scatter
     every MXU intersection kernel shares (pads land in a trash column that
-    the slice discards)."""
+    the slice discards). `dtype` is resolved OUTSIDE jit (wrappers below)
+    so the env override participates in the compile-cache key."""
     m, s = ids.shape
     rows = jax.lax.broadcasted_iota(jnp.int32, (m, s), 0)
     cols = jnp.where(ids != PAD_ID, ids, v_pad)
-    return jnp.zeros((m, v_pad + 1), jnp.int8).at[rows, cols].set(1)[:, :v_pad]
+    return jnp.zeros((m, v_pad + 1), dtype).at[rows, cols].set(1)[:, :v_pad]
 
 
-@functools.partial(jax.jit, static_argnames=("v_pad",))
+def _int_dot(a, b_t):
+    """Exact int32 intersection counts from two indicator matrices,
+    contracting the vocabulary axis — int32 accumulation for int8 inputs,
+    f32 dot + cast for f32 inputs (exact under _indicator_dtype's width
+    bound)."""
+    if a.dtype == jnp.int8:
+        return jax.lax.dot_general(
+            a, b_t, (((1,), (1,)), ((), ())), preferred_element_type=jnp.int32
+        )
+    return jax.lax.dot_general(
+        a, b_t, (((1,), (1,)), ((), ()))
+    ).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("v_pad", "dtype"))
+def _intersect_matmul_rect_jit(a_ids, b_ids, *, v_pad: int, dtype):
+    return _int_dot(_indicator(a_ids, v_pad, dtype), _indicator(b_ids, v_pad, dtype))
+
+
 def _intersect_matmul_rect(a_ids, b_ids, *, v_pad: int):
-    """Rectangular intersection counts |A_i ∩ B_j| — two int8 indicator
+    """Rectangular intersection counts |A_i ∩ B_j| — two indicator
     scatters, one MXU matmul contracting the vocabulary axis. The greedy
     path's block-vs-representatives comparisons run here on TPU instead of
     through gather tiles (batched gathers serialize on the scalar unit —
     the measured ~70x penalty noted in ops/minhash.py)."""
-    return jax.lax.dot_general(
-        _indicator(a_ids, v_pad), _indicator(b_ids, v_pad),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32,
-    )
+    dt = _indicator_dtype(max(a_ids.shape[1], b_ids.shape[1]))
+    return _intersect_matmul_rect_jit(a_ids, b_ids, v_pad=v_pad, dtype=dt)
 
 
 class VocabChunkGeometry:

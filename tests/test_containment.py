@@ -101,3 +101,28 @@ def test_empty_sketch_row(rng):
 
     a2, c2 = all_vs_all_containment_matmul(packed, k=21)
     assert c2[3, 0] == 0.0 and a2[3, 0] == 0.0
+
+
+def test_indicator_dtype_paths_bit_identical(rng, monkeypatch):
+    """The two indicator dtypes (int8 — the production choice on every
+    backend — and the float32 experiment override, see _indicator_dtype)
+    must produce IDENTICAL int32 counts. Covers the self matmul, the
+    vocab-chunked path, and the rectangular kernel the greedy route
+    uses."""
+    from drep_tpu.ops.containment import (
+        all_vs_all_containment_matmul_chunked,
+        intersect_counts_matmul_rect,
+    )
+
+    sketches = _sketches(rng, n=11, size=350)
+    packed = pack_scaled_sketches(sketches, [f"g{i}" for i in range(11)], pad_multiple=32)
+    out = {}
+    for dt in ("int8", "float32"):
+        monkeypatch.setenv("DREP_TPU_INDICATOR_DTYPE", dt)
+        ani_s, cov_s = all_vs_all_containment_matmul(packed, k=21)
+        ani_c, cov_c = all_vs_all_containment_matmul_chunked(packed, k=21)
+        rect = intersect_counts_matmul_rect(packed.ids[:5], packed.ids[5:])
+        out[dt] = (ani_s, cov_s, ani_c, cov_c, rect)
+        assert rect.dtype == np.int32
+    for a, b in zip(out["int8"], out["float32"]):
+        np.testing.assert_array_equal(a, b)

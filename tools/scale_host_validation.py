@@ -265,17 +265,16 @@ with tempfile.TemporaryDirectory() as td:
     # as resumable — proving the run's own meta computation will match
     open_checkpoint_dir(ckpt, meta, clear_suffixes=(".npz",))
     assert open_checkpoint_dir(ckpt, meta, clear_suffixes=(".npz",))
+    import io
+
+    from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
     blk = ii // block
     for bi in range(n_blocks):
         sel = blk == bi
-        np.savez_compressed(
-            os.path.join(ckpt, f"row_{bi:05d}.npz.tmp.npz"),
-            ii=ii[sel], jj=jj[sel], dist=dd[sel],
-        )
-        os.replace(
-            os.path.join(ckpt, f"row_{bi:05d}.npz.tmp.npz"),
-            os.path.join(ckpt, f"row_{bi:05d}.npz"),
-        )
+        buf = io.BytesIO()
+        np.savez_compressed(buf, ii=ii[sel], jj=jj[sel], dist=dd[sel])
+        atomic_write_bytes(os.path.join(ckpt, f"row_{bi:05d}.npz"), buf.getvalue())
     print(f"forged {n_blocks} shards (block={block})", flush=True)
 
     kw = {"streaming_primary": True}
