@@ -83,17 +83,11 @@ class SecondaryCheckpoint:
             if col.dtype == object:
                 col = col.astype(str)  # unicode arrays need no pickle
             arrays[f"ndb_col_{c}"] = col
-        import io
-
-        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+        from drep_tpu.utils.ckptmeta import atomic_savez
 
         # uncompressed: thousands of small per-cluster files per run made
-        # zlib a measured hot spot; the payloads are tiny either way.
-        # in-memory serialize + the shared atomic primitive (uuid tmp
-        # outside the .npz namespace, orphan cleanup on failure)
-        buf = io.BytesIO()
-        np.savez(buf, **arrays)
-        atomic_write_bytes(loc, buf.getvalue())
+        # zlib a measured hot spot; the payloads are tiny either way
+        atomic_savez(loc, compressed=False, **arrays)
 
     def finish(self, n_total: int) -> None:
         if self.dir is None:

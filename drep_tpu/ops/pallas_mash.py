@@ -123,15 +123,29 @@ def _mash_shared_kernel(s_orig: int, r_iter: int, a_rev_ref, na_ref, b_ref, nb_r
     b3 = jnp.broadcast_to(b_block[None], (r_iter, tb, s2))
 
     def body_r(i, _):
-        a_rows = a_rev_ref[pl.ds(i * r_iter, r_iter), :]  # [R, S2]
+        # Per-row dynamic loads/stores, not a [R, S2] block at offset
+        # i*r_iter: Mosaic requires multi-row vector loads/stores to start
+        # at a sublane multiple of 8, and i*{2,4} is not provably one
+        # (BENCH_r04 attempt 1 recorded the compile failure). Single-row
+        # dynamic indexing is the supported pattern (it is what the
+        # r_iter==1 path compiles to); the batched [R, TB, 2*S2] merge —
+        # the point of the knob — is unchanged.
+        base = i * r_iter
+        a_rows = jnp.concatenate(
+            [a_rev_ref[base + t, :][None, :] for t in range(r_iter)], axis=0
+        )  # [R, S2]
         x = jnp.concatenate(
             [b3, jnp.broadcast_to(a_rows[:, None, :], (r_iter, tb, s2))], axis=2
         )
-        na_rows = na_ref[pl.ds(i * r_iter, r_iter), :]  # [R, 1]
+        na_rows = jnp.concatenate(
+            [na_ref[base + t, :][None, :] for t in range(r_iter)], axis=0
+        )  # [R, 1]
         s_use = jnp.minimum(
             jnp.minimum(na_rows[:, :, None], nb_col[None]), s_orig
         )  # [R, TB, 1]
-        out_ref[pl.ds(i * r_iter, r_iter), :] = _shared_counts(x, length, col3, s_use)
+        res = _shared_counts(x, length, col3, s_use)  # [R, TB]
+        for t in range(r_iter):
+            out_ref[base + t, :] = res[t, :]
         return 0
 
     jax.lax.fori_loop(0, ta // r_iter, body_r, 0)

@@ -349,18 +349,9 @@ def streaming_mash_edges(
         jj = np.concatenate(row_jj) if row_jj else np.empty(0, np.int64)
         dd = np.concatenate(row_dd) if row_dd else np.empty(0, np.float32)
         if shard is not None:
-            import io
+            from drep_tpu.utils.ckptmeta import atomic_savez
 
-            from drep_tpu.utils.ckptmeta import atomic_write_bytes
-
-            # serialize in memory, publish through the shared atomic
-            # primitive: uuid tmp (two writers of one target on a shared
-            # pod filesystem must never interleave) whose name does NOT
-            # end in .npz (crash artifacts must stay outside the shard
-            # namespace the resume path and clear_suffixes glob)
-            buf = io.BytesIO()
-            np.savez_compressed(buf, ii=ii, jj=jj, dist=dd)
-            atomic_write_bytes(shard, buf.getvalue())
+            atomic_savez(shard, ii=ii, jj=jj, dist=dd)
         all_ii.append(ii)
         all_jj.append(jj)
         all_dd.append(dd)

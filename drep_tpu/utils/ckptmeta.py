@@ -71,12 +71,28 @@ def atomic_write(path: str, write_fn, keep_suffix: bool = False) -> None:
                 os.remove(tmp)
 
 
-def atomic_write_bytes(path: str, data: bytes) -> None:
+def atomic_write_bytes(path: str, data) -> None:
     def write(tmp: str) -> None:
         with open(tmp, "wb") as f:
             f.write(data)
 
     atomic_write(path, write)
+
+
+def atomic_savez(path: str, compressed: bool = True, **arrays) -> None:
+    """Serialize arrays to `.npz` IN MEMORY and publish through
+    atomic_write: uuid tmp (two writers of one target on a shared pod
+    filesystem must never interleave) whose name does NOT end in .npz —
+    crash artifacts must stay outside the shard namespace that resume
+    globs and `clear_suffixes` scan. One helper for every shard store
+    (streaming row blocks, per-cluster secondary results) so the
+    atomicity recipe cannot drift between them. `compressed=False` for
+    thousands-of-tiny-files stores where zlib is a measured hot spot."""
+    import io
+
+    buf = io.BytesIO()
+    (np.savez_compressed if compressed else np.savez)(buf, **arrays)
+    atomic_write_bytes(path, buf.getbuffer())
 
 
 def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tuple[str, ...]) -> bool:

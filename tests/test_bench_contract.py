@@ -35,3 +35,17 @@ def test_bench_emits_one_json_line_and_cleans_partials(tmp_path):
     assert doc["metric"] == "genome-pairs/sec/chip"
     assert set(doc) >= {"value", "unit", "vs_baseline", "stages"}
     assert not (tmp_path / "BENCH_PARTIAL.json").exists()
+
+
+def test_bench_rejects_unknown_stage(tmp_path):
+    """--stages is an ORDERED list (the wedge-retry loop feeds reversed
+    orders so a repeatedly-wedging stage can't starve the ones behind it);
+    a typo must fail loudly, not silently run nothing."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--stages", "primary,typo"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path), timeout=300,
+    )
+    assert r.returncode == 2
+    assert "unknown stages" in r.stderr

@@ -1,0 +1,55 @@
+#!/bin/bash
+# Probe-and-retry driver for a wedging TPU tunnel: wait until a trivial
+# device execution completes, then run the full bench; repeat until one
+# bench run finishes cleanly (rc=0). Every attempt's stdout/stderr is kept
+# (bench_r04_attempt<N>.log) and the first clean run's JSON line is copied
+# to BENCH_r04_local.json. Motivation: round 3 lost ALL hardware numbers
+# to a wedged tunnel, and round 4's first attempt lost the e2e/production
+# stages the same way — the tunnel has been observed to recover between
+# wedges, so an unattended retry loop converts recovery windows into
+# measurements.
+cd /root/repo || exit 1
+attempt=${1:-2}
+while true; do
+  if timeout 90 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128))
+jax.block_until_ready(x @ x)
+" >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) tunnel alive, bench attempt ${attempt}" >> bench_retry.log
+    # alternate forward/reversed stage order across attempts: if the
+    # tunnel keeps wedging at one stage, the stages queued behind it
+    # still get measured on the next attempt. EVEN attempts run reversed:
+    # attempt 1 was the session's manual forward run, so the first
+    # unattended attempt (2) must cover the starved tail first. The stage
+    # list itself lives in bench.py (--reverse) — no duplicate to drift
+    if [ $((attempt % 2)) -eq 0 ]; then
+      rev="--reverse"
+    else
+      rev=""
+    fi
+    python bench.py $rev > "bench_r04_attempt${attempt}.log" 2>&1
+    rc=$?
+    echo "$(date -u +%FT%TZ) attempt ${attempt} rc=${rc}" >> bench_retry.log
+    partial="BENCH_r04_attempt${attempt}_partial.json"
+    # no JSON line (killed before any _emit) -> no empty artifact
+    grep -o '{"metric".*' "bench_r04_attempt${attempt}.log" > "$partial" 2>/dev/null \
+      || rm -f "$partial"
+    # a process killed before emitting (OOM/SIGKILL — not the watchdog
+    # path, which emits) leaves its incremental record only in
+    # BENCH_PARTIAL.json, and the NEXT attempt's startup deletes that;
+    # preserve it under a per-attempt name before looping
+    if [ ! -f "$partial" ] && [ -f BENCH_PARTIAL.json ]; then
+      cp BENCH_PARTIAL.json "BENCH_r04_attempt${attempt}_killed_partial.json"
+    fi
+    if [ "$rc" -eq 0 ]; then
+      mv "BENCH_r04_attempt${attempt}_partial.json" BENCH_r04_local.json
+      echo "$(date -u +%FT%TZ) full bench complete at attempt ${attempt}" >> bench_retry.log
+      exit 0
+    fi
+    attempt=$((attempt + 1))
+  else
+    echo "$(date -u +%FT%TZ) tunnel still dead" >> bench_retry.log
+  fi
+  sleep 300
+done

@@ -265,16 +265,15 @@ with tempfile.TemporaryDirectory() as td:
     # as resumable — proving the run's own meta computation will match
     open_checkpoint_dir(ckpt, meta, clear_suffixes=(".npz",))
     assert open_checkpoint_dir(ckpt, meta, clear_suffixes=(".npz",))
-    import io
-
-    from drep_tpu.utils.ckptmeta import atomic_write_bytes
+    from drep_tpu.utils.ckptmeta import atomic_savez
 
     blk = ii // block
     for bi in range(n_blocks):
         sel = blk == bi
-        buf = io.BytesIO()
-        np.savez_compressed(buf, ii=ii[sel], jj=jj[sel], dist=dd[sel])
-        atomic_write_bytes(os.path.join(ckpt, f"row_{bi:05d}.npz"), buf.getvalue())
+        atomic_savez(
+            os.path.join(ckpt, f"row_{bi:05d}.npz"),
+            ii=ii[sel], jj=jj[sel], dist=dd[sel],
+        )
     print(f"forged {n_blocks} shards (block={block})", flush=True)
 
     kw = {"streaming_primary": True}
