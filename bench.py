@@ -958,8 +958,13 @@ def main() -> None:
             f"e2e_{args.e2e_n // 1000}k", bench_e2e(args.e2e_n))),
         "prod": (2400, lambda: stages.__setitem__(
             "e2e_prod", bench_e2e(args.prod_n, s_scaled=20_000))),
-        "scale": (3000, lambda: stages.__setitem__(
-            f"e2e_{args.scale_n // 1000}k", bench_e2e(args.scale_n))),
+        # device pair count grows quadratically in scale_n, so the
+        # watchdog budget must too (100k = 4x the default 50k's pairs;
+        # capped at 2h — beyond that a wedge is indistinguishable from
+        # slow and the recovery window is better spent retrying)
+        "scale": (min(7200.0, 3000.0 * max(1.0, (args.scale_n / 50_000.0) ** 2)),
+                  lambda: stages.__setitem__(
+                      f"e2e_{args.scale_n // 1000}k", bench_e2e(args.scale_n))),
         "ingest": (1200, lambda: stages.__setitem__("ingest", bench_ingest())),
         "greedy": (1200, lambda: stages.__setitem__(
             "greedy_secondary", bench_greedy())),

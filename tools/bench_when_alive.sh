@@ -45,6 +45,15 @@ jax.block_until_ready(x @ x)
     if [ "$rc" -eq 0 ]; then
       mv "BENCH_r04_attempt${attempt}_partial.json" BENCH_r04_local.json
       echo "$(date -u +%FT%TZ) full bench complete at attempt ${attempt}" >> bench_retry.log
+      # bonus while the tunnel is alive: the on-chip run at NORTH-STAR
+      # scale (BASELINE configs 4-5 ask for 50k-100k through the real
+      # device tile loop; the 50k number is in the full bench above)
+      echo "$(date -u +%FT%TZ) bonus: 100k scale run" >> bench_retry.log
+      python bench.py --stages scale --scale_n 100000 > bench_r04_100k.log 2>&1
+      rc2=$?
+      echo "$(date -u +%FT%TZ) 100k scale rc=${rc2}" >> bench_retry.log
+      grep -o '{"metric".*' bench_r04_100k.log > BENCH_r04_100k.json 2>/dev/null \
+        || rm -f BENCH_r04_100k.json
       exit 0
     fi
     attempt=$((attempt + 1))
