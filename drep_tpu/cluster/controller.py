@@ -29,7 +29,13 @@ import pandas as pd
 from drep_tpu import schemas
 from drep_tpu.cluster import dispatch, pairs
 from drep_tpu.cluster import engines  # noqa: F401 — registers built-in engines
-from drep_tpu.ingest import DEFAULT_SCALE, DEFAULT_SKETCH_SIZE, GenomeSketches, sketch_genomes
+from drep_tpu.ingest import (
+    DEFAULT_SCALE,
+    DEFAULT_SKETCH_SIZE,
+    GenomeSketches,
+    sketch_args_snapshot,
+    sketch_genomes,
+)
 from drep_tpu.ops.kmers import DEFAULT_K
 from drep_tpu.ops.linkage import cluster_hierarchical, single_linkage_device
 from drep_tpu.utils.logger import get_logger
@@ -300,6 +306,26 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         # running them while this thread sits inside XLA's multithreaded
         # compiler is safe — spawn children inherit no locks
         and snapshot["primary_estimator_resolved"] == "streaming_sort"
+        # nothing to hide the compile behind when the sketch cache will
+        # hit (resumed runs, bench-planted workdirs): sketch_genomes
+        # returns in ms and the main thread then just waits on the same
+        # compile-cache lock — while the warmup's throwaway EXECUTION
+        # races the first real tiles from another thread, a concurrency
+        # the wedge-prone tunneled backend does not need to be exposed
+        # to for zero gain. Cheap pre-check of the cache key only; the
+        # zero-kmer revalidation inside sketch_genomes still governs
+        # whether the cache is actually used
+        and not (
+            wd is not None
+            and wd.has_arrays("sketches")
+            and wd.arguments_match(
+                "sketch",
+                sketch_args_snapshot(
+                    bdb["genome"], kw["kmer_size"], kw["MASH_sketch"],
+                    kw["scale"], kw["hash"],
+                ),
+            )
+        )
     ):
         # overlap the streaming tile kernel's cold XLA compile (~20-40 s)
         # with host ingest — the one ingest/compute overlap that is exact

@@ -215,6 +215,35 @@ def test_overlap_ingest_identical_results(tmp_path, genome_paths):
     )
 
 
+def test_overlap_warmup_skipped_when_sketch_cache_hits(tmp_path, genome_paths, monkeypatch):
+    """The warmup thread exists to hide the cold compile behind INGEST;
+    when the workdir's sketch cache will hit (resumed runs, bench-planted
+    workdirs) there is no ingest to hide behind and the throwaway warmup
+    execution would just race the first real tiles from a second thread —
+    the controller must not start it (r4: the wedge-prone tunneled backend
+    gets zero benefit for the concurrency exposure)."""
+    import drep_tpu.parallel.streaming as streaming_mod
+    from drep_tpu.workflows import compare_wrapper
+
+    calls = []
+    real = streaming_mod.warmup_streaming_compile
+    monkeypatch.setattr(
+        streaming_mod, "warmup_streaming_compile",
+        lambda *a, **k: (calls.append(1), real(*a, **k)),
+    )
+    wd = str(tmp_path / "wd")
+    compare_wrapper(wd, genome_paths, streaming_primary=True,
+                    overlap_ingest=True, skip_plots=True)
+    assert calls, "fresh run (no cache) must start the warmup"
+    calls.clear()
+    # invalidate the Cdb resume but keep the sketch cache: the second run
+    # recomputes clustering from cached sketches — warmup must not start
+    os.remove(os.path.join(wd, "data_tables", "Cdb.csv"))
+    compare_wrapper(wd, genome_paths, streaming_primary=True,
+                    overlap_ingest=True, skip_plots=True)
+    assert not calls, "cache-hit run must skip the warmup thread"
+
+
 def test_streaming_average_widens_zero_retention():
     """keep_dist <= cutoff would leave UPGMA no information beyond the
     cutoff (bound degenerates to connected components); the path must
