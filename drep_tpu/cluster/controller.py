@@ -33,7 +33,7 @@ from drep_tpu.ingest import (
     DEFAULT_SCALE,
     DEFAULT_SKETCH_SIZE,
     GenomeSketches,
-    sketch_args_snapshot,
+    sketch_cache_will_hit,
     sketch_genomes,
 )
 from drep_tpu.ops.kmers import DEFAULT_K
@@ -306,25 +306,19 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         # running them while this thread sits inside XLA's multithreaded
         # compiler is safe — spawn children inherit no locks
         and snapshot["primary_estimator_resolved"] == "streaming_sort"
-        # nothing to hide the compile behind when the sketch cache will
-        # hit (resumed runs, bench-planted workdirs): sketch_genomes
-        # returns in ms and the main thread then just waits on the same
+        # nothing to hide the compile behind when ingest will return
+        # without sketching (whole-run cache hit on resumed runs /
+        # bench-planted workdirs, or a shard store that already covers
+        # every genome after a kill between the last flush and cache
+        # assembly): the main thread then just waits on the same
         # compile-cache lock — while the warmup's throwaway EXECUTION
         # races the first real tiles from another thread, a concurrency
         # the wedge-prone tunneled backend does not need to be exposed
-        # to for zero gain. Cheap pre-check of the cache key only; the
-        # zero-kmer revalidation inside sketch_genomes still governs
-        # whether the cache is actually used
-        and not (
-            wd is not None
-            and wd.has_arrays("sketches")
-            and wd.arguments_match(
-                "sketch",
-                sketch_args_snapshot(
-                    bdb["genome"], kw["kmer_size"], kw["MASH_sketch"],
-                    kw["scale"], kw["hash"],
-                ),
-            )
+        # to for zero gain. Read-only pre-check; the revalidation inside
+        # sketch_genomes still governs whether the cache is actually used
+        and not sketch_cache_will_hit(
+            wd, bdb["genome"], kw["kmer_size"], kw["MASH_sketch"],
+            kw["scale"], kw["hash"],
         )
     ):
         # overlap the streaming tile kernel's cold XLA compile (~20-40 s)

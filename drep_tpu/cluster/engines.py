@@ -147,19 +147,23 @@ def primary_jax_mash(
 
 
 # measured per-element cost ratio of the VPU bitonic merge vs the int8 MXU
-# indicator matmul, at the m=512 / width-32768 / 8.4M-vocab production
-# shape on a tunneled v5e (r3 session: chunked matmul 1.56 s -> 1.4 ps per
-# pair-vocab-column, range merge 3.06 s -> 21 ps per pair-merge-unit).
-# The beyond-budget dispatch weighs merge work (2*s2*log2(2*s2)
-# units/pair) against chunked-matmul work (v_pad columns/pair) with this
-# penalty on the merge side; the merge only wins when the vocabulary
-# outgrows 15x the merge units (very diverse clusters).
-# bench.py::bench_dispatch_crossover re-derives this constant from BOTH
-# kernels measured at 4 vocab/merge-unit ratios (~8x..100x, all honestly
-# reachable shapes) and reports `fitted_elem_cost` +
-# `shipped_matches_measured` in the BENCH record — update this value when
-# a recorded crossover table disagrees by >2x.
-MERGE_VS_MATMUL_ELEM_COST = 15.0
+# indicator matmul. The beyond-budget dispatch weighs merge work
+# (2*s2*log2(2*s2) units/pair) against chunked-matmul work (v_pad
+# columns/pair) with this penalty on the merge side; the merge only wins
+# when the vocabulary outgrows ~47x the merge units (very diverse
+# clusters).
+# Source: BENCH_r04 `dispatch_crossover` (real v5e, healthy link,
+# 2026-07-31) — both kernels measured at 4 vocab/merge-unit ratios
+# (8x/20x/40x/100x, equal=true at every point); fitted_elem_cost = 47.06
+# (median of per-shape ratios 11.97/32.94/75.65/61.19). The measured
+# winners flip between ratio 40 (matmul, 3.39 s vs 6.41 s) and ratio 100
+# (pallas, 9.47 s vs 5.66 s); 47.0 predicts all four winners, while the
+# previous single-measurement value (15.0, r3 session note) mispredicted
+# pallas at ratios 20 and 40. bench.py::bench_dispatch_crossover
+# re-derives this constant every run and reports `fitted_elem_cost` +
+# `shipped_matches_measured` — update again when a recorded crossover
+# table disagrees by >2x.
+MERGE_VS_MATMUL_ELEM_COST = 47.0
 
 
 def beyond_budget_secondary_path(sketch_width: int, v_pad: int) -> str:

@@ -66,6 +66,85 @@ def sketch_args_snapshot(
 # flush to shard files as they accumulate and a rerun resumes from them
 INGEST_SHARD = 512
 
+
+def _sketch_shard_meta(args_snapshot: dict) -> dict:
+    """The shard-store meta for a given args snapshot — one constructor
+    shared by sketch_genomes (which opens the store against it) and
+    sketch_cache_will_hit (which probes it read-only), so the two can
+    never drift."""
+    from drep_tpu.utils.ckptmeta import content_fingerprint
+
+    return {
+        "kind": "sketch_shards",
+        "k": args_snapshot["k"], "sketch_size": args_snapshot["sketch_size"],
+        "scale": args_snapshot["scale"], "hash": args_snapshot["hash"],
+        "genomes": content_fingerprint(args_snapshot["genomes"]),
+    }
+
+
+_SKETCH_SHARD_SUBDIR = os.path.join("data", "sketch_shards")
+
+
+def _sketch_shard_dir(wd: WorkDirectory) -> str:
+    """Shard-store path WITHOUT creating it (read-only probes); the
+    writer side goes through wd.get_dir on the same subdir."""
+    return os.path.join(wd.location, _SKETCH_SHARD_SUBDIR)
+
+
+def sketch_cache_will_hit(
+    wd: WorkDirectory | None,
+    genomes,
+    k: int,
+    sketch_size: int,
+    scale: int,
+    hash_name: str,
+) -> bool:
+    """Will :func:`sketch_genomes` return without sketching any genome?
+
+    True when the whole-run cache matches, OR when a valid shard store
+    already covers every genome — a run killed after the last shard flush
+    but before the whole-run cache was assembled rebuilds from shards in
+    IO-bound seconds with zero sketching work. Read-only (never creates
+    the shard dir or rewrites its meta). The cluster controller uses this
+    to decide whether hiding the streaming compile behind ingest buys
+    anything; sketch_genomes re-validates everything itself, so a wrong
+    answer here costs only a skipped (or useless) warmup overlap, never
+    correctness."""
+    import glob
+
+    from drep_tpu.utils.ckptmeta import checkpoint_meta_matches
+
+    if wd is None:
+        return False
+    snapshot = sketch_args_snapshot(genomes, k, sketch_size, scale, hash_name)
+    if wd.has_arrays("sketches") and wd.arguments_match("sketch", snapshot):
+        # mirror sketch_genomes' staleness rule: a cache carrying a
+        # zero-kmer genome (written before validation existed) gets
+        # dropped and re-sketched — that run wants the warmup, so fall
+        # through to the shard probe instead of claiming a hit
+        try:
+            if not (wd.get_db("Gdb")["n_kmers"] == 0).any():
+                return True
+        except Exception:
+            pass  # unreadable Gdb: let the shard probe decide
+    shard_dir = _sketch_shard_dir(wd)
+    if not checkpoint_meta_matches(shard_dir, _sketch_shard_meta(snapshot)):
+        return False
+    covered: set[str] = set()
+    for f in glob.glob(os.path.join(shard_dir, "*.npz")):
+        try:
+            # np.load on an npz reads only the members touched — names +
+            # n_kmers, not the sketch arrays — so this stays cheap at 100k
+            with np.load(f, allow_pickle=False) as z:
+                names = [str(x) for x in z["names"]]
+                n_kmers = z["n_kmers"]
+        except Exception:
+            return False  # corrupt shard: its genomes re-sketch -> warmup pays
+        # zero-kmer entries are dropped on resume (see sketch_genomes);
+        # a shard that only covers a genome with n_kmers==0 does not cover it
+        covered.update(g for g, n in zip(names, n_kmers) if int(n) > 0)
+    return covered >= set(snapshot["genomes"])
+
 _SHARD_SCALARS = ("length", "N50", "contigs", "n_kmers")
 
 
@@ -156,15 +235,12 @@ def sketch_genomes(
     results: dict[str, dict] = {}
     shard_dir = None
     if wd is not None:
-        from drep_tpu.utils.ckptmeta import content_fingerprint, open_checkpoint_dir
+        from drep_tpu.utils.ckptmeta import open_checkpoint_dir
 
-        shard_dir = wd.get_dir(os.path.join("data", "sketch_shards"))
-        meta = {
-            "kind": "sketch_shards",
-            "k": k, "sketch_size": sketch_size, "scale": scale, "hash": hash_name,
-            "genomes": content_fingerprint(args_snapshot["genomes"]),
-        }
-        if open_checkpoint_dir(shard_dir, meta, clear_suffixes=(".npz",)):
+        shard_dir = wd.get_dir(_SKETCH_SHARD_SUBDIR)
+        if open_checkpoint_dir(
+            shard_dir, _sketch_shard_meta(args_snapshot), clear_suffixes=(".npz",)
+        ):
             for f in sorted(glob.glob(os.path.join(shard_dir, "*.npz"))):
                 try:
                     shard = _load_sketch_shard(f)

@@ -124,20 +124,31 @@ def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tup
     return _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
 
 
+def checkpoint_meta_matches(ckpt_dir: str, meta: dict[str, Any]) -> bool:
+    """Read-only probe: does `ckpt_dir` hold a meta equal to `meta`?
+
+    Unlike open_checkpoint_dir this never creates the directory, clears
+    shards, or writes a meta — safe for pre-checks that only want to know
+    whether existing shards WOULD be resumable (e.g. the controller's
+    compile-warmup decision) without disturbing the store."""
+    loc = os.path.join(ckpt_dir, META_NAME)
+    if not os.path.exists(loc):
+        return False
+    try:
+        with open(loc) as f:
+            stored = json.load(f)
+    except Exception:
+        return False  # corrupt meta -> not resumable
+    return stored == meta
+
+
 def _open_checkpoint_dir_local(
     ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tuple[str, ...]
 ) -> bool:
     os.makedirs(ckpt_dir, exist_ok=True)
-    loc = os.path.join(ckpt_dir, META_NAME)
-    stored = None
-    if os.path.exists(loc):
-        try:
-            with open(loc) as f:
-                stored = json.load(f)
-        except Exception:
-            stored = None  # corrupt meta -> rebuild
-    if stored == meta:
+    if checkpoint_meta_matches(ckpt_dir, meta):
         return True
+    loc = os.path.join(ckpt_dir, META_NAME)
     for f in os.listdir(ckpt_dir):
         if f == META_NAME or any(f.endswith(s) for s in clear_suffixes):
             with contextlib.suppress(FileNotFoundError):

@@ -130,3 +130,74 @@ def test_cli_reports_clean_error_for_bad_input(tmp_path):
     combined = r.stdout + r.stderr
     assert "!!!" in combined
     assert "Traceback" not in combined
+
+
+def test_sketch_cache_will_hit_sees_shard_complete_store(
+    tmp_path, genome_paths, counting_sketch
+):
+    """The controller's warmup pre-check (sketch_cache_will_hit) must
+    treat a shard store that already covers every genome as a hit: a run
+    killed after the last shard flush but before whole-run cache assembly
+    rebuilds from shards with zero sketching work, so there is no ingest
+    to hide the streaming compile behind (and the warmup's throwaway
+    execution would just race the first real tiles)."""
+    import os
+
+    from drep_tpu.ingest import (
+        DEFAULT_SCALE,
+        DEFAULT_SKETCH_SIZE,
+        sketch_args_snapshot,
+        sketch_cache_will_hit,
+    )
+    from drep_tpu.ops.kmers import DEFAULT_K
+    from drep_tpu.utils.ckptmeta import open_checkpoint_dir
+
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    bdb = make_bdb(genome_paths)
+    key = (bdb["genome"], DEFAULT_K, DEFAULT_SKETCH_SIZE, DEFAULT_SCALE, "splitmix64")
+
+    assert not sketch_cache_will_hit(None, *key)
+    assert not sketch_cache_will_hit(wd, *key)  # empty workdir
+
+    # real sketches computed without a workdir, then planted as shards —
+    # the on-disk state of a run killed between last flush and assembly
+    gs = sketch_genomes(bdb)
+    batch = {
+        g: {
+            **{k: int(gs.gdb.iloc[i][k]) for k in ("length", "N50", "contigs", "n_kmers")},
+            "bottom": gs.bottom[i],
+            "scaled": gs.scaled[i],
+        }
+        for i, g in enumerate(gs.names)
+    }
+    shard_dir = wd.get_dir(ingest_mod._SKETCH_SHARD_SUBDIR)
+    snapshot = sketch_args_snapshot(*key)
+    open_checkpoint_dir(
+        shard_dir, ingest_mod._sketch_shard_meta(snapshot), clear_suffixes=(".npz",)
+    )
+
+    # partial coverage: not a hit (real sketching remains -> warmup pays)
+    ingest_mod._save_sketch_shard(
+        os.path.join(shard_dir, "shard_a.npz"), {g: batch[g] for g in gs.names[:3]}
+    )
+    assert not sketch_cache_will_hit(wd, *key)
+
+    # complete coverage with NO whole-run cache: must be a hit
+    ingest_mod._save_sketch_shard(
+        os.path.join(shard_dir, "shard_b.npz"), {g: batch[g] for g in gs.names[3:]}
+    )
+    assert not wd.has_arrays("sketches")
+    assert sketch_cache_will_hit(wd, *key)
+    # different args against the same store: meta mismatch, no hit —
+    # and read-only: the probe must not clear the store's shards
+    assert not sketch_cache_will_hit(wd, bdb["genome"], DEFAULT_K,
+                                     DEFAULT_SKETCH_SIZE, 100, "splitmix64")
+    assert len(os.listdir(shard_dir)) == 3  # meta + two shards survive
+
+    # and the pre-check told the truth: the resumed run sketches nothing
+    counting_sketch["n"] = 0
+    gs2 = sketch_genomes(bdb, wd=wd)
+    assert counting_sketch["n"] == 0
+    assert gs2.names == gs.names
+    # after assembly the whole-run cache carries the hit
+    assert sketch_cache_will_hit(wd, *key)
