@@ -1,0 +1,62 @@
+"""Which bench stages still need a (healthy-link) hardware number?
+
+Prints a comma list of bench.py stage-plan names, for
+tools/bench_when_alive.sh to run FIRST when the tunnel answers: a wedge
+mid-full-run must not cost the one number the round is still missing.
+
+A stage is missing when the merged artifact (tools/merge_bench_partials.py
+over the per-attempt partials) has no successful record for it, or when
+the record's provenance carries no link-health stamp — the pre-`link`-stage
+attempt 1 ran on a link later shown ~5.3x degraded (PARITY.md round-4
+note), so its numbers want a healthy re-measure, not trust.
+"""
+
+from __future__ import annotations
+
+import sys
+
+# bench.py stage-plan name -> the stage-record key its success writes
+PLAN_TO_RECORD = {
+    "primary": "primary",
+    "secondary": "secondary_matmul",
+    "e2e": "e2e_10k",
+    "prod": "e2e_prod",
+    "scale": "e2e_50k",
+    "ingest": "ingest",
+    "greedy": "greedy_secondary",
+    "production": "secondary_production",
+    "crossover": "dispatch_crossover",
+}
+
+
+def missing(merged: dict) -> list[str]:
+    stages = merged.get("stages", {})
+    prov = merged.get("stage_provenance", {})
+    out = []
+    for plan, key in PLAN_TO_RECORD.items():
+        rec = stages.get(key)
+        ok = isinstance(rec, dict) and "error" not in rec
+        if not ok or prov.get(key, {}).get("link") is None:
+            out.append(plan)
+    # preserve bench.py's value ordering (its default_order) so the most
+    # valuable missing number is measured first in the recovery window
+    order = ["primary", "secondary", "e2e", "prod", "scale",
+             "ingest", "greedy", "production", "crossover"]
+    return sorted(out, key=order.index)
+
+
+def main() -> None:
+    import json
+
+    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_r04_merged.json"
+    try:
+        with open(path) as f:
+            merged = json.load(f)
+    except Exception:
+        print(",".join(PLAN_TO_RECORD))  # no merged record yet: everything
+        return
+    print(",".join(missing(merged)))
+
+
+if __name__ == "__main__":
+    main()
