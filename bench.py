@@ -671,6 +671,20 @@ def bench_e2e(n: int, s_scaled: int = 1200) -> dict:
     rng = np.random.default_rng(2)
     gs = _plant_sketches(n, rng, s_scaled=s_scaled)
     paths_before = dict(SECONDARY_PATH_COUNTS)
+
+    # per-stage attribution via the pipeline's own Counters — diffed
+    # around the fresh run because the instance is process-global and
+    # earlier bench stages (e2e_10k before e2e_prod) already fed it.
+    # Answers where an e2e second went (primary tile loop vs secondary
+    # kernels vs everything else: linkage, IO, compile not inside a
+    # counted stage) so a below-parity e2e number is diagnosable from
+    # the record instead of re-running with a profiler.
+    from drep_tpu.utils.profiling import counters
+
+    def _snap() -> dict:
+        return {k: (v.pairs, v.seconds) for k, v in counters.stages.items()}
+
+    ctr_before = _snap()
     with tempfile.TemporaryDirectory() as td:
         wd = WorkDirectory(td)
         bdb = pd.DataFrame(
@@ -684,6 +698,13 @@ def bench_e2e(n: int, s_scaled: int = 1200) -> dict:
         t0 = time.perf_counter()
         cdb = d_cluster_wrapper(wd, bdb, streaming_primary=True)
         dt = time.perf_counter() - t0
+        ctr_after = _snap()
+        stage_seconds = {
+            k: round(s - ctr_before.get(k, (0, 0.0))[1], 2)
+            for k, (_, s) in ctr_after.items()
+            if s - ctr_before.get(k, (0, 0.0))[1] > 0.005
+        }
+        stage_seconds["other"] = round(dt - sum(stage_seconds.values()), 2)
         retained_edges = int(len(wd.get_db("Mdb"))) if wd.hasDb("Mdb") else -1
         secondary_paths = {
             p: c - paths_before.get(p, 0)
@@ -724,6 +745,7 @@ def bench_e2e(n: int, s_scaled: int = 1200) -> dict:
         "scaled_width_max": int(max(len(s) for s in gs.scaled)),
         "secondary_paths": secondary_paths,
         "seconds": round(dt, 2),
+        "stage_seconds": stage_seconds,
         "primary_clusters": int(cdb["primary_cluster"].max()),
         "secondary_clusters": int(cdb["secondary_cluster"].nunique()),
         "retained_edges": retained_edges,
