@@ -127,7 +127,17 @@ def _merge_roofline(pairs: float, s2: int, hbm_bytes: float, dt: float) -> dict:
     }
 
 
-def bench_primary() -> dict:
+def bench_primary(publish=None) -> dict:
+    """`publish(out)` is called the moment the HEADLINE number exists and
+    `out` is mutated in place afterwards: attempt 2 wedged somewhere in
+    this stage after 8 other stages succeeded, and because the stage only
+    published its dict on return, whatever it had already measured was
+    lost with it. Publishing early means the watchdog's bail snapshot
+    carries the headline even when a later variant compile wedges — and
+    the sub-stage progress markers on stderr make the wedge point
+    attributable from the attempt log."""
+    import sys
+
     from drep_tpu.cluster.engines import mash_distance_matrix
     from drep_tpu.ops.merge import next_pow2
     from drep_tpu.ops.minhash import PackedSketches
@@ -170,6 +180,13 @@ def bench_primary() -> dict:
             **_rate_fields(pairs, dt),
             **_merge_roofline(pairs, s2, hbm, dt),
         }
+        if publish is not None:
+            publish(out)
+        print(
+            f"bench: primary headline done "
+            f"({out['pairs_per_sec_per_chip']:.0f} pairs/s/chip)",
+            file=sys.stderr, flush=True,
+        )
 
         # kernel-variant diagnostics: measure the row-batched mash kernel
         # (DREP_TPU_MASH_ROWS_PER_ITER — correctness equality-tested in
@@ -182,6 +199,10 @@ def bench_primary() -> dict:
         if jax.devices()[0].platform == "tpu" and len(jax.local_devices()) == 1:
             for r in (2, 4):
                 os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = str(r)
+                print(
+                    f"bench: primary variant rows_per_iter={r} compiling",
+                    file=sys.stderr, flush=True,
+                )
                 try:
                     mash_distance_matrix(packed, k=K, tile=TILE)  # variant compile
                     dt_r = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
@@ -648,7 +669,7 @@ def _plant_sketches(n: int, rng: np.random.Generator, s_scaled: int = 1200):
     )
 
 
-def bench_e2e(n: int, s_scaled: int = 1200) -> dict:
+def bench_e2e(n: int, s_scaled: int = 1200, publish=None) -> dict:
     """Wall-clock to Cdb: streaming primary + batched secondary on planted
     sketches. The sketch cache is pre-stored in the workdir (the supported
     resume path), so the measurement starts at the cluster stage — the
@@ -659,7 +680,13 @@ def bench_e2e(n: int, s_scaled: int = 1200) -> dict:
     At s_scaled=20_000 (the e2e_prod stage) the batched secondary rides
     the beyond-budget chunked/range kernels — `secondary_paths` in the
     result records which engine paths actually served the run (diffed
-    from the engine's path counter, not inferred)."""
+    from the engine's path counter, not inferred).
+
+    `publish(out)` fires as soon as the FRESH measurement exists (the
+    dict is then mutated in place with the resume-leg fields): the 50k
+    fresh run is ~20 min of scarce tunnel time, and a wedge during the
+    resume leg must not cost it — same early-publish contract as
+    bench_primary."""
     import pandas as pd
 
     import jax
@@ -711,6 +738,28 @@ def bench_e2e(n: int, s_scaled: int = 1200) -> dict:
             for p, c in SECONDARY_PATH_COUNTS.items()
             if c - paths_before.get(p, 0)
         }
+        pairs = n * (n - 1) / 2
+        n_chips = len(jax.local_devices())
+        value = pairs / dt / n_chips
+        out = {
+            "n_genomes": n,
+            "s_scaled": s_scaled,
+            "scaled_width_max": int(max(len(s) for s in gs.scaled)),
+            "secondary_paths": secondary_paths,
+            "seconds": round(dt, 2),
+            "stage_seconds": stage_seconds,
+            "primary_clusters": int(cdb["primary_cluster"].max()),
+            "secondary_clusters": int(cdb["secondary_cluster"].nunique()),
+            "retained_edges": retained_edges,
+            "peak_host_rss_gb": round(
+                resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2
+            ),
+            "pairs_per_sec_per_chip": round(value, 1),
+            "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+            "resume_pending": True,  # removed when the resume leg lands
+        }
+        if publish is not None:
+            publish(out)
 
         # mid-run kill/resume at scale: drop the assembled tables but keep
         # the shard-level state (streaming row shards + per-cluster
@@ -736,25 +785,14 @@ def bench_e2e(n: int, s_scaled: int = 1200) -> dict:
             .reset_index(drop=True)
             .equals(cdb.sort_values("genome")[key].reset_index(drop=True))
         )
-    pairs = n * (n - 1) / 2
-    n_chips = len(jax.local_devices())
-    value = pairs / dt / n_chips
-    return {
-        "n_genomes": n,
-        "s_scaled": s_scaled,
-        "scaled_width_max": int(max(len(s) for s in gs.scaled)),
-        "secondary_paths": secondary_paths,
-        "seconds": round(dt, 2),
-        "stage_seconds": stage_seconds,
-        "primary_clusters": int(cdb["primary_cluster"].max()),
-        "secondary_clusters": int(cdb["secondary_cluster"].nunique()),
-        "retained_edges": retained_edges,
-        "peak_host_rss_gb": round(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2),
-        "resume_seconds": round(resume_dt, 2),
-        "resume_clusters_match": resume_ok,
-        "pairs_per_sec_per_chip": round(value, 1),
-        "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
-    }
+    out.pop("resume_pending", None)
+    out["resume_seconds"] = round(resume_dt, 2)
+    out["resume_clusters_match"] = resume_ok
+    # RSS may have peaked during the resume leg; refresh the published value
+    out["peak_host_rss_gb"] = round(
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2
+    )
+    return out
 
 
 def _require_devices(timeout_s: float = 240.0) -> None:
@@ -974,19 +1012,32 @@ def main() -> None:
     # during them must not cost the production stage's already-measured
     # results.
     registry: dict[str, tuple[float, object]] = {
-        "primary": (600, lambda: stages.__setitem__("primary", bench_primary())),
+        # publish= places the headline in `stages` the moment it exists,
+        # so a wedge during the later variant compiles still bails with
+        # the headline in the snapshot (attempt 2 lost it exactly there)
+        "primary": (600, lambda: stages.__setitem__(
+            "primary",
+            bench_primary(publish=lambda o: stages.__setitem__("primary", o)),
+        )),
         "secondary": (600, _secondary),
         "e2e": (1200, lambda: stages.__setitem__(
-            f"e2e_{args.e2e_n // 1000}k", bench_e2e(args.e2e_n))),
+            f"e2e_{args.e2e_n // 1000}k",
+            bench_e2e(args.e2e_n, publish=lambda o: stages.__setitem__(
+                f"e2e_{args.e2e_n // 1000}k", o)))),
         "prod": (2400, lambda: stages.__setitem__(
-            "e2e_prod", bench_e2e(args.prod_n, s_scaled=20_000))),
+            "e2e_prod",
+            bench_e2e(args.prod_n, s_scaled=20_000,
+                      publish=lambda o: stages.__setitem__("e2e_prod", o)))),
         # device pair count grows quadratically in scale_n, so the
         # watchdog budget must too (100k = 4x the default 50k's pairs;
         # capped at 2h — beyond that a wedge is indistinguishable from
         # slow and the recovery window is better spent retrying)
         "scale": (min(7200.0, 3000.0 * max(1.0, (args.scale_n / 50_000.0) ** 2)),
                   lambda: stages.__setitem__(
-                      f"e2e_{args.scale_n // 1000}k", bench_e2e(args.scale_n))),
+                      f"e2e_{args.scale_n // 1000}k",
+                      bench_e2e(args.scale_n,
+                                publish=lambda o: stages.__setitem__(
+                                    f"e2e_{args.scale_n // 1000}k", o)))),
         "ingest": (1200, lambda: stages.__setitem__("ingest", bench_ingest())),
         "greedy": (1200, lambda: stages.__setitem__(
             "greedy_secondary", bench_greedy())),

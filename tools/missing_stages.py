@@ -35,7 +35,14 @@ def missing(merged: dict) -> list[str]:
     out = []
     for plan, key in PLAN_TO_RECORD.items():
         rec = stages.get(key)
-        ok = isinstance(rec, dict) and "error" not in rec
+        ok = (
+            isinstance(rec, dict)
+            and "error" not in rec
+            # a wedge between the fresh e2e leg and its resume leg
+            # publishes the fresh number with this marker — keep the
+            # stage on the re-measure list until the resume evidence lands
+            and not rec.get("resume_pending")
+        )
         if not ok or prov.get(key, {}).get("link") is None:
             out.append(plan)
     # preserve bench.py's value ordering (its default_order) so the most
