@@ -50,7 +50,9 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import resource
+import shutil
 import tempfile
 import time
 
@@ -669,7 +671,7 @@ def _plant_sketches(n: int, rng: np.random.Generator, s_scaled: int = 1200):
     )
 
 
-def bench_e2e(n: int, s_scaled: int = 1200, publish=None) -> dict:
+def bench_e2e(n: int, s_scaled: int = 1200, publish=None, workdir: str | None = None) -> dict:
     """Wall-clock to Cdb: streaming primary + batched secondary on planted
     sketches. The sketch cache is pre-stored in the workdir (the supported
     resume path), so the measurement starts at the cluster stage — the
@@ -686,7 +688,18 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None) -> dict:
     dict is then mutated in place with the resume-leg fields): the 50k
     fresh run is ~20 min of scarce tunnel time, and a wedge during the
     resume leg must not cost it — same early-publish contract as
-    bench_primary."""
+    bench_primary.
+
+    `workdir` (scale-class stages): a PERSISTENT directory instead of the
+    default throwaway tempdir. The pipeline checkpoints streaming
+    row-block shards as it goes, so a run that wedges at minute 19 of 20
+    leaves its progress on disk and the next recovery window completes
+    from it instead of starting over — the only way a 2h-budget 100k run
+    ever finishes on a tunnel with sub-hour uptime windows. Honesty
+    marker: `warm_start_shards` counts the shard files found before the
+    run; a warm-started wall-clock is NOT a cold-run number, and the
+    merge tool prefers cold records regardless of rate. The directory is
+    deleted after a fully-successful measurement (wedges keep it)."""
     import pandas as pd
 
     import jax
@@ -712,16 +725,39 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None) -> dict:
         return {k: (v.pairs, v.seconds) for k, v in counters.stages.items()}
 
     ctr_before = _snap()
-    with tempfile.TemporaryDirectory() as td:
+    import contextlib
+    import glob as _glob
+
+    if workdir is not None:
+        os.makedirs(workdir, exist_ok=True)
+        td_ctx = contextlib.nullcontext(workdir)
+    else:
+        td_ctx = tempfile.TemporaryDirectory()
+    with td_ctx as td:
+        warm_start_shards = len(
+            _glob.glob(os.path.join(td, "data", "streaming_primary", "*.npz"))
+        )
         wd = WorkDirectory(td)
         bdb = pd.DataFrame(
             {"genome": gs.names, "location": [f"/nonexistent/{g}" for g in gs.names]}
         )
+        # the planted cache is deterministic (seeded rng), so re-planting
+        # over a kept workdir writes identical content and the streaming
+        # shard meta (fingerprint over names+sketches) still matches —
+        # a previous wedged attempt's shards resume, not recompute
         _save(wd, gs)
         wd.store_arguments(
             "sketch",
             sketch_args_snapshot(bdb["genome"], K, gs.sketch_size, DEFAULT_SCALE, "splitmix64"),
         )
+        # a wedged previous attempt may have died between Cdb assembly and
+        # its resume leg; measuring "fresh" with a complete Cdb present
+        # would time the early-return path. Drop assembled tables, keep
+        # shard-level state — exactly the supported mid-run kill state.
+        for tbl in ("Cdb", "Ndb", "Mdb"):
+            p = os.path.join(td, "data_tables", f"{tbl}.csv")
+            if os.path.exists(p):
+                os.remove(p)
         t0 = time.perf_counter()
         cdb = d_cluster_wrapper(wd, bdb, streaming_primary=True)
         dt = time.perf_counter() - t0
@@ -756,6 +792,7 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None) -> dict:
             ),
             "pairs_per_sec_per_chip": round(value, 1),
             "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
+            "warm_start_shards": warm_start_shards,
             "resume_pending": True,  # removed when the resume leg lands
         }
         if publish is not None:
@@ -767,8 +804,6 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None) -> dict:
         # after a kill between secondary compute and Cdb assembly — and
         # re-run; the resume machinery must rebuild Cdb from shards
         # without recomputing pairs
-        import os
-
         for tbl in ("Cdb", "Ndb", "Mdb"):
             p = os.path.join(td, "data_tables", f"{tbl}.csv")
             # fail loudly if the workdir layout ever moves: silently
@@ -792,6 +827,11 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None) -> dict:
     out["peak_host_rss_gb"] = round(
         resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 2
     )
+    # both legs measured: the persistent dir's wedge-resume purpose is
+    # served — reclaim the disk (a 100k workdir is multiple GB). Wedges
+    # never reach this line, so their shards survive for the next window.
+    if workdir is not None:
+        shutil.rmtree(workdir, ignore_errors=True)
     return out
 
 
@@ -1032,12 +1072,17 @@ def main() -> None:
         # watchdog budget must too (100k = 4x the default 50k's pairs;
         # capped at 2h — beyond that a wedge is indistinguishable from
         # slow and the recovery window is better spent retrying)
+        # persistent workdir: a scale run that wedges mid-way leaves its
+        # row-block shards for the next recovery window to finish from
+        # (warm_start_shards marks such records; .bench_wd/ is gitignored)
         "scale": (min(7200.0, 3000.0 * max(1.0, (args.scale_n / 50_000.0) ** 2)),
                   lambda: stages.__setitem__(
                       f"e2e_{args.scale_n // 1000}k",
                       bench_e2e(args.scale_n,
                                 publish=lambda o: stages.__setitem__(
-                                    f"e2e_{args.scale_n // 1000}k", o)))),
+                                    f"e2e_{args.scale_n // 1000}k", o),
+                                workdir=os.path.join(
+                                    ".bench_wd", f"scale_{args.scale_n}")))),
         "ingest": (1200, lambda: stages.__setitem__("ingest", bench_ingest())),
         "greedy": (1200, lambda: stages.__setitem__(
             "greedy_secondary", bench_greedy())),

@@ -89,3 +89,15 @@ def test_cli_round_trip(tmp_path):
     assert merged["value"] == 5e5
     assert merged["merged_from"] == ["attempt1", "attempt2"]
     assert set(merged["stages"]) == {"primary", "link", "ingest"}
+
+
+def test_cold_record_always_beats_warm_started():
+    """A warm-started scale run (resumed a previous attempt's shards) has
+    an inflated wall-clock rate; a cold measurement must win regardless of
+    which is faster or later."""
+    warm = _attempt(2, {"e2e_50k": {"pairs_per_sec_per_chip": 9e6, "warm_start_shards": 40}})
+    cold = _attempt(3, {"e2e_50k": {"pairs_per_sec_per_chip": 1e6, "warm_start_shards": 0}})
+    for order in ([warm, cold], [cold, warm]):
+        merged = mbp.merge(sorted(order))
+        assert merged["stages"]["e2e_50k"]["pairs_per_sec_per_chip"] == 1e6
+        assert merged["stage_provenance"]["e2e_50k"]["attempt"] == 3

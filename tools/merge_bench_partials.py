@@ -75,13 +75,23 @@ def merge(attempts: list[tuple[int, dict]]) -> dict:
                 errors[key] = {"attempt": n, "record": val}  # keep latest failure
                 continue
             if key in stages:
-                old_rate, new_rate = _rate(stages[key]), _rate(val)
-                if (
-                    old_rate is not None
-                    and new_rate is not None
-                    and new_rate < old_rate
-                ):
-                    continue  # keep the faster measurement (best-of)
+                old, new = stages[key], val
+                old_warm = isinstance(old, dict) and old.get("warm_start_shards", 0) > 0
+                new_warm = isinstance(new, dict) and new.get("warm_start_shards", 0) > 0
+                if old_warm != new_warm:
+                    # a warm-started scale run's wall-clock rode a previous
+                    # attempt's shards — its (inflated) rate never beats a
+                    # cold measurement, and a cold one always replaces it
+                    if new_warm:
+                        continue
+                else:
+                    old_rate, new_rate = _rate(old), _rate(new)
+                    if (
+                        old_rate is not None
+                        and new_rate is not None
+                        and new_rate < old_rate
+                    ):
+                        continue  # keep the faster measurement (best-of)
             stages[key] = val
             provenance[key] = {"attempt": n, "link": link}
     # a failure entry survives only while no attempt succeeded there
