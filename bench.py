@@ -319,10 +319,15 @@ def _production_pack(adversarial: bool = True):
     return pack_scaled_sketches(sketches, [f"g{i}" for i in range(len(sketches))])
 
 
-def bench_secondary_production() -> dict:
+def bench_secondary_production(publish=None) -> dict:
     """The production-width secondary regime (VERDICT r2 next-round #1):
     both range-partitioned paths at m=512 / width 32768 / multi-M vocab,
-    exact cross-equality + sampled searchsorted oracle, no OOM."""
+    exact cross-equality + sampled searchsorted oracle, no OOM.
+
+    Early-publish contract (see bench_primary): `out` reaches the record
+    via `publish` before the first compile and is mutated in place, so a
+    wedge during any sub-measurement keeps everything already measured —
+    one observed wedge struck exactly at this stage's first big compile."""
     import jax
 
     from drep_tpu.cluster.engines import beyond_budget_secondary_path
@@ -348,6 +353,8 @@ def bench_secondary_production() -> dict:
         "v_pad": v_pad,
         "one_shot_fits": bool(matmul_rows_pad(m) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS),
     }
+    if publish is not None:
+        publish(out)
 
     ani_c, cov_c = all_vs_all_containment_matmul_chunked(packed, k=K)  # warmup
     dt_m = _best_of(lambda: all_vs_all_containment_matmul_chunked(packed, k=K), reps=2)
@@ -427,7 +434,7 @@ def _crossover_pack(m: int, width: int, fill: int, v_extent: int, rng):
     return PackedSketches(ids=ids, counts=counts, names=[f"g{i}" for i in range(m)])
 
 
-def bench_dispatch_crossover() -> dict:
+def bench_dispatch_crossover(publish=None) -> dict:
     """Bracket the beyond-budget dispatch (VERDICT r3 weak #2): measure
     BOTH kernels — vocab-chunked MXU matmul and range-bucketed Pallas
     merge — at vocab/merge-unit ratios spanning ~8x to ~100x, and fit the
@@ -458,6 +465,12 @@ def bench_dispatch_crossover() -> dict:
     ]
     table = []
     ratios_fit = []
+    # early-publish: 8 fresh kernel shapes compile in this loop; a wedge
+    # at point 3 must not cost points 1-2 (the list is shared, the dict
+    # is completed in place on return)
+    out: dict = {"table": table, "points_measured": 0}
+    if publish is not None:
+        publish(out)
     for m, width, fill, ratio in points:
         s2 = max(128, next_pow2(width))
         mu = 2 * s2 * ((2 * s2).bit_length() - 1)
@@ -488,17 +501,17 @@ def bench_dispatch_crossover() -> dict:
                 "elem_cost_ratio": round(c_mu / c_col, 2),
             }
         )
+        out["points_measured"] = len(table)
     fitted = float(np.median(ratios_fit))
-    return {
-        "table": table,
-        # the dispatch picks pallas_range when elem_cost * merge_units <
-        # v_pad, so `fitted` IS the constant the measurements support
-        "fitted_elem_cost": round(fitted, 2),
-        "shipped_elem_cost": MERGE_VS_MATMUL_ELEM_COST,
-        "shipped_matches_measured": bool(
-            0.5 <= fitted / MERGE_VS_MATMUL_ELEM_COST <= 2.0
-        ),
-    }
+    out.pop("points_measured", None)  # complete: the table speaks for itself
+    # the dispatch picks pallas_range when elem_cost * merge_units <
+    # v_pad, so `fitted` IS the constant the measurements support
+    out["fitted_elem_cost"] = round(fitted, 2)
+    out["shipped_elem_cost"] = MERGE_VS_MATMUL_ELEM_COST
+    out["shipped_matches_measured"] = bool(
+        0.5 <= fitted / MERGE_VS_MATMUL_ELEM_COST <= 2.0
+    )
+    return out
 
 
 INGEST_N = 96  # enough that process-pool startup amortizes
@@ -1087,9 +1100,13 @@ def main() -> None:
         "greedy": (1200, lambda: stages.__setitem__(
             "greedy_secondary", bench_greedy())),
         "production": (1500, lambda: stages.__setitem__(
-            "secondary_production", bench_secondary_production())),
+            "secondary_production",
+            bench_secondary_production(publish=lambda o: stages.__setitem__(
+                "secondary_production", o)))),
         "crossover": (1500, lambda: stages.__setitem__(
-            "dispatch_crossover", bench_dispatch_crossover())),
+            "dispatch_crossover",
+            bench_dispatch_crossover(publish=lambda o: stages.__setitem__(
+                "dispatch_crossover", o)))),
     }
     # link context first, under its own watchdog (a wedge here must still
     # emit an honest record): every later stage is read against these
