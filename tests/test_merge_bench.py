@@ -101,3 +101,19 @@ def test_cold_record_always_beats_warm_started():
         merged = mbp.merge(sorted(order))
         assert merged["stages"]["e2e_50k"]["pairs_per_sec_per_chip"] == 1e6
         assert merged["stage_provenance"]["e2e_50k"]["attempt"] == 3
+
+
+def test_duplicate_attempt_files_do_not_crash(tmp_path):
+    """One attempt can leave BOTH an emitted partial and a preserved
+    killed-partial; merging must not fall through to comparing dicts."""
+    (tmp_path / "BENCH_rX_attempt3_partial.json").write_text(
+        json.dumps({"stages": {"ingest": {"genomes_per_sec": 28.0}}})
+    )
+    (tmp_path / "BENCH_rX_attempt3_killed_partial.json").write_text(
+        json.dumps({"completed_through": "link",
+                    "stages": {"link": {"dispatch_ms_median": 0.05}}})
+    )
+    attempts = mbp.load_attempts(str(tmp_path / "BENCH_rX_attempt*_partial.json"))
+    assert [n for n, _ in attempts] == [3, 3]
+    merged = mbp.merge(attempts)
+    assert set(merged["stages"]) == {"ingest", "link"}

@@ -60,7 +60,11 @@ def load_attempts(pattern: str) -> list[tuple[int, dict]]:
             continue  # unreadable partial: nothing to merge from it
         if rec.get("stages"):
             out.append((int(m.group(1)), rec))
-    return sorted(out)  # ascending attempt order; later overwrites earlier
+    # key on the attempt number ONLY: an attempt can leave two files (its
+    # emitted partial plus a preserved killed-partial), and bare tuple
+    # sorting would fall through to comparing the dicts — a TypeError
+    out.sort(key=lambda t: t[0])
+    return out  # ascending attempt order; later overwrites earlier
 
 
 def merge(attempts: list[tuple[int, dict]]) -> dict:
