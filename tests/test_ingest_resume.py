@@ -201,3 +201,30 @@ def test_sketch_cache_will_hit_sees_shard_complete_store(
     assert gs2.names == gs.names
     # after assembly the whole-run cache carries the hit
     assert sketch_cache_will_hit(wd, *key)
+
+
+def test_sketch_cache_will_hit_rejects_zero_kmer_stale_cache(tmp_path, genome_paths):
+    """A whole-run cache carrying a zero-kmer genome is dropped and fully
+    re-sketched by sketch_genomes (legacy pre-validation caches); the
+    warmup pre-check must mirror that rule and NOT claim a hit, or the
+    re-sketch runs without the compile overlap it exists for."""
+    from drep_tpu.ingest import (
+        DEFAULT_SCALE,
+        DEFAULT_SKETCH_SIZE,
+        sketch_cache_will_hit,
+    )
+    from drep_tpu.ops.kmers import DEFAULT_K
+
+    wd = WorkDirectory(str(tmp_path / "wd"))
+    bdb = make_bdb(genome_paths)
+    key = (bdb["genome"], DEFAULT_K, DEFAULT_SKETCH_SIZE, DEFAULT_SCALE, "splitmix64")
+
+    sketch_genomes(bdb, wd=wd)
+    assert sketch_cache_will_hit(wd, *key)  # healthy cache: hit
+
+    # forge the legacy state: same cache arrays/args, but Gdb says one
+    # genome sketched to zero k-mers (written before validation existed)
+    gdb = wd.get_db("Gdb")
+    gdb.loc[0, "n_kmers"] = 0
+    wd.store_db(gdb, "Gdb")
+    assert not sketch_cache_will_hit(wd, *key)
