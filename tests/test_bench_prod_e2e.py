@@ -70,3 +70,51 @@ def test_e2e_prod_width_composition():
         f"production-width batches stayed in the one-shot regime: {paths} "
         "— the stage is not exercising the beyond-budget kernels"
     )
+
+
+def test_scale_workdir_survives_sigkill_and_warm_starts(tmp_path):
+    """Rehearse the wedge-recovery path the 100k bonus depends on: a scale
+    run SIGKILLed mid-streaming leaves row-block shards in its persistent
+    workdir; the next attempt warm-starts from them (warm_start_shards>0
+    in the record — the merge tool's cold-preference key) and still
+    produces a complete, resume-consistent measurement."""
+    import json
+    import os
+    import signal
+    import subprocess
+    import time
+
+    wdp = str(tmp_path / "scale_wd")
+    out_json = str(tmp_path / "r.json")
+    script = (
+        "import json, sys\n"
+        "from drep_tpu.controller import _honor_jax_platforms_env\n"
+        "_honor_jax_platforms_env()\n"
+        "import bench\n"
+        f"r = bench.bench_e2e(1200, workdir={wdp!r})\n"
+        f"json.dump(r, open({out_json!r}, 'w'))\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    shard_dir = os.path.join(wdp, "data", "streaming_primary")
+
+    p = subprocess.Popen([sys.executable, "-c", script], cwd=str(REPO), env=env)
+    # kill as soon as the first row-block shard lands (mid-streaming)
+    deadline = time.time() + 600
+    killed = False
+    while time.time() < deadline and p.poll() is None:
+        if len([f for f in os.listdir(shard_dir)] if os.path.isdir(shard_dir) else []) > 1:
+            p.send_signal(signal.SIGKILL)
+            killed = True
+            break
+        time.sleep(0.25)
+    p.wait(timeout=600)
+    assert killed, "run finished before any shard appeared — enlarge n"
+    assert os.path.isdir(wdp), "killed run must leave the workdir"
+
+    r = subprocess.run([sys.executable, "-c", script], cwd=str(REPO), env=env, timeout=900)
+    assert r.returncode == 0
+    rec = json.load(open(out_json))
+    assert rec["warm_start_shards"] > 0
+    assert rec["resume_clusters_match"] is True
+    assert "resume_pending" not in rec
+    assert not os.path.isdir(wdp), "successful measurement must reclaim the dir"
