@@ -17,6 +17,13 @@
 # iteration's missing-stage computation sees it.
 cd /root/repo || exit 1
 attempt=${1:-3}
+# hard stop (epoch seconds, optional): the round-end driver runs its own
+# bench on the same single chip and .bench_wd — an attempt still running
+# then would contaminate both measurements. Checked before STARTING an
+# attempt; a long full run launched just before the deadline can still
+# overlap, so set the deadline earlier than the real cutoff by the
+# longest stage budget you expect (~1h).
+deadline=${BENCH_LOOP_DEADLINE:-0}
 
 run_bench() { # args: extra bench.py flags
   local log="bench_r04_attempt${attempt}.log"
@@ -47,6 +54,10 @@ jax.block_until_ready(x @ x)
 }
 
 while true; do
+  if [ "$deadline" -gt 0 ] && [ "$(date +%s)" -ge "$deadline" ]; then
+    echo "$(date -u +%FT%TZ) loop deadline reached, exiting" >> bench_retry.log
+    exit 0
+  fi
   if alive; then
     echo "$(date -u +%FT%TZ) tunnel alive" >> bench_retry.log
     missing=$(python tools/missing_stages.py 2>/dev/null)
