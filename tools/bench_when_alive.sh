@@ -76,7 +76,12 @@ while true; do
       echo "$(date -u +%FT%TZ) full bench complete at attempt ${full_attempt}" >> bench_retry.log
       # bonus while the tunnel is alive: the on-chip run at NORTH-STAR
       # scale (BASELINE configs 4-5 ask for 50k-100k through the real
-      # device tile loop; the 50k number is in the full bench above)
+      # device tile loop; the 50k number is in the full bench above).
+      # Its watchdog alone is 2 h — re-check the deadline first.
+      if [ "$deadline" -gt 0 ] && [ "$(date +%s)" -ge "$deadline" ]; then
+        echo "$(date -u +%FT%TZ) deadline reached, skipping 100k bonus" >> bench_retry.log
+        exit 0
+      fi
       echo "$(date -u +%FT%TZ) bonus: 100k scale run" >> bench_retry.log
       python bench.py --stages scale --scale_n 100000 > bench_r04_100k.log 2>&1
       rc2=$?
