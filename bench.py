@@ -214,6 +214,21 @@ def bench_primary(publish=None) -> dict:
                     }
                 except Exception as e:  # a failed DIAGNOSTIC must not cost the headline
                     out[f"rows_per_iter_{r}"] = {"error": repr(e)}
+            # decision evidence, machine-readable: the default flips only
+            # when a variant clears a 10% margin (link noise brackets
+            # smaller gaps even with _best_of)
+            speedups = {
+                r: out[f"rows_per_iter_{r}"].get("speedup_vs_default", 0.0)
+                for r in (2, 4)
+                if f"rows_per_iter_{r}" in out
+            }
+            if speedups:
+                best_r, best_s = max(speedups.items(), key=lambda kv: kv[1])
+                out["variant_recommendation"] = (
+                    f"set DREP_TPU_MASH_ROWS_PER_ITER={best_r} ({best_s:.2f}x)"
+                    if best_s > 1.1
+                    else "keep default rows_per_iter=1"
+                )
     finally:
         if prev_r is None:
             os.environ.pop("DREP_TPU_MASH_ROWS_PER_ITER", None)
