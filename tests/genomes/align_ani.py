@@ -99,27 +99,35 @@ def fragment_ani(
         raise ValueError("query shorter than one fragment")
     qs = np.ascontiguousarray(query[: n_frags * frag]).reshape(n_frags, frag)
 
+    # fastANI maps BOTH strands; the realistic mutation model includes
+    # inversions (generate.rearrange), whose fragments only anchor via
+    # their reverse complement
+    comp = np.zeros(256, np.uint8)
+    comp[np.frombuffer(b"ACGT", np.uint8)] = np.frombuffer(b"TGCA", np.uint8)
+
     anchored = []
     windows = []
     offsets = range(0, frag - SEED_K, 47)  # ~20 tries; coprime-ish stride
     for f in range(n_frags):
-        row = qs[f]
-        row_b = row.tobytes()
         diag = None
-        for off in offsets:
-            pos = idx.get(row_b[off : off + SEED_K])
-            if pos is not None:
-                diag = pos - off
+        for row in (qs[f], comp[qs[f]][::-1]):
+            row_b = row.tobytes()
+            for off in offsets:
+                pos = idx.get(row_b[off : off + SEED_K])
+                if pos is not None:
+                    diag = pos - off
+                    break
+            if diag is not None:
                 break
         if diag is None:
-            continue  # unmapped: no exact 15-mer anywhere — heavy divergence
+            continue  # unmapped: no exact 15-mer on either strand
         lo = diag - band
         cols = np.arange(lo, lo + frag + 2 * band)
         ok = (cols >= 0) & (cols < len(reference))
         win = np.where(ok, reference[np.clip(cols, 0, len(reference) - 1)], 0).astype(
             np.uint8
         )
-        anchored.append(row)
+        anchored.append(np.ascontiguousarray(row))
         windows.append(win)
 
     if not anchored:

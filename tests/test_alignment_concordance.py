@@ -25,7 +25,13 @@ import pytest
 sys.path.insert(0, str(Path(__file__).resolve().parent / "genomes"))
 
 from align_ani import fragment_ani  # noqa: E402
-from generate import mutate, mutate_indels, random_genome, write_fasta  # noqa: E402
+from generate import (  # noqa: E402
+    mutate,
+    mutate_indels,
+    random_genome,
+    rearrange,
+    write_fasta,
+)
 
 SUB_RATES = [0.01, 0.03, 0.05, 0.07]
 # sketch estimator noise at scale=50 on 60 kb (~1200 scaled hashes):
@@ -43,6 +49,7 @@ def planted(tmp_path_factory):
     for r in SUB_RATES:
         seqs[f"sub_{r}"] = mutate(rng, anc, r)
     seqs["indel"] = mutate_indels(rng, mutate(rng, anc, 0.02), 0.0005)
+    seqs["rearr"] = rearrange(rng, mutate(rng, anc, 0.03), 8_000)
     paths = []
     for name, seq in seqs.items():
         p = td / f"{name}.fasta"
@@ -103,4 +110,18 @@ def test_indel_regime_stays_concordant(planted):
     est = pipe["indel.fasta"]
     assert mapped > 0.7  # heavy-drift fragments legitimately drop out
     assert abs(est - oracle) < 0.03, (est, oracle)
+    assert (oracle >= 0.95) == (est >= 0.95)
+
+
+def test_inversion_regime_stays_concordant(planted):
+    """An 8 kb inversion leaves canonical k-mer sets (and so containment)
+    untouched while the oracle maps the inverted span via its reverse
+    complement (fastANI is strand-aware the same way) — both must still
+    agree, with only fragment-boundary loss separating them."""
+    paths, seqs = planted
+    pipe, _ = _pipeline_ani(paths)
+    oracle, mapped = fragment_ani(seqs["rearr"], seqs["anc"])
+    est = pipe["rearr.fasta"]
+    assert mapped > 0.9  # only inversion-boundary fragments may drop
+    assert abs(est - oracle) < 0.02, (est, oracle)
     assert (oracle >= 0.95) == (est >= 0.95)
