@@ -947,11 +947,35 @@ def link_health() -> dict:
     t0 = time.perf_counter()
     np.asarray(dev)
     d2h = big.nbytes / (time.perf_counter() - t0)
-    return {
+    out = {
         "dispatch_ms_median": round(statistics.median(lats) * 1e3, 2),
         "h2d_gbps": round(h2d / 1e9, 3),
         "d2h_gbps": round(d2h / 1e9, 3),
     }
+    # the Mosaic REMOTE COMPILE helper is a separate service from the
+    # execution path and fails independently (attempt 1: HTTP 500s on
+    # kernel compiles while execution still worked) — probe it with a
+    # trivial Pallas kernel at a per-process-unique width so the
+    # persistent XLA cache cannot satisfy it without the helper
+    if jax.devices()[0].platform == "tpu":
+        try:
+            import jax.experimental.pallas as pl
+
+            w = 128 * (2 + os.getpid() % 31)
+
+            def _probe_kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...] + 1
+
+            t0 = time.perf_counter()
+            y = pl.pallas_call(
+                _probe_kernel,
+                out_shape=jax.ShapeDtypeStruct((8, w), jnp.int32),
+            )(jnp.zeros((8, w), jnp.int32))
+            jax.block_until_ready(y)
+            out["pallas_compile_s"] = round(time.perf_counter() - t0, 2)
+        except Exception as e:  # helper down: context, not a bail
+            out["pallas_compile_error"] = repr(e)[:300]
+    return out
 
 
 def _emit(stages: dict) -> None:
