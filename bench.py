@@ -1075,7 +1075,11 @@ def main() -> None:
     # process killed, and a previous run's partial surviving that kill
     # would be misattributed to this run
     _clear_partial()
-    _require_devices()
+    if want:
+        # `--stages none` is the instant emit-contract probe: it must not
+        # touch the backend at all (on a wedged tunnel even the probe
+        # blocks for its full 240 s watchdog before the error line)
+        _require_devices()
 
     # (label, budget_seconds, thunk). Budgets are ~4x the longest wall
     # ever measured for the stage on the tunneled chip, because the
