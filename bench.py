@@ -367,6 +367,10 @@ def bench_secondary_production(publish=None) -> dict:
         "sketch": width,
         "v_pad": v_pad,
         "one_shot_fits": bool(matmul_rows_pad(m) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS),
+        # cleared when the first real rate lands: a wedge before then
+        # leaves a number-free record that must not read as a completed
+        # stage (ADVICE r4 medium — missing_stages keys on this)
+        "measurement_pending": True,
     }
     if publish is not None:
         publish(out)
@@ -377,6 +381,7 @@ def bench_secondary_production(publish=None) -> dict:
     n_chunks = -(-vocab_extent(packed.ids) // v_chunk)
     flops = 2.0 * matmul_rows_pad(m) ** 2 * n_chunks * v_chunk
     out["matmul_chunked"] = {**_rate_fields(pairs, dt_m), **_matmul_roofline(flops, dt_m)}
+    out.pop("measurement_pending", None)  # first real rate is in the record
 
     if jax.devices()[0].platform == "tpu":
         from drep_tpu.ops.containment import ani_cov_from_intersections
@@ -483,7 +488,7 @@ def bench_dispatch_crossover(publish=None) -> dict:
     # early-publish: 8 fresh kernel shapes compile in this loop; a wedge
     # at point 3 must not cost points 1-2 (the list is shared, the dict
     # is completed in place on return)
-    out: dict = {"table": table, "points_measured": 0}
+    out: dict = {"table": table, "points_measured": 0, "measurement_pending": True}
     if publish is not None:
         publish(out)
     for m, width, fill, ratio in points:
@@ -517,6 +522,7 @@ def bench_dispatch_crossover(publish=None) -> dict:
             }
         )
         out["points_measured"] = len(table)
+        out.pop("measurement_pending", None)  # >=1 real point in the record
     fitted = float(np.median(ratios_fit))
     out.pop("points_measured", None)  # complete: the table speaks for itself
     # the dispatch picks pallas_range when elem_cost * merge_units <
@@ -955,13 +961,17 @@ def link_health() -> dict:
     # the Mosaic REMOTE COMPILE helper is a separate service from the
     # execution path and fails independently (attempt 1: HTTP 500s on
     # kernel compiles while execution still worked) — probe it with a
-    # trivial Pallas kernel at a per-process-unique width so the
-    # persistent XLA cache cannot satisfy it without the helper
+    # trivial Pallas kernel at a per-invocation-unique width so the
+    # PERSISTENT on-disk XLA cache (enabled at startup, survives across
+    # processes) cannot satisfy it without the helper. pid%31 was only
+    # 31-way unique across a round's attempts (ADVICE r4); fold in wall
+    # time so a repeat width needs a same-second pid collision. 509
+    # widths keep the buffer <= 8*65408*4 B, safely inside VMEM.
     if jax.devices()[0].platform == "tpu":
         try:
             import jax.experimental.pallas as pl
 
-            w = 128 * (2 + os.getpid() % 31)
+            w = 128 * (2 + (os.getpid() ^ int(time.time())) % 509)
 
             def _probe_kernel(x_ref, o_ref):
                 o_ref[...] = x_ref[...] + 1

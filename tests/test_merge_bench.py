@@ -103,6 +103,56 @@ def test_cold_record_always_beats_warm_started():
         assert merged["stage_provenance"]["e2e_50k"]["attempt"] == 3
 
 
+def test_complete_record_beats_pending_regardless_of_rate():
+    """An attempt that wedged mid-stage (pending marker still set) must not
+    displace a complete record on a marginally higher fresh-leg rate — that
+    would drop the resume evidence and re-queue the stage (ADVICE r4)."""
+    complete = _attempt(1, {"e2e_50k": {
+        "pairs_per_sec_per_chip": 1.0e6, "resume_seconds": 72.0,
+        "resume_clusters_match": True}})
+    pending = _attempt(2, {"e2e_50k": {
+        "pairs_per_sec_per_chip": 1.1e6, "resume_pending": True}})
+    for order in ([complete, pending], [pending, complete]):
+        merged = mbp.merge(sorted(order))
+        assert merged["stages"]["e2e_50k"]["resume_clusters_match"] is True
+        assert merged["stage_provenance"]["e2e_50k"]["attempt"] == 1
+    # and a pending record still beats NOTHING (only-attempt case)
+    merged = mbp.merge([pending])
+    assert merged["stages"]["e2e_50k"]["pairs_per_sec_per_chip"] == 1.1e6
+
+
+def test_measurement_pending_counts_as_missing():
+    """missing_stages must keep early-published, number-free records (a
+    wedge before the first real measurement) on the re-measure list, and
+    must not trust a link stamp that is itself an error record."""
+    import importlib.util as _ilu
+
+    tool = os.path.join(os.path.dirname(__file__), "..", "tools", "missing_stages.py")
+    spec = _ilu.spec_from_file_location("missing_stages", tool)
+    ms = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+
+    link = {"dispatch_ms_median": 0.05, "h2d_gbps": 0.118, "d2h_gbps": 0.005}
+    merged = {
+        "stages": {
+            "secondary_production": {"n_genomes": 512, "measurement_pending": True},
+            "dispatch_crossover": {"table": [], "fitted_elem_cost": 47.0},
+            "primary": {"pairs_per_sec_per_chip": 2.7e6},
+        },
+        "stage_provenance": {
+            "secondary_production": {"attempt": 1, "link": link},
+            "dispatch_crossover": {"attempt": 1, "link": link},
+            # a watchdog-overrun link probe stores an error dict; it must
+            # read as NO stamp, not a healthy one (ADVICE r4)
+            "primary": {"attempt": 1, "link": {"error": "link probe exceeded 120s"}},
+        },
+    }
+    out = ms.missing(merged)
+    assert "production" in out  # pending -> still missing
+    assert "crossover" not in out  # measured + healthy stamp -> done
+    assert "primary" in out  # error-valued link stamp -> re-measure
+
+
 def test_duplicate_attempt_files_do_not_crash(tmp_path):
     """One attempt can leave BOTH an emitted partial and a preserved
     killed-partial; merging must not fall through to comparing dicts."""

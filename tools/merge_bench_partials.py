@@ -2,9 +2,9 @@
 
 The tunneled TPU wedges mid-run (PARITY.md round-3/4 session notes), so a
 round's hardware evidence accumulates across recovery windows as
-BENCH_r04_attempt<N>_partial.json files whose stage coverage differs —
+BENCH_r<N>_attempt<A>_partial.json files whose stage coverage differs —
 tools/bench_when_alive.sh alternates stage order across attempts for
-exactly this reason. This tool merges them into BENCH_r04_merged.json:
+exactly this reason. This tool merges them into BENCH_r<N>_merged.json:
 for every stage key, the best successful record across attempts, stamped
 with which attempt produced it and that attempt's measured link health
 (the `link` stage: dispatch latency + h2d/d2h bandwidth) so a reader can
@@ -82,7 +82,21 @@ def merge(attempts: list[tuple[int, dict]]) -> dict:
                 old, new = stages[key], val
                 old_warm = isinstance(old, dict) and old.get("warm_start_shards", 0) > 0
                 new_warm = isinstance(new, dict) and new.get("warm_start_shards", 0) > 0
-                if old_warm != new_warm:
+                old_pend = isinstance(old, dict) and bool(
+                    old.get("resume_pending") or old.get("measurement_pending")
+                )
+                new_pend = isinstance(new, dict) and bool(
+                    new.get("resume_pending") or new.get("measurement_pending")
+                )
+                if old_pend != new_pend:
+                    # completeness beats rate (ADVICE r4): an attempt that
+                    # wedged mid-stage (pending marker still set) must not
+                    # displace a complete record on a marginally higher
+                    # fresh-leg rate — that drops the resume evidence and
+                    # re-queues the stage, wasting a recovery window
+                    if new_pend:
+                        continue
+                elif old_warm != new_warm:
                     # a warm-started scale run's wall-clock rode a previous
                     # attempt's shards — its (inflated) rate never beats a
                     # cold measurement, and a cold one always replaces it
@@ -123,10 +137,10 @@ def merge(attempts: list[tuple[int, dict]]) -> dict:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--pattern", default="BENCH_r04_attempt*_partial.json",
+        "--pattern", default="BENCH_r05_attempt*_partial.json",
         help="glob of per-attempt partials (attempt number parsed from name)",
     )
-    ap.add_argument("--out", default="BENCH_r04_merged.json")
+    ap.add_argument("--out", default="BENCH_r05_merged.json")
     args = ap.parse_args()
     attempts = load_attempts(args.pattern)
     if not attempts:

@@ -29,6 +29,19 @@ PLAN_TO_RECORD = {
 }
 
 
+def _link_ok(link) -> bool:
+    """A usable link-health stamp has real bandwidth numbers. A watchdog
+    overrun stores {'error': ...} under stages['link'] and merge copies
+    that into provenance — non-None but measurement-free; treating it as
+    healthy would launder an unknown-link attempt's numbers (ADVICE r4)."""
+    return (
+        isinstance(link, dict)
+        and "error" not in link
+        and "h2d_gbps" in link
+        and "d2h_gbps" in link
+    )
+
+
 def missing(merged: dict) -> list[str]:
     stages = merged.get("stages", {})
     prov = merged.get("stage_provenance", {})
@@ -42,8 +55,13 @@ def missing(merged: dict) -> list[str]:
             # publishes the fresh number with this marker — keep the
             # stage on the re-measure list until the resume evidence lands
             and not rec.get("resume_pending")
+            # early-published stages (production/crossover) carry this
+            # until their first real measurement lands; a wedge before
+            # then leaves a number-free record that must not count as
+            # done (ADVICE r4 medium)
+            and not rec.get("measurement_pending")
         )
-        if not ok or prov.get(key, {}).get("link") is None:
+        if not ok or not _link_ok(prov.get(key, {}).get("link")):
             out.append(plan)
     # preserve bench.py's value ordering (its default_order) so the most
     # valuable missing number is measured first in the recovery window
@@ -55,7 +73,7 @@ def missing(merged: dict) -> list[str]:
 def main() -> None:
     import json
 
-    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_r04_merged.json"
+    path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_r05_merged.json"
     try:
         with open(path) as f:
             merged = json.load(f)
