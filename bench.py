@@ -347,11 +347,11 @@ def bench_secondary_production(publish=None) -> dict:
 
     from drep_tpu.cluster.engines import beyond_budget_secondary_path
     from drep_tpu.ops.containment import (
-        MATMUL_BUDGET_ELEMS,
         all_vs_all_containment_matmul_chunked,
         matmul_rows_pad,
         matmul_vocab_chunk,
         matmul_vocab_pad,
+        one_shot_fits,
     )
     from drep_tpu.ops.merge import next_pow2
     from drep_tpu.ops.minhash import PAD_ID
@@ -366,7 +366,7 @@ def bench_secondary_production(publish=None) -> dict:
         "n_genomes": m,
         "sketch": width,
         "v_pad": v_pad,
-        "one_shot_fits": bool(matmul_rows_pad(m) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS),
+        "one_shot_fits": bool(one_shot_fits(m, v_pad)),
         # cleared when the first real rate lands: a wedge before then
         # leaves a number-free record that must not read as a completed
         # stage (ADVICE r4 medium — missing_stages keys on this)
@@ -424,9 +424,7 @@ def bench_secondary_production(publish=None) -> dict:
     flops_r = 2.0 * matmul_rows_pad(packed_r.n) ** 2 * v_pad_r
     out["realistic_highoverlap"] = {
         "v_pad": v_pad_r,
-        "one_shot_fits": bool(
-            matmul_rows_pad(packed_r.n) * (v_pad_r + 1) <= MATMUL_BUDGET_ELEMS
-        ),
+        "one_shot_fits": bool(one_shot_fits(packed_r.n, v_pad_r)),
         **_rate_fields(packed_r.n * (packed_r.n - 1) / 2, dt_r),
         **_matmul_roofline(flops_r, dt_r),
     }
@@ -1041,7 +1039,10 @@ def main() -> None:
         help="comma list: primary,secondary,production,crossover,ingest,greedy,e2e,prod,scale",
     )
     ap.add_argument("--e2e_n", type=int, default=10_000)
-    ap.add_argument("--prod_n", type=int, default=5_000)
+    # n=10k: large enough that compile/fixed costs amortize (VERDICT r4
+    # missing #1 — the 5k composite could not distinguish fixed cost from
+    # secondary throughput), small enough for the 2400 s stage watchdog
+    ap.add_argument("--prod_n", type=int, default=10_000)
     ap.add_argument("--scale_n", type=int, default=50_000)
     ap.add_argument(
         "--reverse",

@@ -209,15 +209,14 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
     import jax
 
     from drep_tpu.ops.containment import (
-        MATMUL_BUDGET_ELEMS,
         all_vs_all_containment_matmul,
         all_vs_all_containment_matmul_chunked,
-        matmul_rows_pad,
         matmul_vocab_pad,
+        one_shot_fits,
     )
 
     v_pad = matmul_vocab_pad(packed)  # one scan; budget uses the REAL width
-    if matmul_rows_pad(packed.n) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS:
+    if one_shot_fits(packed.n, v_pad):
         _count_path("one_shot")
         return all_vs_all_containment_matmul(packed, k=k, v_pad=v_pad)
     mesh = _mesh_or_none(mesh_shape, packed.n)
@@ -267,14 +266,46 @@ def secondary_jax_ani_batched(
 
     At production scale most primary clusters hold a handful of genomes;
     one dispatch per cluster pays the host<->device round-trip latency
-    hundreds of times. Here every cluster's sketches pack into ONE matrix
-    (shared vocabulary), one intersection matmul runs, and each cluster's
-    diagonal block is sliced out. Cross-cluster blocks are wasted FLOPs —
-    a fine trade while the combined matrix stays small (the caller bounds
-    total rows)."""
+    hundreds of times. Only each cluster's DIAGONAL block of the pairwise
+    matrices is ever read, so the pack uses per-cluster-LOCAL dense id
+    spaces (ops/containment.py::pack_scaled_sketches_clusterlocal): the
+    joint vocabulary extent is the max single-cluster vocabulary, not the
+    union — at production sketch depth (20k-wide sketches, mostly private
+    hash space across unrelated clusters) the union pack measured 8.4M
+    ids and forced the chunked kernels (BENCH_r04 `e2e_prod`:
+    matmul_chunked x9, 0.756x), while the cluster-local pack stays in the
+    one-shot indicator regime. Falls back to the shared-vocabulary pack +
+    full path dispatch when a mesh is requested (the ring path computes
+    full matrices) or when even the local extent exceeds the one-shot
+    budget."""
+    from drep_tpu.ops.containment import (
+        all_vs_all_containment_matmul,
+        matmul_vocab_pad_extent,
+        one_shot_fits,
+        pack_scaled_sketches_clusterlocal,
+    )
+
     flat = [i for cl in clusters for i in cl]
-    packed = pack_scaled_sketches([gs.scaled[i] for i in flat], [gs.names[i] for i in flat])
-    ani_all, cov_all = containment_matrices(packed, gs.k, mesh_shape=mesh_shape, tile=tile)
+    names = [gs.names[i] for i in flat]
+    ani_all = cov_all = None
+    if _mesh_or_none(mesh_shape, len(flat)) is None:
+        packed_l, v_extent = pack_scaled_sketches_clusterlocal(
+            [[gs.scaled[i] for i in cl] for cl in clusters], names
+        )
+        v_pad = matmul_vocab_pad_extent(v_extent)
+        if one_shot_fits(packed_l.n, v_pad):
+            _count_path("one_shot_clusterlocal")
+            # full-matrix ani/cov over the cluster-local pack: diagonal
+            # blocks are exact; cross blocks are id-collision garbage the
+            # slicing below never reads
+            ani_all, cov_all = all_vs_all_containment_matmul(
+                packed_l, k=gs.k, v_pad=v_pad
+            )
+    if ani_all is None:
+        packed = pack_scaled_sketches([gs.scaled[i] for i in flat], names)
+        ani_all, cov_all = containment_matrices(
+            packed, gs.k, mesh_shape=mesh_shape, tile=tile
+        )
     out: list[tuple[np.ndarray, np.ndarray]] = []
     o = 0
     for cl in clusters:

@@ -67,6 +67,64 @@ def pack_scaled_sketches(
     return PackedSketches(ids=ids, counts=lens.astype(np.int32), names=list(names))
 
 
+def pack_scaled_sketches_clusterlocal(
+    sketch_groups: list[list[np.ndarray]],
+    names: list[str],
+    pad_multiple: int = 128,
+) -> tuple[PackedSketches, int]:
+    """Pack MANY clusters into one id matrix with per-cluster-LOCAL dense
+    id spaces: cluster c's ids are ranks into c's OWN vocabulary, so every
+    cluster shares the same narrow [0, v_extent) range.
+
+    This is the production-depth fix for the batched small-cluster
+    secondary (BENCH_r04 `e2e_prod`: 9 beyond-budget chunked calls): a
+    shared-vocabulary pack of 512 rows of ~20k-wide sketches unions to a
+    multi-million-id vocabulary (mostly private hash space across
+    unrelated clusters) and forces the chunked kernels, yet only the
+    per-cluster DIAGONAL blocks of the intersection matrix are ever read.
+    With cluster-local remapping the joint vocabulary extent is the MAX
+    single-cluster vocabulary (~tens of thousands: primary clustering
+    guarantees members are Mash-similar, so their sketches overlap), and
+    one one-shot indicator matmul serves the whole batch. Cross-cluster
+    blocks contain id collisions and are GARBAGE by construction — callers
+    must read diagonal blocks only.
+
+    Returns (packed, v_extent): `v_extent` = max cluster vocabulary size
+    (the honest extent for budget checks; `vocab_extent(packed.ids)` would
+    under-report when the widest cluster's top ids are unused).
+    """
+    if not sketch_groups:
+        raise ValueError("no clusters to pack")
+    # one searchsorted per GROUP over its concatenation, one global
+    # scatter for the matrix fill — same vectorized-repack idiom as
+    # pack_scaled_sketches (per-row Python loops were a measured hot spot
+    # at production cluster counts)
+    rank_parts: list[np.ndarray] = []
+    lens: list[int] = []
+    v_extent = 1
+    for group in sketch_groups:
+        flat = np.concatenate(group) if group else np.array([], np.uint64)
+        vocab = np.unique(flat)
+        if vocab.size >= np.iinfo(np.int32).max:
+            raise ValueError("id space overflow: >2^31 distinct sketch hashes")
+        v_extent = max(v_extent, int(vocab.size))
+        rank_parts.append(np.searchsorted(vocab, flat).astype(np.int32))
+        lens.extend(len(s) for s in group)
+    lens_arr = np.array(lens, dtype=np.int64)
+    n = len(lens_arr)
+    width = _pow2_bucket(max(int(lens_arr.max()) if n else 1, 1), pad_multiple)
+    ids = np.full((n, width), PAD_ID, dtype=np.int32)
+    flat_ranks = np.concatenate(rank_parts) if rank_parts else np.zeros(0, np.int32)
+    rows = np.repeat(np.arange(n), lens_arr)
+    offs = np.concatenate([[0], np.cumsum(lens_arr)[:-1]])
+    cols = np.arange(len(flat_ranks)) - np.repeat(offs, lens_arr)
+    ids[rows, cols] = flat_ranks  # ranks of a sorted-unique sketch are sorted
+    return (
+        PackedSketches(ids=ids, counts=lens_arr.astype(np.int32), names=list(names)),
+        v_extent,
+    )
+
+
 def _pair_intersection(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """|A ∩ B| for two sorted, PAD_ID-padded int32 rows (static shapes)."""
     idx = jnp.searchsorted(b, a)
@@ -141,6 +199,13 @@ def cap_gather_tile(row_width: int, tile: int, budget: int = GATHER_BUDGET_ELEMS
     return min(tile, 1 << (cap.bit_length() - 1))
 
 
+def matmul_vocab_pad_extent(extent: int) -> int:
+    """Bucketed indicator width for a known vocabulary extent — THE
+    pow2/floor rule every caller that already holds an extent (the
+    cluster-local batched pack) must share with :func:`matmul_vocab_pad`."""
+    return _pow2_bucket(max(extent, 1), _VOCAB_BUCKET_MIN)
+
+
 def matmul_vocab_pad(packed: PackedSketches) -> int:
     """Bucketed indicator width for the MXU path (one scan of packed.ids).
 
@@ -149,13 +214,33 @@ def matmul_vocab_pad(packed: PackedSketches) -> int:
     """
     from drep_tpu.ops.rangepart import vocab_extent
 
-    return _pow2_bucket(max(vocab_extent(packed.ids), 1), _VOCAB_BUCKET_MIN)
+    return matmul_vocab_pad_extent(vocab_extent(packed.ids))
 
 
-@functools.partial(jax.jit, static_argnames=("v_pad", "dtype"))
-def _intersect_matmul_jit(ids, *, v_pad: int, dtype):
-    ind = _indicator(ids, v_pad, dtype)
+def one_shot_fits(n_rows: int, v_pad: int) -> bool:
+    """Whether the [rows, v_pad(+trash)] indicator fits the one-shot
+    budget — THE dispatch inequality (containment_matrices, the batched
+    engine, and the bench all read this one definition so the budget rule
+    cannot drift between them)."""
+    return matmul_rows_pad(n_rows) * (v_pad + 1) <= MATMUL_BUDGET_ELEMS
+
+
+@functools.partial(jax.jit, static_argnames=("v_pad", "dtype", "use_pallas"))
+def _intersect_matmul_jit(ids, *, v_pad: int, dtype, use_pallas: bool = False):
+    ind = _indicator(ids, v_pad, dtype, use_pallas=use_pallas)
     return _int_dot(ind, ind)
+
+
+def _use_pallas_indicator(dtype) -> bool:
+    """Static (outside-jit) gate for the Pallas indicator build: int8 only
+    (the kernel writes int8) and the one-time on-device self-test passed
+    (ops/pallas_indicator.py — XLA's scatter measured ~10M elem/s on TPU
+    and dominated every production-width matmul stage)."""
+    if dtype != jnp.int8:
+        return False
+    from drep_tpu.ops.pallas_indicator import pallas_indicator_ok
+
+    return pallas_indicator_ok()
 
 
 def _intersect_matmul(ids, *, v_pad: int):
@@ -169,7 +254,10 @@ def _intersect_matmul(ids, *, v_pad: int):
     ships ONE integer matrix and the cov/ani elementwise math runs on host
     (host<->device links can be the bottleneck on tunneled TPU setups).
     """
-    return _intersect_matmul_jit(ids, v_pad=v_pad, dtype=_indicator_dtype(ids.shape[1]))
+    dtype = _indicator_dtype(ids.shape[1])
+    return _intersect_matmul_jit(
+        ids, v_pad=v_pad, dtype=dtype, use_pallas=_use_pallas_indicator(dtype)
+    )
 
 
 def ani_cov_from_intersections(
@@ -264,11 +352,28 @@ def _indicator_dtype(width: int):
     )
 
 
-def _indicator(ids, v_pad: int, dtype):
-    """[m, v_pad] 0/1 indicator from PAD-padded id rows — THE scatter
-    every MXU intersection kernel shares (pads land in a trash column that
-    the slice discards). `dtype` is resolved OUTSIDE jit (wrappers below)
-    so the env override participates in the compile-cache key."""
+def _indicator(ids, v_pad: int, dtype, use_pallas: bool = False):
+    """[m, v_pad] 0/1 indicator from PAD-padded id rows — THE build every
+    MXU intersection kernel shares. Two lowerings, identical semantics
+    (ids >= v_pad, PAD_ID included, contribute nothing):
+
+    - XLA scatter into a trash column (always correct, every backend);
+    - the Pallas VMEM scatter kernel when `use_pallas` (static, resolved
+      outside jit by :func:`_use_pallas_indicator` alongside `dtype` so
+      both participate in the compile-cache key) — the scatter was the
+      measured dominant cost of production-width stages (BENCH_r04).
+    """
+    from drep_tpu.ops.pallas_indicator import _rows_per_step, indicator_pallas
+
+    if (
+        use_pallas
+        # static trace-time guards: the kernel grid needs whole row steps
+        # and whole 128-lane vocab rows; pow2-bucketed callers always
+        # satisfy both, ad-hoc row counts (some rect callers) fall back
+        and ids.shape[0] % _rows_per_step(v_pad) == 0
+        and v_pad % 128 == 0
+    ):
+        return indicator_pallas(ids, v_pad)
     m, s = ids.shape
     rows = jax.lax.broadcasted_iota(jnp.int32, (m, s), 0)
     cols = jnp.where(ids != PAD_ID, ids, v_pad)
@@ -289,19 +394,24 @@ def _int_dot(a, b_t):
     ).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("v_pad", "dtype"))
-def _intersect_matmul_rect_jit(a_ids, b_ids, *, v_pad: int, dtype):
-    return _int_dot(_indicator(a_ids, v_pad, dtype), _indicator(b_ids, v_pad, dtype))
+@functools.partial(jax.jit, static_argnames=("v_pad", "dtype", "use_pallas"))
+def _intersect_matmul_rect_jit(a_ids, b_ids, *, v_pad: int, dtype, use_pallas: bool = False):
+    return _int_dot(
+        _indicator(a_ids, v_pad, dtype, use_pallas=use_pallas),
+        _indicator(b_ids, v_pad, dtype, use_pallas=use_pallas),
+    )
 
 
 def _intersect_matmul_rect(a_ids, b_ids, *, v_pad: int):
     """Rectangular intersection counts |A_i ∩ B_j| — two indicator
-    scatters, one MXU matmul contracting the vocabulary axis. The greedy
+    builds, one MXU matmul contracting the vocabulary axis. The greedy
     path's block-vs-representatives comparisons run here on TPU instead of
     through gather tiles (batched gathers serialize on the scalar unit —
     the measured ~70x penalty noted in ops/minhash.py)."""
     dt = _indicator_dtype(max(a_ids.shape[1], b_ids.shape[1]))
-    return _intersect_matmul_rect_jit(a_ids, b_ids, v_pad=v_pad, dtype=dt)
+    return _intersect_matmul_rect_jit(
+        a_ids, b_ids, v_pad=v_pad, dtype=dt, use_pallas=_use_pallas_indicator(dt)
+    )
 
 
 class VocabChunkGeometry:

@@ -1,0 +1,149 @@
+"""Pallas TPU kernel: build [m, V] 0/1 int8 indicator rows from packed ids.
+
+Every MXU intersection path (ops/containment.py one-shot / chunked /
+rectangular — SURVEY.md §7 step 6's production secondary; reference mount
+empty) starts by scattering each row's sketch ids into a dense indicator
+matrix. XLA lowers ``zeros.at[rows, cols].set(1)`` to a general scatter
+that TPU executes at ~10M elements/s — measured as the DOMINANT cost of
+the production-width regime (BENCH_r04 `secondary_production`: mfu 0.0022
+on the chunked path; `realistic_highoverlap` one-shot 1.95 s of which the
+[512, 32768] scatter is ~1.3 s). This kernel replaces it with a VMEM
+scatter loop: each grid step owns a [RB, V] output block, zero-fills it
+(vector stores), then walks its rows' ids with a while loop (sorted rows
+put PAD_ID last, so the loop stops at the first pad — no work on padding)
+and ORs a lane one-hot into the dynamic sublane row the id addresses.
+
+The id decomposes as (hi, lo) = (id >> 7, id & 127) over an output viewed
+[RB, V/128, 128]: `lo` selects a lane via an iota compare (one vector op)
+and `hi` a dynamically-indexed 128-lane row — lane-aligned dynamic-slice
+load/store, the access pattern Mosaic supports, instead of a per-element
+byte store at an arbitrary offset.
+
+Mosaic support for this pattern is validated by a one-time per-process
+SELF-TEST on the real device (compile + exact equality vs the XLA scatter
+on a tiny case): any failure — Mosaic rejection, remote-compile-helper
+outage, wrong numerics — permanently falls back to the XLA scatter for
+the process. The TPU tunnel in this image wedges for hours (PARITY.md),
+so new Mosaic patterns cannot be assumed validated at author time; the
+self-test makes the fast path self-deploying when hardware answers.
+`DREP_TPU_PALLAS_INDICATOR=0` pins the fallback for experiments.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+# VMEM cap for one grid step's output block (int8 bytes): RB*V <= this.
+# 8 MB leaves room for the [RB, W] id block and loop temporaries in a
+# ~16 MB VMEM budget.
+_BLOCK_BYTES = 1 << 23
+_MAX_ROWS_PER_STEP = 8
+
+
+def _indicator_kernel(ids_ref, out_ref):
+    """ids_ref [RB, W] int32 sorted rows (PAD_ID tail); out_ref
+    [RB, V/128, 128] int8 — this grid step's indicator block."""
+    rb, w = ids_ref.shape
+    v = out_ref.shape[1] * LANES
+    out_ref[...] = jnp.zeros_like(out_ref)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
+    def row_body(r, _):
+        def cond(c):
+            # sorted row: the first id >= v (PAD_ID or out-of-extent) ends
+            # the real prefix — no iterations spent on padding
+            return jnp.logical_and(c < w, ids_ref[r, c] < v)
+
+        def step(c):
+            idx = ids_ref[r, c]
+            hi = idx // LANES
+            lo = idx - hi * LANES
+            cur = out_ref[r, pl.dslice(hi, 1), :]
+            out_ref[r, pl.dslice(hi, 1), :] = jnp.where(lane == lo, 1, cur).astype(
+                jnp.int8
+            )
+            return c + 1
+
+        jax.lax.while_loop(cond, step, 0)
+        return 0
+
+    jax.lax.fori_loop(0, rb, row_body, 0)
+
+
+def _rows_per_step(v_pad: int) -> int:
+    return max(1, min(_MAX_ROWS_PER_STEP, _BLOCK_BYTES // max(v_pad, 1)))
+
+
+@functools.partial(jax.jit, static_argnames=("v_pad", "interpret"))
+def _indicator_pallas_jit(ids, *, v_pad: int, interpret: bool = False):
+    m, _w = ids.shape
+    rb = _rows_per_step(v_pad)
+    grid = (m // rb,)
+    out = pl.pallas_call(
+        _indicator_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (rb, ids.shape[1]), lambda i: (i, 0), memory_space=pltpu.VMEM
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (rb, v_pad // LANES, LANES), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, v_pad // LANES, LANES), jnp.int8),
+        interpret=interpret,
+    )(ids)
+    return out.reshape(m, v_pad)
+
+
+def indicator_pallas(ids, v_pad: int):
+    """[m, v_pad] int8 indicator. Caller contract: `pallas_indicator_ok()`
+    returned True (TPU backend, self-test passed), m % rows-per-step == 0
+    (pow2 row buckets satisfy this), v_pad % 128 == 0 (pow2 vocab buckets
+    satisfy this). Ids >= v_pad (PAD_ID included) are ignored — the same
+    semantics as the XLA scatter's trash column."""
+    return _indicator_pallas_jit(ids, v_pad=v_pad)
+
+
+_SELFTEST: dict[str, bool | None] = {"ok": None}
+
+
+def pallas_indicator_ok() -> bool:
+    """One-time per-process gate for the fast path: False off-TPU or when
+    the env pin says no; otherwise compile-and-verify a tiny case against
+    a host-built oracle, caching the outcome. A Mosaic rejection or a
+    numerics mismatch must never break a pipeline run — the XLA scatter
+    is always a correct (slower) substitute."""
+    if _SELFTEST["ok"] is not None:
+        return _SELFTEST["ok"]
+    if os.environ.get("DREP_TPU_PALLAS_INDICATOR", "") == "0":
+        _SELFTEST["ok"] = False
+        return False
+    try:
+        if jax.devices()[0].platform != "tpu":
+            _SELFTEST["ok"] = False
+            return False
+        from drep_tpu.ops.minhash import PAD_ID
+
+        rng = np.random.default_rng(0)
+        v_pad = 256
+        ids = np.full((8, 128), PAD_ID, np.int32)
+        for i in range(8):
+            n = int(rng.integers(0, 100))
+            ids[i, :n] = np.sort(rng.choice(v_pad, size=n, replace=False))
+        got = np.asarray(indicator_pallas(jnp.asarray(ids), v_pad))
+        want = np.zeros((8, v_pad), np.int8)
+        for i in range(8):
+            want[i, ids[i][ids[i] != PAD_ID]] = 1
+        _SELFTEST["ok"] = bool(np.array_equal(got, want))
+    except Exception:  # any compile/runtime failure -> permanent fallback
+        _SELFTEST["ok"] = False
+    return _SELFTEST["ok"]

@@ -93,6 +93,115 @@ def _intersect_kernel(a_ref, b_ref, out_ref):
     jax.lax.fori_loop(0, ta, body, 0)
 
 
+def _intersect_kernel_stacked(a_ref, b_ref, out_ref):
+    """Fused range-bucket variant of :func:`_intersect_kernel`.
+
+    a_ref [1, TA, S2] DESCENDING rows of ONE range bucket; b_ref
+    [1, TB, S2] ascending rows of the same bucket; out_ref [TA, TB] int32
+    counts ACCUMULATED across the innermost grid dimension (buckets):
+    intersection counts are additive over disjoint id ranges, and the out
+    index_map ignores the bucket index, so consecutive grid steps revisit
+    the same output tile — zeroed at bucket 0, added to after (the
+    standard Mosaic reduction-dimension pattern, cf. a matmul K loop).
+    One launch + one stacked operand transfer replaces R separate
+    launches/transfers (BENCH_r04 `secondary_production.pallas_range`:
+    vpu_frac 0.026 — overhead-bound, not compute-bound)."""
+    ta = a_ref.shape[1]
+    tb, s2 = b_ref.shape[1], b_ref.shape[2]
+    length = 2 * s2
+    r = pl.program_id(2)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    b_block = b_ref[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (tb, length), 1)
+
+    def body(i, _):
+        a_row = a_ref[0, i, :]
+        x = jnp.concatenate(
+            [b_block, jnp.broadcast_to(a_row[None, :], (tb, s2))], axis=1
+        )
+        x = _merge_bitonic(x, length)
+        prev = pltpu.roll(x, 1, 1)
+        dup = (x == prev) & (x != PAD_ID) & (col > 0)
+        out_ref[i, :] = out_ref[i, :] + jnp.sum(dup.astype(jnp.int32), axis=1)
+        return 0
+
+    jax.lax.fori_loop(0, ta, body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _intersect_grid_symmetric_stacked(stacked, *, tile: int, interpret: bool):
+    """Self-comparison over stacked range buckets [R, na, S2] (ascending
+    rows): the wrapped symmetric half-grid of `_intersect_grid_symmetric`
+    with an innermost bucket dimension accumulating into each output tile.
+    The A-side reversal happens ON DEVICE (jnp.flip) so the host ships the
+    stacked tensor once, not twice."""
+    r_n, na, s2 = stacked.shape
+    a_rev = jnp.flip(stacked, axis=2)
+    t = na // tile
+    th = t // 2 + 1
+    grid = (t, th, r_n)
+    return pl.pallas_call(
+        _intersect_kernel_stacked,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, tile, s2), lambda i, jj, r: (r, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tile, s2),
+                lambda i, jj, r: (r, (i + jj) % t, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile, tile), lambda i, jj, r: (i, jj), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((na, th * tile), jnp.int32),
+        interpret=interpret,
+    )(a_rev, stacked)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_a", "tile_b", "interpret"))
+def _intersect_grid_rect_stacked(a_stacked, b_stacked, *, tile_a: int, tile_b: int, interpret: bool):
+    """Rectangular stacked-bucket grid: [R, na, S2] x [R, nb, S2] ->
+    [na, nb] accumulated across the innermost bucket dimension."""
+    r_n, na, s2 = a_stacked.shape
+    nb = b_stacked.shape[1]
+    a_rev = jnp.flip(a_stacked, axis=2)
+    grid = (na // tile_a, nb // tile_b, r_n)
+    return pl.pallas_call(
+        _intersect_kernel_stacked,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, tile_a, s2), lambda i, j, r: (r, i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, tile_b, s2), lambda i, j, r: (r, j, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (tile_a, tile_b), lambda i, j, r: (i, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((na, nb), jnp.int32),
+        interpret=interpret,
+    )(a_rev, b_stacked)
+
+
+def _pad_rows_stacked(stacked: np.ndarray, multiple: int) -> np.ndarray:
+    """Pad the row axis (axis=1) of a [R, N, W] stacked tensor to a tile
+    multiple with PAD_ID rows."""
+    n = stacked.shape[1]
+    nt = -(-n // multiple) * multiple
+    if nt == n:
+        return stacked
+    return np.pad(stacked, ((0, 0), (0, nt - n), (0, 0)), constant_values=PAD_ID)
+
+
 def _use_interpret() -> bool:
     # device platform, not jax.default_backend(): TPU access can ride a
     # plugin whose backend name differs while devices still report "tpu"
@@ -249,28 +358,23 @@ def intersect_counts_pallas(
         return np.asarray(inter)[:na, :nb]
 
     if force == "range" or (force is None and not _use_interpret()):
-        from drep_tpu.ops.rangepart import partition_by_range
+        from drep_tpu.ops.rangepart import stacked_range_buckets
 
-        # accumulate bucket grids ON DEVICE, transfer once — per-bucket
-        # host syncs serialize on link latency (tunneled-TPU measurement in
-        # containment.all_vs_all_containment_matmul_chunked)
-        interpret = _use_interpret()
-        acc = None
-        for _origin, (a_r, b_r) in partition_by_range([a, b], PALLAS_MAX_WIDTH):
-            s2_r = max(128, next_pow2(a_r.shape[1]))
-            ar = _pad_rows(_pad_cols_pow2(a_r, s2_r), TILE_A)
-            br = _pad_rows(_pad_cols_pow2(b_r, s2_r), TILE_B)
-            part = _intersect_grid(
-                np.ascontiguousarray(ar[:, ::-1]),
-                br,
-                tile_a=TILE_A,
-                tile_b=TILE_B,
-                interpret=interpret,
-            )
-            acc = part if acc is None else acc + part
-        if acc is None:
+        # ONE stacked [R, n, W] tensor per side, one transfer, one fused
+        # launch with bucket accumulation inside the grid — per-bucket
+        # repack/transfer/launch loops measured overhead-bound
+        # (BENCH_r04 secondary_production.pallas_range vpu_frac 0.026)
+        a_st, b_st = stacked_range_buckets([a, b], PALLAS_MAX_WIDTH)
+        if a_st.shape[0] == 0:
             return np.zeros((na, nb), dtype=np.int32)
-        return np.asarray(acc)[:na, :nb]
+        inter = _intersect_grid_rect_stacked(
+            _pad_rows_stacked(a_st, TILE_A),
+            _pad_rows_stacked(b_st, TILE_B),
+            tile_a=TILE_A,
+            tile_b=TILE_B,
+            interpret=_use_interpret(),
+        )
+        return np.asarray(inter)[:na, :nb]
 
     return _intersect_jnp_tiled(a, b, jnp_tile)[:na, :nb]
 
@@ -287,26 +391,22 @@ def intersect_counts_pallas_self(
     a = _pad_cols_pow2(np.ascontiguousarray(ids), s2)
     if s2 > PALLAS_MAX_WIDTH:
         if force == "range" or (force is None and not _use_interpret()):
-            from drep_tpu.ops.rangepart import partition_by_range
+            from drep_tpu.ops.rangepart import stacked_range_buckets
 
-            # every bucket shares the wrapped-compact output layout (same
-            # rows, same tile), so the half-grids accumulate ON DEVICE and
-            # one transfer + one unwrap closes the sum
-            interpret = _use_interpret()
-            acc = None
-            for _origin, (bucket,) in partition_by_range([a], PALLAS_MAX_WIDTH):
-                s2_r = max(128, next_pow2(bucket.shape[1]))
-                ar = _pad_rows(_pad_cols_pow2(bucket, s2_r), TILE_A)
-                part = _intersect_grid_symmetric(
-                    np.ascontiguousarray(ar[:, ::-1]),
-                    ar,
-                    tile=TILE_A,
-                    interpret=interpret,
-                )
-                acc = part if acc is None else acc + part
-            if acc is None:
+            # ONE stacked [R, n, W] tensor, one transfer, one fused launch:
+            # the wrapped half-grid gains an innermost bucket dimension
+            # that accumulates into each output tile (see
+            # _intersect_kernel_stacked) — replacing the per-bucket
+            # repack/transfer/launch loop that measured overhead-bound
+            (stacked,) = stacked_range_buckets([a], PALLAS_MAX_WIDTH)
+            if stacked.shape[0] == 0:
                 return np.zeros((n, n), dtype=np.int32)
-            return _unwrap_symmetric(np.asarray(acc), TILE_A)[:n, :n]
+            compact = _intersect_grid_symmetric_stacked(
+                _pad_rows_stacked(stacked, TILE_A),
+                tile=TILE_A,
+                interpret=_use_interpret(),
+            )
+            return _unwrap_symmetric(np.asarray(compact), TILE_A)[:n, :n]
         return _intersect_jnp_tiled(a, a, jnp_tile)[:n, :n]
     a = _pad_rows(a, TILE_A)
     compact = _intersect_grid_symmetric(

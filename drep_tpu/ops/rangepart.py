@@ -155,3 +155,52 @@ def partition_by_range(
         )
 
 
+def stacked_range_buckets(
+    mats: list[np.ndarray], max_count: int
+) -> list[np.ndarray]:
+    """Range partition like :func:`partition_by_range`, but materialized as
+    ONE [R, N_i, W] stacked tensor per input at a COMMON pow2 width W
+    (<= max_count) — the layout the fused Pallas merge grid consumes
+    (ops/pallas_merge.py): all buckets cross the host->device link in one
+    transfer and run in one kernel launch with an innermost
+    bucket-accumulation grid dimension, instead of R separate repacks +
+    transfers + launches (BENCH_r04 `secondary_production.pallas_range`
+    measured vpu_frac 0.026 — launch/transfer overhead, not compute).
+
+    Buckets empty across ALL inputs are dropped (R counts kept buckets
+    only). Values keep their global ids (no rebase): the merge kernel
+    compares for equality/order only, and each bucket's rows share one
+    disjoint global range, so cross-bucket collisions are impossible.
+    """
+    if max_count < MIN_BUCKET_WIDTH:
+        raise ValueError(f"max_count {max_count} below lane width {MIN_BUCKET_WIDTH}")
+    if max_count & (max_count - 1):
+        raise ValueError(f"max_count {max_count} must be a power of two")
+    vocab = _vocab_extent(mats)
+    if vocab == 0:
+        return [np.full((0, m.shape[0], MIN_BUCKET_WIDTH), PAD_ID, np.int32) for m in mats]
+    longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
+    n_buckets = max(1, next_pow2(-(-longest // max_count)))
+    while True:
+        chunk = -(-vocab // n_buckets)
+        starts = [bucket_starts(m, chunk, n_buckets) for m in mats]
+        hists = [np.diff(s, axis=1) for s in starts]
+        worst = max(int(h.max()) for h in hists)
+        if worst <= max_count or chunk <= max_count:
+            break
+        n_buckets *= 2
+    keep = [
+        r
+        for r in range(n_buckets)
+        if any(int(h[:, r].max()) > 0 for h in hists)
+    ]
+    width = max(MIN_BUCKET_WIDTH, next_pow2(worst))
+    out = []
+    for m, s, h in zip(mats, starts, hists):
+        stacked = np.full((len(keep), m.shape[0], width), PAD_ID, np.int32)
+        for o, r in enumerate(keep):
+            stacked[o] = repack_bucket(m, s[:, r], h[:, r], width)
+        out.append(stacked)
+    return out
+
+

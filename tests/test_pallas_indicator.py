@@ -1,0 +1,68 @@
+"""Pallas indicator-build kernel (ops/pallas_indicator.py) vs the XLA
+scatter it replaces — interpret-mode equality on CPU; the on-device
+self-test gate (`pallas_indicator_ok`) is exercised for its fallback
+behavior (off-TPU it must say no and the matmul paths must keep working
+through the scatter)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from drep_tpu.ops.minhash import PAD_ID
+from drep_tpu.ops.pallas_indicator import (
+    _indicator_pallas_jit,
+    _rows_per_step,
+    pallas_indicator_ok,
+)
+
+
+def _oracle(ids, v_pad):
+    out = np.zeros((ids.shape[0], v_pad), np.int8)
+    for i in range(ids.shape[0]):
+        real = ids[i][(ids[i] != PAD_ID) & (ids[i] < v_pad)]
+        out[i, real] = 1
+    return out
+
+
+@pytest.mark.parametrize("v_pad", [256, 8192])
+def test_kernel_matches_oracle_interpret(v_pad):
+    rng = np.random.default_rng(4)
+    m, w = 16, 128
+    ids = np.full((m, w), PAD_ID, np.int32)
+    for i in range(m):
+        n = int(rng.integers(0, w))
+        ids[i, :n] = np.sort(rng.choice(v_pad, size=min(n, v_pad), replace=False))
+    got = np.asarray(_indicator_pallas_jit(jnp.asarray(ids), v_pad=v_pad, interpret=True))
+    np.testing.assert_array_equal(got, _oracle(ids, v_pad))
+
+
+def test_kernel_ignores_out_of_extent_ids_interpret():
+    """Ids >= v_pad (the scatter's trash-column cases) contribute nothing;
+    an all-pad row stays all-zero."""
+    v_pad = 256
+    ids = np.full((8, 128), PAD_ID, np.int32)
+    ids[0, :3] = [0, 255, 256]  # 256 is out of extent
+    got = np.asarray(_indicator_pallas_jit(jnp.asarray(ids), v_pad=v_pad, interpret=True))
+    want = np.zeros((8, v_pad), np.int8)
+    want[0, [0, 255]] = 1
+    np.testing.assert_array_equal(got, want)
+
+
+def test_rows_per_step_respects_vmem_and_pow2():
+    assert _rows_per_step(8192) == 8
+    assert _rows_per_step(1 << 20) == 8
+    assert _rows_per_step(1 << 23) == 1
+    assert _rows_per_step(1 << 24) == 1  # never zero
+
+
+def test_gate_is_false_off_tpu_and_paths_still_work():
+    assert pallas_indicator_ok() is False  # CPU backend in tests
+    # the matmul path must keep producing exact counts through the scatter
+    from drep_tpu.ops.containment import _intersect_matmul
+
+    ids = np.full((4, 128), PAD_ID, np.int32)
+    ids[0, :2] = [1, 5]
+    ids[1, :3] = [1, 5, 9]
+    ids[2, :1] = [9]
+    inter = np.asarray(_intersect_matmul(jnp.asarray(ids), v_pad=8192))
+    assert inter[0, 1] == 2 and inter[1, 2] == 1 and inter[0, 2] == 0

@@ -123,6 +123,40 @@ def test_range_partitioned_self_matches_rectangular(rng):
     np.testing.assert_array_equal(got, got.T)
 
 
+def test_stacked_range_buckets_reconstruct_and_share_layout(rng):
+    """The fused-kernel layout: every input's real elements survive the
+    stacked repack exactly once, buckets share boundaries and ONE common
+    width <= max_count, and all-empty buckets are dropped."""
+    from drep_tpu.ops.rangepart import stacked_range_buckets
+
+    a = _sorted_rows(rng, 6, 700, 4096)
+    b = _sorted_rows(rng, 4, 500, 4096)
+    a_st, b_st = stacked_range_buckets([a, b], MIN_BUCKET_WIDTH)
+    assert a_st.shape[0] == b_st.shape[0]  # shared bucket set
+    assert a_st.shape[2] == b_st.shape[2] <= MIN_BUCKET_WIDTH
+    for mat, st in ((a, a_st), (b, b_st)):
+        for i in range(mat.shape[0]):
+            got = np.sort(st[:, i][st[:, i] != PAD_ID])
+            np.testing.assert_array_equal(got, mat[i][mat[i] != PAD_ID])
+    # no bucket is empty across BOTH inputs
+    for r in range(a_st.shape[0]):
+        assert (a_st[r] != PAD_ID).any() or (b_st[r] != PAD_ID).any()
+
+
+def test_stacked_buckets_hold_disjoint_ranges(rng):
+    """Each kept bucket's values must lie in one disjoint global range —
+    the additivity precondition the fused kernel's accumulation rests on."""
+    from drep_tpu.ops.rangepart import stacked_range_buckets
+
+    (st,) = stacked_range_buckets([_sorted_rows(rng, 5, 900, 5000)], MIN_BUCKET_WIDTH)
+    prev_max = -1
+    for r in range(st.shape[0]):
+        vals = st[r][st[r] != PAD_ID]
+        if vals.size:
+            assert int(vals.min()) > prev_max
+            prev_max = int(vals.max())
+
+
 def test_jnp_fallback_is_capped_and_exact(rng):
     """The over-width jnp fallback must obey the shared HBM-temp budget
     (VERDICT r2 weak #1: a fixed 128-tile at width 32768 materializes
