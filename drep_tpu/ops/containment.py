@@ -478,6 +478,86 @@ def rect_from_chunks(a_chunks, b_chunks, v_chunk: int) -> np.ndarray:
     return np.asarray(acc)
 
 
+def self_from_chunks(chunks, v_chunk: int) -> np.ndarray:
+    """Σ_c |A∩A| over one side's chunk tensors — ONE indicator build per
+    chunk instead of rect_from_chunks' two (the operands are identical;
+    the greedy block self-comparison was paying a second build per block
+    for no information)."""
+    acc = None
+    for c in chunks:
+        part = _intersect_matmul(jnp.asarray(c), v_pad=v_chunk)
+        acc = part if acc is None else acc + part
+    return np.asarray(acc)
+
+
+@functools.lru_cache(maxsize=None)
+def _rect_sharded_fn(v_pad: int, dtype_name: str, use_pallas: bool, mesh):
+    """One jitted shard_map program per (v_pad, dtype, pallas-gate, mesh):
+    A rows sharded over the mesh axis, B replicated, each device building
+    its shard's indicators locally and contracting on its own MXU — no
+    collectives at all (the output stays row-sharded until the host
+    gather). Follows parallel/allpairs.py's per-mesh lru_cache pattern."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from drep_tpu.parallel.mesh import AXIS
+
+    dtype = {"int8": jnp.int8, "float32": jnp.float32}[dtype_name]
+
+    def body(a, b):
+        return _int_dot(
+            _indicator(a, v_pad, dtype, use_pallas=use_pallas),
+            _indicator(b, v_pad, dtype, use_pallas=use_pallas),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(AXIS, None), P(None, None)),
+            out_specs=P(AXIS, None),
+        )
+    )
+
+
+def replicate_on_mesh(arr: np.ndarray, mesh):
+    """Device-put a host array replicated across every mesh device — for
+    append-only operand caches (greedy's filled rep tiles) that should
+    cross the link once, not once per block."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from drep_tpu.parallel.allpairs import put_global
+
+    return put_global(arr, NamedSharding(mesh, P(None, None)))
+
+
+def rect_from_chunks_sharded(a_chunks, b_chunks, v_chunk: int, mesh) -> np.ndarray:
+    """`rect_from_chunks` with the A rows sharded across a device mesh and
+    B replicated — the greedy engine's candidate-block parallelism
+    (BASELINE config 5: 100k greedy dereplicate on a multi-chip mesh).
+    A's row count must divide the mesh size (callers pad blocks to a
+    device multiple). B chunks may be host arrays (shipped replicated
+    here) or already-replicated device arrays from
+    :func:`replicate_on_mesh` (zero link traffic). The result gathers via
+    the multi-host-safe allgather path, not np.asarray (remote shards
+    have no local buffers on a pod)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from drep_tpu.parallel.allpairs import gather_global, put_global
+    from drep_tpu.parallel.mesh import AXIS
+
+    dt = _indicator_dtype(max(a_chunks[0].shape[1], b_chunks[0].shape[1]))
+    fn = _rect_sharded_fn(
+        v_chunk, str(np.dtype(dt)), _use_pallas_indicator(dt), mesh
+    )
+    row_sh = NamedSharding(mesh, P(AXIS, None))
+    acc = None
+    for a_c, b_c in zip(a_chunks, b_chunks):
+        b_d = b_c if isinstance(b_c, jax.Array) else replicate_on_mesh(np.asarray(b_c), mesh)
+        part = fn(put_global(np.asarray(a_c), row_sh), b_d)
+        acc = part if acc is None else acc + part
+    return gather_global(acc)
+
+
 def intersect_counts_matmul_rect(a_ids: np.ndarray, b_ids: np.ndarray) -> np.ndarray:
     """|A_i ∩ B_j| for sorted PAD-padded id rows sharing one id space,
     chunking the vocabulary when the joint indicator exceeds the budget

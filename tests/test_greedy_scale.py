@@ -64,6 +64,52 @@ def test_greedy_recovers_planted_clusters(synthetic):
     assert dt < 60, f"greedy took {dt:.1f}s — pair-loop regression?"
 
 
+def test_greedy_mesh_sharded_equals_single_device(synthetic, monkeypatch):
+    """The mesh-sharded matmul route (candidate blocks sharded over the
+    CPU test mesh, reps replicated — BASELINE config 5's 100k multi-chip
+    greedy) must reproduce the single-device run exactly: same labels,
+    same Ndb comparison set and values. DREP_TPU_GREEDY_MATMUL forces the
+    matmul family off-TPU; mesh_shape picks the 8-device test mesh."""
+    gs, _truth = synthetic
+    m = len(gs.names)
+    kw = {"S_ani": 0.95, "cov_thresh": 0.1}
+    want_ndb, want_labels = greedy_secondary_cluster(gs, None, list(range(m)), pc=1, kw=kw)
+
+    monkeypatch.setenv("DREP_TPU_GREEDY_MATMUL", "1")
+    kw_mesh = {**kw, "mesh_shape": 8}
+    got_ndb, got_labels = greedy_secondary_cluster(gs, None, list(range(m)), pc=1, kw=kw_mesh)
+
+    np.testing.assert_array_equal(got_labels, want_labels)
+    assert len(got_ndb) == len(want_ndb)
+    for col in ("reference", "querry"):
+        assert list(got_ndb[col]) == list(want_ndb[col])
+    for col in ("ani", "alignment_coverage", "ref_coverage", "querry_coverage"):
+        np.testing.assert_allclose(got_ndb[col], want_ndb[col], atol=1e-6, err_msg=col)
+
+    from drep_tpu.cluster.greedy import GREEDY_TIMINGS
+
+    assert GREEDY_TIMINGS.get("device_compare_s", 0) > 0  # attribution recorded
+
+
+def test_greedy_matmul_single_device_equals_gather(synthetic, monkeypatch):
+    """The NON-mesh matmul route (the default single-chip TPU production
+    path, incl. the single-indicator self comparison) forced onto CPU via
+    the env knob + mesh_shape=1 must reproduce the gather-path run."""
+    gs, _truth = synthetic
+    m = len(gs.names)
+    kw = {"S_ani": 0.95, "cov_thresh": 0.1}
+    want_ndb, want_labels = greedy_secondary_cluster(gs, None, list(range(m)), pc=1, kw=kw)
+
+    monkeypatch.setenv("DREP_TPU_GREEDY_MATMUL", "1")
+    kw_one = {**kw, "mesh_shape": 1}  # pin single device: 8 CPU test devices
+    got_ndb, got_labels = greedy_secondary_cluster(gs, None, list(range(m)), pc=1, kw=kw_one)
+
+    np.testing.assert_array_equal(got_labels, want_labels)
+    assert len(got_ndb) == len(want_ndb)
+    for col in ("ani", "alignment_coverage", "ref_coverage", "querry_coverage"):
+        np.testing.assert_allclose(got_ndb[col], want_ndb[col], atol=1e-6, err_msg=col)
+
+
 def test_greedy_from_matrices_equals_engine(synthetic):
     """The small-cluster route (batched matrices + host greedy assignment)
     must reproduce the per-cluster greedy engine exactly: same labels,
