@@ -15,8 +15,10 @@ the pack matmul, so processes, not threads). The optional C++ ingest
 
 from __future__ import annotations
 
+import contextlib
 import multiprocessing
 import os
+import time
 from concurrent.futures import ProcessPoolExecutor
 from dataclasses import dataclass
 
@@ -199,6 +201,17 @@ def _load_sketch_shard(path: str) -> dict[str, dict]:
     return out
 
 
+_INGEST_BARRIER_ENV = "DREP_TPU_INGEST_BARRIER_S"
+_INGEST_BARRIER_POLL_S = 0.2
+
+
+def _barrier_deadline() -> float:
+    """Monotonic deadline for the sharded-ingest coordination waits (one
+    env knob, one default, shared by the assembly barrier and the
+    marker wait so the two cannot drift)."""
+    return time.monotonic() + float(os.environ.get(_INGEST_BARRIER_ENV, "600"))
+
+
 def sketch_genomes(
     bdb: pd.DataFrame,
     k: int = kmers.DEFAULT_K,
@@ -260,14 +273,51 @@ def sketch_genomes(
                     len(results), len(jobs),
                 )
 
-    todo = [j for j in jobs if j[0] not in results]
+    # per-process sharded ingest (SURVEY.md §7 hard part (f)): under an
+    # initialized jax.distributed runtime each process sketches only its
+    # stripe of the work into the shared shard dir (writes are atomic —
+    # tmp suffix + os.replace), then assembles the full set by polling
+    # the dir until every genome is covered. The barrier is DATA
+    # COMPLETENESS, not marker files: stale state from a killed run can
+    # delay it only until the owning process re-sketches, never fake it.
+    # jax.process_count() is safe here: open_checkpoint_dir above already
+    # initialized the backend on every wd path.
+    nproc, pid = 1, 0
+    if shard_dir is not None:
+        import jax
+
+        nproc, pid = jax.process_count(), jax.process_index()
+    if nproc > 1:
+        # stripe ownership keys on the GLOBAL job index, never on the
+        # locally-observed resume state: two processes whose resume globs
+        # saw different shard sets would otherwise interleave DIFFERENT
+        # todo lists, leaving some genome in nobody's stripe and every
+        # process stuck in the barrier below
+        todo = [
+            j for i, j in enumerate(jobs)
+            if i % nproc == pid and j[0] not in results
+        ]
+        # best-effort hygiene (pid 0, right after the synchronized
+        # checkpoint-dir open): a previous killed run's assembly markers
+        # must not satisfy this run's marker wait instantly — the
+        # cache-first ordering and tolerant marker writes below keep any
+        # residual race benign, this just removes the common case
+        if pid == 0:
+            import glob as _glob
+
+            for f in _glob.glob(os.path.join(shard_dir, "assembled_*.done")):
+                with contextlib.suppress(OSError):
+                    os.remove(f)
+    else:
+        todo = [j for j in jobs if j[0] not in results]
+    my_shard_files: set[str] = set()  # shards THIS process wrote (skip re-reading)
     pending: dict[str, dict] = {}
 
     def flush(force: bool = False) -> None:
         if shard_dir is not None and pending and (force or len(pending) >= INGEST_SHARD):
-            _save_sketch_shard(
-                os.path.join(shard_dir, f"shard_{uuid.uuid4().hex}.npz"), pending
-            )
+            path = os.path.join(shard_dir, f"shard_{uuid.uuid4().hex}.npz")
+            _save_sketch_shard(path, pending)
+            my_shard_files.add(path)  # already in `results`: barrier skips it
             pending.clear()
 
     def collect(name: str, res: dict) -> None:
@@ -294,6 +344,46 @@ def sketch_genomes(
         for job in todo:
             collect(*_sketch_one(job))
     flush(force=True)
+
+    if nproc > 1:
+        # assemble peers' stripes: re-glob until all genomes are covered,
+        # or until the whole-run cache appears (a peer that finished
+        # assembly first may have written it and reclaimed the shards).
+        # Own shard files are pre-seen: their genomes are already in
+        # `results`, and re-decompressing them would duplicate this
+        # process's share of the pod-wide shard I/O for nothing.
+        deadline = _barrier_deadline()
+        seen_files: set[str] = set(my_shard_files)
+        need = {j[0] for j in jobs}
+        while need - set(results):
+            for f in sorted(glob.glob(os.path.join(shard_dir, "*.npz"))):
+                if f in seen_files:
+                    continue
+                try:
+                    shard = _load_sketch_shard(f)
+                except Exception:
+                    continue  # peer mid-write artifact: retry next pass
+                seen_files.add(f)
+                results.update({g: r for g, r in shard.items() if r["n_kmers"] > 0})
+            if not (need - set(results)):
+                break
+            if wd.has_arrays("sketches") and wd.arguments_match("sketch", args_snapshot):
+                cached = _load(wd, k, sketch_size, scale)
+                if not (cached.gdb["n_kmers"] == 0).any():
+                    logger.info(
+                        "ingest: peer assembled the whole-run cache first — using it"
+                    )
+                    return cached
+            if time.monotonic() > deadline:
+                missing = sorted(need - set(results))[:10]
+                raise RuntimeError(
+                    f"sharded ingest barrier timed out: {len(need - set(results))} "
+                    f"genomes never appeared in {shard_dir} (first: {missing}). "
+                    "A peer process likely died — or hit an unparseable input "
+                    "(zero-kmer genomes are never checkpointed; that peer "
+                    "raises UserInputError in its own process)."
+                )
+            time.sleep(_INGEST_BARRIER_POLL_S)
 
     names = list(bdb["genome"])
     unparsed = [g for g in names if results[g]["n_kmers"] == 0]
@@ -322,11 +412,41 @@ def sketch_genomes(
         scale=scale,
     )
     if wd is not None:
+        if nproc > 1 and pid != 0:
+            # signal assembly-complete and leave the cache write + shard
+            # reclamation to process 0: concurrent identical cache writes
+            # are not atomic, and reclaiming shards a peer still reads
+            # would strand its barrier (it recovers via the cache, but
+            # only after process 0 wrote it — ordering below). Tolerant
+            # write: if a stale-marker race let process 0 reclaim the dir
+            # already, the cache necessarily exists (written BEFORE the
+            # rmtree) and this process's result is complete — the signal
+            # is moot, not an error.
+            from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
+            with contextlib.suppress(OSError):
+                atomic_write_bytes(
+                    os.path.join(shard_dir, f"assembled_{pid}.done"), b""
+                )
+            return out
+        if nproc > 1:
+            # wait (bounded) for peers to finish assembling; cache-first
+            # ordering below makes a timeout or stale marker harmless —
+            # a peer still polling finds the cache on its next pass
+            deadline = _barrier_deadline()
+            peers = [
+                os.path.join(shard_dir, f"assembled_{p}.done")
+                for p in range(1, nproc)
+            ]
+            peers_done = all(os.path.exists(f) for f in peers)
+            while not peers_done and time.monotonic() < deadline:
+                time.sleep(_INGEST_BARRIER_POLL_S)
+                peers_done = all(os.path.exists(f) for f in peers)
         _save(wd, out)
         wd.store_arguments("sketch", args_snapshot)
         # the assembled cache supersedes the shards — drop them rather
         # than double the on-disk footprint (~16 GB at 100k genomes)
-        if shard_dir is not None:
+        if shard_dir is not None and (nproc == 1 or peers_done):
             shutil.rmtree(shard_dir, ignore_errors=True)
     return out
 

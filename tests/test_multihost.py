@@ -74,6 +74,11 @@ def test_distributed_matches_single(tmp_path, nproc, single_cdb):
         assert p.returncode == 0, f"worker {i} failed:\n{outs[i]}"
         assert (tmp_path / f"ok_{i}").exists(), f"worker {i} wrote no ok-file:\n{outs[i]}"
 
+    # sharded ingest: every process must have assembled the IDENTICAL
+    # sketch set from the pod's interleaved stripes
+    digests = {(tmp_path / f"ingest_digest_{i}").read_text() for i in range(nproc)}
+    assert len(digests) == 1, f"ingest assembly diverged across processes: {digests}"
+
     # the shared-workdir Cdb the pod produced must match a single-process
     # run of the same planted data, as a cluster partition (labels may
     # permute; membership may not)
