@@ -247,6 +247,7 @@ def sketch_genomes(
     jobs = [(row.genome, row.location, k, sketch_size, scale, hash_name) for row in bdb.itertuples()]
     results: dict[str, dict] = {}
     shard_dir = None
+    resume_loaded: set[str] = set()  # shard paths the resume glob consumed
     if wd is not None:
         from drep_tpu.utils.ckptmeta import open_checkpoint_dir
 
@@ -257,6 +258,7 @@ def sketch_genomes(
             for f in sorted(glob.glob(os.path.join(shard_dir, "*.npz"))):
                 try:
                     shard = _load_sketch_shard(f)
+                    resume_loaded.add(f)
                 except Exception:
                     logger.warning("ingest: corrupt sketch shard %s — recomputing its genomes", f)
                     os.remove(f)
@@ -298,16 +300,18 @@ def sketch_genomes(
             if i % nproc == pid and j[0] not in results
         ]
         # best-effort hygiene (pid 0, right after the synchronized
-        # checkpoint-dir open): a previous killed run's assembly markers
-        # must not satisfy this run's marker wait instantly — the
-        # cache-first ordering and tolerant marker writes below keep any
-        # residual race benign, this just removes the common case
+        # checkpoint-dir open): a previous killed run's assembly/poison
+        # markers must not satisfy this run's marker wait or fail its
+        # barrier instantly — the cache-first ordering and tolerant
+        # marker writes below keep any residual race benign, this just
+        # removes the common case
         if pid == 0:
             import glob as _glob
 
-            for f in _glob.glob(os.path.join(shard_dir, "assembled_*.done")):
-                with contextlib.suppress(OSError):
-                    os.remove(f)
+            for pat in ("assembled_*.done", "ingest_error_*.json"):
+                for f in _glob.glob(os.path.join(shard_dir, pat)):
+                    with contextlib.suppress(OSError):
+                        os.remove(f)
     else:
         todo = [j for j in jobs if j[0] not in results]
     my_shard_files: set[str] = set()  # shards THIS process wrote (skip re-reading)
@@ -346,16 +350,41 @@ def sketch_genomes(
     flush(force=True)
 
     if nproc > 1:
+        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
+        # unparseable inputs in THIS stripe fail the whole pod fast: a
+        # poison marker carries the real error to every peer's barrier
+        # (zero-kmer results are never checkpointed, so without it peers
+        # would stall their full timeout on a genome that never arrives)
+        bad = sorted(g for g, r in results.items() if r["n_kmers"] == 0)
+        if bad:
+            import json as _json
+
+            with contextlib.suppress(OSError):
+                atomic_write_bytes(
+                    os.path.join(shard_dir, f"ingest_error_{pid}.json"),
+                    _json.dumps({"pid": pid, "genomes": bad[:10], "n": len(bad)}).encode(),
+                )
+            shown = ", ".join(bad[:10]) + (" ..." if len(bad) > 10 else "")
+            raise UserInputError(
+                f"no FASTA records with valid nucleotide {k}-mers in {len(bad)} "
+                f"input file(s) (not FASTA, empty, or shorter than k): {shown}"
+            )
+
         # assemble peers' stripes: re-glob until all genomes are covered,
         # or until the whole-run cache appears (a peer that finished
         # assembly first may have written it and reclaimed the shards).
-        # Own shard files are pre-seen: their genomes are already in
-        # `results`, and re-decompressing them would duplicate this
-        # process's share of the pod-wide shard I/O for nothing.
+        # Own + resume-loaded shard files are pre-seen: their genomes are
+        # already in `results`, and re-decompressing them would duplicate
+        # this process's share of the pod-wide shard I/O for nothing.
+        # The timeout is PROGRESS-based: any new shard resets it — stripe
+        # skew (one process owning slower genomes) is normal at scale and
+        # must never read as a dead peer while shards keep appearing.
         deadline = _barrier_deadline()
-        seen_files: set[str] = set(my_shard_files)
+        seen_files: set[str] = set(my_shard_files) | resume_loaded
         need = {j[0] for j in jobs}
         while need - set(results):
+            progressed = False
             for f in sorted(glob.glob(os.path.join(shard_dir, "*.npz"))):
                 if f in seen_files:
                     continue
@@ -364,24 +393,51 @@ def sketch_genomes(
                 except Exception:
                     continue  # peer mid-write artifact: retry next pass
                 seen_files.add(f)
+                progressed = True
                 results.update({g: r for g, r in shard.items() if r["n_kmers"] > 0})
+            if progressed:
+                deadline = _barrier_deadline()
             if not (need - set(results)):
                 break
+            for f in glob.glob(os.path.join(shard_dir, "ingest_error_*.json")):
+                import json as _json
+
+                try:
+                    with open(f) as fh:
+                        info = _json.load(fh)
+                except Exception:
+                    continue
+                shown = ", ".join(info.get("genomes", []))
+                raise UserInputError(
+                    f"ingest peer process {info.get('pid')} reported "
+                    f"{info.get('n')} unparseable input file(s) "
+                    f"(not FASTA, empty, or shorter than k): {shown}"
+                )
             if wd.has_arrays("sketches") and wd.arguments_match("sketch", args_snapshot):
                 cached = _load(wd, k, sketch_size, scale)
                 if not (cached.gdb["n_kmers"] == 0).any():
                     logger.info(
                         "ingest: peer assembled the whole-run cache first — using it"
                     )
+                    if pid != 0:
+                        # still signal process 0: its marker wait may be
+                        # pending, and an unsignaled exit here would leak
+                        # the superseded shard store forever (no later
+                        # run reopens it past the whole-run cache hit)
+                        with contextlib.suppress(OSError):
+                            atomic_write_bytes(
+                                os.path.join(shard_dir, f"assembled_{pid}.done"), b""
+                            )
                     return cached
             if time.monotonic() > deadline:
                 missing = sorted(need - set(results))[:10]
                 raise RuntimeError(
                     f"sharded ingest barrier timed out: {len(need - set(results))} "
-                    f"genomes never appeared in {shard_dir} (first: {missing}). "
-                    "A peer process likely died — or hit an unparseable input "
-                    "(zero-kmer genomes are never checkpointed; that peer "
-                    "raises UserInputError in its own process)."
+                    f"genomes never appeared in {shard_dir} for "
+                    f"{os.environ.get(_INGEST_BARRIER_ENV, '600')}s with no new "
+                    f"shards (first missing: {missing}). A peer process likely "
+                    "died; raise the window via DREP_TPU_INGEST_BARRIER_S if its "
+                    "per-shard gaps are legitimately longer."
                 )
             time.sleep(_INGEST_BARRIER_POLL_S)
 
@@ -456,6 +512,9 @@ def _save(wd: WorkDirectory, gs: GenomeSketches) -> None:
     scaled, scaled_offsets = _pack_ragged(gs.scaled)
     wd.store_arrays(
         "sketches",
+        # uniform 64-bit hashes are incompressible: zlib here was pure CPU
+        # on the save AND on the cache-hit load inside every timed resume
+        compressed=False,
         bottom=bottom,
         bottom_offsets=bottom_offsets,
         scaled=scaled,

@@ -95,8 +95,13 @@ class WorkDirectory:
     def _array_loc(self, name: str) -> str:
         return os.path.join(self.location, "data", "arrays", f"{name}.npz")
 
-    def store_arrays(self, name: str, **arrays: np.ndarray) -> None:
-        _atomic_write(self._array_loc(name), lambda tmp: np.savez_compressed(tmp, **arrays))
+    def store_arrays(self, name: str, compressed: bool = True, **arrays: np.ndarray) -> None:
+        """`compressed=False` for high-entropy payloads (the MinHash sketch
+        cache: uniform 64-bit hashes are incompressible, and zlib over the
+        ~GB-scale cache was pure CPU on both the save AND the timed-resume
+        load path — cf. ckptmeta.atomic_savez's same knob)."""
+        writer = np.savez_compressed if compressed else np.savez
+        _atomic_write(self._array_loc(name), lambda tmp: writer(tmp, **arrays))
 
     def get_arrays(self, name: str) -> dict[str, np.ndarray]:
         with np.load(self._array_loc(name), allow_pickle=False) as z:

@@ -228,3 +228,86 @@ def test_sketch_cache_will_hit_rejects_zero_kmer_stale_cache(tmp_path, genome_pa
     gdb.loc[0, "n_kmers"] = 0
     wd.store_db(gdb, "Gdb")
     assert not sketch_cache_will_hit(wd, *key)
+
+
+# ---- per-process sharded ingest (faked 2-process pod, single process) ----
+
+
+@pytest.fixture()
+def fake_pod_pid1(monkeypatch):
+    """Make sketch_genomes believe it is process 1 of a 2-process pod
+    without real jax.distributed: process count/index faked, the
+    checkpoint-dir open barrier no-op'd (single OS process)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    monkeypatch.setenv("DREP_TPU_INGEST_BARRIER_S", "5")
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    monkeypatch.setattr(multihost_utils, "sync_global_devices", lambda *_a, **_k: None)
+
+
+def _plant_peer_shards(wd_path, bdb, indices, k=21, sketch_size=1000, scale=200):
+    """Simulate the pid-0 peer: sketch `indices` and write them as shards
+    with the matching meta (real single-process calls, before any fakes)."""
+    import os
+
+    from drep_tpu.ingest import (
+        _SKETCH_SHARD_SUBDIR,
+        _save_sketch_shard,
+        _sketch_shard_meta,
+        sketch_args_snapshot,
+    )
+    from drep_tpu.utils.ckptmeta import open_checkpoint_dir
+
+    wd = WorkDirectory(wd_path)
+    shard_dir = wd.get_dir(_SKETCH_SHARD_SUBDIR)
+    snap = sketch_args_snapshot(bdb["genome"], k, sketch_size, scale, "splitmix64")
+    open_checkpoint_dir(shard_dir, _sketch_shard_meta(snap), clear_suffixes=(".npz",))
+    batch = {}
+    for i in indices:
+        row = bdb.iloc[i]
+        name, res = ingest_mod._sketch_one(
+            (row.genome, row.location, k, sketch_size, scale, "splitmix64")
+        )
+        batch[name] = res
+    _save_sketch_shard(os.path.join(shard_dir, "shard_peer.npz"), batch)
+    return shard_dir
+
+
+def test_sharded_ingest_assembles_peer_stripes(tmp_path, genome_paths, counting_sketch, fake_pod_pid1):
+    """pid 1 of a faked 2-process pod must sketch ONLY its global-index
+    stripe (odd indices), assemble the even indices from the peer's
+    shards, and signal assembly with its marker instead of writing the
+    cache (that is pid 0's job)."""
+    import os
+
+    bdb = make_bdb(genome_paths)  # 5 genomes: pid1 owns indices 1, 3
+    shard_dir = _plant_peer_shards(str(tmp_path / "wd"), bdb, [0, 2, 4])
+    counting_sketch["n"] = 0  # planting went through the counted wrapper
+
+    gs = sketch_genomes(bdb, wd=WorkDirectory(str(tmp_path / "wd")))
+    assert counting_sketch["n"] == 2  # stripe only: indices 1 and 3
+    assert gs.names == list(bdb["genome"])  # full assembly
+    assert all(len(s) > 0 for s in gs.scaled)
+    assert os.path.exists(os.path.join(shard_dir, "assembled_1.done"))
+    # cache write + shard reclamation belong to pid 0
+    assert not WorkDirectory(str(tmp_path / "wd")).has_arrays("sketches")
+
+
+def test_sharded_ingest_poison_marker_fails_fast(tmp_path, genome_paths, fake_pod_pid1):
+    """A peer's unparseable-input poison marker must surface as the real
+    UserInputError in every process's barrier, not a timeout."""
+    import json
+    import os
+    import time
+
+    bdb = make_bdb(genome_paths)
+    shard_dir = _plant_peer_shards(str(tmp_path / "wd"), bdb, [])  # peer wrote nothing
+    with open(os.path.join(shard_dir, "ingest_error_0.json"), "w") as f:
+        json.dump({"pid": 0, "genomes": ["genome_A.fasta"], "n": 1}, f)
+
+    t0 = time.monotonic()
+    with pytest.raises(UserInputError, match="peer process 0"):
+        sketch_genomes(bdb, wd=WorkDirectory(str(tmp_path / "wd")))
+    assert time.monotonic() - t0 < 4  # fail fast, not the barrier timeout
