@@ -132,6 +132,20 @@ def _intersect_kernel_stacked(a_ref, b_ref, out_ref):
     jax.lax.fori_loop(0, ta, body, 0)
 
 
+def _widen_ids(x):
+    """uint16 stacked buckets (per-bucket rebased, U16_PAD sentinel — the
+    half-link-bytes plan from rangepart.stacked_range_buckets) widen to
+    the kernel's int32/PAD_ID contract ON DEVICE, after the one cheap
+    transfer."""
+    from drep_tpu.ops.rangepart import U16_PAD
+
+    if x.dtype == jnp.uint16:
+        return jnp.where(
+            x == jnp.uint16(U16_PAD), jnp.int32(PAD_ID), x.astype(jnp.int32)
+        )
+    return x
+
+
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
 def _intersect_grid_symmetric_stacked(stacked, *, tile: int, interpret: bool):
     """Self-comparison over stacked range buckets [R, na, S2] (ascending
@@ -139,6 +153,7 @@ def _intersect_grid_symmetric_stacked(stacked, *, tile: int, interpret: bool):
     with an innermost bucket dimension accumulating into each output tile.
     The A-side reversal happens ON DEVICE (jnp.flip) so the host ships the
     stacked tensor once, not twice."""
+    stacked = _widen_ids(stacked)
     r_n, na, s2 = stacked.shape
     a_rev = jnp.flip(stacked, axis=2)
     t = na // tile
@@ -169,6 +184,8 @@ def _intersect_grid_symmetric_stacked(stacked, *, tile: int, interpret: bool):
 def _intersect_grid_rect_stacked(a_stacked, b_stacked, *, tile_a: int, tile_b: int, interpret: bool):
     """Rectangular stacked-bucket grid: [R, na, S2] x [R, nb, S2] ->
     [na, nb] accumulated across the innermost bucket dimension."""
+    a_stacked = _widen_ids(a_stacked)
+    b_stacked = _widen_ids(b_stacked)
     r_n, na, s2 = a_stacked.shape
     nb = b_stacked.shape[1]
     a_rev = jnp.flip(a_stacked, axis=2)
@@ -194,12 +211,15 @@ def _intersect_grid_rect_stacked(a_stacked, b_stacked, *, tile_a: int, tile_b: i
 
 def _pad_rows_stacked(stacked: np.ndarray, multiple: int) -> np.ndarray:
     """Pad the row axis (axis=1) of a [R, N, W] stacked tensor to a tile
-    multiple with PAD_ID rows."""
+    multiple with the dtype's pad sentinel."""
+    from drep_tpu.ops.rangepart import U16_PAD
+
     n = stacked.shape[1]
     nt = -(-n // multiple) * multiple
     if nt == n:
         return stacked
-    return np.pad(stacked, ((0, 0), (0, nt - n), (0, 0)), constant_values=PAD_ID)
+    pad = U16_PAD if stacked.dtype == np.uint16 else PAD_ID
+    return np.pad(stacked, ((0, 0), (0, nt - n), (0, 0)), constant_values=pad)
 
 
 def _use_interpret() -> bool:

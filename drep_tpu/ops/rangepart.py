@@ -130,21 +130,10 @@ def partition_by_range(
     vocab = _vocab_extent(mats)
     if vocab == 0:
         return
-    longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
-    n_buckets = max(1, next_pow2(-(-longest // max_count)))
-    while True:
-        chunk = -(-vocab // n_buckets)
-        starts = [bucket_starts(m, chunk, n_buckets) for m in mats]
-        hists = [np.diff(s, axis=1) for s in starts]
-        worst = max(int(h.max()) for h in hists)
-        if worst <= max_count or chunk <= max_count:
-            break
-        n_buckets *= 2
-    for r in range(n_buckets):
+    chunk, starts, hists, keep, _width = _stacked_plan(mats, max_count)
+    for r in keep:
         counts_r = [h[:, r] for h in hists]
         w = max(int(c.max()) for c in counts_r)
-        if w == 0:
-            continue
         width = max(MIN_BUCKET_WIDTH, next_pow2(w))
         yield (
             r * chunk,
@@ -155,8 +144,47 @@ def partition_by_range(
         )
 
 
+U16_PAD = np.uint16(0xFFFF)  # stacked-u16 pad sentinel (sorts last; never a real id)
+
+
+def _stacked_plan(mats: list[np.ndarray], max_count: int, min_buckets: int = 1):
+    """Bucket plan (chunk, starts, hists, kept bucket ids, common width)
+    for a stacked layout, WITHOUT materializing — callers compare plans
+    by byte size before paying the repack."""
+    longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
+    vocab = _vocab_extent(mats)
+    n_buckets = max(min_buckets, next_pow2(-(-longest // max_count)), 1)
+    while True:
+        chunk = -(-vocab // n_buckets)
+        starts = [bucket_starts(m, chunk, n_buckets) for m in mats]
+        hists = [np.diff(s, axis=1) for s in starts]
+        worst = max(int(h.max()) for h in hists)
+        if worst <= max_count or chunk <= max_count:
+            break
+        n_buckets *= 2
+    keep = [r for r in range(n_buckets) if any(int(h[:, r].max()) > 0 for h in hists)]
+    width = max(MIN_BUCKET_WIDTH, next_pow2(worst))
+    return chunk, starts, hists, keep, width
+
+
+def _materialize_stacked(mats, chunk, starts, hists, keep, width, dtype):
+    out = []
+    rebase = dtype == np.uint16  # u16 needs per-bucket local values
+    pad = U16_PAD if rebase else PAD_ID
+    for m, s, h in zip(mats, starts, hists):
+        stacked = np.full((len(keep), m.shape[0], width), pad, dtype)
+        for o, r in enumerate(keep):
+            b = repack_bucket(m, s[:, r], h[:, r], width, rebase=r * chunk if rebase else 0)
+            if rebase:
+                stacked[o] = np.where(b == PAD_ID, U16_PAD, b).astype(np.uint16)
+            else:
+                stacked[o] = b
+        out.append(stacked)
+    return out
+
+
 def stacked_range_buckets(
-    mats: list[np.ndarray], max_count: int
+    mats: list[np.ndarray], max_count: int, dtype: str = "auto"
 ) -> list[np.ndarray]:
     """Range partition like :func:`partition_by_range`, but materialized as
     ONE [R, N_i, W] stacked tensor per input at a COMMON pow2 width W
@@ -168,39 +196,45 @@ def stacked_range_buckets(
     measured vpu_frac 0.026 — launch/transfer overhead, not compute).
 
     Buckets empty across ALL inputs are dropped (R counts kept buckets
-    only). Values keep their global ids (no rebase): the merge kernel
-    compares for equality/order only, and each bucket's rows share one
-    disjoint global range, so cross-bucket collisions are impossible.
+    only). Two dtype plans are compared by actual byte size and the
+    smaller ships:
+
+    - int32, global ids (no rebase): each bucket's rows share one
+      disjoint global range, so cross-bucket collisions are impossible.
+    - uint16, PER-BUCKET REBASED ids (pad 0xFFFF) when a finer partition
+      brings every chunk under 2^16: HALF the host->device bytes — the
+      fused kernel is link-floored at production width on slow links —
+      at the cost of more, narrower buckets (total merge work SHRINKS
+      with bucket count: Σ 2W·log2W falls as W does; only padding skew
+      can lose). The kernel widens on device (ops/pallas_merge._widen_ids).
     """
     if max_count < MIN_BUCKET_WIDTH:
         raise ValueError(f"max_count {max_count} below lane width {MIN_BUCKET_WIDTH}")
     if max_count & (max_count - 1):
         raise ValueError(f"max_count {max_count} must be a power of two")
+    if dtype not in ("auto", "int32"):
+        raise ValueError(f"dtype {dtype!r}: expected 'auto' or 'int32'")
     vocab = _vocab_extent(mats)
     if vocab == 0:
         return [np.full((0, m.shape[0], MIN_BUCKET_WIDTH), PAD_ID, np.int32) for m in mats]
-    longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
-    n_buckets = max(1, next_pow2(-(-longest // max_count)))
-    while True:
-        chunk = -(-vocab // n_buckets)
-        starts = [bucket_starts(m, chunk, n_buckets) for m in mats]
-        hists = [np.diff(s, axis=1) for s in starts]
-        worst = max(int(h.max()) for h in hists)
-        if worst <= max_count or chunk <= max_count:
-            break
-        n_buckets *= 2
-    keep = [
-        r
-        for r in range(n_buckets)
-        if any(int(h[:, r].max()) > 0 for h in hists)
-    ]
-    width = max(MIN_BUCKET_WIDTH, next_pow2(worst))
-    out = []
-    for m, s, h in zip(mats, starts, hists):
-        stacked = np.full((len(keep), m.shape[0], width), PAD_ID, np.int32)
-        for o, r in enumerate(keep):
-            stacked[o] = repack_bucket(m, s[:, r], h[:, r], width)
-        out.append(stacked)
-    return out
+    plan32 = _stacked_plan(mats, max_count)
+    best = (plan32, np.int32)
+    if dtype == "auto":
+        # the u16 plan forces chunk <= 65535 (rebased values + the 0xFFFF
+        # sentinel must fit 16 bits); when plan32's chunk already fits,
+        # the u16 plan IS plan32 — don't pay the planning pass twice
+        min_b = max(1, next_pow2(-(-vocab // 0xFFFF)))
+        plan16 = (
+            plan32
+            if plan32[0] <= 0xFFFF
+            else _stacked_plan(mats, max_count, min_buckets=min_b)
+        )
+        if plan16[0] <= 0xFFFF:
+            bytes32 = len(plan32[3]) * plan32[4] * 4
+            bytes16 = len(plan16[3]) * plan16[4] * 2
+            if bytes16 < bytes32:
+                best = (plan16, np.uint16)
+    plan, dtype_np = best
+    return _materialize_stacked(mats, *plan, dtype_np)
 
 

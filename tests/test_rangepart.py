@@ -131,7 +131,7 @@ def test_stacked_range_buckets_reconstruct_and_share_layout(rng):
 
     a = _sorted_rows(rng, 6, 700, 4096)
     b = _sorted_rows(rng, 4, 500, 4096)
-    a_st, b_st = stacked_range_buckets([a, b], MIN_BUCKET_WIDTH)
+    a_st, b_st = stacked_range_buckets([a, b], MIN_BUCKET_WIDTH, dtype="int32")
     assert a_st.shape[0] == b_st.shape[0]  # shared bucket set
     assert a_st.shape[2] == b_st.shape[2] <= MIN_BUCKET_WIDTH
     for mat, st in ((a, a_st), (b, b_st)):
@@ -148,13 +148,32 @@ def test_stacked_buckets_hold_disjoint_ranges(rng):
     the additivity precondition the fused kernel's accumulation rests on."""
     from drep_tpu.ops.rangepart import stacked_range_buckets
 
-    (st,) = stacked_range_buckets([_sorted_rows(rng, 5, 900, 5000)], MIN_BUCKET_WIDTH)
+    (st,) = stacked_range_buckets(
+        [_sorted_rows(rng, 5, 900, 5000)], MIN_BUCKET_WIDTH, dtype="int32"
+    )
     prev_max = -1
     for r in range(st.shape[0]):
         vals = st[r][st[r] != PAD_ID]
         if vals.size:
             assert int(vals.min()) > prev_max
             prev_max = int(vals.max())
+
+
+def test_stacked_auto_picks_u16_and_stays_exact(rng):
+    """When every chunk fits 16 bits the auto plan must ship uint16
+    (HALF the link bytes — the production fused-merge path is
+    link-floored), and the end-to-end range path must stay exact."""
+    from drep_tpu.ops.pallas_merge import PALLAS_MAX_WIDTH, intersect_counts_pallas
+    from drep_tpu.ops.rangepart import U16_PAD, stacked_range_buckets
+
+    a = _sorted_rows(rng, 7, PALLAS_MAX_WIDTH + 600, 3 * PALLAS_MAX_WIDTH)
+    b = _sorted_rows(rng, 5, PALLAS_MAX_WIDTH + 600, 3 * PALLAS_MAX_WIDTH)
+    a_st, b_st = stacked_range_buckets([a, b], PALLAS_MAX_WIDTH)
+    assert a_st.dtype == np.uint16 == b_st.dtype  # vocab 6144 << 2^16
+    # rebased per-bucket values never reach the sentinel
+    assert all((a_st[r][a_st[r] != U16_PAD] < 0xFFFF).all() for r in range(a_st.shape[0]))
+    got = intersect_counts_pallas(a, b, force="range")  # u16 plan end-to-end
+    np.testing.assert_array_equal(got, _oracle_inter(a, b))
 
 
 def test_jnp_fallback_is_capped_and_exact(rng):
