@@ -113,7 +113,14 @@ def pack_scaled_sketches_clusterlocal(
     lens_arr = np.array(lens, dtype=np.int64)
     n = len(lens_arr)
     width = _pow2_bucket(max(int(lens_arr.max()) if n else 1, 1), pad_multiple)
-    ids = np.full((n, width), PAD_ID, dtype=np.int32)
+    # link compression: ranks < v_extent, so when every cluster vocabulary
+    # fits 16 bits the pack ships as uint16 (0xFFFF pad) — HALF the
+    # host->device bytes of the production batched secondary, widened on
+    # device by _intersect_matmul. 0xFFFE bound keeps the sentinel free.
+    if v_extent < 0xFFFF:
+        ids = np.full((n, width), np.uint16(0xFFFF), dtype=np.uint16)
+    else:
+        ids = np.full((n, width), PAD_ID, dtype=np.int32)
     flat_ranks = np.concatenate(rank_parts) if rank_parts else np.zeros(0, np.int32)
     rows = np.repeat(np.arange(n), lens_arr)
     offs = np.concatenate([[0], np.cumsum(lens_arr)[:-1]])
@@ -227,7 +234,9 @@ def one_shot_fits(n_rows: int, v_pad: int) -> bool:
 
 @functools.partial(jax.jit, static_argnames=("v_pad", "dtype", "use_pallas"))
 def _intersect_matmul_jit(ids, *, v_pad: int, dtype, use_pallas: bool = False):
-    ind = _indicator(ids, v_pad, dtype, use_pallas=use_pallas)
+    from drep_tpu.ops.minhash import widen_ids_device
+
+    ind = _indicator(widen_ids_device(ids), v_pad, dtype, use_pallas=use_pallas)
     return _int_dot(ind, ind)
 
 
@@ -408,6 +417,11 @@ def _intersect_matmul_rect(a_ids, b_ids, *, v_pad: int):
     path's block-vs-representatives comparisons run here on TPU instead of
     through gather tiles (batched gathers serialize on the scalar unit —
     the measured ~70x penalty noted in ops/minhash.py)."""
+    from drep_tpu.ops.minhash import require_int32_ids
+
+    # dtype-only checks: no host pull of device operands
+    require_int32_ids(a_ids, "_intersect_matmul_rect")
+    require_int32_ids(b_ids, "_intersect_matmul_rect")
     dt = _indicator_dtype(max(a_ids.shape[1], b_ids.shape[1]))
     return _intersect_matmul_rect_jit(
         a_ids, b_ids, v_pad=v_pad, dtype=dt, use_pallas=_use_pallas_indicator(dt)
@@ -429,8 +443,10 @@ class VocabChunkGeometry:
     """
 
     def __init__(self, ids: np.ndarray, max_rows_per_call: int):
+        from drep_tpu.ops.minhash import require_int32_ids
         from drep_tpu.ops.rangepart import MIN_BUCKET_WIDTH, bucket_starts, vocab_extent
 
+        require_int32_ids(ids, "VocabChunkGeometry")
         self.ids = ids
         extent = vocab_extent(ids)
         # budget covers BOTH operands of a rectangular call at the stated
@@ -563,6 +579,10 @@ def intersect_counts_matmul_rect(a_ids: np.ndarray, b_ids: np.ndarray) -> np.nda
     chunking the vocabulary when the joint indicator exceeds the budget
     (same additivity as the self path; one shared geometry keeps the
     chunks aligned across both sides). Returns int32 [na, nb]."""
+    from drep_tpu.ops.minhash import require_int32_ids
+
+    require_int32_ids(a_ids, "intersect_counts_matmul_rect")
+    require_int32_ids(b_ids, "intersect_counts_matmul_rect")
     na, nb = a_ids.shape[0], b_ids.shape[0]
     if na == 0 or nb == 0:
         return np.zeros((na, nb), np.int32)
@@ -630,6 +650,9 @@ def all_vs_all_containment_matmul_chunked(
     one-shot matmul (int8 0/1 inputs, int32 accumulation — exact at any
     count).
     """
+    from drep_tpu.ops.minhash import require_int32_ids
+
+    require_int32_ids(packed.ids, "all_vs_all_containment_matmul_chunked")
     m = packed.n
     m_pad = matmul_rows_pad(m)
     v_chunk = matmul_vocab_chunk(m_pad)
@@ -651,6 +674,9 @@ def all_vs_all_containment(
     """Full [N, N] (symmetric max-containment ani, directional cov) via
     fixed-shape coverage tiles; the ANI transform runs once on the full
     coverage matrix (it needs both directions of every pair)."""
+    from drep_tpu.ops.minhash import require_int32_ids
+
+    require_int32_ids(packed.ids, "all_vs_all_containment")
     n = packed.n
     tile = cap_gather_tile(packed.sketch_size, tile)
     ids, counts = pad_packed_rows(packed.ids, packed.counts, tile)

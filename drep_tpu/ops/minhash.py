@@ -26,6 +26,37 @@ import jax.numpy as jnp
 import numpy as np
 
 PAD_ID = np.int32(2**31 - 1)  # sorts after every real id; never counted
+U16_PAD = np.uint16(0xFFFF)  # pad sentinel of link-compressed uint16 id packs
+
+
+def pad_sentinel(dtype):
+    """THE pad value for an id matrix of `dtype` — one rule for every
+    module that fills, pads, or masks id rows (int32/PAD_ID is the kernel
+    contract; uint16/U16_PAD is the link-compressed layout that device
+    code widens via :func:`widen_ids_device` before use)."""
+    return U16_PAD if np.dtype(dtype) == np.uint16 else PAD_ID
+
+
+def widen_ids_device(x):
+    """uint16 id rows -> the int32/PAD_ID contract, ON DEVICE (inside
+    jit, after the half-size host->device transfer). int32 passes
+    through untouched. The ONE widen shared by every device consumer."""
+    if x.dtype == jnp.uint16:
+        return jnp.where(x == jnp.uint16(U16_PAD), jnp.int32(PAD_ID), x.astype(jnp.int32))
+    return x
+
+
+def require_int32_ids(ids, where: str) -> None:  # np OR device array (dtype-only)
+    """Loud boundary check for paths that do NOT widen: a uint16 pack
+    reaching them would read its 0xFFFF pads as real ids and produce
+    silently wrong counts (pads matching pads inflate every
+    intersection)."""
+    if ids.dtype != np.int32:
+        raise TypeError(
+            f"{where} requires int32/PAD_ID id rows, got {ids.dtype}: uint16 "
+            "link-compressed packs are consumed only by the one-shot matmul "
+            "and stacked-bucket paths, which widen on device"
+        )
 
 
 @dataclass
@@ -82,7 +113,9 @@ def pad_packed_rows(ids: np.ndarray, counts: np.ndarray, multiple: int):
     nt = -(-n // multiple) * multiple
     if nt == n:
         return ids, counts
-    pad_ids = np.full((nt, ids.shape[1]), PAD_ID, dtype=ids.dtype)
+    # uint16 packs (the cluster-local batched secondary's link-compressed
+    # layout) pad with their own sentinel — PAD_ID overflows 16 bits
+    pad_ids = np.full((nt, ids.shape[1]), pad_sentinel(ids.dtype), dtype=ids.dtype)
     pad_ids[:n] = ids
     pad_counts = np.zeros(nt, dtype=counts.dtype)
     pad_counts[:n] = counts

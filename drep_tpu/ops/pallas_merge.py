@@ -46,7 +46,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from drep_tpu.ops.merge import merge_sorted_rows, next_pow2
-from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+from drep_tpu.ops.minhash import (
+    PAD_ID,
+    PackedSketches,
+    pad_sentinel,
+    require_int32_ids,
+    widen_ids_device,
+)
 
 TILE_B = 128  # lane width — the pair tile's last dim must be 128-aligned
 TILE_A = 128
@@ -132,18 +138,11 @@ def _intersect_kernel_stacked(a_ref, b_ref, out_ref):
     jax.lax.fori_loop(0, ta, body, 0)
 
 
-def _widen_ids(x):
-    """uint16 stacked buckets (per-bucket rebased, U16_PAD sentinel — the
-    half-link-bytes plan from rangepart.stacked_range_buckets) widen to
-    the kernel's int32/PAD_ID contract ON DEVICE, after the one cheap
-    transfer."""
-    from drep_tpu.ops.rangepart import U16_PAD
-
-    if x.dtype == jnp.uint16:
-        return jnp.where(
-            x == jnp.uint16(U16_PAD), jnp.int32(PAD_ID), x.astype(jnp.int32)
-        )
-    return x
+# uint16 stacked buckets (per-bucket rebased, U16_PAD sentinel — the
+# half-link-bytes plan from rangepart.stacked_range_buckets) widen to the
+# kernel's int32/PAD_ID contract ON DEVICE via minhash.widen_ids_device,
+# after the one cheap transfer
+_widen_ids = widen_ids_device
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
@@ -212,14 +211,14 @@ def _intersect_grid_rect_stacked(a_stacked, b_stacked, *, tile_a: int, tile_b: i
 def _pad_rows_stacked(stacked: np.ndarray, multiple: int) -> np.ndarray:
     """Pad the row axis (axis=1) of a [R, N, W] stacked tensor to a tile
     multiple with the dtype's pad sentinel."""
-    from drep_tpu.ops.rangepart import U16_PAD
-
     n = stacked.shape[1]
     nt = -(-n // multiple) * multiple
     if nt == n:
         return stacked
-    pad = U16_PAD if stacked.dtype == np.uint16 else PAD_ID
-    return np.pad(stacked, ((0, 0), (0, nt - n), (0, 0)), constant_values=pad)
+    return np.pad(
+        stacked, ((0, 0), (0, nt - n), (0, 0)),
+        constant_values=pad_sentinel(stacked.dtype),
+    )
 
 
 def _use_interpret() -> bool:
@@ -359,6 +358,8 @@ def intersect_counts_pallas(
     Python per grid cell). `force` ('range' | 'jnp') pins the path so tests
     exercise both on CPU.
     """
+    require_int32_ids(a_ids, "intersect_counts_pallas")
+    require_int32_ids(b_ids, "intersect_counts_pallas")
     na, nb = a_ids.shape[0], b_ids.shape[0]
     s2 = max(128, next_pow2(max(a_ids.shape[1], b_ids.shape[1])))
     a = _pad_cols_pow2(np.ascontiguousarray(a_ids), s2)
@@ -406,6 +407,7 @@ def intersect_counts_pallas_self(
     Pallas path runs the wrapped half-grid (~2x less work than the general
     rectangular call); over-width sets range-partition and re-enter the
     half-grid per bucket (same row order every bucket, so symmetry holds)."""
+    require_int32_ids(ids, "intersect_counts_pallas_self")
     n = ids.shape[0]
     s2 = max(128, next_pow2(ids.shape[1]))
     a = _pad_cols_pow2(np.ascontiguousarray(ids), s2)

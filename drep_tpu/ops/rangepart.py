@@ -32,7 +32,7 @@ from collections.abc import Iterator
 import numpy as np
 
 from drep_tpu.ops.merge import next_pow2
-from drep_tpu.ops.minhash import PAD_ID
+from drep_tpu.ops.minhash import PAD_ID, U16_PAD, pad_sentinel
 
 MIN_BUCKET_WIDTH = 128  # lane width — never repack below one full lane row
 
@@ -40,8 +40,10 @@ MIN_BUCKET_WIDTH = 128  # lane width — never repack below one full lane row
 def vocab_extent(ids: np.ndarray) -> int:
     """1 + max real id (0 when everything is padding) — THE extent rule:
     the range partitioner, the matmul vocab bucketing, the chunk geometry,
-    and the bench's FLOP model all derive from this one definition."""
-    valid = ids != PAD_ID
+    and the bench's FLOP model all derive from this one definition.
+    uint16 packs (link-compressed cluster-local layout) use their own pad
+    sentinel."""
+    valid = ids != pad_sentinel(ids.dtype)
     return int(ids[valid].max()) + 1 if valid.any() else 0
 
 
@@ -130,7 +132,7 @@ def partition_by_range(
     vocab = _vocab_extent(mats)
     if vocab == 0:
         return
-    chunk, starts, hists, keep, _width = _stacked_plan(mats, max_count)
+    chunk, starts, hists, keep, _width = _stacked_plan(mats, max_count, vocab=vocab)
     for r in keep:
         counts_r = [h[:, r] for h in hists]
         w = max(int(c.max()) for c in counts_r)
@@ -144,15 +146,22 @@ def partition_by_range(
         )
 
 
-U16_PAD = np.uint16(0xFFFF)  # stacked-u16 pad sentinel (sorts last; never a real id)
-
-
-def _stacked_plan(mats: list[np.ndarray], max_count: int, min_buckets: int = 1):
+def _stacked_plan(
+    mats: list[np.ndarray],
+    max_count: int,
+    min_buckets: int = 1,
+    vocab: int | None = None,
+    longest: int | None = None,
+):
     """Bucket plan (chunk, starts, hists, kept bucket ids, common width)
     for a stacked layout, WITHOUT materializing — callers compare plans
-    by byte size before paying the repack."""
-    longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
-    vocab = _vocab_extent(mats)
+    by byte size before paying the repack. `vocab`/`longest` accept the
+    caller's already-computed scans (each is a full pass over the id
+    matrices — ~17M elements/side at production shape)."""
+    if longest is None:
+        longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
+    if vocab is None:
+        vocab = _vocab_extent(mats)
     n_buckets = max(min_buckets, next_pow2(-(-longest // max_count)), 1)
     while True:
         chunk = -(-vocab // n_buckets)
@@ -170,7 +179,7 @@ def _stacked_plan(mats: list[np.ndarray], max_count: int, min_buckets: int = 1):
 def _materialize_stacked(mats, chunk, starts, hists, keep, width, dtype):
     out = []
     rebase = dtype == np.uint16  # u16 needs per-bucket local values
-    pad = U16_PAD if rebase else PAD_ID
+    pad = pad_sentinel(dtype)
     for m, s, h in zip(mats, starts, hists):
         stacked = np.full((len(keep), m.shape[0], width), pad, dtype)
         for o, r in enumerate(keep):
@@ -217,7 +226,8 @@ def stacked_range_buckets(
     vocab = _vocab_extent(mats)
     if vocab == 0:
         return [np.full((0, m.shape[0], MIN_BUCKET_WIDTH), PAD_ID, np.int32) for m in mats]
-    plan32 = _stacked_plan(mats, max_count)
+    longest = max(int((m != PAD_ID).sum(axis=1).max()) for m in mats)
+    plan32 = _stacked_plan(mats, max_count, vocab=vocab, longest=longest)
     best = (plan32, np.int32)
     if dtype == "auto":
         # the u16 plan forces chunk <= 65535 (rebased values + the 0xFFFF
@@ -227,7 +237,7 @@ def stacked_range_buckets(
         plan16 = (
             plan32
             if plan32[0] <= 0xFFFF
-            else _stacked_plan(mats, max_count, min_buckets=min_b)
+            else _stacked_plan(mats, max_count, min_buckets=min_b, vocab=vocab, longest=longest)
         )
         if plan16[0] <= 0xFFFF:
             bytes32 = len(plan32[3]) * plan32[4] * 4

@@ -81,7 +81,10 @@ def test_clusterlocal_pack_ranks_and_extent():
     packed, v_extent = pack_scaled_sketches_clusterlocal([g0, g1], list("abcd"))
     assert v_extent == 5  # cluster 1's vocab {1000,2000,3000,4000,5000}
     assert packed.ids.shape[1] == 128  # lane-width pad floor
-    row = lambda i: packed.ids[i][packed.ids[i] != PAD_ID].tolist()
+    # tiny vocab -> the link-compressed uint16 layout (0xFFFF pad)
+    assert packed.ids.dtype == np.uint16
+    pad = np.uint16(0xFFFF) if packed.ids.dtype == np.uint16 else PAD_ID
+    row = lambda i: packed.ids[i][packed.ids[i] != pad].tolist()
     assert row(0) == [0, 1, 2] and row(1) == [1, 2]  # cluster-0 local ranks
     assert row(2) == [0, 1] and row(3) == [1, 2, 3, 4]  # cluster-1 reuses 0..
     assert packed.counts.tolist() == [3, 2, 2, 4]
