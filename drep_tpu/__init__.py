@@ -17,7 +17,7 @@ SURVEY.md §0):
   (Bdb/Mdb/Ndb/Cdb/Sdb/Wdb) persisted through :class:`WorkDirectory`.
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 
 def __getattr__(name):  # PEP 562 — keep the package import lean: ingest

@@ -243,21 +243,25 @@ def shared_counts_to_distance(
     b_counts: np.ndarray,
     s_orig: int,
     k: int,
+    xp=np,
 ) -> tuple[np.ndarray, np.ndarray]:
     """(distance, jaccard) float32 from raw `shared` counts — THE single
-    host-side transform for every Pallas-mash consumer (full matrix, tile
-    wrapper, streaming), so the estimator cannot drift between them.
+    transform for every Pallas-mash consumer (full matrix, tile wrapper,
+    streaming — host via xp=np, on-device inside the streaming compact
+    jit via xp=jnp), so the estimator cannot drift between them.
     All-float32 intermediates: an int64 outer + float64 division would
     triple transient memory at large N for no precision gain (counts are
     bounded by the sketch width)."""
-    s_use = np.minimum(
-        np.minimum.outer(a_counts.astype(np.int32), b_counts.astype(np.int32)),
-        np.int32(s_orig),
-    ).astype(np.float32)
-    j = np.where(
-        s_use > 0, shared.astype(np.float32) / np.maximum(s_use, np.float32(1.0)), np.float32(0.0)
-    ).astype(np.float32)
-    dist = mash_distance_from_jaccard(j, k, xp=np).astype(np.float32)
+    s_use = xp.minimum(
+        xp.minimum(
+            a_counts.astype(xp.int32)[:, None], b_counts.astype(xp.int32)[None, :]
+        ),
+        xp.int32(s_orig),
+    ).astype(xp.float32)
+    j = xp.where(
+        s_use > 0, shared.astype(xp.float32) / xp.maximum(s_use, xp.float32(1.0)), xp.float32(0.0)
+    ).astype(xp.float32)
+    dist = mash_distance_from_jaccard(j, k, xp=xp).astype(xp.float32)
     return dist, j
 
 

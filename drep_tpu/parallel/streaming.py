@@ -55,10 +55,72 @@ from drep_tpu.utils.logger import get_logger
 
 DEFAULT_BLOCK = 1024
 
+# per-tile device->host edge budget for the compact threshold path: the
+# retained edge graph is ~0.02% dense at scale (BENCH_r04 e2e_50k:
+# 233k edges over 1.25G pairs), yet the dense [block, block] f32 tile is
+# 4 MB — and tunneled-TPU d2h measured 0.005 GB/s, making the dense
+# readback the dominant composite cost (~4.9 GB over 1225 tiles at 50k).
+# Thresholding ON DEVICE and shipping up to this many (i, j, dist)
+# triples per tile cuts readback ~20x; a tile with more survivors falls
+# back to the dense readback (correctness never depends on the budget).
+EDGE_BUDGET = 16384
+
 # the sort-merge HBM-temp budget rule lives beside the merge itself
 # (ops/merge.py::cap_merge_tile), shared with the pallas_merge over-width
 # fallback
 from drep_tpu.ops.merge import cap_merge_tile  # noqa: E402
+
+
+def _compact_tile_jit_factory():
+    """Build the jit'd device-side threshold+compact once (import-time jax
+    use is avoided module-wide; streaming may be imported before the
+    platform guard runs)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from drep_tpu.ops.minhash import mash_distance_from_jaccard
+
+    @functools.partial(
+        jax.jit, static_argnames=("budget", "from_counts", "s_orig", "k", "diag")
+    )
+    def compact(out, ca, cb, cutoff, *, budget, from_counts, s_orig, k, diag):
+        if from_counts:
+            # the Pallas kernel ships raw shared counts; THE shared
+            # count->distance transform runs on device (xp=jnp) so only
+            # survivors cross the link
+            from drep_tpu.ops.pallas_mash import shared_counts_to_distance
+
+            d, _j = shared_counts_to_distance(out, ca, cb, s_orig, k, xp=jnp)
+        else:
+            d = out
+        keep = d <= cutoff
+        # padding rows carry count 0 (every real genome has >= 1 k-mer);
+        # masking on counts reproduces the host path's gi/gj < n filter
+        keep &= (ca > 0)[:, None] & (cb > 0)[None, :]
+        if diag:
+            ri = jax.lax.broadcasted_iota(jnp.int32, keep.shape, 0)
+            rj = jax.lax.broadcasted_iota(jnp.int32, keep.shape, 1)
+            keep &= rj > ri  # i < j only on the diagonal tile
+        count = keep.sum(dtype=jnp.int32)
+        ki, kj = jnp.nonzero(keep, size=budget, fill_value=0)
+        # d rides along so a budget-overflow readback reuses the SAME
+        # device-computed values — the edge set must not depend on
+        # device-vs-host libm ulps at the cutoff boundary
+        return ki.astype(jnp.int32), kj.astype(jnp.int32), d[ki, kj], count, d
+
+    return compact
+
+
+_COMPACT_TILE = None
+
+
+def _compact_tile():
+    global _COMPACT_TILE
+    if _COMPACT_TILE is None:
+        _COMPACT_TILE = _compact_tile_jit_factory()
+    return _COMPACT_TILE
 
 
 def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
@@ -287,11 +349,19 @@ def streaming_mash_edges(
                 ids_on = [jax.device_put(ids_pal, dev) for dev in devices]
                 rev_on = [jax.device_put(ids_rev, dev) for dev in devices]
                 counts_on = [jax.device_put(counts_col, dev) for dev in devices]
+                counts1d_on = [jax.device_put(counts, dev) for dev in devices]
             else:
                 ids_on = [jax.device_put(ids, dev) for dev in devices]
                 counts_on = [jax.device_put(counts, dev) for dev in devices]
+                counts1d_on = counts_on
         i0 = bi * block
-        # dispatch the whole stripe asynchronously, one tile per device turn
+        # dispatch the whole stripe asynchronously, one tile per device
+        # turn; each tile's threshold+compact also dispatches here, so
+        # only ~EDGE_BUDGET survivors per tile cross the link at the sync
+        # points below (the dense [block, block] readback measured as the
+        # composite bottleneck on slow d2h links)
+        budget = min(EDGE_BUDGET, block * block)
+        compact = _compact_tile()
         tiles = []
         for t, bj in enumerate(range(bi, n_blocks)):
             j0 = bj * block
@@ -317,22 +387,41 @@ def streaming_mash_edges(
                     counts_on[di][j0 : j0 + block],
                     k=k,
                 )
-            tiles.append((j0, out))
+            comp = compact(
+                out,
+                counts1d_on[di][i0 : i0 + block],
+                counts1d_on[di][j0 : j0 + block],
+                cutoff,
+                budget=budget,
+                from_counts=use_pallas,
+                s_orig=width,
+                k=k,
+                diag=j0 == i0,
+            )
+            tiles.append((j0, comp))
             pairs_computed += _real_pairs_in_tile(i0, j0, block, n)
 
         row_ii: list[np.ndarray] = []
         row_jj: list[np.ndarray] = []
         row_dd: list[np.ndarray] = []
-        for j0, out in tiles:
-            out = np.asarray(out)  # sync point for this tile
-            if use_pallas:
-                from drep_tpu.ops.pallas_mash import shared_counts_to_distance
-
-                d, _j = shared_counts_to_distance(
-                    out, counts[i0 : i0 + block], counts[j0 : j0 + block], width, k
-                )
-            else:
-                d = out
+        for j0, (ki_d, kj_d, dd_d, cnt_d, d_full) in tiles:
+            cnt = int(cnt_d)  # sync point for this tile (scalar)
+            if cnt <= budget:
+                ki = np.asarray(ki_d)[:cnt]
+                kj = np.asarray(kj_d)[:cnt]
+                if cnt:
+                    # device-side masks already excluded pad rows and the
+                    # diagonal tile's lower triangle
+                    row_ii.append(ki.astype(np.int64) + i0)
+                    row_jj.append(kj.astype(np.int64) + j0)
+                    row_dd.append(np.asarray(dd_d)[:cnt].astype(np.float32))
+                continue
+            # budget overflow (denser tile than the edge model assumes):
+            # fall back to reading back the SAME device-computed dense
+            # distances — correctness never depends on the budget, only
+            # readback bytes do, and the edge set cannot shift by
+            # device-vs-host libm ulps at the cutoff boundary
+            d = np.asarray(d_full)
             keep = d <= cutoff
             if j0 == i0:
                 keep &= np.triu(np.ones_like(keep, dtype=bool), 1)  # i < j only

@@ -80,6 +80,22 @@ def test_streaming_edges_match_dense():
     np.testing.assert_allclose(dd, dist[ii, jj], rtol=1e-6)
 
 
+def test_streaming_edge_budget_overflow_falls_back_dense(monkeypatch):
+    """A tile with more survivors than the per-tile device->host edge
+    budget must fall back to the dense readback with identical results —
+    correctness never depends on EDGE_BUDGET."""
+    import drep_tpu.parallel.streaming as streaming_mod
+
+    packed = _random_packed()
+    cutoff = 2.0  # keep EVERY pair: every tile overflows a tiny budget
+    want = streaming_mash_edges(packed, k=21, cutoff=cutoff, block=16)
+    monkeypatch.setattr(streaming_mod, "EDGE_BUDGET", 4)
+    got = streaming_mash_edges(packed, k=21, cutoff=cutoff, block=16)
+    for a, b in zip(got[:3], want[:3]):
+        np.testing.assert_array_equal(a, b)
+    assert got[3] == want[3]
+
+
 def test_streaming_partition_matches_single_linkage():
     packed = _random_packed()
     p_ani = 0.9
