@@ -597,7 +597,22 @@ def intersect_counts_matmul_rect(a_ids: np.ndarray, b_ids: np.ndarray) -> np.nda
     return rect_from_chunks(a_chunks, b_chunks, geom.v_chunk)
 
 
-def _stacked_vocab_chunks(ids: np.ndarray, v_chunk: int, m_pad: int) -> np.ndarray:
+def _chunk_plan(ids: np.ndarray, v_chunk: int, extent: int):
+    """(n_chunks, starts, hist, width) for a vocab-chunk layout — shared
+    by the byte comparison and the materialization so they cannot drift."""
+    from drep_tpu.ops.merge import next_pow2
+    from drep_tpu.ops.rangepart import MIN_BUCKET_WIDTH, bucket_starts
+
+    n_chunks = -(-extent // v_chunk)
+    starts = bucket_starts(ids, v_chunk, n_chunks)
+    hist = np.diff(starts, axis=1)
+    width = max(MIN_BUCKET_WIDTH, next_pow2(int(hist.max())))
+    return n_chunks, starts, hist, width
+
+
+def _stacked_vocab_chunks(
+    ids: np.ndarray, v_chunk: int, m_pad: int, plan=None
+) -> np.ndarray:
     """[R, m_pad, W] stacked rebased vocab-chunk matrices, ready for ONE
     host->device transfer.
 
@@ -608,28 +623,36 @@ def _stacked_vocab_chunks(ids: np.ndarray, v_chunk: int, m_pad: int) -> np.ndarr
     chunk instead measured 4.7x slower at the 512x32768 production shape;
     so did 20 separate per-chunk transfers on a tunneled v5e link (link
     latency serialized), hence the single stacked tensor.
+
+    When `v_chunk < 2^16` (strict: at 2^16 a rebased id of 65535 would
+    collide with the sentinel) the rebased values fit uint16, and the
+    stacked tensor ships at HALF the bytes (U16_PAD sentinel; the matmul
+    jit widens on device) — `all_vs_all_containment_matmul_chunked` picks
+    the chunk size by comparing actual plan bytes.
+
+    `plan`: a precomputed `_chunk_plan(ids, v_chunk, extent)` so callers
+    that already planned (the byte comparison) don't pay the per-row
+    searchsorted pass twice.
     """
-    from drep_tpu.ops.rangepart import (
-        MIN_BUCKET_WIDTH,
-        bucket_starts,
-        repack_bucket,
-        vocab_extent,
-    )
+    from drep_tpu.ops.minhash import U16_PAD, pad_sentinel
+    from drep_tpu.ops.rangepart import MIN_BUCKET_WIDTH, repack_bucket, vocab_extent
 
     extent = vocab_extent(ids)
     if extent == 0:
         return np.full((0, m_pad, MIN_BUCKET_WIDTH), PAD_ID, np.int32)
-    n_chunks = -(-extent // v_chunk)
-    starts = bucket_starts(ids, v_chunk, n_chunks)
-    hist = np.diff(starts, axis=1)
-    from drep_tpu.ops.merge import next_pow2
-
-    width = max(MIN_BUCKET_WIDTH, next_pow2(int(hist.max())))
-    out = np.full((n_chunks, m_pad, width), PAD_ID, np.int32)
+    n_chunks, starts, hist, width = plan if plan is not None else _chunk_plan(
+        ids, v_chunk, extent
+    )
+    dtype = np.uint16 if v_chunk < (1 << 16) else np.int32
+    out = np.full((n_chunks, m_pad, width), pad_sentinel(dtype), dtype)
     for r in range(n_chunks):
-        out[r, : ids.shape[0]] = repack_bucket(
-            ids, starts[:, r], hist[:, r], width, rebase=r * v_chunk
-        )
+        blk = repack_bucket(ids, starts[:, r], hist[:, r], width, rebase=r * v_chunk)
+        if dtype == np.uint16:
+            out[r, : ids.shape[0]] = np.where(blk == PAD_ID, U16_PAD, blk).astype(
+                np.uint16
+            )
+        else:
+            out[r, : ids.shape[0]] = blk
     return out
 
 
@@ -651,12 +674,29 @@ def all_vs_all_containment_matmul_chunked(
     count).
     """
     from drep_tpu.ops.minhash import require_int32_ids
+    from drep_tpu.ops.rangepart import vocab_extent
 
     require_int32_ids(packed.ids, "all_vs_all_containment_matmul_chunked")
     m = packed.n
     m_pad = matmul_rows_pad(m)
     v_chunk = matmul_vocab_chunk(m_pad)
-    stacked = jnp.asarray(_stacked_vocab_chunks(packed.ids, v_chunk, m_pad))
+    # uint16 alternative: cap chunks below 2^16 so the rebased stacked
+    # tensor ships at 2 bytes/element. More, narrower chunks cost extra
+    # per-chunk dispatches but identical total indicator/matmul work;
+    # padding skew at narrow widths can lose, so compare ACTUAL plan
+    # bytes and keep the smaller operand. The matmul jit widens u16 on
+    # device (ops/minhash.widen_ids_device).
+    extent = vocab_extent(packed.ids)
+    u16_chunk = 1 << 15
+    plan = None
+    if v_chunk > u16_chunk and extent > 0:
+        plan32 = _chunk_plan(packed.ids, v_chunk, extent)
+        plan16 = _chunk_plan(packed.ids, u16_chunk, extent)
+        if plan16[0] * plan16[3] * 2 < plan32[0] * plan32[3] * 4:
+            v_chunk, plan = u16_chunk, plan16
+        else:
+            plan = plan32
+    stacked = jnp.asarray(_stacked_vocab_chunks(packed.ids, v_chunk, m_pad, plan=plan))
     acc = None
     for r in range(stacked.shape[0]):
         part = _intersect_matmul(stacked[r], v_pad=v_chunk)

@@ -81,17 +81,21 @@ def test_stacked_vocab_chunks_rebase_and_reconstruct(rng):
     rebased to origin; chunks together reconstruct the original rows."""
     from drep_tpu.ops.containment import _stacked_vocab_chunks
 
+    from drep_tpu.ops.minhash import pad_sentinel
+
     ids = _sorted_rows(rng, 8, 400, 50_000)
     v_chunk = 8192
     stacked = _stacked_vocab_chunks(ids, v_chunk, m_pad=16)
-    assert stacked.shape[1] == 16 and (stacked[:, 8:] == PAD_ID).all()
+    assert stacked.dtype == np.uint16  # chunk < 2^16 ships link-compressed
+    pad = pad_sentinel(stacked.dtype)
+    assert stacked.shape[1] == 16 and (stacked[:, 8:] == pad).all()
     seen = [np.empty(0, np.int64)] * 8
     for r in range(stacked.shape[0]):
-        real = stacked[r][stacked[r] != PAD_ID]
+        real = stacked[r][stacked[r] != pad]
         if real.size:
             assert real.min() >= 0 and real.max() < v_chunk
         for i in range(8):
-            vals = stacked[r, i][stacked[r, i] != PAD_ID].astype(np.int64) + r * v_chunk
+            vals = stacked[r, i][stacked[r, i] != pad].astype(np.int64) + r * v_chunk
             seen[i] = np.concatenate([seen[i], vals])
     for i in range(8):
         np.testing.assert_array_equal(seen[i], ids[i][ids[i] != PAD_ID].astype(np.int64))
