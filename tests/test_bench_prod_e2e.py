@@ -56,7 +56,11 @@ def test_crossover_pack_chunked_matches_oracle(rng):
 def test_e2e_prod_width_composition():
     """bench_e2e at production scaled depth (20k -> packed width 32768),
     reduced n: clusters recovered, resume identical, and the secondary
-    stage ran OUTSIDE the one-shot indicator regime."""
+    stage rode the CLUSTER-LOCAL one-shot pack — the round-5 production
+    fix (BENCH_r04 e2e_prod ran 9 beyond-budget chunked mega-calls on the
+    union vocabulary; cluster-local remapping keeps batches one-shot).
+    The beyond-budget kernels keep their own coverage in
+    test_rangepart/test_containment and the secondary_production bench."""
     res = bench.bench_e2e(300, s_scaled=20_000)
     assert res["s_scaled"] == 20_000
     assert res["scaled_width_max"] > 16_384, "not production depth"
@@ -66,9 +70,11 @@ def test_e2e_prod_width_composition():
     assert res["secondary_clusters"] == res["primary_clusters"]
     paths = res["secondary_paths"]
     assert paths, "no containment_matrices calls recorded"
+    assert paths.get("one_shot_clusterlocal"), (
+        f"production-depth batches missed the cluster-local one-shot pack: {paths}"
+    )
     assert "one_shot" not in paths, (
-        f"production-width batches stayed in the one-shot regime: {paths} "
-        "— the stage is not exercising the beyond-budget kernels"
+        f"a union-vocabulary one-shot at production depth is impossible: {paths}"
     )
 
 
