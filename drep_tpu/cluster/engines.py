@@ -274,10 +274,14 @@ def secondary_jax_ani_batched(
     hash space across unrelated clusters) the union pack measured 8.4M
     ids and forced the chunked kernels (BENCH_r04 `e2e_prod`:
     matmul_chunked x9, 0.756x), while the cluster-local pack stays in the
-    one-shot indicator regime. Falls back to the shared-vocabulary pack +
-    full path dispatch when a mesh is requested (the ring path computes
-    full matrices) or when even the local extent exceeds the one-shot
-    budget."""
+    one-shot indicator regime. The cluster-local one-shot is preferred
+    even when a mesh is available: a <=512-row batch over a cluster-max
+    vocabulary is a single small matmul, and sharding it over a ring is
+    collective-latency-dominated for zero compute win — the mesh earns
+    its keep on the per-cluster path for big single clusters, not here.
+    Falls back to the shared-vocabulary pack + full path dispatch (which
+    may pick the mesh ring) when even the local extent exceeds the
+    one-shot budget."""
     from drep_tpu.ops.containment import (
         all_vs_all_containment_matmul,
         matmul_vocab_pad_extent,
@@ -288,19 +292,18 @@ def secondary_jax_ani_batched(
     flat = [i for cl in clusters for i in cl]
     names = [gs.names[i] for i in flat]
     ani_all = cov_all = None
-    if _mesh_or_none(mesh_shape, len(flat)) is None:
-        packed_l, v_extent = pack_scaled_sketches_clusterlocal(
-            [[gs.scaled[i] for i in cl] for cl in clusters], names
+    packed_l, v_extent = pack_scaled_sketches_clusterlocal(
+        [[gs.scaled[i] for i in cl] for cl in clusters], names
+    )
+    v_pad = matmul_vocab_pad_extent(v_extent)
+    if one_shot_fits(packed_l.n, v_pad):
+        _count_path("one_shot_clusterlocal")
+        # full-matrix ani/cov over the cluster-local pack: diagonal
+        # blocks are exact; cross blocks are id-collision garbage the
+        # slicing below never reads
+        ani_all, cov_all = all_vs_all_containment_matmul(
+            packed_l, k=gs.k, v_pad=v_pad
         )
-        v_pad = matmul_vocab_pad_extent(v_extent)
-        if one_shot_fits(packed_l.n, v_pad):
-            _count_path("one_shot_clusterlocal")
-            # full-matrix ani/cov over the cluster-local pack: diagonal
-            # blocks are exact; cross blocks are id-collision garbage the
-            # slicing below never reads
-            ani_all, cov_all = all_vs_all_containment_matmul(
-                packed_l, k=gs.k, v_pad=v_pad
-            )
     if ani_all is None:
         packed = pack_scaled_sketches([gs.scaled[i] for i in flat], names)
         ani_all, cov_all = containment_matrices(
