@@ -229,9 +229,24 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
         if beyond_budget_secondary_path(packed.sketch_size, v_pad) == "pallas_range":
             from drep_tpu.ops.pallas_merge import all_vs_all_containment_pallas
 
-            _count_path("pallas_range")
-            return all_vs_all_containment_pallas(packed, k=k)
-        _count_path("matmul_chunked")
+            try:
+                _count_path("pallas_range")
+                return all_vs_all_containment_pallas(packed, k=k)
+            except Exception:
+                # a Mosaic rejection of the fused stacked grid on some
+                # TPU generation must degrade a production run to the
+                # (always-valid) chunked matmul, not kill it — same
+                # self-deploying stance as the pallas indicator gate
+                from drep_tpu.utils.logger import get_logger
+
+                get_logger().warning(
+                    "pallas_range kernel failed to compile/run — falling "
+                    "back to the chunked MXU path for this cluster",
+                    exc_info=True,
+                )
+                _count_path("pallas_range_fallback")
+        else:
+            _count_path("matmul_chunked")
         return all_vs_all_containment_matmul_chunked(packed, k=k)
     _count_path("cpu_tiles")
     return all_vs_all_containment(packed, k=k, tile=tile)
