@@ -44,6 +44,16 @@ number exists — SURVEY.md §6), so the honest denominator everywhere is the
 north-star requirement: 100k MAGs in <30 min on v5e-16 =>
 100k*(100k-1)/2 pairs / 1800 s / 16 chips ~= 1.736e5 pairs/s/chip.
 vs_baseline > 1 means the stage clears the north-star rate.
+
+Triangle-only accounting (ISSUE 1): every stage reports `unique_pairs`
+(N*(N-1)/2 — the engines compute each unordered pair once and mirror),
+and the primary stage reports `tiles_computed`/`tiles_total`/
+`tile_fraction` diffed from the engine's schedule counters, proving the
+triangular schedule engaged (~0.5-0.56) rather than the full grid (1.0).
+The emitted `value` falls back to the first completed stage
+(`value_source`) when the headline stage itself never measured — partial
+results beat `value: null` (BENCH_r05 post-mortem), and a failed stage is
+recorded as `{"error": ...}` inside its stage dict.
 """
 
 from __future__ import annotations
@@ -100,8 +110,13 @@ def _best_of(fn, reps: int = 3) -> float:
 
 
 def _rate_fields(pairs: float, dt: float) -> dict:
+    """Per-stage throughput over UNIQUE genome pairs (N*(N-1)/2): the
+    triangular schedules compute each unordered pair once and mirror the
+    transpose, so unique pairs are the honest numerator — counting both
+    (i,j) and (j,i) would double-report the same work."""
     value = pairs / dt
     return {
+        "unique_pairs": int(pairs),
         "seconds": round(dt, 4),
         "pairs_per_sec_per_chip": round(value, 1),
         "vs_baseline": round(value / NORTH_STAR_PAIRS_PER_SEC_PER_CHIP, 3),
@@ -113,6 +128,17 @@ def _matmul_roofline(flops: float, dt: float) -> dict:
         "tflops": round(flops / dt / 1e12, 2),
         "mfu": round(flops / dt / V5E_INT8_OPS, 4),
     }
+
+
+def _tri_matmul_flops(m_pad: int, v_cols: float) -> float:
+    """MACs*2 the TRIANGULAR intersection matmul actually issues: the
+    canonical (bi <= bj) block rows sum to m_pad^2 * (B+1)/(2B) output
+    elements (B block rows), each contracting v_cols — the honest mfu
+    numerator now that the engines skip the mirrored half."""
+    from drep_tpu.ops.containment import tri_row_block
+
+    b = m_pad // tri_row_block(m_pad)
+    return 2.0 * m_pad * m_pad * ((b + 1) / (2 * b)) * v_cols
 
 
 def _merge_roofline(pairs: float, s2: int, hbm_bytes: float, dt: float) -> dict:
@@ -167,7 +193,13 @@ def bench_primary(publish=None) -> dict:
     # later stage in the process
     try:
         os.environ["DREP_TPU_MASH_ROWS_PER_ITER"] = "1"
+        from drep_tpu.utils.profiling import counters as _counters
+
         mash_distance_matrix(packed, k=K, tile=TILE)  # compile warmup at full shape
+        _tiles0 = _counters.stages.get("primary_compare")
+        _tc0, _tt0 = (
+            (_tiles0.tiles_computed, _tiles0.tiles_total) if _tiles0 else (0, 0)
+        )
         dt = _best_of(lambda: mash_distance_matrix(packed, k=K, tile=TILE))
         pairs = N_GENOMES * (N_GENOMES - 1) / 2
         s2 = max(128, next_pow2(SKETCH_SIZE))
@@ -182,6 +214,16 @@ def bench_primary(publish=None) -> dict:
             **_rate_fields(pairs, dt),
             **_merge_roofline(pairs, s2, hbm, dt),
         }
+        # triangular-schedule proof: the engine records its pair-tile
+        # schedule into the process counters — diffed around the measured
+        # calls, the ratio shows the triangle-only path actually engaged
+        # (~0.5-0.56) instead of the full grid (1.0)
+        _tiles1 = _counters.stages.get("primary_compare")
+        if _tiles1 is not None and _tiles1.tiles_total > _tt0:
+            tc, tt = _tiles1.tiles_computed - _tc0, _tiles1.tiles_total - _tt0
+            out["tiles_computed"] = tc
+            out["tiles_total"] = tt
+            out["tile_fraction"] = round(tc / tt, 4)
         if publish is not None:
             publish(out)
         print(
@@ -261,7 +303,7 @@ def bench_secondary_matmul(packed) -> dict:
     all_vs_all_containment_matmul(packed, k=K)  # warmup
     dt = _best_of(lambda: all_vs_all_containment_matmul(packed, k=K))
     pairs = SEC_M * (SEC_M - 1) / 2
-    flops = 2.0 * matmul_rows_pad(SEC_M) ** 2 * matmul_vocab_pad(packed)
+    flops = _tri_matmul_flops(matmul_rows_pad(SEC_M), matmul_vocab_pad(packed))
     return {
         "n_genomes": SEC_M,
         "sketch": SEC_WIDTH,
@@ -379,7 +421,7 @@ def bench_secondary_production(publish=None) -> dict:
     dt_m = _best_of(lambda: all_vs_all_containment_matmul_chunked(packed, k=K), reps=2)
     v_chunk = matmul_vocab_chunk(matmul_rows_pad(m))
     n_chunks = -(-vocab_extent(packed.ids) // v_chunk)
-    flops = 2.0 * matmul_rows_pad(m) ** 2 * n_chunks * v_chunk
+    flops = _tri_matmul_flops(matmul_rows_pad(m), n_chunks * v_chunk)
     out["matmul_chunked"] = {**_rate_fields(pairs, dt_m), **_matmul_roofline(flops, dt_m)}
     out.pop("measurement_pending", None)  # first real rate is in the record
 
@@ -421,7 +463,7 @@ def bench_secondary_production(publish=None) -> dict:
     v_pad_r = matmul_vocab_pad(packed_r)
     containment_matrices(packed_r, K)  # warmup
     dt_r = _best_of(lambda: containment_matrices(packed_r, K), reps=2)
-    flops_r = 2.0 * matmul_rows_pad(packed_r.n) ** 2 * v_pad_r
+    flops_r = _tri_matmul_flops(matmul_rows_pad(packed_r.n), v_pad_r)
     out["realistic_highoverlap"] = {
         "v_pad": v_pad_r,
         "one_shot_fits": bool(one_shot_fits(packed_r.n, v_pad_r)),
@@ -933,6 +975,11 @@ def _require_devices(timeout_s: float = 240.0) -> None:
                     "vs_baseline": None,
                     "drep_tpu_version": version,
                     "error": err,
+                    # structured stage record even on init failure, so the
+                    # driver's stage-level tooling sees WHERE it died
+                    # instead of an empty document (BENCH_r05 emitted
+                    # value:null with no stage data)
+                    "stages": {"backend_probe": {"error": err}},
                 }
             ),
             flush=True,
@@ -1004,25 +1051,63 @@ def link_health() -> dict:
 
 def _emit(stages: dict) -> None:
     """The one JSON line the driver records. Callable from the watchdog,
-    so a mid-run tunnel wedge still reports every stage measured so far."""
+    so a mid-run tunnel wedge still reports every stage measured so far.
+
+    `value` prefers the primary headline but FALLS BACK to the first stage
+    that measured a rate (value_source names it): a run where the headline
+    stage wedged but others completed must not read as `value: null` —
+    partial results beat null (BENCH_r05 post-mortem)."""
     try:
         from drep_tpu import __version__ as version
     except Exception:  # provenance must never block the record
         version = None
     head = stages.get("primary", {})
-    print(
-        json.dumps(
-            {
-                "metric": "genome-pairs/sec/chip",
-                "value": head.get("pairs_per_sec_per_chip"),
-                "unit": "pairs/s",
-                "vs_baseline": head.get("vs_baseline"),
-                "drep_tpu_version": version,
-                "stages": stages,
-            }
-        ),
-        flush=True,
-    )
+    value = head.get("pairs_per_sec_per_chip") if isinstance(head, dict) else None
+    vs = head.get("vs_baseline") if isinstance(head, dict) else None
+    source = "primary"
+    if value is None:
+        for name, st in stages.items():
+            if not isinstance(st, dict):
+                continue
+            if st.get("pairs_per_sec_per_chip") is not None:
+                value, vs, source = st["pairs_per_sec_per_chip"], st.get("vs_baseline"), name
+                break
+            # secondary_production / dispatch_crossover nest their rate
+            # fields one level down (per-kernel sub-records) — a run where
+            # only those completed must still report a value
+            for sub_name, sub in st.items():
+                if isinstance(sub, dict) and sub.get("pairs_per_sec_per_chip") is not None:
+                    value = sub["pairs_per_sec_per_chip"]
+                    vs = sub.get("vs_baseline")
+                    source = f"{name}.{sub_name}"
+                    break
+            if value is not None:
+                break
+    doc = {
+        "metric": "genome-pairs/sec/chip",
+        "value": value,
+        "unit": "pairs/s",
+        "vs_baseline": vs,
+        "drep_tpu_version": version,
+        "stages": stages,
+    }
+    if value is not None and source != "primary":
+        doc["value_source"] = source
+    print(json.dumps(doc), flush=True)
+
+
+def _record_stage_error(stages: dict, label: str, msg: str) -> None:
+    """Record a stage failure as `{"error": ...}` INSIDE the stage's dict
+    (merging with any early-published partial measurements) rather than a
+    side-channel key: partial numbers + a structured error beat both a
+    bare error string and a silently absent stage."""
+    entry = stages.get(label)
+    if isinstance(entry, dict):
+        entry = dict(entry)  # the worker thread may still hold a reference
+        entry["error"] = msg
+        stages[label] = entry
+    else:
+        stages[label] = {"error": msg}
 
 
 def _clear_partial() -> None:
@@ -1183,6 +1268,20 @@ def main() -> None:
     # latency/bandwidth numbers. Skipped when no stages run — `--stages
     # none` is the instant emit-contract probe and must not dispatch real
     # device work (a wedged tunnel would turn it into a 120 s rc=3)
+    # label -> the key the stage publishes under in `stages`: error records
+    # must merge INTO that entry (a partial secondary_production record
+    # with no error field is indistinguishable from a complete one).
+    # "secondary" keeps its label — it fans into two sub-records and the
+    # error cannot be attributed to one of them from here.
+    stage_keys = {
+        "e2e": f"e2e_{args.e2e_n // 1000}k",
+        "prod": "e2e_prod",
+        "scale": f"e2e_{args.scale_n // 1000}k",
+        "greedy": "greedy_secondary",
+        "production": "secondary_production",
+        "crossover": "dispatch_crossover",
+    }
+
     plan: list[tuple[str, float, object]] = []
     if want:
         plan.append(("link", 120, lambda: stages.__setitem__("link", link_health())))
@@ -1198,7 +1297,7 @@ def main() -> None:
             except Exception as e:  # a broken stage must not kill the rest
                 import traceback
 
-                stages[f"{label}_error"] = repr(e)
+                _record_stage_error(stages, stage_keys.get(label, label), repr(e))
                 traceback.print_exc()  # the JSON repr alone is undebuggable
             finally:
                 done.set()
@@ -1224,9 +1323,11 @@ def main() -> None:
             # json.dumps over a resizing dict raises — which would skip
             # the very output line this path exists to guarantee
             snap = dict(stages)
-            snap[f"{label}_error"] = (
+            _record_stage_error(
+                snap,
+                stage_keys.get(label, label),
                 f"stage exceeded its {budget:.0f}s watchdog budget "
-                "(wedged TPU tunnel mid-run?) — remaining stages skipped"
+                "(wedged TPU tunnel mid-run?) — remaining stages skipped",
             )
             print(f"bench: {label} WEDGED after {budget:.0f}s, bailing", file=sys.stderr, flush=True)
             _emit(snap)
@@ -1261,10 +1362,11 @@ def main() -> None:
     # record); remove the partial so a later killed run can never be
     # misattributed this run's stages
     _clear_partial()
-    if "primary" in want and "primary" not in stages:
-        # headline failed by exception: the JSON line above still carries
-        # every other stage, but the run must read as broken (matching
-        # the pre-watchdog behavior where bench_primary ran bare)
+    if "primary" in want and "pairs_per_sec_per_chip" not in stages.get("primary", {}):
+        # headline failed by exception (its stage entry is an {"error": ...}
+        # record or absent): the JSON line above still carries every other
+        # stage, but the run must read as broken (matching the pre-watchdog
+        # behavior where bench_primary ran bare)
         sys.exit(1)
 
 

@@ -8,6 +8,15 @@ Both engines pick their execution layout automatically: single-device tiled
 loops on one chip, ring-sharded ``shard_map`` all-pairs (parallel/allpairs)
 when the mesh has more than one device and the problem is big enough to
 amortize the collectives.
+
+Every dense path is TRIANGLE-ONLY (ISSUE 1): Mash distance and the raw
+MinHash/FracMinHash intersection size are symmetric, so each engine
+computes only the canonical upper-triangle pair tiles (single chip: blocked
+(bi <= bj) schedules or the wrapped symmetric Pallas grids; mesh: the
+half-ring, parallel/allpairs.py) and mirrors the transposed blocks on host
+— ~2x genome-pairs/sec/chip on the same hardware. The schedules record
+``tiles_computed / tiles_total`` into utils/profiling counters so the
+triangular engagement is observable in perf_counters.json and bench.py.
 """
 
 from __future__ import annotations
@@ -84,6 +93,12 @@ def mash_distance_matrix(
 
     Shared by the jax_mash engine and the multiround chunked path so both
     honor `mesh_shape` identically.
+
+    All dispatch targets are triangle-only: the mesh ring runs the
+    half-ring schedule (ceil((D+1)/2) of D steps + host mirror), the
+    Pallas path its wrapped symmetric grid, the sort tiles an upper-
+    triangle walk, and the MXU estimator canonical (bi <= bj) blocks —
+    each exactly equal to its full-grid twin at ~half the tile work.
 
     `estimator`: 'auto' (mesh ring if multi-device, else MXU matmul for
     large N, else sort tiles), 'sort' (union-bottom-s, the reference Mash
@@ -163,6 +178,11 @@ def primary_jax_mash(
 # re-derives this constant every run and reports `fitted_elem_cost` +
 # `shipped_matches_measured` — update again when a recorded crossover
 # table disagrees by >2x.
+# NB: the triangle-only refactor (ISSUE 1) cut the chunked-matmul side's
+# FLOPs ~1.8x while the pallas self path was already half-grid, so the
+# next on-hardware crossover run is expected to fit a LOWER constant;
+# until it lands, 47.0 conservatively over-favors the (now cheaper)
+# matmul side only near the boundary.
 MERGE_VS_MATMUL_ELEM_COST = 47.0
 
 
@@ -193,6 +213,12 @@ def _count_path(path: str) -> None:
 def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: int = 128):
     """(symmetric max-containment ani, directional cov) with automatic
     path selection.
+
+    Every path is triangle-only (intersection counts are symmetric; the
+    directional cov derives from counts on host): the matmul paths run
+    canonical (bi <= bj) blocks, the mesh ring the half-ring schedule,
+    the Pallas merge its wrapped symmetric grid, the CPU fallback an
+    upper-triangle tile walk — all mirror-exact vs their full grids.
 
     Preference order (measured on v5e):
     1. MXU indicator-matmul — ~340x faster than the gather path and exact;

@@ -24,6 +24,12 @@ coverages). C itself stays DIRECTIONAL as the alignment-fraction proxy for
 the reference's two-sided ``cov_thresh`` gate (pairs with coverage <
 cov_thresh in either direction get similarity zeroed, as in the
 reference's Ndb post-processing).
+
+Triangle-only execution (ISSUE 1): every all-vs-all path here ships the
+SYMMETRIC raw intersection size |A∩B| from the device and derives both
+cov directions (and the ani) from ``counts`` on host — so each engine
+computes only canonical upper-triangle tiles/blocks and host-mirrors the
+transposed rest, exactly equal to the full grid at ~half the device work.
 """
 
 from __future__ import annotations
@@ -138,6 +144,19 @@ def _pair_intersection(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     idx = jnp.clip(idx, 0, b.shape[0] - 1)
     hit = (b[idx] == a) & (a != PAD_ID)
     return jnp.sum(hit.astype(jnp.int32))
+
+
+@jax.jit
+def containment_inter_tile(a_ids, b_ids):
+    """SYMMETRIC intersection-size tile between sketch blocks:
+    inter[i,j] = |A_i ∩ B_j| (int32, exact). This is the payload the
+    triangular schedules ship — tile(A, B) == tile(B, A).T bit-exactly
+    (set intersection is symmetric), so mirrored blocks are transposed
+    copies, never recomputed. cov/ani derive from counts on host
+    (:func:`ani_cov_from_intersections`)."""
+    row = jax.vmap(_pair_intersection, in_axes=(None, 0))
+    tile = jax.vmap(row, in_axes=(0, None))
+    return tile(a_ids, b_ids)
 
 
 def containment_to_ani(c, k: int, xp=np):
@@ -269,6 +288,67 @@ def _intersect_matmul(ids, *, v_pad: int):
     )
 
 
+def tri_row_block(m_pad: int) -> int:
+    """Row-block size of the triangular (upper-block) matmul schedule:
+    a power of two dividing the pow2-bucketed `m_pad`, targeting 8 block
+    rows. 8 blocks put the canonical-block FLOPs at (8*9/2)/64 ≈ 56% of
+    the full grid while keeping the per-call dot count single-digit (the
+    asymptotic 50% needs many narrow matmuls, which trade MXU efficiency
+    for diminishing block savings)."""
+    return max(ROW_BUCKET_MIN, m_pad // 8)
+
+
+@functools.partial(jax.jit, static_argnames=("v_pad", "dtype", "use_pallas", "tb"))
+def _intersect_matmul_tri_jit(ids, *, v_pad: int, dtype, use_pallas: bool, tb: int):
+    """Upper-block-triangle variant of :func:`_intersect_matmul_jit`:
+    ONE indicator build, then per canonical row block `bi` a single rect
+    dot against all columns from that block onward — exactly the
+    (bi <= bj) blocks, ~half the MXU FLOPs. Intersections are symmetric,
+    so the skipped lower blocks are transposes the HOST mirrors in
+    (:func:`mirror_lower_blocks`); counts are exact integers, so the
+    mirrored matrix is bit-equal to the full matmul's."""
+    from drep_tpu.ops.minhash import widen_ids_device
+
+    ind = _indicator(widen_ids_device(ids), v_pad, dtype, use_pallas=use_pallas)
+    m = ind.shape[0]
+    out = jnp.zeros((m, m), jnp.int32)
+    for lo in range(0, m, tb):
+        out = out.at[lo : lo + tb, lo:].set(_int_dot(ind[lo : lo + tb], ind[lo:]))
+    return out
+
+
+def _intersect_matmul_tri(ids, *, v_pad: int):
+    """Triangular-schedule twin of :func:`_intersect_matmul`: returns the
+    upper-block-triangle count matrix (lower blocks zero — callers mirror
+    with :func:`mirror_lower_blocks`)."""
+    dtype = _indicator_dtype(ids.shape[1])
+    return _intersect_matmul_tri_jit(
+        ids,
+        v_pad=v_pad,
+        dtype=dtype,
+        use_pallas=_use_pallas_indicator(dtype),
+        tb=tri_row_block(ids.shape[0]),
+    )
+
+
+def mirror_lower_blocks(mat: np.ndarray, tb: int) -> np.ndarray:
+    """Fill the strictly-lower block triangle of a block-upper-triangular
+    symmetric matrix with the transposed upper blocks, in place (the host
+    half of the triangular matmul schedule)."""
+    for lo in range(tb, mat.shape[0], tb):
+        mat[lo : lo + tb, :lo] = mat[:lo, lo : lo + tb].T
+    return mat
+
+
+def _count_tri_tiles(m_pad: int, tb: int) -> None:
+    """Record the triangular matmul schedule into the secondary-stage tile
+    counters: B*(B+1)/2 canonical blocks of the B^2 grid."""
+    from drep_tpu.utils.profiling import counters
+
+    b = m_pad // tb
+    counters.add_tiles("secondary_compare", computed=b * (b + 1) // 2, total=b * b)
+
+
 def ani_cov_from_intersections(
     inter: np.ndarray, counts: np.ndarray, k: int
 ) -> tuple[np.ndarray, np.ndarray]:
@@ -292,12 +372,18 @@ def matmul_rows_pad(n: int) -> int:
 
 
 def all_vs_all_containment_matmul(
-    packed: PackedSketches, k: int = 21, v_pad: int | None = None
+    packed: PackedSketches, k: int = 21, v_pad: int | None = None,
+    triangular: bool = True,
 ) -> tuple[np.ndarray, np.ndarray]:
     """MXU path for the directional (ani, cov) matrices. Use when
     m * (v_pad+1) fits MATMUL_BUDGET_ELEMS; exact-equal to the searchsorted
     path (verified in tests). Pass a precomputed `v_pad` (from
     :func:`matmul_vocab_pad`) to avoid rescanning packed.ids.
+
+    `triangular` (default) runs only the canonical (bi <= bj) row blocks
+    of the intersection matmul and mirrors the rest on host — bit-equal
+    output (integer counts are symmetric) at ~half the MXU FLOPs; False
+    keeps the one-shot full matmul as the equality reference.
 
     Rows are padded to a pow2 bucket before the jit call: the secondary
     stage runs once per primary cluster/batch, and without bucketing every
@@ -310,7 +396,16 @@ def all_vs_all_containment_matmul(
     # padding to the matmul_rows_pad target itself (>= m) gives that exact
     # row count — the same number the dispatch budget check used
     ids, _ = pad_packed_rows(packed.ids, packed.counts, matmul_rows_pad(m))
-    inter = np.asarray(_intersect_matmul(jnp.asarray(ids), v_pad=v_pad))[:m, :m]
+    if triangular:
+        # np.array (not asarray): the host mirror mutates, and a device
+        # array's __array__ view is not guaranteed writable
+        inter_pad = np.array(_intersect_matmul_tri(jnp.asarray(ids), v_pad=v_pad))
+        tb = tri_row_block(ids.shape[0])
+        mirror_lower_blocks(inter_pad, tb)
+        _count_tri_tiles(ids.shape[0], tb)
+        inter = inter_pad[:m, :m]
+    else:
+        inter = np.asarray(_intersect_matmul(jnp.asarray(ids), v_pad=v_pad))[:m, :m]
     return ani_cov_from_intersections(inter, packed.counts, k)
 
 
@@ -517,6 +612,7 @@ def _rect_sharded_fn(v_pad: int, dtype_name: str, use_pallas: bool, mesh):
     from jax.sharding import PartitionSpec as P
 
     from drep_tpu.parallel.mesh import AXIS
+    from drep_tpu.utils.jaxcompat import shard_map
 
     dtype = {"int8": jnp.int8, "float32": jnp.float32}[dtype_name]
 
@@ -527,7 +623,7 @@ def _rect_sharded_fn(v_pad: int, dtype_name: str, use_pallas: bool, mesh):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             body, mesh=mesh, in_specs=(P(AXIS, None), P(None, None)),
             out_specs=P(AXIS, None),
         )
@@ -697,42 +793,59 @@ def all_vs_all_containment_matmul_chunked(
         else:
             plan = plan32
     stacked = jnp.asarray(_stacked_vocab_chunks(packed.ids, v_chunk, m_pad, plan=plan))
+    # triangular schedule per chunk: counts are additive over disjoint hash
+    # ranges AND symmetric, so each chunk contributes only its canonical
+    # (bi <= bj) blocks; the partials accumulate ON DEVICE and ONE host
+    # mirror after the final transfer completes the matrix — ~half the MXU
+    # FLOPs of the full per-chunk matmuls, same single-result-transfer
+    # dispatch pattern
     acc = None
     for r in range(stacked.shape[0]):
-        part = _intersect_matmul(stacked[r], v_pad=v_chunk)
+        part = _intersect_matmul_tri(stacked[r], v_pad=v_chunk)
         acc = part if acc is None else acc + part
     if acc is None:
         inter = np.zeros((m, m), dtype=np.int32)
     else:
-        inter = np.asarray(acc)[:m, :m]
+        tb = tri_row_block(m_pad)
+        inter = mirror_lower_blocks(np.array(acc), tb)[:m, :m]
+        _count_tri_tiles(m_pad, tb)
     return ani_cov_from_intersections(inter, packed.counts, k)
 
 
 def all_vs_all_containment(
-    packed: PackedSketches, k: int = 21, tile: int = 128
+    packed: PackedSketches, k: int = 21, tile: int = 128, triangular: bool = True
 ) -> tuple[np.ndarray, np.ndarray]:
     """Full [N, N] (symmetric max-containment ani, directional cov) via
-    fixed-shape coverage tiles; the ANI transform runs once on the full
-    coverage matrix (it needs both directions of every pair)."""
+    fixed-shape intersection tiles.
+
+    `triangular` (default) walks only the canonical (i0 <= j0) tile blocks:
+    the tile payload is the SYMMETRIC |A∩B| (containment_inter_tile), so
+    the lower blocks are host-transposed copies — ~2x fewer device tiles,
+    bit-equal output. Both cov directions and the ANI transform derive from
+    the full intersection matrix + counts on host (one shared formula,
+    :func:`ani_cov_from_intersections`)."""
     from drep_tpu.ops.minhash import require_int32_ids
+    from drep_tpu.utils.profiling import counters
 
     require_int32_ids(packed.ids, "all_vs_all_containment")
     n = packed.n
     tile = cap_gather_tile(packed.sketch_size, tile)
     ids, counts = pad_packed_rows(packed.ids, packed.counts, tile)
     nt = ids.shape[0]
+    nb = nt // tile
 
-    cov = np.zeros((nt, nt), dtype=np.float32)
+    inter = np.zeros((nt, nt), dtype=np.int32)
     for i0 in range(0, nt, tile):
-        for j0 in range(0, nt, tile):
-            c = containment_cov_tile(
-                ids[i0 : i0 + tile],
-                counts[i0 : i0 + tile],
-                ids[j0 : j0 + tile],
-                k=k,
+        for j0 in range(i0 if triangular else 0, nt, tile):
+            t = np.asarray(
+                containment_inter_tile(ids[i0 : i0 + tile], ids[j0 : j0 + tile])
             )
-            cov[i0 : i0 + tile, j0 : j0 + tile] = np.asarray(c)
-    cov = cov[:n, :n]
-    ani = max_containment_ani(cov, k)
-    np.fill_diagonal(cov, 1.0)
-    return ani, cov
+            inter[i0 : i0 + tile, j0 : j0 + tile] = t
+            if triangular and j0 != i0:
+                inter[j0 : j0 + tile, i0 : i0 + tile] = t.T
+    counters.add_tiles(
+        "secondary_compare",
+        computed=nb * (nb + 1) // 2 if triangular else nb * nb,
+        total=nb * nb,
+    )
+    return ani_cov_from_intersections(inter[:n, :n], packed.counts, k)

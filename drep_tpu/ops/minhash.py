@@ -193,9 +193,15 @@ def all_vs_all_mash(
     call has the same static shape (one XLA compilation, cached). For very
     large N use drep_tpu.parallel.allpairs (mesh-sharded) instead.
     """
+    from drep_tpu.utils.profiling import counters
+
     n = packed.n
     ids, counts = pad_packed_rows(packed.ids, packed.counts, tile)
     nt = ids.shape[0]
+    nb = nt // tile
+    # upper-triangle tile walk (j0 >= i0): Mash distance is symmetric, the
+    # lower blocks below are host-transposed copies — record the schedule
+    counters.add_tiles("primary_compare", computed=nb * (nb + 1) // 2, total=nb * nb)
 
     dist = np.ones((nt, nt), dtype=np.float32)
     jac = np.zeros((nt, nt), dtype=np.float32)

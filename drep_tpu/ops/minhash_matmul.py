@@ -91,13 +91,30 @@ def _build_chunks(ids: np.ndarray, chunk_entries: int):
     return rows_c, dcol_c
 
 
-@functools.partial(jax.jit, static_argnames=("n", "compact_out"))
-def _accumulate_chunks(rows_c, dcol_c, *, n: int, compact_out: bool):
+# row-block size of the triangular matmul schedule; must divide the
+# _ROW_BUCKET-padded row count, so it equals the bucket quantum
+_TRI_BLOCK = 256
+
+
+def _tri_blocks(n_pad: int) -> int:
+    return -(-n_pad // _TRI_BLOCK)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "compact_out", "triangular"))
+def _accumulate_chunks(rows_c, dcol_c, *, n: int, compact_out: bool, triangular: bool = True):
     """lax.scan over chunks: inter += I@I.T — the [n, n] intersection-count
     matrix (exact: 0/1 bf16 products, f32 accumulation). With `compact_out`
     the result is cast to int16 (counts <= sketch size < 2^15): the
     host link is the bottleneck on tunneled TPU setups, so the download is
-    halved and the Jaccard math runs on host instead."""
+    halved and the Jaccard math runs on host instead.
+
+    `triangular` (default): intersection counts are symmetric, so each
+    chunk contributes only the canonical (bi <= bj) row blocks — per block
+    row one rect dot [_TRI_BLOCK, W] x [W, n - lo] against the remaining
+    columns (~half the MXU FLOPs at 8+ blocks). The strictly-lower blocks
+    stay zero; the HOST mirrors them in after the single result transfer
+    (:func:`_mirror_lower`) — bit-equal to the full matmul (0/1 products
+    accumulate to exact small integers in f32, order-independent)."""
     width = rows_c.shape[1]
 
     def step(inter, chunk):
@@ -111,15 +128,34 @@ def _accumulate_chunks(rows_c, dcol_c, *, n: int, compact_out: bool):
         # NT-layout dot_general: contract the W axis of both operands
         # directly (measured faster than scattering a second transposed
         # indicator for the MXU-native NN layout)
-        inter = inter + jax.lax.dot_general(
-            ind, ind, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
+        if triangular:
+            for lo in range(0, n, _TRI_BLOCK):
+                part = jax.lax.dot_general(
+                    ind[lo : lo + _TRI_BLOCK],
+                    ind[lo:],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                inter = inter.at[lo : lo + _TRI_BLOCK, lo:].add(part)
+        else:
+            inter = inter + jax.lax.dot_general(
+                ind, ind, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
         return inter, None
 
     inter, _ = jax.lax.scan(
         step, jnp.zeros((n, n), jnp.float32), (rows_c, dcol_c)
     )
     return inter.astype(jnp.int16) if compact_out else inter
+
+
+def _mirror_lower(mat: np.ndarray) -> np.ndarray:
+    """Host half of the triangular schedule at this module's block size —
+    ONE mirror implementation serves every triangular matmul
+    (ops/containment.py owns it)."""
+    from drep_tpu.ops.containment import mirror_lower_blocks
+
+    return mirror_lower_blocks(mat, _TRI_BLOCK)
 
 
 def _below_counts(ids: np.ndarray, counts: np.ndarray, thresholds: np.ndarray) -> np.ndarray:
@@ -187,9 +223,15 @@ def _bucket_chunks(rows_c: np.ndarray, dcol_c: np.ndarray, n_pad: int):
 
 
 def all_vs_all_mash_matmul(
-    packed: PackedSketches, k: int = 21, chunk_entries: int = DEFAULT_CHUNK_ENTRIES
+    packed: PackedSketches,
+    k: int = 21,
+    chunk_entries: int = DEFAULT_CHUNK_ENTRIES,
+    triangular: bool = True,
 ) -> tuple[np.ndarray, np.ndarray]:
-    """Full [N, N] (dist, jaccard) via the MXU estimator."""
+    """Full [N, N] (dist, jaccard) via the MXU estimator. `triangular`
+    (default) computes only canonical (bi <= bj) intersection blocks and
+    mirrors the rest on host — bit-equal, ~half the MXU FLOPs; False keeps
+    the full-grid scan as the equality reference."""
     n = packed.n
     if n == 0:
         return np.zeros((0, 0), np.float32), np.zeros((0, 0), np.float32)
@@ -221,10 +263,22 @@ def all_vs_all_mash_matmul(
     # dispatch the device scan first (async), then fill `below` on host
     # while the MXU works — the searchsorted pass costs ~zero wall-clock
     inter_dev = _accumulate_chunks(
-        jnp.asarray(rows_c), jnp.asarray(dcol_c), n=n_pad, compact_out=compact
+        jnp.asarray(rows_c), jnp.asarray(dcol_c), n=n_pad, compact_out=compact,
+        triangular=triangular,
     )
     below = _below_counts(ids, counts, t)
-    dist, jac = _jaccard_host(np.asarray(inter_dev), below, counts, t, k=k)
+    # np.array (not asarray): the host mirror mutates, and a device
+    # array's __array__ view is not guaranteed writable
+    inter_host = _mirror_lower(np.array(inter_dev)) if triangular else np.asarray(inter_dev)
+    from drep_tpu.utils.profiling import counters
+
+    nb = _tri_blocks(n_pad)
+    counters.add_tiles(
+        "primary_compare",
+        computed=nb * (nb + 1) // 2 if triangular else nb * nb,
+        total=nb * nb,
+    )
+    dist, jac = _jaccard_host(inter_host, below, counts, t, k=k)
     dist = dist[:n, :n]
     jac = jac[:n, :n]
     np.fill_diagonal(dist, 0.0)

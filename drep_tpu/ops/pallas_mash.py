@@ -214,12 +214,22 @@ def all_vs_all_mash_pallas(packed, k: int = 21) -> tuple[np.ndarray, np.ndarray]
     union-bottom-s estimator, not an alternative family). Same output
     contract as ops/minhash.py::all_vs_all_mash."""
     from drep_tpu.ops.pallas_merge import _unwrap_symmetric
+    from drep_tpu.utils.profiling import counters
 
     n = packed.n
     ids, counts = packed.ids, packed.counts
     width = ids.shape[1]
     s2 = max(128, next_pow2(width))
     rows = -(-n // TILE) * TILE
+    # wrapped symmetric grid: t*(t//2+1) tiles of the t^2 full grid (for
+    # even t the last wrapped column double-covers half its tiles, so the
+    # count sits slightly above the exact triangle — recorded as executed)
+    t_blocks = rows // TILE
+    counters.add_tiles(
+        "primary_compare",
+        computed=t_blocks * (t_blocks // 2 + 1),
+        total=t_blocks * t_blocks,
+    )
     a = np.full((rows, s2), PAD_ID, np.int32)
     a[:n, :width] = ids
     cc = np.zeros((rows, 1), np.int32)

@@ -400,6 +400,22 @@ def intersect_counts_pallas(
     return _intersect_jnp_tiled(a, b, jnp_tile)[:na, :nb]
 
 
+def _count_self_tiles(n_rows: int, tile: int, half_grid: bool) -> None:
+    """Record the self-comparison schedule that ACTUALLY ran into the
+    secondary tile counters: the wrapped half-grid's t*(t//2+1) tiles, or
+    the full t^2 when a fallback took the rectangular walk — the counter
+    exists to expose full-grid regressions, so it must never claim the
+    triangular schedule for a path that did not run it."""
+    from drep_tpu.utils.profiling import counters
+
+    t = -(-n_rows // tile)
+    counters.add_tiles(
+        "secondary_compare",
+        computed=t * (t // 2 + 1) if half_grid else t * t,
+        total=t * t,
+    )
+
+
 def intersect_counts_pallas_self(
     ids: np.ndarray, jnp_tile: int = 128, force: str | None = None
 ) -> np.ndarray:
@@ -423,14 +439,19 @@ def intersect_counts_pallas_self(
             (stacked,) = stacked_range_buckets([a], PALLAS_MAX_WIDTH)
             if stacked.shape[0] == 0:
                 return np.zeros((n, n), dtype=np.int32)
+            _count_self_tiles(n, TILE_A, half_grid=True)
             compact = _intersect_grid_symmetric_stacked(
                 _pad_rows_stacked(stacked, TILE_A),
                 tile=TILE_A,
                 interpret=_use_interpret(),
             )
             return _unwrap_symmetric(np.asarray(compact), TILE_A)[:n, :n]
+        from drep_tpu.ops.merge import cap_merge_tile
+
+        _count_self_tiles(n, cap_merge_tile(jnp_tile, a.shape[1]), half_grid=False)
         return _intersect_jnp_tiled(a, a, jnp_tile)[:n, :n]
     a = _pad_rows(a, TILE_A)
+    _count_self_tiles(n, TILE_A, half_grid=True)
     compact = _intersect_grid_symmetric(
         np.ascontiguousarray(a[:, ::-1]),
         a,
@@ -449,5 +470,7 @@ def all_vs_all_containment_pallas(
     max(cov, cov.T)^(1/k), diagonals pinned to 1."""
     from drep_tpu.ops.containment import ani_cov_from_intersections
 
+    # tile accounting happens inside intersect_counts_pallas_self, per the
+    # schedule branch that actually runs (half-grid vs jnp full fallback)
     inter = intersect_counts_pallas_self(packed.ids)
     return ani_cov_from_intersections(inter, packed.counts, k)

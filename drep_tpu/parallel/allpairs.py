@@ -5,12 +5,30 @@ jobs (SURVEY.md §2c, §3.2) with the canonical TPU pattern (SURVEY.md §7
 step 7, SNIPPETS.md ring patterns): genomes are row-sharded over a 1-D
 mesh; each device holds 1/D of the sketches and computes its stripe of the
 distance matrix while the "B" operand ring-rotates over the mesh axis with
-``lax.ppermute`` — D steps, each overlapping an ICI hop with a tile of
-compute, never materializing more than 2/D of the sketches per device.
+``lax.ppermute`` — never materializing more than 2/D of the sketches per
+device.
 
-The jitted shard_map programs are cached per (kernel kind, k, mesh), so
-repeated calls — e.g. one per large primary cluster during secondary
-clustering — recompile only when shapes actually change.
+Half-ring schedule (ISSUE 1): every registered tile kernel is SYMMETRIC in
+its pair — Mash distance and the raw MinHash intersection size both satisfy
+``tile(A, B) == tile(B, A).T`` bit-exactly (integer shared/intersection
+counts, identical merged unions) — so the full D-step ring does every
+unordered block pair twice. The half ring runs only ``D//2 + 1`` of the D
+steps (= ceil((D+1)/2)): at step ``i`` device ``m`` computes block
+``(m, (m-i) mod D)``, and the redundant mirror of that block would only
+arrive at step ``D-i``. For even D the middle step ``i = D/2`` is
+self-paired (device ``m`` and ``m + D/2`` compute mirror tiles of the same
+unordered pair), so it is split across device halves: only devices
+``m < D/2`` keep their middle-step tile. Net effect: ``D*(D+1)/2`` unique
+block tiles instead of ``D^2`` — ~2x less tile compute AND ~2x fewer
+``lax.ppermute`` ICI hops — and the host mirrors the transposed blocks
+into the uncomputed triangle after ``gather_global``. The containment ring
+ships the symmetric raw intersection size (not the directional
+``cov = |A∩B|/|A|``) precisely so it can ride this schedule; both cov
+directions derive from ``counts`` on host.
+
+The jitted shard_map programs are cached per (kernel kind, k, mesh,
+schedule), so repeated calls — e.g. one per large primary cluster during
+secondary clustering — recompile only when shapes actually change.
 """
 
 from __future__ import annotations
@@ -24,23 +42,46 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from drep_tpu.ops.containment import containment_cov_tile, max_containment_ani
+from drep_tpu.ops.containment import ani_cov_from_intersections, containment_inter_tile
 from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
 from drep_tpu.parallel.mesh import AXIS, make_mesh
+from drep_tpu.utils.jaxcompat import pcast, shard_map
 
 
-def _ring_allpairs_shard(a_ids, a_counts, tile_fn, n_outputs: int):
+def half_ring_steps(n_devices: int) -> int:
+    """Ring steps the triangular schedule runs: ceil((D+1)/2) of D."""
+    return n_devices // 2 + 1
+
+
+def ring_tiles_computed(n_devices: int, half: bool) -> int:
+    """Unique block tiles the schedule produces (D*(D+1)/2 when half: the
+    even-D middle step contributes only its canonical device half)."""
+    if half:
+        return n_devices * (n_devices + 1) // 2
+    return n_devices * n_devices
+
+
+def _ring_allpairs_shard(a_ids, a_counts, tile_fn, n_outputs: int, half: bool):
     """Per-shard body (runs under shard_map): local A block vs ring-rotating
-    B block. Returns [n_local, N_global] stripes for each tile output."""
+    B block. Returns [n_local, N_global] stripes for each tile output.
+
+    With ``half`` (symmetric kernels only) the loop runs ``D//2 + 1`` steps
+    instead of D, and for even D the final step's store is masked to the
+    canonical device half ``my < D/2`` — the other half's blocks are
+    mirrored on host from their transposed twins (see module docstring).
+    """
     n_devices = lax.psum(1, AXIS)
     my = lax.axis_index(AXIS)
     n_local = a_ids.shape[0]
+    n_steps = half_ring_steps(n_devices) if half else n_devices
+    # even-D half ring: the middle step is self-paired across device halves
+    split_mid = half and n_devices % 2 == 0 and n_devices > 1
 
     b_ids, b_counts = a_ids, a_counts
     # mark the accumulators as device-varying so the scan carry type is
     # stable (the updates are derived from axis_index and vary over the mesh)
     outs = [
-        lax.pcast(jnp.zeros((n_local, n_local * n_devices), jnp.float32), (AXIS,), to="varying")
+        pcast(jnp.zeros((n_local, n_local * n_devices), jnp.float32), (AXIS,), to="varying")
         for _ in range(n_outputs)
     ]
     perm = [(j, (j + 1) % n_devices) for j in range(n_devices)]
@@ -53,23 +94,32 @@ def _ring_allpairs_shard(a_ids, a_counts, tile_fn, n_outputs: int):
         # after i rotations device m holds block (m - i) mod D
         src = jnp.remainder(my - i, n_devices)
         col0 = src * n_local
-        outs = [
+        updated = [
             lax.dynamic_update_slice(out, tile.astype(jnp.float32), (0, col0))
             for out, tile in zip(outs, tiles)
         ]
+        if split_mid:
+            # keep the middle-step tile only on the canonical half; the
+            # predicate is data-flow (where), not control-flow, so SPMD
+            # lockstep and replication checking are untouched
+            keep = jnp.logical_or(i < n_steps - 1, my < n_devices // 2)
+            outs = [jnp.where(keep, u, o) for u, o in zip(updated, outs)]
+        else:
+            outs = updated
 
         def rotate(ops):
             bi, bc = ops
             return lax.ppermute(bi, AXIS, perm), lax.ppermute(bc, AXIS, perm)
 
         # the final iteration's rotation result is never read — skip the
-        # ICI traffic (the predicate is uniform across devices)
+        # ICI traffic (the predicate is uniform across devices). Under the
+        # half schedule this saves D - n_steps ADDITIONAL hops per call.
         b_ids, b_counts = lax.cond(
-            i < n_devices - 1, rotate, lambda ops: ops, (b_ids, b_counts)
+            i < n_steps - 1, rotate, lambda ops: ops, (b_ids, b_counts)
         )
         return (b_ids, b_counts, *outs)
 
-    carry = lax.fori_loop(0, n_devices, step, (b_ids, b_counts, *outs))
+    carry = lax.fori_loop(0, n_steps, step, (b_ids, b_counts, *outs))
     return tuple(carry[2:])
 
 
@@ -82,16 +132,23 @@ def _mash_tile(k: int):
 
 
 def _containment_tile(k: int):
+    del k  # |A∩B| is count-free; k rides only in the cache key
+
     def tile(a_ids, a_counts, b_ids, b_counts):
-        del b_counts  # cov = |A∩B|/|A| needs only the query side
-        return containment_cov_tile(a_ids, a_counts, b_ids, k=k)
+        del a_counts, b_counts  # symmetric raw intersections need no counts
+        return containment_inter_tile(a_ids, b_ids)
 
     return tile
 
 
-# containment ships ONE output stripe (cov); ani derives from the gathered
-# full matrix on host (max_containment_ani needs both directions of every
-# pair, which no single ring stripe holds) — and halves the result traffic
+# containment ships ONE output stripe: the SYMMETRIC raw intersection size
+# |A∩B| (int counts, exact in f32 below 2^24 — far above any packed sketch
+# width). Both cov directions and the max-containment ani derive from the
+# gathered full matrix + counts on host (ani_cov_from_intersections); the
+# symmetric payload is what lets containment ride the half-ring schedule,
+# and it halves the result traffic vs shipping both cov directions.
+# Every kind must keep tile(A,B) == tile(B,A).T bit-exact — the half-ring
+# host mirror DEPENDS on it (asymmetric kernels would need the full ring).
 _TILE_KINDS: dict[str, tuple[Callable[[int], Callable], int]] = {
     "mash": (_mash_tile, 1),
     "containment": (_containment_tile, 1),
@@ -127,15 +184,47 @@ def gather_global(x: jax.Array) -> np.ndarray:
     return np.array(x)
 
 
+def _ring_block_computed(a: int, b: int, n_devices: int) -> bool:
+    """Whether the half-ring schedule stored block (row a, col b): device a
+    computes column block (a - i) mod D at step i, steps 0..n_steps-1, with
+    the even-D middle step kept only on devices a < D/2."""
+    i = (a - b) % n_devices
+    n_steps = half_ring_steps(n_devices)
+    if i >= n_steps:
+        return False
+    if n_devices % 2 == 0 and n_devices > 1 and i == n_devices // 2:
+        return a < n_devices // 2
+    return True
+
+
+def mirror_half_ring(mat: np.ndarray, n_devices: int) -> None:
+    """Fill the blocks the half-ring schedule skipped with the transpose of
+    their computed twins, in place. `mat` is the gathered [n_pad, n_pad]
+    matrix (n_pad a multiple of n_devices)."""
+    n_local = mat.shape[0] // n_devices
+    for a in range(n_devices):
+        for b in range(n_devices):
+            if a == b or _ring_block_computed(a, b, n_devices):
+                continue
+            assert _ring_block_computed(b, a, n_devices), "schedule hole"
+            ra = slice(a * n_local, (a + 1) * n_local)
+            rb = slice(b * n_local, (b + 1) * n_local)
+            mat[ra, rb] = mat[rb, ra].T
+
+
 @functools.lru_cache(maxsize=None)
-def _ring_fn(kind: str, k: int, mesh) -> tuple[Callable, int]:
-    """One jitted shard_map program per (kernel kind, k, mesh); jax.jit then
-    caches per input shape, so same-shape calls are compile-free."""
+def _ring_fn(kind: str, k: int, mesh, half: bool) -> tuple[Callable, int]:
+    """One jitted shard_map program per (kernel kind, k, mesh, schedule);
+    jax.jit then caches per input shape, so same-shape calls are
+    compile-free."""
     make_tile, n_outputs = _TILE_KINDS[kind]
     fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             functools.partial(
-                _ring_allpairs_shard, tile_fn=make_tile(k), n_outputs=n_outputs
+                _ring_allpairs_shard,
+                tile_fn=make_tile(k),
+                n_outputs=n_outputs,
+                half=half,
             ),
             mesh=mesh,
             in_specs=(P(AXIS, None), P(AXIS)),
@@ -150,39 +239,62 @@ def ring_allpairs(
     kind: str,
     k: int,
     mesh=None,
+    full_grid: bool = False,
 ) -> tuple[np.ndarray, ...]:
     """Run the `kind` tile kernel over every pair of rows, sharded over the
     mesh. Returns full [N, N] float32 matrices (one per kernel output),
-    gathered to host and trimmed to the real N."""
+    gathered to host and trimmed to the real N.
+
+    The half-ring (triangular) schedule is the default — every registered
+    kernel is symmetric (see _TILE_KINDS). ``full_grid=True`` forces the
+    original D-step ring; it exists as the equality reference for tests
+    and for any future asymmetric kernel.
+    """
     if mesh is None:
         mesh = make_mesh()
     n_devices = mesh.devices.size
+    half = not full_grid
     n = packed.n
     ids, counts = pad_packed_rows(packed.ids, packed.counts, n_devices)
 
     ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
     counts_d = put_global(counts, NamedSharding(mesh, P(AXIS)))
 
-    fn, _ = _ring_fn(kind, k, mesh)
+    fn, _ = _ring_fn(kind, k, mesh, half)
     outs = fn(ids_d, counts_d)
     # copy to host (np.array copies): buffers are read-only and callers
     # fill diagonals; gather_global handles the >1-process reshard
-    return tuple(gather_global(o)[:n, :n] for o in outs)
+    gathered = [gather_global(o) for o in outs]
+    if half:
+        for g in gathered:
+            mirror_half_ring(g, n_devices)
+    from drep_tpu.utils.profiling import counters
+
+    counters.add_tiles(
+        "primary_compare" if kind == "mash" else "secondary_compare",
+        computed=ring_tiles_computed(n_devices, half),
+        total=n_devices * n_devices,
+    )
+    return tuple(g[:n, :n] for g in gathered)
 
 
-def sharded_mash_allpairs(packed: PackedSketches, k: int = 21, mesh=None) -> np.ndarray:
-    """[N, N] Mash distance matrix, ring-sharded over the mesh."""
-    (dist,) = ring_allpairs(packed, "mash", k, mesh=mesh)
+def sharded_mash_allpairs(
+    packed: PackedSketches, k: int = 21, mesh=None, full_grid: bool = False
+) -> np.ndarray:
+    """[N, N] Mash distance matrix, ring-sharded over the mesh (half-ring
+    triangular schedule unless ``full_grid``)."""
+    (dist,) = ring_allpairs(packed, "mash", k, mesh=mesh, full_grid=full_grid)
     np.fill_diagonal(dist, 0.0)
     return dist
 
 
 def sharded_containment_allpairs(
-    packed: PackedSketches, k: int = 21, mesh=None
+    packed: PackedSketches, k: int = 21, mesh=None, full_grid: bool = False
 ) -> tuple[np.ndarray, np.ndarray]:
     """([N,N] symmetric max-containment ani, [N,N] directional cov),
-    ring-sharded over the mesh."""
-    (cov,) = ring_allpairs(packed, "containment", k, mesh=mesh)
-    ani = max_containment_ani(cov, k)
-    np.fill_diagonal(cov, 1.0)
-    return ani, cov
+    ring-sharded over the mesh. The ring ships symmetric raw intersection
+    sizes (half-ring schedule); both cov directions derive from `counts`
+    on host — same directional-cov contract as every other containment
+    path."""
+    (inter,) = ring_allpairs(packed, "containment", k, mesh=mesh, full_grid=full_grid)
+    return ani_cov_from_intersections(inter, packed.counts, k)

@@ -313,6 +313,8 @@ def streaming_mash_edges(
     all_dd: list[np.ndarray] = []
     n_resumed = 0
     pairs_computed = 0
+    tiles_done = 0  # upper-triangle tiles actually dispatched this call
+    tiles_full = 0  # full-grid tiles of the same stripes (resumed: 0/0)
 
     for bi in range(n_blocks):
         if bi % pc != pid:
@@ -400,6 +402,8 @@ def streaming_mash_edges(
             )
             tiles.append((j0, comp))
             pairs_computed += _real_pairs_in_tile(i0, j0, block, n)
+            tiles_done += 1
+        tiles_full += n_blocks
 
         row_ii: list[np.ndarray] = []
         row_jj: list[np.ndarray] = []
@@ -447,6 +451,10 @@ def streaming_mash_edges(
 
     if n_resumed:
         logger.info("streaming primary: resumed %d/%d row-block shards", n_resumed, n_blocks)
+    if tiles_full:
+        from drep_tpu.utils.profiling import counters
+
+        counters.add_tiles("primary_compare", computed=tiles_done, total=tiles_full)
     ii = np.concatenate(all_ii) if all_ii else np.empty(0, np.int64)
     jj = np.concatenate(all_jj) if all_jj else np.empty(0, np.int64)
     dd = np.concatenate(all_dd) if all_dd else np.empty(0, np.float32)

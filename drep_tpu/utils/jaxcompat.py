@@ -1,0 +1,80 @@
+"""Version shims honoring the ``jax>=0.4.30`` pin in pyproject.toml.
+
+The parallel layer is written against the modern public API
+(``jax.shard_map``, ``lax.pcast``), but the pin admits releases where
+those names do not exist yet. Three API generations matter:
+
+1. modern: ``jax.shard_map`` + ``lax.pcast`` — used as-is.
+2. mid-range (``jax.shard_map`` public, ``lax.pcast`` absent): the
+   varying/replicated value-type system may exist without ``pcast`` —
+   ``lax.pvary`` covers our one use (marking a replicated zeros block
+   varying before a loop carry); if even that is missing, the value-type
+   check is disabled instead (``check_vma=False`` / ``check_rep=False``,
+   whichever kwarg the release knows).
+3. 0.4.x (e.g. the installed 0.4.37): ``shard_map`` lives under
+   ``jax.experimental.shard_map`` and there is no varying-type system at
+   all; the static replication checker has no annotation for
+   axis_index-derived loop carries, so it is disabled the same way.
+
+Every call site routes through THIS module so the compat decision is made
+exactly once: :func:`shard_map` (keyword subset ``mesh``, ``in_specs``,
+``out_specs``) and :func:`pcast` (no-op when the release has no value
+types to cast between).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_shard_map_impl = (
+    jax.shard_map
+    if hasattr(jax, "shard_map")
+    else __import__("jax.experimental.shard_map", fromlist=["shard_map"]).shard_map
+)
+
+if hasattr(lax, "pcast"):
+    shard_map = _shard_map_impl
+    pcast = lax.pcast
+elif hasattr(jax, "shard_map") and hasattr(lax, "pvary"):
+    # mid-range: value types exist but pcast does not; pvary is exactly
+    # our replicated->varying cast, so checking can stay ON
+    shard_map = _shard_map_impl
+
+    def pcast(x, axes, to=None):  # type: ignore[misc]
+        del to  # only the replicated->varying direction is ever used here
+        return lax.pvary(x, axes)
+
+else:
+    # no way to annotate the varying loop carry: disable the value-type /
+    # replication checker (the programs are correct; only the static
+    # checker lacks the vocabulary). The kwarg name changed across
+    # releases — resolve it by SIGNATURE inspection, never by a probe
+    # call: this module is imported before ``jax.distributed.initialize``
+    # on multi-host bring-up, and touching the backend here would pin the
+    # process single-host.
+    def pcast(x, axes, to=None):  # type: ignore[misc]
+        del axes, to
+        return x
+
+    def _pick_check_kwarg() -> dict:
+        import inspect
+
+        try:
+            params = inspect.signature(_shard_map_impl).parameters
+        except (TypeError, ValueError):
+            return {}
+        for name in ("check_vma", "check_rep"):
+            if name in params:
+                return {name: False}
+        return {}
+
+    _CHECK_KWARG = _pick_check_kwarg()
+
+    def shard_map(f, *, mesh, in_specs, out_specs):  # type: ignore[misc]
+        return _shard_map_impl(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **_CHECK_KWARG
+        )
+
+
+__all__ = ["shard_map", "pcast"]

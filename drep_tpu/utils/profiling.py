@@ -26,6 +26,12 @@ class _Stage:
     pairs: int = 0
     seconds: float = 0.0
     calls: int = 0
+    # triangular-schedule proof (ISSUE 1): how many pair-tiles the stage's
+    # compute schedule actually ran vs the full N^2 grid it covers. A
+    # triangle-only engine reports ~(B+1)/(2B) of the full grid; a silent
+    # regression to full-grid scheduling shows up as fraction ~1.0.
+    tiles_computed: int = 0
+    tiles_total: int = 0
 
 
 @dataclass
@@ -53,6 +59,17 @@ class Counters:
         st.seconds += float(seconds)
         st.calls += 1
 
+    def add_tiles(self, name: str, computed: int, total: int) -> None:
+        """Record one compare schedule's pair-tile accounting: `computed`
+        tiles actually dispatched vs `total` tiles of the full N^2 grid the
+        output covers. Separate from add()/stage() on purpose — pairs and
+        seconds are recorded once at the pipeline layer (controller), tiles
+        once at the compute layer (the engine that knows its schedule), so
+        neither is ever double-counted."""
+        st = self.stages.setdefault(name, _Stage())
+        st.tiles_computed += int(computed)
+        st.tiles_total += int(total)
+
     def report(self) -> dict[str, Any]:
         import jax
 
@@ -68,6 +85,12 @@ class Counters:
                 "pairs_per_sec": round(rate, 1),
                 "pairs_per_sec_per_chip": round(rate / n_chips, 1),
             }
+            if st.tiles_total > 0:
+                out["stages"][name]["tiles_computed"] = st.tiles_computed
+                out["stages"][name]["tiles_total"] = st.tiles_total
+                out["stages"][name]["tile_fraction"] = round(
+                    st.tiles_computed / st.tiles_total, 4
+                )
             total_pairs += st.pairs
             total_seconds += st.seconds
         total_rate = total_pairs / total_seconds if total_seconds > 0 else 0.0

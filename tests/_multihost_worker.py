@@ -24,9 +24,23 @@ def main() -> None:
     import jax
 
     # jax 0.9: the forced-host XLA_FLAGS route no longer multiplies CPU
-    # devices; the config knob does, and must be set pre-backend-init
+    # devices; the config knob does, and must be set pre-backend-init.
+    # Older releases within the pyproject pin (e.g. 0.4.37) lack the knob
+    # and rely on the XLA_FLAGS the parent test already exported.
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        pass
+    try:
+        # pre-0.5 jaxlib implements cross-process CPU collectives only
+        # through gloo, and the default ("none") makes every multiprocess
+        # computation fail with "Multiprocess computations aren't
+        # implemented on the CPU backend"; newer releases dropped the knob
+        # (gloo became the default)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
     jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == 2 * nproc, jax.devices()

@@ -24,6 +24,28 @@ def _oracle(ids, v_pad):
     return out
 
 
+def _interpret_supported() -> bool:
+    """Older jax (e.g. 0.4.37, within the pyproject pin) cannot DISCHARGE
+    the kernel's dynamic-sublane ref stores in Pallas interpret mode
+    (NotImplementedError from jax._src.state.discharge) — a test-vehicle
+    limitation only: on TPU the compiled path is gated by the on-device
+    self-test, and off-TPU the engine never calls this kernel."""
+    try:
+        _indicator_pallas_jit(
+            jnp.asarray(np.full((8, 8), PAD_ID, np.int32)), v_pad=128, interpret=True
+        )
+        return True
+    except NotImplementedError:
+        return False
+
+
+needs_interpret = pytest.mark.skipif(
+    not _interpret_supported(),
+    reason="pallas interpret mode lacks dynamic-ref discharge on this jax",
+)
+
+
+@needs_interpret
 @pytest.mark.parametrize("v_pad", [256, 8192])
 def test_kernel_matches_oracle_interpret(v_pad):
     rng = np.random.default_rng(4)
@@ -36,6 +58,7 @@ def test_kernel_matches_oracle_interpret(v_pad):
     np.testing.assert_array_equal(got, _oracle(ids, v_pad))
 
 
+@needs_interpret
 def test_kernel_ignores_out_of_extent_ids_interpret():
     """Ids >= v_pad (the scatter's trash-column cases) contribute nothing;
     an all-pad row stays all-zero."""
