@@ -815,6 +815,7 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None, workdir: str | None = 
         return {k: (v.pairs, v.seconds) for k, v in counters.stages.items()}
 
     ctr_before = _snap()
+    faults_before = dict(counters.faults)
     import contextlib
     import glob as _glob
 
@@ -885,6 +886,17 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None, workdir: str | None = 
             "warm_start_shards": warm_start_shards,
             "resume_pending": True,  # removed when the resume leg lands
         }
+        # honesty: a run that survived on retries / a quarantined chip /
+        # CPU-fallback tiles is NOT the same measurement as a clean one —
+        # the fault-tolerance counters (diffed, same idiom as stage_seconds)
+        # ride in the record so the merge tooling can tell them apart
+        ft_events = {
+            k: c - faults_before.get(k, 0)
+            for k, c in counters.faults.items()
+            if c - faults_before.get(k, 0)
+        }
+        if ft_events:
+            out["fault_tolerance"] = ft_events
         if publish is not None:
             publish(out)
 
@@ -1061,6 +1073,15 @@ def _emit(stages: dict) -> None:
         from drep_tpu import __version__ as version
     except Exception:  # provenance must never block the record
         version = None
+    fault_spec = os.environ.get("DREP_TPU_FAULTS")
+    if fault_spec:
+        # chaos-mode provenance, stamped INTO each stage record so it
+        # survives the partial-merge tooling: an injected-fault bench run
+        # must never be mistaken for a clean measurement
+        # (tools/missing_stages.py treats stamped records as not-done)
+        for st in stages.values():
+            if isinstance(st, dict):
+                st["faults_injected"] = fault_spec
     head = stages.get("primary", {})
     value = head.get("pairs_per_sec_per_chip") if isinstance(head, dict) else None
     vs = head.get("vs_baseline") if isinstance(head, dict) else None

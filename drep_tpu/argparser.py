@@ -88,6 +88,15 @@ def build_parser() -> argparse.ArgumentParser:
                          help="disable overlapping the streaming kernel's XLA "
                               "compile with host ingest (results are identical "
                               "either way; this exists for debugging)")
+        tpu.add_argument("--fault_retries", type=int, default=2,
+                         help="re-dispatch attempts after a failed/wedged device "
+                              "call before quarantining the device or falling "
+                              "back to CPU recompute (parallel/faulttol.py)")
+        tpu.add_argument("--dispatch_timeout", type=float, default=0.0,
+                         help="per-dispatch watchdog in seconds: a device call "
+                              "exceeding it counts as failed and is retried on "
+                              "another device (0 = disabled; wedge-prone "
+                              "backends want ~60-300s)")
         tpu.add_argument("--profile", nargs="?", const="auto", default=None,
                          help="record a jax.profiler trace of the compare stage "
                               "(optionally to the given directory; default "

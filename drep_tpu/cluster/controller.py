@@ -66,6 +66,12 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "streaming_block": 1024,
     "streaming_threshold": 30_000,
     "overlap_ingest": True,
+    # fault tolerance (parallel/faulttol.py): retries per failed device
+    # dispatch, and the per-dispatch watchdog (seconds; 0 = disabled).
+    # Neither affects results, only how failures are survived — kept out
+    # of _RESUME_KEYS so changing them never invalidates a workdir.
+    "fault_retries": 2,
+    "dispatch_timeout": 0.0,
 }
 
 _RESUME_KEYS = [
@@ -95,6 +101,20 @@ def _fill_defaults(kwargs: dict[str, Any]) -> dict[str, Any]:
     out = dict(CLUSTER_DEFAULTS)
     out.update({k: v for k, v in kwargs.items() if v is not None})
     return out
+
+
+def _ft_config(kw: dict[str, Any]):
+    """Fault-tolerance knobs -> executor config (also installed as the
+    process default so paths that cannot thread a config — the dense
+    ring — honor the same CLI flags)."""
+    from drep_tpu.parallel.faulttol import FaultTolConfig, configure_defaults
+
+    cfg = FaultTolConfig(
+        max_retries=int(kw["fault_retries"]),
+        dispatch_timeout_s=float(kw["dispatch_timeout"]),
+    )
+    configure_defaults(cfg)
+    return cfg
 
 
 def _warn_dist(kw: dict[str, Any]) -> float:
@@ -167,7 +187,11 @@ def _resolve_estimator_for_run(n: int, kw: dict[str, Any]) -> str:
 
 
 def _primary_clusters(
-    gs: GenomeSketches, bdb: pd.DataFrame, kw: dict[str, Any], wd: WorkDirectory | None = None
+    gs: GenomeSketches,
+    bdb: pd.DataFrame,
+    kw: dict[str, Any],
+    wd: WorkDirectory | None = None,
+    ft_cfg=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, pd.DataFrame | None, int]:
     """Returns (labels 1..C, dist matrix | None, linkage, sparse Mdb | None,
     pairs actually compared — 0 for skipped work, honest across resumes)."""
@@ -214,6 +238,7 @@ def _primary_clusters(
             checkpoint_dir=ckpt,
             keep_dist=_warn_dist(kw),  # evaluate-stage visibility
             cluster_alg=kw["clusterAlg"],
+            ft_config=ft_cfg,
         )
         return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names), pairs_computed
     engine = dispatch.get_primary(kw["primary_algorithm"])
@@ -272,6 +297,7 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     """Run (or resume) the full clustering stage; returns Cdb."""
     logger = get_logger()
     kw = _fill_defaults(kwargs)
+    ft_cfg = _ft_config(kw)  # install the run's fault-tolerance defaults
     snapshot = {k: kw.get(k) for k in _RESUME_KEYS if k != "genomes"}
     # normalize: CLI passes 0.25 explicitly, library callers omit it — the
     # effective value must snapshot identically from both entry points
@@ -365,7 +391,9 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     from drep_tpu.utils.profiling import counters
 
     t0 = _time.perf_counter()
-    primary, pdist, plink, sparse_mdb, pairs_done = _primary_clusters(gs, bdb, kw, wd=wd)
+    primary, pdist, plink, sparse_mdb, pairs_done = _primary_clusters(
+        gs, bdb, kw, wd=wd, ft_cfg=ft_cfg
+    )
     counters.add("primary_compare", pairs=pairs_done, seconds=_time.perf_counter() - t0)
     n_primary = int(primary.max()) if n else 0
     logger.info("primary clustering: %d clusters from %d genomes", n_primary, n)
@@ -453,8 +481,20 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                 results[pc] = (ndb, labels, np.empty((0, 4)))
                 ckpt.save(pc, *results[pc])
             else:
+                from drep_tpu.parallel.faulttol import retrying_call
+
                 with counters.stage("secondary_compare", pairs=m * (m - 1) // 2):
-                    results[pc] = _secondary_for_cluster(gs, bdb, indices, pc, kw)
+                    # a transient device failure on one big cluster must
+                    # not kill a run that already banked thousands of
+                    # per-cluster checkpoint shards — bounded retries,
+                    # same knobs as the streaming tile executor
+                    results[pc] = retrying_call(
+                        lambda indices=indices, pc=pc: _secondary_for_cluster(
+                            gs, bdb, indices, pc, kw
+                        ),
+                        site="secondary_batch",
+                        config=ft_cfg,
+                    )
                 ckpt.save(pc, *results[pc])
 
         # flush the small clusters in row-bounded batches
@@ -476,8 +516,14 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                 else sum(len(ix) * (len(ix) - 1) // 2 for _, ix in batch)
             )
             with counters.stage("secondary_compare", pairs=pairs_in_batch):
-                outs = batched_fn(
-                    gs, [ix for _, ix in batch], mesh_shape=kw["mesh_shape"]
+                from drep_tpu.parallel.faulttol import retrying_call
+
+                outs = retrying_call(
+                    lambda batch=batch: batched_fn(
+                        gs, [ix for _, ix in batch], mesh_shape=kw["mesh_shape"]
+                    ),
+                    site="secondary_batch",
+                    config=ft_cfg,
                 )
             with counters.stage("secondary_postprocess"):
                 for (pc, indices), (ani, cov) in zip(batch, outs, strict=True):

@@ -261,7 +261,19 @@ def ring_allpairs(
     counts_d = put_global(counts, NamedSharding(mesh, P(AXIS)))
 
     fn, _ = _ring_fn(kind, k, mesh, half)
-    outs = fn(ids_d, counts_d)
+    # bounded-retry dispatch (parallel/faulttol.py): the ring is one
+    # shard_map program, so the retry unit is the whole schedule — inputs
+    # are still device-resident, so a retry costs compute, not transfer.
+    # On a >1-process pod retrying_call runs the dispatch BARE: a
+    # per-process retry of a collective program would desync the pod
+    # (see its docstring); multi-host live failures abort loudly via the
+    # collective timeouts instead.
+    from drep_tpu.parallel.faulttol import retrying_call
+
+    outs = retrying_call(
+        lambda: jax.block_until_ready(fn(ids_d, counts_d)),
+        site="ring_dispatch",
+    )
     # copy to host (np.array copies): buffers are read-only and callers
     # fill diagonals; gather_global handles the >1-process reshard
     gathered = [gather_global(o) for o in outs]

@@ -1,0 +1,367 @@
+"""Fault-tolerant device dispatch: retries, watchdog, device quarantine.
+
+The compare engines' hot paths assume every dispatch returns: one wedged
+TPU call, one per-device XLA runtime error, or one hung multi-host
+collective kills hours of streamed tiles (PARITY.md documents exactly
+this operating reality — a wedge-prone tunneled backend with zero usable
+windows for ~10h). This module is the live-failure counterpart to the
+crash story (atomic shards + Cdb resume):
+
+- :class:`TileExecutor` — the retrying tile executor used by
+  parallel/streaming.py. Dispatch stays fully async (submit returns
+  immediately; device parallelism is untouched); the bounded wait runs
+  at finalize: with a watchdog timeout the ``block_until_ready`` happens
+  on a disposable worker thread so a wedged dispatch costs
+  ``dispatch_timeout_s``, not forever. Failures retry with exponential
+  backoff on the next round-robin device; a device that fails
+  ``quarantine_after`` consecutive times is quarantined out of the
+  round-robin (the run continues on the remaining devices); when no
+  device can produce the tile, the caller's CPU fallback recomputes it
+  host-side. Every event lands in utils/profiling counters (``retries``,
+  ``watchdog_trips``, ``quarantined_devices``, ``cpu_fallback_tiles``)
+  so a degraded run is honest about how it finished.
+- :func:`retrying_call` — the same bounded-retry/watchdog contract for
+  coarse-grained dispatches that manage their own devices (the secondary
+  engine calls in cluster/controller.py, the dense ring in
+  parallel/allpairs.py).
+- :func:`run_with_timeout` — a watchdog for multi-host collectives
+  (the streaming edge allgather, the checkpoint-dir barrier): a dead
+  peer produces an actionable error in minutes instead of an infinite
+  hang. The abandoned waiter thread is a daemon — XLA gives no way to
+  cancel an in-flight collective, so the process can still exit.
+
+Fault-injection points (utils/faults.py) fire INSIDE the watched
+regions, so injected hangs trip the same watchdogs real wedges do.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from drep_tpu.utils import faults
+from drep_tpu.utils.logger import get_logger
+
+# multi-host collective watchdog (seconds); 0 disables; the env var
+# overrides BOTH defaults when set. Two defaults because the legitimate
+# skew differs by an order of magnitude at the two wait points:
+# - barrier (stage START): every process arrives within seconds of its
+#   peers (ingest is replicated work), so a 15-minute overrun means a
+#   peer is gone — diagnosis in minutes beats an infinite hang by hours.
+# - allgather (stage END): a process that resumed all its shards waits
+#   for peers still COMPUTING theirs — healthy skew spans the whole
+#   stripe recompute (hours at the 100k scale, and quarantine-degraded
+#   peers run slower still), so the default must sit above any plausible
+#   single-stage wall, catching only truly dead pods.
+COLLECTIVE_TIMEOUT_ENV = "DREP_TPU_COLLECTIVE_TIMEOUT_S"
+DEFAULT_COLLECTIVE_TIMEOUT_S = 900.0
+DEFAULT_ALLGATHER_TIMEOUT_S = 6 * 3600.0
+
+
+def collective_timeout_s(default: float = DEFAULT_COLLECTIVE_TIMEOUT_S) -> float:
+    return float(os.environ.get(COLLECTIVE_TIMEOUT_ENV, default))
+
+
+class FaultTolError(RuntimeError):
+    """A dispatch failed beyond the retry/quarantine/fallback budget."""
+
+
+class WatchdogTimeout(FaultTolError):
+    """A single dispatch exceeded the per-dispatch watchdog."""
+
+
+class CollectiveTimeout(FaultTolError):
+    """A multi-host collective did not complete within the timeout —
+    almost always a dead/wedged peer process."""
+
+
+@dataclass(frozen=True)
+class FaultTolConfig:
+    """Knobs for the retrying executor (CLI: --fault_retries,
+    --dispatch_timeout)."""
+
+    max_retries: int = 2  # re-dispatch attempts after the first failure
+    dispatch_timeout_s: float = 0.0  # per-dispatch watchdog; 0 disables
+    backoff_s: float = 0.05  # first retry delay, doubled per attempt
+    quarantine_after: int = 3  # consecutive failures that bench a device
+
+
+# process-wide defaults, set once per run by the cluster controller from
+# the CLI flags; paths without explicit config (the dense ring) read this
+DEFAULT_CONFIG = FaultTolConfig()
+
+
+def configure_defaults(config: FaultTolConfig) -> None:
+    global DEFAULT_CONFIG
+    DEFAULT_CONFIG = config
+
+
+def _watchdog_run(fn: Callable[[], Any], timeout_s: float, what: str, site: str):
+    """THE watchdog primitive: run `fn` on a disposable daemon thread,
+    bounded by `timeout_s`; raise WatchdogTimeout (counted) on overrun,
+    relay the worker's exception otherwise. One disposable thread per
+    watched call on purpose — a tripped watchdog leaves its thread stuck
+    inside the runtime (XLA waits and collectives are not cancellable)
+    and the NEXT call must not queue behind it."""
+    box: dict[str, Any] = {}
+    done = threading.Event()
+
+    def work() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box["err"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=work, daemon=True, name=f"drep-watchdog-{site}").start()
+    if not done.wait(timeout_s):
+        from drep_tpu.utils.profiling import counters
+
+        counters.add_fault("watchdog_trips")
+        raise WatchdogTimeout(f"{what}: exceeded the {timeout_s:.1f}s watchdog")
+    if "err" in box:
+        raise box["err"]
+    return box["value"]
+
+
+def _wait_ready(value: Any, timeout_s: float, site: str, device: int | None) -> None:
+    """Block until `value`'s buffers are ready, bounded by `timeout_s`
+    when positive. The fault-injection fire runs inside the watched
+    region so injected hangs exercise the real watchdog path."""
+    import jax
+
+    def work() -> None:
+        faults.fire(site, device=device)
+        jax.block_until_ready(value)
+
+    if timeout_s <= 0:
+        work()
+        return
+    _watchdog_run(
+        work, timeout_s,
+        what=f"{site}: dispatch on device slot {device}", site=site,
+    )
+
+
+class TileExecutor:
+    """Retrying round-robin dispatcher over the local devices.
+
+    ``submit(compute)`` picks the next non-quarantined device slot and
+    calls ``compute(slot)`` — the caller's closure dispatches its tile on
+    that slot's device-resident data and returns the (async) result.
+    ``finalize(pending, cpu_fallback=...)`` waits (watchdog-bounded),
+    and on failure re-dispatches on the surviving devices with backoff;
+    when every avenue is exhausted it runs the CPU fallback or raises
+    :class:`FaultTolError`.
+
+    `slot` indexes the `devices` list given at construction — the caller
+    keeps per-slot device-resident operands and the executor only ever
+    routes between slots, so quarantining is a pure scheduling decision.
+    """
+
+    def __init__(
+        self,
+        devices: list,
+        config: FaultTolConfig | None = None,
+        fault_site: str = "streaming_tile",
+    ) -> None:
+        self.devices = list(devices)
+        self.config = config if config is not None else DEFAULT_CONFIG
+        self.fault_site = fault_site
+        self.active: list[int] = list(range(len(self.devices)))
+        self._failures = [0] * len(self.devices)
+        self._rr = 0
+
+    # -- scheduling -------------------------------------------------------
+    def next_slot(self, exclude: frozenset | set = frozenset()) -> int:
+        """Next round-robin slot among active devices, skipping `exclude`
+        (slots the current tile already failed on — retrying there would
+        burn another full watchdog wait on a known-bad device) unless
+        nothing else remains."""
+        if all(s in exclude for s in self.active):
+            exclude = frozenset()
+        for _ in range(len(self.active)):
+            slot = self.active[self._rr % len(self.active)]
+            self._rr += 1
+            if slot not in exclude:
+                return slot
+        raise AssertionError("unreachable: active is never empty")
+
+    def quarantined(self) -> list[int]:
+        return [i for i in range(len(self.devices)) if i not in self.active]
+
+    def _record_failure(self, slot: int, exc: BaseException) -> None:
+        from drep_tpu.utils.profiling import counters
+
+        self._failures[slot] += 1
+        get_logger().warning(
+            "%s: dispatch failed on device slot %d (%d consecutive): %s",
+            self.fault_site, slot, self._failures[slot], exc,
+        )
+        if (
+            self._failures[slot] >= self.config.quarantine_after
+            and slot in self.active
+            and len(self.active) > 1
+        ):
+            self.active.remove(slot)
+            counters.add_fault("quarantined_devices")
+            get_logger().warning(
+                "%s: quarantining device slot %d (%s) after %d consecutive "
+                "failures — continuing on %d device(s)",
+                self.fault_site, slot, self.devices[slot],
+                self._failures[slot], len(self.active),
+            )
+
+    # -- dispatch ---------------------------------------------------------
+    def submit(self, compute: Callable[[int], Any]) -> tuple:
+        """Async dispatch on the next active slot. Never waits; a raise
+        at dispatch time is captured and handled at finalize (the stripe
+        loop's pipelining must not stall on one bad tile)."""
+        slot = self.next_slot()
+        try:
+            return (compute, slot, compute(slot), None)
+        except Exception as e:  # noqa: BLE001 — retried at finalize
+            return (compute, slot, None, e)
+
+    def finalize(self, pending: tuple, cpu_fallback: Callable[[], Any] | None = None):
+        """Wait for a submitted tile; retry / quarantine / fall back."""
+        from drep_tpu.utils.profiling import counters
+
+        compute, slot, value, err = pending
+        if err is None:
+            try:
+                _wait_ready(value, self.config.dispatch_timeout_s, self.fault_site, slot)
+                self._failures[slot] = 0
+                return value
+            except Exception as e:  # noqa: BLE001
+                err = e
+        self._record_failure(slot, err)
+        failed = {slot}
+
+        for attempt in range(self.config.max_retries):
+            time.sleep(self.config.backoff_s * (2**attempt))
+            slot = self.next_slot(exclude=failed)
+            counters.add_fault("retries")
+            try:
+                value = compute(slot)
+                _wait_ready(value, self.config.dispatch_timeout_s, self.fault_site, slot)
+                self._failures[slot] = 0
+                return value
+            except Exception as e:  # noqa: BLE001
+                self._record_failure(slot, e)
+                failed.add(slot)
+                err = e
+
+        if cpu_fallback is not None:
+            counters.add_fault("cpu_fallback_tiles")
+            get_logger().warning(
+                "%s: device retries exhausted (%s) — recomputing this tile "
+                "on the host CPU path", self.fault_site, err,
+            )
+            return cpu_fallback()
+        raise FaultTolError(
+            f"{self.fault_site}: dispatch failed after {self.config.max_retries}"
+            f" retries with no CPU fallback (last error: {err!r})"
+        ) from err
+
+
+def retrying_call(
+    fn: Callable[[], Any],
+    site: str,
+    config: FaultTolConfig | None = None,
+):
+    """Bounded-retry wrapper for coarse dispatches that pick their own
+    devices (secondary engine calls, the dense ring). The watchdog (when
+    configured) bounds each attempt; retries re-run the whole call.
+
+    Multi-process pods run the wrapped call BARE: the call may be a
+    collective (mesh ring / sharded secondary), and a per-process retry
+    or watchdog trip is a LOCAL decision — one process re-entering a
+    collective program (or abandoning it) while its peers sit at a
+    different program point desyncs the pod into exactly the infinite
+    hang this layer exists to remove. Coordinated multi-host retry needs
+    a shared ownership/retry epoch (ROADMAP follow-up); until then the
+    multi-host live-failure guards are the collective timeouts
+    (run_with_timeout), which abort loudly instead of retrying.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        return fn()
+    from drep_tpu.utils.profiling import counters
+
+    cfg = config if config is not None else DEFAULT_CONFIG
+    last: BaseException | None = None
+    for attempt in range(cfg.max_retries + 1):
+        if attempt:
+            time.sleep(cfg.backoff_s * (2 ** (attempt - 1)))
+            counters.add_fault("retries")
+        try:
+            def attempt_fn() -> Any:
+                faults.fire(site)
+                return fn()
+
+            if cfg.dispatch_timeout_s > 0:
+                return _watchdog_run(
+                    attempt_fn, cfg.dispatch_timeout_s, what=site, site=site
+                )
+            return attempt_fn()
+        except Exception as e:  # noqa: BLE001
+            last = e
+            get_logger().warning(
+                "%s: attempt %d/%d failed: %s",
+                site, attempt + 1, cfg.max_retries + 1, e,
+            )
+    raise FaultTolError(
+        f"{site}: failed after {cfg.max_retries + 1} attempts (last: {last!r})"
+    ) from last
+
+
+def run_with_timeout(
+    fn: Callable[[], Any],
+    what: str,
+    site: str = "allgather",
+    timeout_s: float | None = None,
+    diagnose: Callable[[], str] | None = None,
+):
+    """Watchdog for multi-host collectives: run `fn` on a worker thread;
+    on overrun (or a collective-layer error) raise CollectiveTimeout with
+    an actionable message — `diagnose()` contributes peer-level detail
+    (e.g. which process never reached the barrier) when the caller has a
+    way to know."""
+    t = collective_timeout_s() if timeout_s is None else timeout_s
+
+    def work() -> Any:
+        faults.fire(site)
+        return fn()
+
+    if t <= 0:
+        return work()
+
+    def detail() -> str:
+        if diagnose is None:
+            return ""
+        try:
+            return " " + diagnose()
+        except Exception:  # noqa: BLE001 — diagnosis is best-effort
+            return ""
+
+    try:
+        return _watchdog_run(work, t, what=what, site=site)
+    except WatchdogTimeout:
+        raise CollectiveTimeout(
+            f"{what} did not complete within {t:.0f}s — a peer process has "
+            f"likely crashed or wedged.{detail()} Restart the pod; shard-level "
+            f"checkpoints will resume finished work. (Timeout is configurable "
+            f"via {COLLECTIVE_TIMEOUT_ENV}; 0 disables.)"
+        ) from None
+    except Exception as e:  # noqa: BLE001 — the collective layer's own error
+        raise CollectiveTimeout(
+            f"{what} failed at the collective layer ({e!r}) — a peer "
+            f"process has likely crashed.{detail()} Restart the pod; "
+            f"shard-level checkpoints will resume finished work."
+        ) from e

@@ -145,6 +145,20 @@ def connected_components(n: int, ii: np.ndarray, jj: np.ndarray) -> np.ndarray:
     return remap[raw]
 
 
+def stripe_owner(bi: int, n_blocks: int, pc: int) -> int:
+    """Which process owns row-block stripe `bi` (balanced dealing).
+
+    Stripe `bi` of the upper-triangle walk carries ``n_blocks - bi``
+    tiles, so the old ``bi % pc`` dealing loaded early processes ~2x
+    heavier than late ones and multi-host wall-clock tracked the heaviest
+    stripe chain. Pairing stripe `bi` with its mirror ``n_blocks-1-bi``
+    makes every pair carry a constant ``n_blocks + 1`` tiles (the odd
+    middle stripe is its own half-weight pair), so dealing PAIRS
+    round-robin balances total tiles per process to within one stripe.
+    """
+    return min(bi, n_blocks - 1 - bi) % pc
+
+
 def _real_pairs_in_tile(i0: int, j0: int, block: int, n: int) -> int:
     """Unique real (unpadded, i<j) pairs a tile covers."""
     ra = max(0, min(i0 + block, n) - i0)
@@ -237,6 +251,7 @@ def streaming_mash_edges(
     block: int = DEFAULT_BLOCK,
     checkpoint_dir: str | None = None,
     use_pallas: bool | None = None,
+    ft_config=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """All unordered pairs (i < j) with Mash distance <= cutoff.
 
@@ -246,8 +261,19 @@ def streaming_mash_edges(
     than one row-block stripe of the distance matrix on host; sketches are
     device-resident (one transfer per device) and tiles round-robin over
     every local device.
+
+    Tile dispatch is fault-tolerant (parallel/faulttol.py, `ft_config` —
+    defaults to the process config set by the CLI flags): failed or
+    watchdog-tripped tiles retry with backoff on the surviving devices, a
+    repeatedly-failing device is quarantined out of the round-robin, and
+    a tile no device can produce is recomputed on the host CPU via the
+    jnp path. The CPU fallback thresholds against the SAME distance array
+    it ships, so a fallback tile's edge set is self-consistent at the
+    cutoff boundary (no mixed device/host provenance inside one tile).
     """
     import jax
+
+    from drep_tpu.parallel.faulttol import TileExecutor
 
     logger = get_logger()
     n = packed.n
@@ -276,11 +302,15 @@ def streaming_mash_edges(
         r_iter = rows_per_iter(ids_pal.shape[1])
     # local devices only: on a multi-host pod jax.devices() includes remote
     # chips, and device_put to a non-addressable device raises. Row-block
-    # stripes are instead divided across processes (bi % pc == pid below)
-    # and the surviving edges all-gathered at the end.
+    # stripes are instead divided across processes (the mirror-paired
+    # stripe_owner(bi, n_blocks, pc) == pid dealing below) and the
+    # surviving edges all-gathered at the end.
     devices = jax.local_devices()
     pc = jax.process_count()
     pid = jax.process_index()
+    # the retrying dispatcher: round-robins over non-quarantined devices,
+    # watchdogs each wait, retries on survivors, CPU-recomputes last
+    ft = TileExecutor(devices, ft_config, fault_site="streaming_tile")
 
     resume = False
     if checkpoint_dir is not None:
@@ -312,12 +342,13 @@ def streaming_mash_edges(
     all_jj: list[np.ndarray] = []
     all_dd: list[np.ndarray] = []
     n_resumed = 0
+    n_owned = sum(1 for b in range(n_blocks) if stripe_owner(b, n_blocks, pc) == pid)
     pairs_computed = 0
     tiles_done = 0  # upper-triangle tiles actually dispatched this call
     tiles_full = 0  # full-grid tiles of the same stripes (resumed: 0/0)
 
     for bi in range(n_blocks):
-        if bi % pc != pid:
+        if stripe_owner(bi, n_blocks, pc) != pid:
             continue  # another process owns this row stripe
         shard = (
             os.path.join(checkpoint_dir, f"row_{bi:05d}.npz")
@@ -365,42 +396,47 @@ def streaming_mash_edges(
         budget = min(EDGE_BUDGET, block * block)
         compact = _compact_tile()
         tiles = []
-        for t, bj in enumerate(range(bi, n_blocks)):
+        for bj in range(bi, n_blocks):
             j0 = bj * block
-            di = t % len(devices)
-            if use_pallas:
-                from drep_tpu.ops.pallas_mash import _mash_shared_grid
-                from drep_tpu.ops.pallas_merge import _use_interpret
+            diag = j0 == i0
 
-                out = _mash_shared_grid(
-                    rev_on[di][i0 : i0 + block],
-                    counts_on[di][i0 : i0 + block],
-                    ids_on[di][j0 : j0 + block],
-                    counts_on[di][j0 : j0 + block],
+            def dispatch(slot, i0=i0, j0=j0, diag=diag):
+                # async dispatch on device slot `slot` (the executor's
+                # round-robin pick; retries may re-call with another slot)
+                if use_pallas:
+                    from drep_tpu.ops.pallas_mash import _mash_shared_grid
+                    from drep_tpu.ops.pallas_merge import _use_interpret
+
+                    out = _mash_shared_grid(
+                        rev_on[slot][i0 : i0 + block],
+                        counts_on[slot][i0 : i0 + block],
+                        ids_on[slot][j0 : j0 + block],
+                        counts_on[slot][j0 : j0 + block],
+                        s_orig=width,
+                        r_iter=r_iter,
+                        interpret=_use_interpret(),
+                    )
+                else:
+                    out, _j = mash_distance_tile(
+                        ids_on[slot][i0 : i0 + block],
+                        counts_on[slot][i0 : i0 + block],
+                        ids_on[slot][j0 : j0 + block],
+                        counts_on[slot][j0 : j0 + block],
+                        k=k,
+                    )
+                return compact(
+                    out,
+                    counts1d_on[slot][i0 : i0 + block],
+                    counts1d_on[slot][j0 : j0 + block],
+                    cutoff,
+                    budget=budget,
+                    from_counts=use_pallas,
                     s_orig=width,
-                    r_iter=r_iter,
-                    interpret=_use_interpret(),
-                )
-            else:
-                out, _j = mash_distance_tile(
-                    ids_on[di][i0 : i0 + block],
-                    counts_on[di][i0 : i0 + block],
-                    ids_on[di][j0 : j0 + block],
-                    counts_on[di][j0 : j0 + block],
                     k=k,
+                    diag=diag,
                 )
-            comp = compact(
-                out,
-                counts1d_on[di][i0 : i0 + block],
-                counts1d_on[di][j0 : j0 + block],
-                cutoff,
-                budget=budget,
-                from_counts=use_pallas,
-                s_orig=width,
-                k=k,
-                diag=j0 == i0,
-            )
-            tiles.append((j0, comp))
+
+            tiles.append((j0, diag, ft.submit(dispatch)))
             pairs_computed += _real_pairs_in_tile(i0, j0, block, n)
             tiles_done += 1
         tiles_full += n_blocks
@@ -408,7 +444,13 @@ def streaming_mash_edges(
         row_ii: list[np.ndarray] = []
         row_jj: list[np.ndarray] = []
         row_dd: list[np.ndarray] = []
-        for j0, (ki_d, kj_d, dd_d, cnt_d, d_full) in tiles:
+        for j0, diag, pending in tiles:
+            ki_d, kj_d, dd_d, cnt_d, d_full = ft.finalize(
+                pending,
+                cpu_fallback=lambda i0=i0, j0=j0, diag=diag: _cpu_fallback_tile(
+                    ids, counts, i0, j0, block, k, cutoff, diag
+                ),
+            )
             cnt = int(cnt_d)  # sync point for this tile (scalar)
             if cnt <= budget:
                 ki = np.asarray(ki_d)[:cnt]
@@ -450,7 +492,18 @@ def streaming_mash_edges(
         all_dd.append(dd)
 
     if n_resumed:
-        logger.info("streaming primary: resumed %d/%d row-block shards", n_resumed, n_blocks)
+        # report against the stripes THIS process owns: on multi-process
+        # runs the global n_blocks would understate resume progress ~pc-fold
+        logger.info(
+            "streaming primary: resumed %d/%d owned row-block shards (process %d/%d)",
+            n_resumed, n_owned, pid, pc,
+        )
+    if ft.quarantined():
+        logger.warning(
+            "streaming primary: finished with device slot(s) %s quarantined "
+            "(of %d local devices) — see fault_tolerance counters",
+            ft.quarantined(), len(devices),
+        )
     if tiles_full:
         from drep_tpu.utils.profiling import counters
 
@@ -461,6 +514,40 @@ def streaming_mash_edges(
     if pc > 1:
         ii, jj, dd, pairs_computed = _allgather_edges(ii, jj, dd, pairs_computed)
     return ii, jj, dd, pairs_computed
+
+
+def _cpu_fallback_tile(
+    ids: np.ndarray,
+    counts: np.ndarray,
+    i0: int,
+    j0: int,
+    block: int,
+    k: int,
+    cutoff: float,
+    diag: bool,
+) -> tuple:
+    """Recompute one tile on the host CPU via the jnp path — the last
+    resort when retries are exhausted on every surviving device. Returns
+    the same (ki, kj, dd, cnt, d_full) contract as the device compact.
+    Edge membership and shipped distances derive from ONE CPU-computed
+    array, so a fallback tile is self-consistent at the cutoff boundary
+    (no mixed device/host libm provenance inside a tile)."""
+    import jax
+
+    a_counts = counts[i0 : i0 + block]
+    b_counts = counts[j0 : j0 + block]
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        d, _j = mash_distance_tile(
+            ids[i0 : i0 + block], a_counts, ids[j0 : j0 + block], b_counts, k=k
+        )
+        d = np.asarray(d)
+    keep = d <= cutoff
+    # pad rows carry count 0 — same mask the device compact applies
+    keep &= (a_counts > 0)[:, None] & (b_counts > 0)[None, :]
+    if diag:
+        keep &= np.triu(np.ones_like(keep, dtype=bool), 1)  # i < j only
+    ki, kj = np.nonzero(keep)
+    return ki.astype(np.int32), kj.astype(np.int32), d[ki, kj], np.int32(len(ki)), d
 
 
 def _allgather_edges(
@@ -480,6 +567,28 @@ def _allgather_edges(
     """
     from jax.experimental import multihost_utils as mhu
 
+    from drep_tpu.parallel.faulttol import (
+        DEFAULT_ALLGATHER_TIMEOUT_S,
+        collective_timeout_s,
+        run_with_timeout,
+    )
+
+    def _gather(arr: np.ndarray, what: str) -> np.ndarray:
+        # watchdog'd collective: a peer that died must produce an
+        # actionable error, not leave every survivor wedged forever. The
+        # first-to-arrive process legitimately waits out its peers'
+        # remaining STRIPE COMPUTE here (asymmetric resume; quarantine
+        # slowdown), so the default timeout is the generous allgather one
+        # — only a truly dead pod trips it (faulttol.py has the analysis)
+        return np.array(
+            run_with_timeout(
+                lambda: mhu.process_allgather(arr),
+                what=f"streaming edge allgather ({what})",
+                site="allgather",
+                timeout_s=collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S),
+            )
+        )
+
     def _split64(v: int) -> list[int]:
         return [v & 0xFFFFFFFF, v >> 32]
 
@@ -487,7 +596,7 @@ def _allgather_edges(
         return int(lo) | (int(hi) << 32)
 
     header = np.array(_split64(len(ii)) + _split64(pairs_computed), np.uint32)
-    g_head = np.array(mhu.process_allgather(header))  # [pc, 4]
+    g_head = _gather(header, "header")  # [pc, 4]
     lengths = [_join64(r[0], r[1]) for r in g_head]
     total_pairs = sum(_join64(r[2], r[3]) for r in g_head)
     m = max(lengths)
@@ -505,8 +614,12 @@ def _allgather_edges(
         return out
 
     g_ii, g_jj, g_dd = (
-        np.array(mhu.process_allgather(_pad(a)))
-        for a in (ii.astype(np.uint32), jj.astype(np.uint32), dd)
+        _gather(_pad(a), what)
+        for a, what in (
+            (ii.astype(np.uint32), "ii"),
+            (jj.astype(np.uint32), "jj"),
+            (dd, "dist"),
+        )
     )
     return (
         np.concatenate([g_ii[p][:c] for p, c in enumerate(lengths)]).astype(np.int64),
@@ -524,6 +637,7 @@ def streaming_primary_clusters(
     checkpoint_dir: str | None = None,
     keep_dist: float = 0.0,
     cluster_alg: str = "average",
+    ft_config=None,
 ) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray], int]:
     """Streaming primary clustering: (labels 1..C, retained edges, pairs
     actually computed this call).
@@ -564,7 +678,8 @@ def streaming_primary_clusters(
             cutoff, keep,
         )
     ii, jj, dd, pairs_computed = streaming_mash_edges(
-        packed, k, keep, block=block, checkpoint_dir=checkpoint_dir
+        packed, k, keep, block=block, checkpoint_dir=checkpoint_dir,
+        ft_config=ft_config,
     )
     if cluster_alg == "single":
         in_cluster = dd <= cutoff

@@ -92,6 +92,17 @@ def atomic_savez(path: str, compressed: bool = True, **arrays) -> None:
 
     buf = io.BytesIO()
     (np.savez_compressed if compressed else np.savez)(buf, **arrays)
+    from drep_tpu.utils import faults
+
+    if faults.torn_write("shard_write"):
+        # chaos injection: publish a truncated file AT the target path,
+        # bypassing the atomic tmp+rename — the on-disk state a mid-write
+        # kill on a non-atomic filesystem would leave. Resume must detect
+        # it as corrupt and recompute (the path this injection tests).
+        data = bytes(buf.getbuffer())
+        with open(path, "wb") as f:
+            f.write(data[: max(1, len(data) // 2)])
+        return
     atomic_write_bytes(path, buf.getbuffer())
 
 
@@ -112,16 +123,88 @@ def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tup
     import jax
 
     if jax.process_count() > 1:
-        from jax.experimental import multihost_utils as mhu
-
         resume = False
         if jax.process_index() == 0:
             resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
-        mhu.sync_global_devices("drep_tpu_ckpt_open:" + os.path.abspath(ckpt_dir))
+        barrier_with_timeout("drep_tpu_ckpt_open:" + os.path.abspath(ckpt_dir), ckpt_dir)
         if jax.process_index() != 0:
             resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
         return resume
     return _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
+
+
+# per-tag barrier sequence numbers (replicated control flow: every process
+# reaches the same barriers in the same order, so sequence k on one host
+# pairs with sequence k on every other)
+_BARRIER_SEQ: dict[str, int] = {}
+
+
+def _barrier_note(note_dir: str, tag: str, pid: int) -> str:
+    taghash = hashlib.sha1(tag.encode()).hexdigest()[:10]
+    return os.path.join(note_dir, f".barrier-{taghash}.p{pid}")
+
+
+def barrier_with_timeout(tag: str, note_dir: str) -> None:
+    """``sync_global_devices`` that cannot hang forever: a dead peer
+    produces an actionable error NAMING the missing process(es) within
+    the collective timeout (parallel/faulttol.py, env-configurable)
+    instead of an infinite wait.
+
+    `note_dir` is the shared checkpoint directory the barrier guards —
+    before entering the collective, each process writes a sentinel note
+    carrying its barrier sequence number there, so the survivor of a
+    timeout can read WHICH peers never arrived (the collective layer
+    itself cannot say). Note names start with ``.barrier-`` and end in a
+    process suffix, so shard-store resume globs (``*.npz``) and
+    ``clear_suffixes`` scans never see them.
+    """
+    import jax
+    from jax.experimental import multihost_utils as mhu
+
+    from drep_tpu.parallel.faulttol import run_with_timeout
+
+    pid, pc = jax.process_index(), jax.process_count()
+    seq = _BARRIER_SEQ.get(tag, 0) + 1
+    _BARRIER_SEQ[tag] = seq
+    os.makedirs(note_dir, exist_ok=True)
+    atomic_write_bytes(_barrier_note(note_dir, tag, pid), str(seq).encode())
+
+    def diagnose() -> str:
+        missing = []
+        for p in range(pc):
+            try:
+                with open(_barrier_note(note_dir, tag, p)) as f:
+                    if int(f.read().strip()) >= seq:
+                        continue
+            except (OSError, ValueError):
+                pass
+            missing.append(p)
+        if missing:
+            return (
+                f"Process(es) {missing} of {pc} never reached checkpoint "
+                f"barrier {tag!r} (no sentinel note in {note_dir})."
+            )
+        return (
+            f"All {pc} processes left sentinel notes for barrier {tag!r} — "
+            f"a peer died INSIDE the collective or the interconnect wedged."
+        )
+
+    try:
+        run_with_timeout(
+            lambda: mhu.sync_global_devices(tag),
+            what=f"checkpoint barrier {tag!r} ({pc} processes)",
+            site="barrier",
+            diagnose=diagnose,
+        )
+    finally:
+        # remove the own note on success AND on timeout/abort: a reused
+        # checkpoint dir (the 'restart the pod' recovery this error
+        # recommends) must not inherit stale notes that make diagnose()
+        # claim a dead peer 'arrived'. Only a process killed between
+        # note-write and sync leaves one behind — and such a process IS
+        # the missing peer next time, so naming degrades, never inverts.
+        with contextlib.suppress(OSError):
+            os.remove(_barrier_note(note_dir, tag, pid))
 
 
 def checkpoint_meta_matches(ckpt_dir: str, meta: dict[str, Any]) -> bool:

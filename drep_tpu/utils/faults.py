@@ -1,0 +1,201 @@
+"""Deterministic, env/config-driven fault injection for device hot paths.
+
+The pipeline's crash story (atomic shard checkpoints, Cdb resume) is
+testable on CPU because kills are external; its LIVE-failure story — a
+wedged dispatch, an XLA runtime error on one chip, a hung collective —
+is not, unless the failures themselves can be manufactured on CPU in CI.
+This registry is that manufacturing layer: named injection points are
+threaded through every device-dispatch hot path (streaming tile waits,
+dense ring dispatch, secondary batched calls, shard writes, the edge
+allgather, the checkpoint barrier), and a spec string decides which of
+them misbehave, how, and how often — deterministically, so a failing
+chaos run replays.
+
+Spec syntax (``DREP_TPU_FAULTS`` env var, or :func:`configure`)::
+
+    site:mode[:prob][:key=value ...]  [, site:mode ...]
+
+    DREP_TPU_FAULTS="streaming_tile:raise:0.05:seed=7,shard_write:torn,allgather:hang"
+
+- ``site``   — injection-point name (see SITES).
+- ``mode``   — ``raise`` (InjectedFault), ``hang`` (sleep ``secs``,
+  default 3600 — trips watchdogs/collective timeouts), ``sleep``
+  (sleep ``secs`` then continue — paces a run so a chaos test can kill
+  it mid-flight), ``torn`` (write sites only: publish a truncated file
+  in place of the atomic write).
+- ``prob``   — per-call fire probability (default 1.0), drawn from a
+  per-rule ``random.Random(seed)`` stream, so runs are reproducible.
+- ``key=value`` — ``seed=N`` (default 0), ``secs=F`` (sleep duration),
+  ``device=N`` (fire only when the caller reports that device slot),
+  ``max=N`` (stop after N fires — e.g. tear exactly two shards).
+
+Zero overhead when unset: the spec parses once (lazily, from the env);
+every :func:`fire` call thereafter is a no-op behind one falsy check.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass, field
+
+ENV = "DREP_TPU_FAULTS"
+
+# the named injection points currently threaded through the pipeline —
+# unknown sites in a spec raise at parse time so a typo'd chaos run
+# cannot silently inject nothing and "pass"
+SITES = (
+    "streaming_tile",  # per-tile watchdog'd wait, parallel/streaming.py
+    "ring_dispatch",  # dense all-pairs ring shard_map call, parallel/allpairs.py
+    "secondary_batch",  # secondary engine calls, cluster/controller.py
+    "shard_write",  # atomic shard publish, utils/ckptmeta.py (torn)
+    "allgather",  # multi-host edge allgather, parallel/streaming.py
+    "barrier",  # checkpoint-dir open barrier, utils/ckptmeta.py
+)
+
+MODES = ("raise", "hang", "sleep", "torn")
+
+
+class InjectedFault(RuntimeError):
+    """An artificial failure fired by the registry — retried/quarantined
+    exactly like a real device error (nothing downstream knows it is
+    synthetic except the counters that label it injected)."""
+
+
+class FaultSpecError(ValueError):
+    """Malformed DREP_TPU_FAULTS spec (bad site/mode/field)."""
+
+
+@dataclass
+class _Rule:
+    site: str
+    mode: str
+    prob: float = 1.0
+    seed: int = 0
+    secs: float | None = None
+    device: int | None = None
+    max_fires: int | None = None
+    fired: int = 0
+    rng: random.Random = field(init=False)
+
+    def __post_init__(self) -> None:
+        self.rng = random.Random(self.seed)
+
+    def should_fire(self, device: int | None) -> bool:
+        if self.max_fires is not None and self.fired >= self.max_fires:
+            return False
+        if self.device is not None and device != self.device:
+            return False
+        # draw unconditionally so the stream position depends only on the
+        # number of matching calls, not on earlier rules' outcomes
+        return self.rng.random() < self.prob
+
+
+def _parse(spec: str) -> dict[str, list[_Rule]]:
+    rules: dict[str, list[_Rule]] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        fields = entry.split(":")
+        if len(fields) < 2:
+            raise FaultSpecError(f"fault entry needs site:mode, got {entry!r}")
+        site, mode = fields[0], fields[1]
+        if site not in SITES:
+            raise FaultSpecError(f"unknown fault site {site!r} (known: {', '.join(SITES)})")
+        if mode not in MODES:
+            raise FaultSpecError(f"unknown fault mode {mode!r} (known: {', '.join(MODES)})")
+        rule = _Rule(site=site, mode=mode)
+        for f in fields[2:]:
+            if "=" in f:
+                key, _, val = f.partition("=")
+                if key == "seed":
+                    rule.seed = int(val)
+                elif key == "secs":
+                    rule.secs = float(val)
+                elif key == "device":
+                    rule.device = int(val)
+                elif key == "max":
+                    rule.max_fires = int(val)
+                else:
+                    raise FaultSpecError(f"unknown fault field {key!r} in {entry!r}")
+            else:
+                rule.prob = float(f)
+        rule.__post_init__()  # re-seed after the seed= field landed
+        rules.setdefault(site, []).append(rule)
+    return rules
+
+
+# None = not parsed yet (parse lazily from the env on first use); {} =
+# parsed, nothing injected — the common case, one falsy check per call
+_RULES: dict[str, list[_Rule]] | None = None
+
+
+def configure(spec: str | None) -> None:
+    """Install a spec programmatically (tests). ``None``/"" disables."""
+    global _RULES
+    _RULES = _parse(spec) if spec else {}
+
+
+def reset() -> None:
+    """Forget any parsed spec; the env var is re-read on next use."""
+    global _RULES
+    _RULES = None
+
+
+def _rules() -> dict[str, list[_Rule]]:
+    global _RULES
+    if _RULES is None:
+        _RULES = _parse(os.environ.get(ENV, ""))
+    return _RULES
+
+
+def active() -> bool:
+    return bool(_rules())
+
+
+def _record(rule: _Rule) -> None:
+    rule.fired += 1
+    from drep_tpu.utils.profiling import counters
+
+    counters.add_fault(f"injected_{rule.site}_{rule.mode}")
+
+
+def fire(site: str, device: int | None = None) -> None:
+    """Run any matching rules for `site`: raise, hang, or sleep.
+
+    Called on the execution path being protected — for watchdog'd sites
+    the caller must invoke this INSIDE the watched region, so a ``hang``
+    rule trips the watchdog instead of wedging the main thread.
+    """
+    rules = _RULES
+    if rules is None:
+        rules = _rules()
+    if not rules:
+        return
+    for rule in rules.get(site, ()):
+        if not rule.should_fire(device):
+            continue
+        _record(rule)
+        if rule.mode == "raise":
+            raise InjectedFault(f"injected fault at {site} (device={device})")
+        if rule.mode == "hang":
+            time.sleep(3600.0 if rule.secs is None else rule.secs)
+            raise InjectedFault(f"injected hang at {site} woke up (device={device})")
+        if rule.mode == "sleep":
+            time.sleep(0.05 if rule.secs is None else rule.secs)
+        # 'torn' rules are polled via torn_write(), never fired here
+
+
+def torn_write(site: str = "shard_write") -> bool:
+    """Should the caller tear this write? (write sites poll this instead
+    of fire(): tearing is an action the WRITER performs, not an
+    exception)."""
+    rules = _RULES
+    if rules is None:
+        rules = _rules()
+    if not rules:
+        return False
+    for rule in rules.get(site, ()):
+        if rule.mode == "torn" and rule.should_fire(None):
+            _record(rule)
+            return True
+    return False

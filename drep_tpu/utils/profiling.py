@@ -41,6 +41,13 @@ class Counters:
     stage) plus independent instances for tests."""
 
     stages: dict[str, _Stage] = field(default_factory=dict)
+    # fault-tolerance accounting (parallel/faulttol.py): retries,
+    # watchdog_trips, quarantined_devices, cpu_fallback_tiles, plus
+    # injected_<site>_<mode> counts from utils/faults.py. A degraded run
+    # must be honest about HOW it finished — a completed run that burned
+    # 40 retries or benched a chip is not the same measurement as a clean
+    # one, and bench records must be able to tell them apart.
+    faults: dict[str, int] = field(default_factory=dict)
 
     @contextlib.contextmanager
     def stage(self, name: str, pairs: int = 0) -> Iterator[None]:
@@ -69,6 +76,11 @@ class Counters:
         st = self.stages.setdefault(name, _Stage())
         st.tiles_computed += int(computed)
         st.tiles_total += int(total)
+
+    def add_fault(self, kind: str, n: int = 1) -> None:
+        """Count one fault-tolerance event (retry, watchdog trip, device
+        quarantine, CPU-fallback tile, or an injected fault firing)."""
+        self.faults[kind] = self.faults.get(kind, 0) + int(n)
 
     def report(self) -> dict[str, Any]:
         import jax
@@ -99,6 +111,8 @@ class Counters:
             "seconds": round(total_seconds, 4),
             "pairs_per_sec_per_chip": round(total_rate / n_chips, 1),
         }
+        if self.faults:
+            out["fault_tolerance"] = dict(sorted(self.faults.items()))
         return out
 
     def write(self, log_dir: str) -> str:
@@ -109,6 +123,7 @@ class Counters:
 
     def reset(self) -> None:
         self.stages.clear()
+        self.faults.clear()
 
 
 counters = Counters()  # the process-global instance used by the pipeline

@@ -1,0 +1,60 @@
+"""Kill-target subprocess for tests/test_chaos.py — NOT collected by pytest.
+
+Runs a checkpointed streaming edge pass over deterministic planted
+sketches (the SAME recipe the pytest process uses for its uninterrupted
+oracle), paced by a ``streaming_tile:sleep`` fault injection from the
+parent's env so the parent can SIGKILL it mid-run with shards on disk.
+On completion it writes the edges + single-linkage labels to an npz the
+parent compares bit-for-bit.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+N, S, BLOCK, K, CUTOFF = 48, 64, 8, 21, 0.2
+
+
+def planted_packed():
+    """Deterministic group-structured sketches — identical in every
+    process (seeded), so oracle and kill/resume runs see the same data."""
+    from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+
+    rng = np.random.default_rng(11)
+    ids = np.full((N, S), PAD_ID, dtype=np.int32)
+    counts = np.zeros(N, dtype=np.int32)
+    pools = [
+        np.sort(rng.choice(2**20, size=S * 2, replace=False).astype(np.int32))
+        for _ in range(4)
+    ]
+    for i in range(N):
+        ids[i] = np.sort(rng.choice(pools[i % 4], size=S, replace=False))
+        counts[i] = S
+    return PackedSketches(ids=ids, counts=counts, names=[f"g{i}" for i in range(N)])
+
+
+def run(ckpt_dir: str):
+    """(ii, jj, dd, pairs_computed, labels) for the planted set."""
+    from drep_tpu.parallel.streaming import connected_components, streaming_mash_edges
+
+    packed = planted_packed()
+    ii, jj, dd, pairs = streaming_mash_edges(
+        packed, k=K, cutoff=CUTOFF, block=BLOCK, checkpoint_dir=ckpt_dir
+    )
+    labels = connected_components(N, ii, jj)
+    return ii, jj, dd, pairs, labels
+
+
+def main() -> None:
+    ckpt_dir, out_path = sys.argv[1], sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    ii, jj, dd, pairs, labels = run(ckpt_dir)
+    np.savez(out_path, ii=ii, jj=jj, dd=dd, pairs=pairs, labels=labels)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ def main() -> None:
     nproc = int(sys.argv[2])
     coord = sys.argv[3]
     outdir = sys.argv[4]
+    mode = sys.argv[5] if len(sys.argv) > 5 else "full"
 
     os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -45,6 +46,10 @@ def main() -> None:
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == 2 * nproc, jax.devices()
     assert len(jax.local_devices()) == 2
+
+    if mode == "barrier_timeout":
+        _barrier_timeout_case(pid, nproc, outdir)
+        return
 
     from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
     from drep_tpu.parallel.allpairs import sharded_mash_allpairs
@@ -203,6 +208,36 @@ def truth_partition() -> set[frozenset]:
     return set(out)
 
 
+def _barrier_timeout_case(pid: int, nproc: int, outdir: str) -> None:
+    """Dead-peer barrier diagnosis (ISSUE 2 multi-host hardening): every
+    process except 0 exits BEFORE reaching open_checkpoint_dir's barrier;
+    process 0 must raise the actionable CollectiveTimeout NAMING the
+    missing process(es) within the (test-shortened) collective timeout,
+    instead of hanging in sync_global_devices forever."""
+    if pid != 0:
+        # die before the barrier — but after distributed init, so the
+        # survivor's collective layer genuinely waits on a vanished peer
+        os._exit(0)
+
+    from drep_tpu.parallel.faulttol import CollectiveTimeout
+    from drep_tpu.utils.ckptmeta import open_checkpoint_dir
+
+    ckpt = os.path.join(outdir, "barrier_ckpt")
+    try:
+        open_checkpoint_dir(ckpt, {"probe": 1}, clear_suffixes=(".npz",))
+    except CollectiveTimeout as e:
+        msg = str(e)
+        missing = [p for p in range(1, nproc)]
+        assert f"{missing}" in msg, f"error does not name missing process(es): {msg}"
+        with open(os.path.join(outdir, "ok_0"), "w") as f:
+            f.write(msg)
+        # the abandoned watchdog thread is still parked inside the dead
+        # collective; normal interpreter teardown can wedge on the
+        # distributed client — exit hard, the ok-file is the verdict
+        os._exit(0)
+    raise AssertionError("open_checkpoint_dir returned despite a dead peer")
+
+
 INGEST_N = 12
 INGEST_MB = 1
 
@@ -284,8 +319,12 @@ def _combo_shared_workdir(pid: int, nproc: int, outdir: str) -> None:
     rewriting any of them."""
     from jax.experimental import multihost_utils as mhu
 
+    from drep_tpu.parallel.streaming import stripe_owner
+
     n_blocks = -(-COMBO_N // COMBO_BLOCK)
-    my_stripes = [bi for bi in range(n_blocks) if bi % nproc == pid]
+    my_stripes = [
+        bi for bi in range(n_blocks) if stripe_owner(bi, n_blocks, nproc) == pid
+    ]
     assert len(my_stripes) >= 2, (
         f"pid {pid}/{nproc}: only {len(my_stripes)} stripes — the test is "
         "not exercising interleaved multi-stripe ownership"

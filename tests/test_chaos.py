@@ -1,0 +1,328 @@
+"""Chaos suite: live-failure behavior of the fault-tolerance layer.
+
+Three failure families, all manufactured on CPU (ISSUE 2):
+
+- external kills — SIGKILL a streaming subprocess mid-run; the resumed
+  run must be BIT-identical to an uninterrupted one (the crash story).
+- injected device failures — per-tile raises/hangs via DREP_TPU_FAULTS;
+  runs must complete with honest retry/watchdog/quarantine counters and
+  unchanged results (the live story).
+- torn durable state — a shard published half-written; resume must
+  detect, recompute, and heal it.
+
+Everything here is seconds-scale and tier-1 (marker `chaos`); the
+multi-host dead-peer case lives in test_multihost.py (same marker).
+"""
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+import _chaos_worker as cw
+from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+from drep_tpu.parallel.faulttol import FaultTolConfig, FaultTolError
+from drep_tpu.parallel.streaming import streaming_mash_edges, stripe_owner
+from drep_tpu.utils import faults
+from drep_tpu.utils.logger import get_logger
+from drep_tpu.utils.profiling import counters
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_chaos_worker.py")
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with injection disabled and counters
+    clean — a leaked spec would poison the rest of the suite."""
+    faults.configure(None)
+    counters.reset()
+    yield
+    faults.configure(None)
+    counters.reset()
+
+
+@contextmanager
+def _capture_log(level=logging.WARNING):
+    """Capture drep_tpu log records regardless of propagate (setup_logger
+    disables propagation, so caplog can miss records depending on test
+    order within the session)."""
+    records: list[logging.LogRecord] = []
+
+    class H(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    h = H(level=level)
+    logger = get_logger()
+    old_level = logger.level
+    logger.setLevel(min(level, old_level) if old_level else level)
+    logger.addHandler(h)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(h)
+        logger.setLevel(old_level)
+
+
+def _packed(n=120, s=64, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = np.full((n, s), PAD_ID, dtype=np.int32)
+    cts = np.zeros(n, dtype=np.int32)
+    pools = [
+        np.sort(rng.choice(2**20, size=s * 2, replace=False).astype(np.int32))
+        for _ in range(5)
+    ]
+    for i in range(n):
+        ids[i] = np.sort(rng.choice(pools[i % 5], size=s, replace=False))
+        cts[i] = s
+    return PackedSketches(ids=ids, counts=cts, names=[f"g{i}" for i in range(n)])
+
+
+def _assert_edges_equal(got, want):
+    """Bit-for-bit: indices AND float payload (the fault layer must not
+    shift results by a single ulp when every tile ultimately computes)."""
+    for g, w in zip(got[:3], want[:3]):
+        assert np.asarray(g).tobytes() == np.asarray(w).tobytes()
+
+
+# --- external kill: SIGKILL mid-run, resume bit-identical ----------------
+
+
+def test_sigkill_mid_streaming_run_resumes_bit_identical(tmp_path):
+    n_blocks = -(-cw.N // cw.BLOCK)
+    ckpt = str(tmp_path / "ckpt")
+
+    # uninterrupted oracle (separate checkpoint dir, same planted data)
+    oracle = cw.run(str(tmp_path / "oracle_ckpt"))
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # pace every tile so the parent can reliably kill between shard
+    # writes; determinism of the RESULT is untouched (sleep-only rule)
+    env["DREP_TPU_FAULTS"] = "streaming_tile:sleep:1.0:secs=0.25"
+    out_npz = str(tmp_path / "killed.npz")
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, ckpt, out_npz],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            shards = [f for f in os.listdir(ckpt)] if os.path.isdir(ckpt) else []
+            if sum(f.startswith("row_") and f.endswith(".npz") for f in shards) >= 2:
+                break
+            if proc.poll() is not None:
+                out = proc.communicate()[0].decode(errors="replace")
+                pytest.fail(f"worker finished before the kill (pacing broken?):\n{out}")
+            time.sleep(0.02)
+        else:
+            proc.kill()
+            out = proc.communicate()[0].decode(errors="replace")
+            pytest.fail(f"no shards appeared within the deadline:\n{out}")
+        proc.send_signal(signal.SIGKILL)
+        proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+    assert proc.returncode == -signal.SIGKILL
+    assert not os.path.exists(out_npz), "worker published results despite the kill"
+    done = sorted(
+        f for f in os.listdir(ckpt) if f.startswith("row_") and f.endswith(".npz")
+    )
+    assert 1 <= len(done) < n_blocks, f"kill was not mid-run: {done}"
+
+    # resume in-process with injection off: must complete the missing
+    # stripes and agree with the oracle bit-for-bit, computing only the
+    # unfinished work
+    ii, jj, dd, pairs, labels = cw.run(ckpt)
+    _assert_edges_equal((ii, jj, dd), oracle[:3])
+    assert np.array_equal(labels, oracle[4])
+    assert 0 < pairs < oracle[3], (pairs, oracle[3])
+
+
+# --- injected per-tile failures: retries, quarantine, watchdog ----------
+
+
+def test_injected_tile_failures_retry_to_completion():
+    packed = _packed()
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    counters.reset()
+    # the acceptance shape: 5% per-tile failure, deterministic stream.
+    # 120 genomes / block 8 -> 15 stripes, 120 upper-triangle tiles, so
+    # seed 7 fires several times (asserted via the honest counters)
+    faults.configure("streaming_tile:raise:0.05:seed=7")
+    got = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    _assert_edges_equal(got, want)
+    assert got[3] == want[3]
+    assert counters.faults.get("retries", 0) > 0
+    assert counters.faults.get("injected_streaming_tile_raise", 0) > 0
+    rep = counters.report()
+    assert rep["fault_tolerance"]["retries"] > 0  # surfaces in the report
+
+
+def test_single_bad_device_is_quarantined_and_run_completes():
+    import jax
+
+    if len(jax.local_devices()) < 2:
+        pytest.skip("quarantine needs >= 2 devices (conftest forces 8)")
+    packed = _packed()
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    counters.reset()
+    # one fake device fails EVERY dispatch; the run must finish on the
+    # remaining devices with the quarantine recorded in counters + log
+    faults.configure("streaming_tile:raise:1.0:device=1")
+    with _capture_log() as records:
+        got = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    _assert_edges_equal(got, want)
+    assert counters.faults.get("quarantined_devices", 0) >= 1
+    assert counters.faults.get("retries", 0) > 0
+    assert any("quarantining device slot 1" in r.getMessage() for r in records)
+    assert any("finished with device slot(s) [1] quarantined" in r.getMessage() for r in records)
+
+
+def test_watchdog_trips_on_injected_hang():
+    packed = _packed(n=60)
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    counters.reset()
+    faults.configure("streaming_tile:hang:1.0:device=2:secs=30")
+    got = streaming_mash_edges(
+        packed, k=21, cutoff=0.2, block=8,
+        ft_config=FaultTolConfig(dispatch_timeout_s=0.5),
+    )
+    _assert_edges_equal(got, want)
+    assert counters.faults.get("watchdog_trips", 0) > 0
+
+
+def test_cpu_fallback_when_every_retry_fails():
+    """All devices failing every dispatch: retries exhaust, quarantine
+    can't help (it always keeps one device), and each tile must be
+    recomputed by the host CPU fallback — completing the run with
+    identical edges and honest cpu_fallback_tiles accounting."""
+    packed = _packed(n=32)
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    counters.reset()
+    faults.configure("streaming_tile:raise:1.0")
+    got = streaming_mash_edges(
+        packed, k=21, cutoff=0.2, block=8,
+        ft_config=FaultTolConfig(max_retries=1, backoff_s=0.0),
+    )
+    _assert_edges_equal(got, want)
+    assert counters.faults.get("cpu_fallback_tiles", 0) == 4 * 5 // 2  # all tiles
+
+
+# --- torn durable state: detect, recompute, heal ------------------------
+
+
+def test_torn_shard_write_is_recomputed_on_resume(tmp_path):
+    packed = _packed(n=48)
+    ckpt = str(tmp_path / "ckpt")
+    faults.configure("shard_write:torn:1.0:max=2")
+    r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    faults.configure(None)
+    # run 1's RESULTS are unaffected (tearing happens at publish time);
+    # the first two shards on disk are truncated
+    assert counters.faults.get("injected_shard_write_torn") == 2
+
+    with _capture_log() as records:
+        r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    _assert_edges_equal(r2, r1)
+    corrupt_warnings = [r for r in records if "corrupt shard" in r.getMessage()]
+    assert len(corrupt_warnings) == 2, [r.getMessage() for r in records]
+    # only the two torn stripes recomputed — and their shards are healed:
+    assert 0 < r2[3] < r1[3]
+    r3 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    assert r3[3] == 0  # fully resumed now
+    _assert_edges_equal(r3, r1)
+
+
+# --- registry semantics --------------------------------------------------
+
+
+def test_fault_spec_parsing_and_env_activation(monkeypatch):
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("not_a_site:raise")
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("streaming_tile:not_a_mode")
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("streaming_tile:raise:0.5:bogus=1")
+    # env route: reset() re-reads the env on next use
+    monkeypatch.setenv(faults.ENV, "streaming_tile:raise:1.0")
+    faults.reset()
+    assert faults.active()
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("streaming_tile", device=0)
+    monkeypatch.delenv(faults.ENV)
+    faults.reset()
+    assert not faults.active()
+    faults.fire("streaming_tile", device=0)  # no-op when unset
+
+
+def test_fault_rule_filters():
+    faults.configure("streaming_tile:raise:1.0:device=3:max=2")
+    faults.fire("streaming_tile", device=1)  # other device: no-op
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("streaming_tile", device=3)
+    faults.fire("streaming_tile", device=3)  # max=2 exhausted: no-op
+    assert counters.faults["injected_streaming_tile_raise"] == 2
+
+
+def test_retrying_call_exhaustion_raises_faulttol_error():
+    from drep_tpu.parallel.faulttol import retrying_call
+
+    faults.configure("secondary_batch:raise:1.0")
+    with pytest.raises(FaultTolError, match="secondary_batch"):
+        retrying_call(
+            lambda: 1, site="secondary_batch",
+            config=FaultTolConfig(max_retries=1, backoff_s=0.0),
+        )
+    faults.configure("secondary_batch:raise:1.0:max=1")
+    assert retrying_call(
+        lambda: 42, site="secondary_batch",
+        config=FaultTolConfig(max_retries=1, backoff_s=0.0),
+    ) == 42  # first attempt injected, retry succeeds
+    assert counters.faults.get("retries", 0) >= 1
+
+
+# --- stripe->process balance (ROADMAP open item) -------------------------
+
+
+def test_stripe_owner_balances_tile_load():
+    """Pairing stripe bi with n_blocks-1-bi must bound the per-process
+    tile-load spread by one pair's weight (n_blocks+1) — the old bi%pc
+    dealing had a ~2x spread at large n_blocks."""
+    for n_blocks in (9, 16, 40, 97):
+        for pc in (2, 3, 4, 8):
+            loads = [0] * pc
+            for bi in range(n_blocks):
+                loads[stripe_owner(bi, n_blocks, pc)] += n_blocks - bi
+            assert all(0 <= o < pc for o in map(lambda b: stripe_owner(b, n_blocks, pc), range(n_blocks)))
+            assert max(loads) - min(loads) <= n_blocks + 1, (
+                n_blocks, pc, loads,
+            )
+            # every stripe owned exactly once (partition, no gaps)
+            total = sum(loads)
+            assert total == n_blocks * (n_blocks + 1) // 2
+
+
+def test_resume_log_reports_owned_stripes(tmp_path):
+    packed = _packed(n=48)
+    ckpt = str(tmp_path / "ckpt")
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    with _capture_log(level=logging.INFO) as records:
+        streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    msgs = [r.getMessage() for r in records]
+    assert any("resumed 6/6 owned row-block shards (process 0/1)" in m for m in msgs), msgs

@@ -3,7 +3,8 @@ evaluate and pick_winners must stay vectorized — a regression to per-row
 Python loops turns minutes-at-scale and fails these wall-clock bounds.
 Synthetic sizes are ~1e6 Ndb rows / 2e5 genomes; bounds are generous (5 s)
 so slow CI machines do not flake, while a Python-loop regression (>60 s)
-fails decisively.
+fails decisively. The streaming guard pins the fault-tolerance layer's
+zero-overhead-when-unset contract (ISSUE 2).
 """
 
 import time
@@ -65,3 +66,32 @@ def test_pick_winners_vectorized_at_2e5_genomes(rng):
     grp = sdb[sdb["secondary_cluster"] == "0_1"]
     best = grp.sort_values(["score", "genome"], ascending=[False, True]).iloc[0]
     assert wdb.set_index("cluster").loc["0_1", "genome"] == best["genome"]
+
+
+def test_streaming_fault_layer_zero_overhead_when_unset(rng):
+    """With DREP_TPU_FAULTS unset and the watchdog disabled (the
+    defaults), the retrying executor must add no meaningful per-tile cost:
+    no watchdog threads, no fault events, and a many-tile streaming pass
+    inside a wall bound that a per-tile synchronization or thread-spawn
+    regression (~ms x 1e3 tiles at scale) would blow decisively."""
+    from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.profiling import counters
+
+    n, s = 256, 64
+    ids = np.full((n, s), PAD_ID, np.int32)
+    cts = np.full(n, s, np.int32)
+    pools = [np.sort(rng.choice(2**20, size=s * 2, replace=False).astype(np.int32)) for _ in range(5)]
+    for i in range(n):
+        ids[i] = np.sort(rng.choice(pools[i % 5], size=s, replace=False))
+    packed = PackedSketches(ids=ids, counts=cts, names=[f"g{i}" for i in range(n)])
+
+    faults.configure(None)
+    before = dict(counters.faults)
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)  # warm the jits
+    t0 = time.perf_counter()
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)  # 32 blocks, 528 tiles
+    dt = time.perf_counter() - t0
+    assert counters.faults == before, "fault events recorded with injection unset"
+    assert dt < 20.0, f"528-tile warm streaming pass took {dt:.1f}s — executor overhead?"

@@ -42,6 +42,17 @@ def _link_ok(link) -> bool:
     )
 
 
+def _has_error(rec) -> bool:
+    """Any `{"error": ...}` ANYWHERE in the record — bench stages record
+    sub-failures nested inside otherwise-successful dicts (e.g. a failed
+    `rows_per_iter_N` variant inside a completed primary record, or a
+    stage error merged into early-published partials), and a record
+    carrying one wants a healthy re-measure, not trust."""
+    if not isinstance(rec, dict):
+        return False
+    return "error" in rec or any(_has_error(v) for v in rec.values())
+
+
 def missing(merged: dict) -> list[str]:
     stages = merged.get("stages", {})
     prov = merged.get("stage_provenance", {})
@@ -50,7 +61,11 @@ def missing(merged: dict) -> list[str]:
         rec = stages.get(key)
         ok = (
             isinstance(rec, dict)
-            and "error" not in rec
+            and not _has_error(rec)
+            # bench stamps DREP_TPU_FAULTS provenance into every stage it
+            # emits: a chaos-mode run exercised the fault layer, it did
+            # NOT measure clean hardware throughput — never count it done
+            and not rec.get("faults_injected")
             # a wedge between the fresh e2e leg and its resume leg
             # publishes the fresh number with this marker — keep the
             # stage on the re-measure list until the resume evidence lands
