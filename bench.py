@@ -897,6 +897,14 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None, workdir: str | None = 
         }
         if ft_events:
             out["fault_tolerance"] = ft_events
+        # degraded-pod honesty (same contract as fault-stamped records): a
+        # run that lost a pod member and completed via an ownership-epoch
+        # bump produced CORRECT results on FEWER chips — its wall-clock is
+        # not a clean throughput measurement, and tools/missing_stages.py
+        # refuses these stamps as measured perf
+        if ft_events.get("pod_epoch_bumps") or ft_events.get("dead_processes"):
+            out["pod_epochs"] = 1 + int(ft_events.get("pod_epoch_bumps", 0))
+            out["dead_processes"] = int(ft_events.get("dead_processes", 0))
         if publish is not None:
             publish(out)
 

@@ -95,8 +95,19 @@ def build_parser() -> argparse.ArgumentParser:
         tpu.add_argument("--dispatch_timeout", type=float, default=0.0,
                          help="per-dispatch watchdog in seconds: a device call "
                               "exceeding it counts as failed and is retried on "
-                              "another device (0 = disabled; wedge-prone "
-                              "backends want ~60-300s)")
+                              "another device. 0 (default) auto-derives the "
+                              "deadline from the run's own tile latencies "
+                              "(20x rolling median, floor 30s, warmup excluded; "
+                              "a generous 300s bound covers the compile warmup; "
+                              "reported as derived_dispatch_timeout_s in "
+                              "perf_counters.json); explicit positive values "
+                              "are authoritative; negative disables")
+        tpu.add_argument("--max_dead_processes", type=int, default=1,
+                         help="pod-member deaths the elastic streaming protocol "
+                              "tolerates per run (heartbeat detection + "
+                              "ownership-epoch stripe re-assignment across the "
+                              "survivors) before aborting; heartbeat cadence "
+                              "via DREP_TPU_HEARTBEAT_S (0 disables)")
         tpu.add_argument("--profile", nargs="?", const="auto", default=None,
                          help="record a jax.profiler trace of the compare stage "
                               "(optionally to the given directory; default "

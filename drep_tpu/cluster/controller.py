@@ -67,11 +67,14 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "streaming_threshold": 30_000,
     "overlap_ingest": True,
     # fault tolerance (parallel/faulttol.py): retries per failed device
-    # dispatch, and the per-dispatch watchdog (seconds; 0 = disabled).
-    # Neither affects results, only how failures are survived — kept out
-    # of _RESUME_KEYS so changing them never invalidates a workdir.
+    # dispatch, the per-dispatch watchdog (seconds; 0 = auto-derived from
+    # the run's own tile latencies), and how many pod-member deaths the
+    # elastic streaming protocol tolerates before aborting. None affects
+    # results, only how failures are survived — kept out of _RESUME_KEYS
+    # so changing them never invalidates a workdir.
     "fault_retries": 2,
     "dispatch_timeout": 0.0,
+    "max_dead_processes": 1,
 }
 
 _RESUME_KEYS = [
@@ -106,12 +109,18 @@ def _fill_defaults(kwargs: dict[str, Any]) -> dict[str, Any]:
 def _ft_config(kw: dict[str, Any]):
     """Fault-tolerance knobs -> executor config (also installed as the
     process default so paths that cannot thread a config — the dense
-    ring — honor the same CLI flags)."""
+    ring — honor the same CLI flags). --dispatch_timeout 0 enables the
+    auto-derived watchdog (k x rolling median tile latency, floored —
+    parallel/faulttol.py); an explicit positive value is authoritative,
+    a negative value disables the watchdog entirely."""
     from drep_tpu.parallel.faulttol import FaultTolConfig, configure_defaults
 
+    timeout = float(kw["dispatch_timeout"])
     cfg = FaultTolConfig(
         max_retries=int(kw["fault_retries"]),
-        dispatch_timeout_s=float(kw["dispatch_timeout"]),
+        dispatch_timeout_s=max(0.0, timeout),
+        auto_timeout=timeout == 0.0,
+        max_dead_processes=int(kw["max_dead_processes"]),
     )
     configure_defaults(cfg)
     return cfg
@@ -395,6 +404,25 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         gs, bdb, kw, wd=wd, ft_cfg=ft_cfg
     )
     counters.add("primary_compare", pairs=pairs_done, seconds=_time.perf_counter() - t0)
+    from drep_tpu.parallel.faulttol import pod_dead, pod_epoch, pod_live
+
+    if pod_live() is not None:
+        # the elastic streaming stage lost pod member(s) and completed on
+        # the survivors. The degradation carries into everything below:
+        # checkpoint-store opens (SecondaryCheckpoint) route their
+        # barriers over the live set (utils/ckptmeta.py), the secondary
+        # engines clamp their mesh to LOCAL devices (engines._mesh_or_none
+        # — a global mesh would dispatch a collective that waits on the
+        # corpse forever), and the honest counters (dead_processes /
+        # pod_epoch_bumps) ride into perf_counters.json + bench records
+        # so a degraded run can never read as a clean measurement.
+        logger.warning(
+            "degraded pod: process(es) %s died during the primary stage; "
+            "continuing the secondary loop on survivors %s (ownership "
+            "epoch %d). Results are identical to a healthy run; restart "
+            "the pod when convenient to restore capacity.",
+            pod_dead(), pod_live(), pod_epoch(),
+        )
     n_primary = int(primary.max()) if n else 0
     logger.info("primary clustering: %d clusters from %d genomes", n_primary, n)
 

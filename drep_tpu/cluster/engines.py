@@ -40,8 +40,19 @@ MESH_MIN_GENOMES = 64
 def _mesh_or_none(mesh_shape: int | None, n: int):
     import jax
 
-    from drep_tpu.parallel.mesh import make_mesh
+    from drep_tpu.parallel.faulttol import pod_live
+    from drep_tpu.parallel.mesh import make_local_mesh, make_mesh
 
+    if pod_live() is not None:
+        # degraded pod (elastic protocol lost a member): a global mesh
+        # spans the dead process's chips and a sharded dispatch over it
+        # would wait on the corpse forever — no timeout guards the
+        # collective itself. Survivors instead run this work REPLICATED
+        # on their local chips: slower, never hung, same numbers.
+        local = len(jax.local_devices())
+        if local > 1 and n >= MESH_MIN_GENOMES:
+            return make_local_mesh()
+        return None
     n_avail = len(jax.devices())
     n_dev = mesh_shape if mesh_shape is not None else n_avail
     if n_dev > 1 and n >= MESH_MIN_GENOMES:

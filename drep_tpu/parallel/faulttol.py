@@ -29,6 +29,14 @@ crash story (atomic shards + Cdb resume):
   peer produces an actionable error in minutes instead of an infinite
   hang. The abandoned waiter thread is a daemon — XLA gives no way to
   cancel an in-flight collective, so the process can still exit.
+- :class:`HeartbeatManager` + the module pod state — the elastic-pod
+  protocol for the streaming primary: per-process heartbeat files in the
+  shared checkpoint dir (cadence ``DREP_TPU_HEARTBEAT_S``), staleness-based
+  death detection, and an ownership EPOCH that survivors bump to re-deal
+  the dead member's unfinished stripes (parallel/streaming.py drives it;
+  utils/ckptmeta.py routes degraded-pod barriers over the survivor set).
+  A dead pod member no longer aborts the run at the collective timeout —
+  the survivors finish the stage with a bit-identical edge list.
 
 Fault-injection points (utils/faults.py) fire INSIDE the watched
 regions, so injected hangs trip the same watchdogs real wedges do.
@@ -36,9 +44,12 @@ regions, so injected hangs trip the same watchdogs real wedges do.
 
 from __future__ import annotations
 
+import json
 import os
+import statistics
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -65,6 +76,21 @@ def collective_timeout_s(default: float = DEFAULT_COLLECTIVE_TIMEOUT_S) -> float
     return float(os.environ.get(COLLECTIVE_TIMEOUT_ENV, default))
 
 
+# per-process heartbeat cadence for the elastic-pod protocol (seconds);
+# 0 disables heartbeats entirely (and with them epoch-coordinated stripe
+# re-assignment — a dead pod member then aborts at the collective timeout,
+# the pre-elastic behavior). Death is diagnosed at 5x the cadence: well
+# past any plausible beat-writer scheduling jitter, still minutes-not-hours
+# at the default.
+HEARTBEAT_ENV = "DREP_TPU_HEARTBEAT_S"
+DEFAULT_HEARTBEAT_S = 5.0
+HEARTBEAT_MISS_FACTOR = 5.0
+
+
+def heartbeat_cadence_s() -> float:
+    return float(os.environ.get(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_S))
+
+
 class FaultTolError(RuntimeError):
     """A dispatch failed beyond the retry/quarantine/fallback budget."""
 
@@ -81,12 +107,36 @@ class CollectiveTimeout(FaultTolError):
 @dataclass(frozen=True)
 class FaultTolConfig:
     """Knobs for the retrying executor (CLI: --fault_retries,
-    --dispatch_timeout)."""
+    --dispatch_timeout, --max_dead_processes)."""
 
     max_retries: int = 2  # re-dispatch attempts after the first failure
-    dispatch_timeout_s: float = 0.0  # per-dispatch watchdog; 0 disables
+    dispatch_timeout_s: float = 0.0  # per-dispatch watchdog; 0 = auto/off
     backoff_s: float = 0.05  # first retry delay, doubled per attempt
     quarantine_after: int = 3  # consecutive failures that bench a device
+    # dispatch_timeout_s == 0 with auto_timeout on derives the watchdog
+    # deadline from the run's own measured tile latencies (TileExecutor);
+    # an explicit positive dispatch_timeout_s is always authoritative.
+    # Off in the bare-library default so direct streaming calls keep the
+    # strict zero-overhead contract; the CLI/controller turns it on.
+    auto_timeout: bool = False
+    # pod-member deaths tolerated per run before the elastic protocol
+    # gives up and aborts (CLI: --max_dead_processes)
+    max_dead_processes: int = 1
+
+
+# auto-derived watchdog: k x the rolling median finalize-wait latency
+# (warmup-excluded — the first waits absorb the XLA compile), floored so
+# pipelined ~0-ms waits cannot derive a hair-trigger deadline. The floor
+# is the effective default on a healthy pipelined run; the multiplier
+# takes over only when tiles are genuinely slow (big blocks, slow links).
+AUTO_TIMEOUT_MULT = 20.0
+AUTO_TIMEOUT_FLOOR_S = 30.0
+AUTO_TIMEOUT_WARMUP = 8  # finalize waits excluded as compile warmup
+AUTO_TIMEOUT_MIN_SAMPLES = 4
+# before enough samples exist the watchdog is not OFF — an early wedge
+# (right after backend init, a common wedge point) must still be caught.
+# The warmup bound is generous enough to cover any cold XLA compile.
+AUTO_TIMEOUT_WARMUP_CAP_S = 300.0
 
 
 # process-wide defaults, set once per run by the cluster controller from
@@ -97,6 +147,371 @@ DEFAULT_CONFIG = FaultTolConfig()
 def configure_defaults(config: FaultTolConfig) -> None:
     global DEFAULT_CONFIG
     DEFAULT_CONFIG = config
+
+
+# -- elastic pod state ----------------------------------------------------
+#
+# Process-global because it outlives the streaming stage that discovers a
+# death: the controller's SECONDARY loop (and any later checkpoint-store
+# open) must route its barriers over the survivor set, or the first
+# full-pod collective after the bump would hang on the dead member until
+# the collective timeout — exactly the abort the epoch protocol removes.
+# Reset at the start of every heartbeat-managed stage (HeartbeatManager
+# .start), so one process can run several pods' worth of work sequentially.
+
+_POD = {"epoch": 0, "live": None, "dead": [], "t0": 0.0}
+
+
+def pod_epoch() -> int:
+    """Current ownership epoch (0 = healthy, never bumped)."""
+    return _POD["epoch"]
+
+
+def pod_live() -> list[int] | None:
+    """The live-process list once degraded, else None (healthy: everyone)."""
+    return _POD["live"]
+
+
+def pod_dead() -> list[int]:
+    return list(_POD["dead"])
+
+
+def pod_t0() -> float:
+    """Wall time the current heartbeat-managed stage began — file-based
+    degraded barriers reject notes older than this (a crashed-then-
+    restarted pod must never trust a previous run's sentinel)."""
+    return _POD["t0"]
+
+
+def reset_pod(t0: float | None = None) -> None:
+    _POD.update(epoch=0, live=None, dead=[], t0=(t0 if t0 is not None else 0.0))
+
+
+def mark_pod_degraded(epoch: int, live: list[int], dead: list[int]) -> None:
+    _POD.update(epoch=int(epoch), live=list(live), dead=list(dead))
+
+
+# per-(note_dir) count of heartbeat-managed stages THIS process has run —
+# the call-sequence scope of done-notes. Replicated control flow means
+# every pod member reaches the same count for the same store, so sequence
+# k on one process pairs with sequence k on every other (the same
+# invariant _BARRIER_SEQ in utils/ckptmeta.py relies on). A RESTARTED
+# process starts over at 1, which is exactly how its stale on-disk notes
+# (seq >= 1 from the previous incarnation) are recognized and cleared.
+_HB_SEQ: dict[str, int] = {}
+
+
+class HeartbeatManager:
+    """Per-process liveness + ownership-epoch bookkeeping over a shared
+    checkpoint directory (the elastic-pod protocol's ground truth).
+
+    Lifecycle (driven by parallel/streaming.py):
+
+    - ``start()`` — bump this store's call sequence, clear THIS process's
+      done-note from a PREVIOUS incarnation (payload seq >= the fresh
+      seq — a crashed-then-restarted pod must never diagnose or trust a
+      previous run's state), write the first beat, and launch the daemon
+      beat writer. Must run BEFORE the stage-open barrier so every peer's
+      cleanup is ordered before anyone starts monitoring. A done-note
+      from this process's OWN earlier call (payload seq < the fresh seq)
+      is deliberately KEPT: a peer may still be consuming it in the
+      previous call's completion wait, and deleting it there deadlocks
+      the pod (observed); the note is overwritten at this call's own
+      ``mark_done``, which cannot happen before every peer has left the
+      previous call (the stage-open barrier orders it).
+    - ``check()`` — time-gated peer scan: a peer whose beat file went
+      stale (``HEARTBEAT_MISS_FACTOR`` x cadence) with no current
+      done-note is declared dead; the epoch bumps, the module pod state
+      is published (so downstream barriers route over the survivors),
+      and honest counters land (``dead_processes``, ``pod_epoch_bumps``).
+      Raises :class:`FaultTolError` past ``max_dead`` deaths.
+    - ``mark_done(pairs)`` — publish this process's done-note (its honest
+      ``pairs_computed`` rides along for the survivor-set total, stamped
+      with the call sequence). A peer whose done-note carries seq >= ours
+      finished OUR call (possibly racing ahead into the next) and is
+      never declared dead, however stale its beat.
+    - ``close()`` — stop the beat writer and remove the own beat file.
+      The done-note stays (peers may still be polling it).
+
+    Correctness never depends on peers agreeing on the epoch at the same
+    instant: shard writes are atomic and idempotent (identical bytes from
+    any process), so a transient live-list disagreement costs at most a
+    duplicated stripe computation.
+    """
+
+    def __init__(
+        self,
+        note_dir: str,
+        cadence: float,
+        max_dead: int = 1,
+        pc: int | None = None,
+        pid: int | None = None,
+    ) -> None:
+        if pc is None or pid is None:
+            import jax
+
+            pc = jax.process_count() if pc is None else pc
+            pid = jax.process_index() if pid is None else pid
+        self.note_dir = note_dir
+        self.cadence = float(cadence)
+        self.max_dead = int(max_dead)
+        self.pc, self.pid = int(pc), int(pid)
+        self.miss_s = max(HEARTBEAT_MISS_FACTOR * self.cadence, 1.0)
+        self.live = list(range(self.pc))
+        self.dead: list[int] = []
+        self.epoch = 0
+        self.seq = 0  # call sequence for this store, set by start()
+        self._beat_seq = 0
+        self._started_at = 0.0
+        self._last_check = 0.0
+        # pid -> wall time the peer FIRST looked stale: a death verdict
+        # needs staleness confirmed across a full cadence, so one
+        # transient failed stat (NFS rename window, ESTALE) can never
+        # fence a healthy member
+        self._suspect: dict[int, float] = {}
+        # pid -> wall time the peer's beat FIRST became unreadable: a
+        # failed stat only counts as staleness after it persists for the
+        # full miss window (a brief shared-FS outage makes EVERY beat
+        # unreadable on every process at once — that must heal, not
+        # trigger mutual fencing)
+        self._unreadable: dict[int, float] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- note paths (dot-prefixed, process-suffixed: shard-store resume
+    # globs and clear_suffixes scans never see them — the same namespace
+    # rule as ckptmeta's barrier sentinels)
+    def _note(self, kind: str, pid: int) -> str:
+        return os.path.join(self.note_dir, f".pod-{kind}.p{pid}")
+
+    def beat_path(self, pid: int | None = None) -> str:
+        return self._note("hb", self.pid if pid is None else pid)
+
+    def done_path(self, pid: int | None = None) -> str:
+        return self._note("done", self.pid if pid is None else pid)
+
+    def verdict_path(self, pid: int) -> str:
+        """Death-verdict note NAMING `pid` (written by whichever survivor
+        detected the staleness first). Verdicts make the live view
+        CONVERGE: every peer adopts a published verdict instead of
+        re-deriving liveness from its own (possibly skewed) view of the
+        beat mtimes, and a process that finds a verdict naming ITSELF is
+        fenced — it aborts rather than continue as a zombie the rest of
+        the pod has already re-dealt around."""
+        return self._note("dead", pid)
+
+    def _beat(self) -> None:
+        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
+        self._beat_seq += 1
+        atomic_write_bytes(self.beat_path(), str(self._beat_seq).encode())
+
+    def start(self) -> None:
+        import contextlib
+
+        os.makedirs(self.note_dir, exist_ok=True)
+        key = os.path.abspath(self.note_dir)
+        self.seq = _HB_SEQ[key] = _HB_SEQ.get(key, 0) + 1
+        # a done-note with seq >= our fresh sequence can only be a leftover
+        # from a previous incarnation of this process (ours count up from
+        # here) — clear it BEFORE the stage-open barrier, so no peer's
+        # post-barrier monitoring can ever read previous-run state. Lower
+        # sequences are our own earlier calls' notes: kept (see class doc).
+        stale = self.read_done(self.pid)
+        if stale is None or int(stale.get("seq", 0)) >= self.seq:
+            with contextlib.suppress(OSError):
+                os.remove(self.done_path())
+        # a verdict naming THIS process can only be a previous
+        # incarnation's (current-run verdicts are written post-barrier,
+        # and this cleanup is ordered pre-barrier): a restarted pod must
+        # not self-fence on the previous run's death
+        with contextlib.suppress(OSError):
+            os.remove(self.verdict_path(self.pid))
+        # own stale degraded-barrier sentinels likewise predate this
+        # stage: a restarted degraded pod must not satisfy a file barrier
+        # with a previous incarnation's note. Safe against peers still
+        # polling an EARLIER barrier of this run: _file_barrier counts a
+        # note once seen, and a process only removes its notes after
+        # passing (it reaches this cleanup only via later stages).
+        import glob
+
+        for note in glob.glob(
+            os.path.join(self.note_dir, f".barrier-*.p{self.pid}")
+        ):
+            with contextlib.suppress(OSError):
+                os.remove(note)
+        self._started_at = time.time()
+        prev_live = pod_live()
+        if prev_live is not None:
+            # the pod already lost members in an earlier stage of this
+            # process's run: a new heartbeat-managed stage must keep the
+            # survivor view (resetting to the full pod would re-route its
+            # barriers over the corpse) — only the freshness epoch resets
+            self.live = [p for p in prev_live if p < self.pc]
+            self.dead = [p for p in pod_dead() if p < self.pc]
+            self.epoch = pod_epoch()
+            _POD["t0"] = self._started_at
+        else:
+            reset_pod(t0=self._started_at)
+        self._beat()
+        if self.cadence > 0:
+            self._thread = threading.Thread(
+                target=self._beat_loop, daemon=True, name="drep-heartbeat"
+            )
+            self._thread.start()
+
+    def _beat_loop(self) -> None:
+        while not self._stop.wait(self.cadence):
+            try:
+                self._beat()
+            except OSError:  # a flaky write must not kill the writer —
+                pass  # one missed beat is well inside the miss window
+
+    def read_done(self, pid: int) -> dict | None:
+        """Raw done-note payload, no sequence validation."""
+        try:
+            with open(self.done_path(pid)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def done_payload(self, pid: int) -> dict | None:
+        """The peer's done-note IF it covers the current call (payload
+        seq >= ours — a racing peer's next-call overwrite still implies it
+        finished this one). Older notes are a previous call's state."""
+        note = self.read_done(pid)
+        if note is not None and int(note.get("seq", 0)) >= self.seq:
+            return note
+        return None
+
+    def peer_finished(self, pid: int) -> bool:
+        return self.done_payload(pid) is not None
+
+    def maybe_check(self) -> bool:
+        """Time-gated :meth:`check` (at most once per cadence) — cheap
+        enough to call per stripe."""
+        if time.time() - self._last_check < self.cadence:
+            return False
+        return self.check()
+
+    def check(self) -> bool:
+        """Scan peer liveness; returns True when the epoch bumped.
+
+        Published death verdicts are adopted BEFORE any local staleness
+        judgment, so the survivor view converges pod-wide even when one
+        process's view of the beat mtimes is skewed (NFS attribute
+        caching): whoever detects first publishes, everyone else follows,
+        and the subject — if actually alive — fences itself."""
+        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+        from drep_tpu.utils.profiling import counters
+
+        now = time.time()
+        self._last_check = now
+        if os.path.exists(self.verdict_path(self.pid)):
+            raise FaultTolError(
+                f"elastic pod: a peer declared process {self.pid} dead (its "
+                f"view of this process's heartbeat went stale) and the pod "
+                f"has re-dealt its stripes — fencing this process rather "
+                f"than continuing as a zombie. Restart the pod member."
+            )
+        newly: list[int] = []
+        adopted: list[int] = []
+        # staleness is judged SERVER-clock-to-server-clock: our own beat
+        # file's mtime (at most one cadence old, stamped by the same
+        # filesystem) is the reference, so a constant NFS-server vs host
+        # clock skew can never fake a death — the local-clock fallback
+        # only covers an unreadable own beat
+        try:
+            ref = os.stat(self.beat_path()).st_mtime
+        except OSError:
+            ref = now
+        for p in self.live:
+            if p == self.pid:
+                continue
+            if os.path.exists(self.verdict_path(p)):
+                newly.append(p)  # adopt a peer's published verdict
+                adopted.append(p)
+                continue
+            if self.peer_finished(p):
+                continue
+            try:
+                stale = ref - os.stat(self.beat_path(p)).st_mtime > self.miss_s
+                self._unreadable.pop(p, None)
+            except OSError:
+                # no readable beat: a transient stat failure, a concurrent
+                # clear, or a very early death. Stale only once the beat
+                # has been unreadable for the full miss window AND the
+                # stage is past its startup grace (the stage-open barrier
+                # ordered every peer's first beat before monitoring began)
+                first_bad = self._unreadable.setdefault(p, now)
+                stale = (
+                    now - first_bad > self.miss_s
+                    and now - self._started_at > self.miss_s
+                )
+            if not stale:
+                self._suspect.pop(p, None)
+                continue
+            # confirm across a full cadence before the irreversible
+            # verdict — a single bad observation must heal, not fence
+            first = self._suspect.setdefault(p, now)
+            if now - first >= max(self.cadence, 0.2):
+                newly.append(p)
+        if not newly:
+            return False
+        if len(self.dead) + len(newly) > self.max_dead:
+            raise FaultTolError(
+                f"elastic pod: process(es) {newly} stopped heartbeating, but "
+                f"{len(self.dead)} death(s) were already tolerated and "
+                f"--max_dead_processes is {self.max_dead} — aborting; restart "
+                f"the pod (shard-level checkpoints resume finished work)"
+            )
+        for p in newly:
+            if p in adopted:
+                continue
+            # publish the verdict so every peer adopts THIS view (and the
+            # subject fences itself if it was a false positive)
+            try:
+                atomic_write_bytes(
+                    self.verdict_path(p),
+                    json.dumps(
+                        {"by": self.pid, "seq": self.seq, "at": now}
+                    ).encode(),
+                )
+            except OSError:  # best-effort: peers can still detect on
+                pass  # their own staleness clock
+        self.dead.extend(newly)
+        self.live = [p for p in self.live if p not in newly]
+        self.epoch += 1
+        counters.add_fault("dead_processes", len(newly))
+        counters.add_fault("pod_epoch_bumps")
+        mark_pod_degraded(self.epoch, self.live, self.dead)
+        get_logger().warning(
+            "elastic pod: process(es) %s stopped heartbeating (> %.1fs stale) "
+            "— bumping ownership epoch to %d and re-dealing their unfinished "
+            "stripes across survivors %s",
+            newly, self.miss_s, self.epoch, self.live,
+        )
+        return True
+
+    def mark_done(self, pairs_computed: int) -> None:
+        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
+        atomic_write_bytes(
+            self.done_path(),
+            json.dumps(
+                {"pairs": int(pairs_computed), "epoch": self.epoch, "seq": self.seq}
+            ).encode(),
+        )
+
+    def close(self) -> None:
+        import contextlib
+
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, 2 * self.cadence))
+            self._thread = None
+        with contextlib.suppress(OSError):
+            os.remove(self.beat_path())
 
 
 def _watchdog_run(fn: Callable[[], Any], timeout_s: float, what: str, site: str):
@@ -168,13 +583,24 @@ class TileExecutor:
         devices: list,
         config: FaultTolConfig | None = None,
         fault_site: str = "streaming_tile",
+        on_quarantine: Callable[[int], None] | None = None,
     ) -> None:
         self.devices = list(devices)
         self.config = config if config is not None else DEFAULT_CONFIG
         self.fault_site = fault_site
+        # called with the slot index the moment a device is quarantined —
+        # the caller's chance to drop its per-slot device-resident operands
+        # (streaming frees the quarantined chip's HBM copy of the genome
+        # pack: a benched device must not keep ~400 MB resident for the
+        # rest of the run)
+        self.on_quarantine = on_quarantine
         self.active: list[int] = list(range(len(self.devices)))
         self._failures = [0] * len(self.devices)
         self._rr = 0
+        # rolling finalize-wait latencies for the auto-derived watchdog
+        # (dispatch_timeout_s == 0 + auto_timeout): warmup-excluded, capped
+        self._waits: deque[float] = deque(maxlen=64)
+        self._n_waits = 0
 
     # -- scheduling -------------------------------------------------------
     def next_slot(self, exclude: frozenset | set = frozenset()) -> int:
@@ -193,6 +619,41 @@ class TileExecutor:
 
     def quarantined(self) -> list[int]:
         return [i for i in range(len(self.devices)) if i not in self.active]
+
+    # -- auto-derived watchdog -------------------------------------------
+    def _note_wait(self, dt: float) -> None:
+        self._n_waits += 1
+        if self._n_waits > AUTO_TIMEOUT_WARMUP:
+            self._waits.append(dt)
+
+    def _effective_timeout(self) -> float:
+        """The per-dispatch watchdog this finalize runs under: an explicit
+        positive config value is authoritative; 0 + auto_timeout derives
+        k x the rolling median tile latency (floored) once enough
+        warmup-excluded samples exist — and before then runs under the
+        generous warmup cap, so an early wedge still cannot hang the run
+        forever; auto off = disabled."""
+        if self.config.dispatch_timeout_s > 0:
+            return self.config.dispatch_timeout_s
+        if not self.config.auto_timeout:
+            return 0.0
+        if len(self._waits) < AUTO_TIMEOUT_MIN_SAMPLES:
+            return AUTO_TIMEOUT_WARMUP_CAP_S
+        return max(
+            AUTO_TIMEOUT_MULT * statistics.median(self._waits),
+            AUTO_TIMEOUT_FLOOR_S,
+        )
+
+    def derived_timeout_s(self) -> float | None:
+        """The auto-derived deadline, or None when an explicit value
+        governs / auto is off / still warming up (the warmup cap is a
+        bound, not a derivation). Reported into perf_counters.json
+        (gauges) by the streaming loop."""
+        if self.config.dispatch_timeout_s > 0 or not self.config.auto_timeout:
+            return None
+        if len(self._waits) < AUTO_TIMEOUT_MIN_SAMPLES:
+            return None
+        return self._effective_timeout()
 
     def _record_failure(self, slot: int, exc: BaseException) -> None:
         from drep_tpu.utils.profiling import counters
@@ -215,6 +676,14 @@ class TileExecutor:
                 self.fault_site, slot, self.devices[slot],
                 self._failures[slot], len(self.active),
             )
+            if self.on_quarantine is not None:
+                try:
+                    self.on_quarantine(slot)
+                except Exception as e:  # noqa: BLE001 — freeing is best-effort
+                    get_logger().warning(
+                        "%s: on_quarantine callback for slot %d failed: %s",
+                        self.fault_site, slot, e,
+                    )
 
     # -- dispatch ---------------------------------------------------------
     def submit(self, compute: Callable[[int], Any]) -> tuple:
@@ -234,7 +703,9 @@ class TileExecutor:
         compute, slot, value, err = pending
         if err is None:
             try:
-                _wait_ready(value, self.config.dispatch_timeout_s, self.fault_site, slot)
+                t0 = time.perf_counter()
+                _wait_ready(value, self._effective_timeout(), self.fault_site, slot)
+                self._note_wait(time.perf_counter() - t0)
                 self._failures[slot] = 0
                 return value
             except Exception as e:  # noqa: BLE001
@@ -248,7 +719,7 @@ class TileExecutor:
             counters.add_fault("retries")
             try:
                 value = compute(slot)
-                _wait_ready(value, self.config.dispatch_timeout_s, self.fault_site, slot)
+                _wait_ready(value, self._effective_timeout(), self.fault_site, slot)
                 self._failures[slot] = 0
                 return value
             except Exception as e:  # noqa: BLE001
@@ -283,9 +754,12 @@ def retrying_call(
     or watchdog trip is a LOCAL decision — one process re-entering a
     collective program (or abandoning it) while its peers sit at a
     different program point desyncs the pod into exactly the infinite
-    hang this layer exists to remove. Coordinated multi-host retry needs
-    a shared ownership/retry epoch (ROADMAP follow-up); until then the
-    multi-host live-failure guards are the collective timeouts
+    hang this layer exists to remove. The streaming primary has a shared
+    ownership epoch for exactly this (HeartbeatManager + the stripe
+    re-deal in parallel/streaming.py) because its unit of work — a stripe
+    shard — is independently redoable; the dense ring and sharded
+    secondary calls are single collective programs with no such unit, so
+    their multi-host live-failure guards stay the collective timeouts
     (run_with_timeout), which abort loudly instead of retrying.
     """
     import jax

@@ -25,6 +25,14 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return jax.make_mesh((len(devices),), (AXIS,), devices=devices)
 
 
+def make_local_mesh() -> Mesh:
+    """1-D mesh over THIS process's devices only — no cross-process
+    collectives can arise from it. The degraded-pod secondary path uses
+    it so a computation never waits on a dead member's chips."""
+    devices = jax.local_devices()
+    return jax.make_mesh((len(devices),), (AXIS,), devices=devices)
+
+
 def initialize_distributed(coordinator: str | None = None, num_processes: int | None = None, process_id: int | None = None) -> None:
     """Multi-host bring-up (v5e-64-style pods; SURVEY.md §5.8).
 

@@ -155,8 +155,76 @@ def stripe_owner(bi: int, n_blocks: int, pc: int) -> int:
     makes every pair carry a constant ``n_blocks + 1`` tiles (the odd
     middle stripe is its own half-weight pair), so dealing PAIRS
     round-robin balances total tiles per process to within one stripe.
+
+    This is the EPOCH-0 deal: :func:`stripe_owner_live` generalizes it to
+    the survivor set after a pod-member death.
     """
     return min(bi, n_blocks - 1 - bi) % pc
+
+
+def stripe_owner_live(bi: int, n_blocks: int, live: list[int]) -> int:
+    """Epoch-scoped stripe ownership: the same mirror-paired dealing, over
+    an explicit live-process list instead of ``range(pc)``. With the full
+    pod alive this IS :func:`stripe_owner`; after an ownership-epoch bump
+    the dead members drop out of `live` and every stripe — including the
+    dead process's unfinished ones — re-deals across the survivors with
+    the same balance bound."""
+    return live[min(bi, n_blocks - 1 - bi) % len(live)]
+
+
+def _shard_name(bi: int, epoch: int) -> str:
+    """Stripe `bi`'s checkpoint shard filename, epoch-stamped: healthy
+    (epoch-0) shards stay ``row_XXXXX.npz``; a stripe computed after an
+    ownership-epoch bump carries the epoch in its name — resume-visible
+    forensics for which shards a degraded run produced. Content is
+    identical whichever process/epoch computed it (deterministic tiles),
+    so a resume replays identically across the bump."""
+    return f"row_{bi:05d}.npz" if epoch == 0 else f"row_{bi:05d}.e{epoch:02d}.npz"
+
+
+def _find_shard(checkpoint_dir: str, bi: int) -> str | None:
+    """Existing shard for stripe `bi` under ANY ownership epoch."""
+    loc = os.path.join(checkpoint_dir, f"row_{bi:05d}.npz")
+    if os.path.exists(loc):
+        return loc
+    import glob
+
+    hits = sorted(glob.glob(os.path.join(checkpoint_dir, f"row_{bi:05d}.e*.npz")))
+    return hits[0] if hits else None
+
+
+def _load_shard(path: str):
+    """(ii, jj, dist) from a checkpoint shard, or None when it reads
+    corrupt — warned and best-effort removed (the remove itself may fail
+    on EACCES/flaky NFS; callers recompute regardless). ALL members are
+    read before returning: zip members are read lazily, so a partially-
+    corrupt shard must not hand back ii while jj/dist would raise
+    (misaligned edge arrays). ONE implementation for the resume loop and
+    the elastic assembly so the corruption contract cannot drift."""
+    import contextlib
+
+    try:
+        with np.load(path) as z:
+            return z["ii"], z["jj"], z["dist"]
+    except Exception:
+        get_logger().warning("streaming primary: corrupt shard %s — recomputing", path)
+        with contextlib.suppress(OSError):
+            os.remove(path)
+        return None
+
+
+def _shard_epoch(path: str) -> int:
+    """The ownership epoch stamped in a shard filename (0 for bare names).
+    Healing a corrupt shard recomputes INTO its own path — the pre-elastic
+    self-heal invariant: even when the remove of the corrupt file fails
+    (EACCES, flaky NFS), the atomic rewrite replaces it."""
+    name = os.path.basename(path)
+    if ".e" in name:
+        try:
+            return int(name.split(".e")[1].split(".")[0])
+        except ValueError:
+            return 0
+    return 0
 
 
 def _real_pairs_in_tile(i0: int, j0: int, block: int, n: int) -> int:
@@ -265,15 +333,29 @@ def streaming_mash_edges(
     Tile dispatch is fault-tolerant (parallel/faulttol.py, `ft_config` —
     defaults to the process config set by the CLI flags): failed or
     watchdog-tripped tiles retry with backoff on the surviving devices, a
-    repeatedly-failing device is quarantined out of the round-robin, and
+    repeatedly-failing device is quarantined out of the round-robin (its
+    HBM copy of the genome pack is freed the moment it is benched), and
     a tile no device can produce is recomputed on the host CPU via the
     jnp path. The CPU fallback thresholds against the SAME distance array
     it ships, so a fallback tile's edge set is self-consistent at the
     cutoff boundary (no mixed device/host provenance inside one tile).
+
+    Multi-process pods with a checkpoint dir additionally run the ELASTIC
+    protocol (heartbeats + ownership epochs, parallel/faulttol.py
+    HeartbeatManager): a pod member that dies mid-stage is detected by
+    heartbeat staleness, the survivors bump the ownership epoch and
+    re-deal its unfinished stripes (:func:`stripe_owner_live`), and the
+    stage completes with the final edge list bit-identical to a healthy
+    run — assembled in the canonical healthy-run order from the shared
+    shard store, which needs no full-pod collective after the death.
+    ``DREP_TPU_HEARTBEAT_S=0`` disables the protocol (a dead member then
+    aborts at the collective timeout, the pre-elastic behavior).
     """
     import jax
 
-    from drep_tpu.parallel.faulttol import TileExecutor
+    from drep_tpu.parallel.faulttol import TileExecutor, heartbeat_cadence_s
+    from drep_tpu.utils import faults as _faults
+    from drep_tpu.utils.profiling import counters
 
     logger = get_logger()
     n = packed.n
@@ -303,14 +385,57 @@ def streaming_mash_edges(
     # local devices only: on a multi-host pod jax.devices() includes remote
     # chips, and device_put to a non-addressable device raises. Row-block
     # stripes are instead divided across processes (the mirror-paired
-    # stripe_owner(bi, n_blocks, pc) == pid dealing below) and the
-    # surviving edges all-gathered at the end.
+    # stripe_owner dealing) and the surviving edges gathered at the end.
     devices = jax.local_devices()
     pc = jax.process_count()
     pid = jax.process_index()
+
+    # the full padded pack lives on every device (N=100k, s=1000 -> ~400 MB,
+    # well within HBM); tiles are sliced on device, so each block crosses
+    # PCIe exactly once per device instead of once per tile. Deferred until
+    # a stripe actually computes — a fully-resumed run transfers nothing.
+    ids_on: list | None = None
+    rev_on: list | None = None
+    counts_on: list | None = None
+    counts1d_on: list | None = None
+
+    def _free_pack_slot(slot: int) -> None:
+        # quarantine callback: a benched device never receives another
+        # dispatch, so its resident pack copy is dead weight — drop the
+        # references and let the runtime reclaim the HBM (ROADMAP
+        # follow-up; ~400 MB per quarantined chip at the 100k scale)
+        freed = 0
+        for arrs in (ids_on, rev_on, counts_on, counts1d_on):
+            if arrs is not None and arrs[slot] is not None:
+                arrs[slot] = None
+                freed += 1
+        if freed:
+            counters.add_fault("pack_buffers_freed", freed)
+
     # the retrying dispatcher: round-robins over non-quarantined devices,
     # watchdogs each wait, retries on survivors, CPU-recomputes last
-    ft = TileExecutor(devices, ft_config, fault_site="streaming_tile")
+    ft = TileExecutor(
+        devices, ft_config, fault_site="streaming_tile",
+        on_quarantine=_free_pack_slot,
+    )
+
+    # elastic-pod liveness: heartbeat notes in the shared checkpoint dir.
+    # Started BEFORE the stage-open barrier so every process's stale-note
+    # cleanup is ordered ahead of every peer's monitoring — a restarted
+    # pod can never diagnose a previous run's dead process. The writer
+    # runs even single-process (negligible: one tiny file per cadence) so
+    # the zero-overhead guard exercises it; monitoring/epochs need peers.
+    hb = None
+    if checkpoint_dir is not None:
+        cadence = heartbeat_cadence_s()
+        if cadence > 0:
+            from drep_tpu.parallel.faulttol import HeartbeatManager
+
+            hb = HeartbeatManager(
+                checkpoint_dir, cadence, max_dead=ft.config.max_dead_processes
+            )
+            hb.start()
+    elastic = hb is not None and pc > 1
 
     resume = False
     if checkpoint_dir is not None:
@@ -327,74 +452,58 @@ def streaming_mash_edges(
             # at identical N (the int32 ids are a run-specific vocab remap)
             "fingerprint": content_fingerprint(packed.names, packed.counts, packed.ids),
         }
-        # process-0-only clear + barrier on >1 process lives inside
-        # open_checkpoint_dir (shared with the secondary shard store)
-        resume = open_checkpoint_dir(checkpoint_dir, meta, clear_suffixes=(".npz",))
-
-    # the full padded pack lives on every device (N=100k, s=1000 -> ~400 MB,
-    # well within HBM); tiles are sliced on device, so each block crosses
-    # PCIe exactly once per device instead of once per tile. Deferred until
-    # a stripe actually computes — a fully-resumed run transfers nothing.
-    ids_on: list | None = None
-    counts_on: list | None = None
+        # leader-only clear + barrier on >1 process lives inside
+        # open_checkpoint_dir (shared with the secondary shard store).
+        # A raising open (dead peer at the stage-open barrier) must not
+        # leak the beat writer: a zombie beat would keep this process
+        # looking alive in the store forever.
+        try:
+            resume = open_checkpoint_dir(checkpoint_dir, meta, clear_suffixes=(".npz",))
+        except BaseException:
+            if hb is not None:
+                hb.close()
+            raise
 
     all_ii: list[np.ndarray] = []
     all_jj: list[np.ndarray] = []
     all_dd: list[np.ndarray] = []
-    n_resumed = 0
     n_owned = sum(1 for b in range(n_blocks) if stripe_owner(b, n_blocks, pc) == pid)
     pairs_computed = 0
     tiles_done = 0  # upper-triangle tiles actually dispatched this call
     tiles_full = 0  # full-grid tiles of the same stripes (resumed: 0/0)
+    # per-tile device->host budget for the compact threshold path
+    budget = min(EDGE_BUDGET, block * block)
+    compact = _compact_tile()
 
-    for bi in range(n_blocks):
-        if stripe_owner(bi, n_blocks, pc) != pid:
-            continue  # another process owns this row stripe
-        shard = (
-            os.path.join(checkpoint_dir, f"row_{bi:05d}.npz")
-            if checkpoint_dir is not None
-            else None
-        )
-        if resume and shard is not None and os.path.exists(shard):
-            try:
-                # load ALL members before appending any: zip members are
-                # read lazily, so a partially-corrupt shard must not leave
-                # ii appended while jj/dist raise (misaligned edge arrays)
-                with np.load(shard) as z:
-                    s_ii, s_jj, s_dd = z["ii"], z["jj"], z["dist"]
-                all_ii.append(s_ii)
-                all_jj.append(s_jj)
-                all_dd.append(s_dd)
-                n_resumed += 1
-                continue
-            except Exception:  # truncated/corrupt shard (disk trouble,
-                # pre-atomic writer): recompute it. The remove itself may
-                # fail (EACCES, flaky NFS) — recompute regardless, matching
-                # SecondaryCheckpoint.load
-                logger.warning("streaming primary: corrupt shard %s — recomputing", shard)
-                import contextlib
+    def _ensure_pack_on_devices() -> None:
+        nonlocal ids_on, rev_on, counts_on, counts1d_on
+        if ids_on is not None:
+            return
+        if use_pallas:
+            ids_on = [jax.device_put(ids_pal, dev) for dev in devices]
+            rev_on = [jax.device_put(ids_rev, dev) for dev in devices]
+            counts_on = [jax.device_put(counts_col, dev) for dev in devices]
+            counts1d_on = [jax.device_put(counts, dev) for dev in devices]
+        else:
+            ids_on = [jax.device_put(ids, dev) for dev in devices]
+            counts_on = [jax.device_put(counts, dev) for dev in devices]
+            counts1d_on = counts_on
 
-                with contextlib.suppress(OSError):
-                    os.remove(shard)
-
-        if ids_on is None:
-            if use_pallas:
-                ids_on = [jax.device_put(ids_pal, dev) for dev in devices]
-                rev_on = [jax.device_put(ids_rev, dev) for dev in devices]
-                counts_on = [jax.device_put(counts_col, dev) for dev in devices]
-                counts1d_on = [jax.device_put(counts, dev) for dev in devices]
-            else:
-                ids_on = [jax.device_put(ids, dev) for dev in devices]
-                counts_on = [jax.device_put(counts, dev) for dev in devices]
-                counts1d_on = counts_on
+    def _compute_stripe(bi: int, epoch: int = 0) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Dispatch + finalize one row-block stripe; publishes its shard
+        (epoch-stamped name) when checkpointing. Returns the stripe's
+        surviving edges."""
+        nonlocal pairs_computed, tiles_done, tiles_full
+        # the elastic chaos tests SIGKILL a pod member here — at a stripe
+        # boundary, with its finished shards already durable
+        _faults.fire("process_death")
+        _ensure_pack_on_devices()
         i0 = bi * block
         # dispatch the whole stripe asynchronously, one tile per device
         # turn; each tile's threshold+compact also dispatches here, so
         # only ~EDGE_BUDGET survivors per tile cross the link at the sync
         # points below (the dense [block, block] readback measured as the
         # composite bottleneck on slow d2h links)
-        budget = min(EDGE_BUDGET, block * block)
-        compact = _compact_tile()
         tiles = []
         for bj in range(bi, n_blocks):
             j0 = bj * block
@@ -480,40 +589,276 @@ def streaming_mash_edges(
                 row_jj.append(gj[valid])
                 row_dd.append(d[ki, kj][valid].astype(np.float32))
 
-        ii = np.concatenate(row_ii) if row_ii else np.empty(0, np.int64)
-        jj = np.concatenate(row_jj) if row_jj else np.empty(0, np.int64)
-        dd = np.concatenate(row_dd) if row_dd else np.empty(0, np.float32)
-        if shard is not None:
+        s_ii = np.concatenate(row_ii) if row_ii else np.empty(0, np.int64)
+        s_jj = np.concatenate(row_jj) if row_jj else np.empty(0, np.int64)
+        s_dd = np.concatenate(row_dd) if row_dd else np.empty(0, np.float32)
+        if checkpoint_dir is not None:
             from drep_tpu.utils.ckptmeta import atomic_savez
 
-            atomic_savez(shard, ii=ii, jj=jj, dist=dd)
-        all_ii.append(ii)
-        all_jj.append(jj)
-        all_dd.append(dd)
+            atomic_savez(
+                os.path.join(checkpoint_dir, _shard_name(bi, epoch)),
+                ii=s_ii, jj=s_jj, dist=s_dd,
+            )
+        return s_ii, s_jj, s_dd
 
-    if n_resumed:
-        # report against the stripes THIS process owns: on multi-process
-        # runs the global n_blocks would understate resume progress ~pc-fold
-        logger.info(
-            "streaming primary: resumed %d/%d owned row-block shards (process %d/%d)",
-            n_resumed, n_owned, pid, pc,
+    try:
+        if not elastic:
+            n_resumed = 0
+            for bi in range(n_blocks):
+                if stripe_owner(bi, n_blocks, pc) != pid:
+                    continue  # another process owns this row stripe
+                found = _find_shard(checkpoint_dir, bi) if resume else None
+                loaded = _load_shard(found) if found is not None else None
+                if loaded is not None:
+                    all_ii.append(loaded[0])
+                    all_jj.append(loaded[1])
+                    all_dd.append(loaded[2])
+                    n_resumed += 1
+                    continue
+                s_ii, s_jj, s_dd = _compute_stripe(bi)
+                all_ii.append(s_ii)
+                all_jj.append(s_jj)
+                all_dd.append(s_dd)
+            if n_resumed:
+                # report against the stripes THIS process owns: on multi-
+                # process runs the global n_blocks would understate resume
+                # progress ~pc-fold
+                logger.info(
+                    "streaming primary: resumed %d/%d owned row-block shards (process %d/%d)",
+                    n_resumed, n_owned, pid, pc,
+                )
+        else:
+            all_ii, all_jj, all_dd, pairs_computed = _elastic_stripe_loop(
+                hb, checkpoint_dir, n_blocks, pc, pid, n_owned,
+                _compute_stripe, lambda: pairs_computed, resume, logger,
+            )
+
+        if ft.quarantined():
+            logger.warning(
+                "streaming primary: finished with device slot(s) %s quarantined "
+                "(of %d local devices) — see fault_tolerance counters",
+                ft.quarantined(), len(devices),
+            )
+        if tiles_full:
+            counters.add_tiles("primary_compare", computed=tiles_done, total=tiles_full)
+        derived = ft.derived_timeout_s()
+        if derived is not None:
+            # the watchdog deadline the run actually derived from its own
+            # tile latencies (--dispatch_timeout left at 0) — reported so
+            # an operator can pin an explicit value from evidence
+            counters.set_gauge("derived_dispatch_timeout_s", round(derived, 3))
+        ii = np.concatenate(all_ii) if all_ii else np.empty(0, np.int64)
+        jj = np.concatenate(all_jj) if all_jj else np.empty(0, np.int64)
+        dd = np.concatenate(all_dd) if all_dd else np.empty(0, np.float32)
+        if pc > 1 and not elastic:
+            ii, jj, dd, pairs_computed = _allgather_edges(ii, jj, dd, pairs_computed)
+        return ii, jj, dd, pairs_computed
+    finally:
+        if hb is not None:
+            hb.close()
+
+
+def _elastic_stripe_loop(
+    hb,
+    checkpoint_dir: str,
+    n_blocks: int,
+    pc: int,
+    pid: int,
+    n_owned: int,
+    compute_stripe,
+    own_pairs,
+    resume: bool,
+    logger,
+) -> tuple[list, list, list, int]:
+    """The epoch-aware stripe loop + survivor-set gather (the elastic-pod
+    tentpole). Returns (ii_parts, jj_parts, dd_parts, pairs_total) — the
+    per-stripe edge arrays in the canonical healthy-run ordering, and the
+    survivor-set pair total (this process's dispatched pairs plus every
+    current done-note's; `own_pairs` reads the caller's running count,
+    which `compute_stripe` advances).
+
+    Every stripe's edges are durable in the shared shard store the moment
+    it finishes, so completion needs no full-pod collective: each process
+    (1) computes the missing stripes it owns under the CURRENT epoch's
+    live list, re-dealing on every bump, (2) publishes a done-note, (3)
+    waits until every stripe has a shard and every live peer is done, and
+    (4) reads the shards back in process-major epoch-0 order — the exact
+    order the healthy jax allgather concatenates, so the final edge list
+    is bit-identical to an undegraded run by construction."""
+    import time
+
+    from drep_tpu.parallel.faulttol import (
+        DEFAULT_ALLGATHER_TIMEOUT_S,
+        CollectiveTimeout,
+        collective_timeout_s,
+    )
+
+    stall_budget = collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S)
+    done_written = False
+    last_progress = time.time()
+    progress_sig = None
+    # stripes this process computed THIS call stay in memory (assembly
+    # reads only peers'/resumed shards from the shared store — bit-equal
+    # either way, the npz round-trip is lossless); FINISHED stripes are
+    # cached so they are never re-statted, and the still-missing set is
+    # re-probed once per cadence-scaled tick (bounded shared-FS traffic)
+    mem: dict[int, tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
+    shard_of: dict[int, str] = {}
+
+    def _missing_stripes() -> list[int]:
+        out = []
+        for b in range(n_blocks):
+            if b in shard_of:
+                continue
+            p = _find_shard(checkpoint_dir, b)
+            if p is not None:
+                shard_of[b] = p
+            else:
+                out.append(b)
+        return out
+
+    if resume:
+        _missing_stripes()  # one scan, kept: seeds shard_of for the loop
+        n_resumed = sum(
+            1 for b in shard_of if stripe_owner(b, n_blocks, pc) == pid
         )
-    if ft.quarantined():
+        if n_resumed:
+            logger.info(
+                "streaming primary: resumed %d/%d owned row-block shards (process %d/%d)",
+                n_resumed, n_owned, pid, pc,
+            )
+
+    while True:
+        live = list(hb.live)
+        missing = _missing_stripes()  # ONE shared-FS scan per tick
+        computed = False
+        for bi in list(missing):
+            if stripe_owner_live(bi, n_blocks, live) != pid:
+                continue
+            computed = True
+            mem[bi] = compute_stripe(bi, epoch=hb.epoch)
+            shard_of[bi] = os.path.join(checkpoint_dir, _shard_name(bi, hb.epoch))
+            missing.remove(bi)
+            if hb.maybe_check():
+                break  # epoch bumped mid-pass: re-deal promptly
+        if not missing and not done_written:
+            # publish completion + honest pairs BEFORE anyone could see
+            # this process's beats stop: a done-note peer is never dead.
+            # (Once published this is final: the note only exists when
+            # EVERY stripe has a shard, so no later death can reopen
+            # compute work in this wait loop.)
+            hb.mark_done(own_pairs())
+            done_written = True
+        waiting = (
+            []
+            if missing
+            else [p for p in hb.live if p != pid and not hb.peer_finished(p)]
+        )
+        sig = (len(missing), tuple(hb.live), len(waiting))
+        if computed or sig != progress_sig:
+            progress_sig = sig
+            last_progress = time.time()
+        if not missing and not waiting:
+            break
+        if hb.maybe_check():  # cadence-gated: detection latency is the
+            continue  # miss window anyway; deaths re-deal with no sleep
+        if time.time() - last_progress > stall_budget:
+            raise CollectiveTimeout(
+                f"streaming elastic completion stalled for {stall_budget:.0f}s: "
+                f"stripe(s) {missing[:8]}{'...' if len(missing) > 8 else ''} "
+                f"unfinished, waiting on process(es) {waiting} of live set "
+                f"{hb.live} whose heartbeats are still fresh — a peer is "
+                f"wedged, not dead. Restart the pod; shard-level checkpoints "
+                f"will resume finished work. (Timeout via "
+                f"DREP_TPU_COLLECTIVE_TIMEOUT_S; heartbeat cadence via "
+                f"DREP_TPU_HEARTBEAT_S.)"
+            )
+        if not computed:
+            # pure wait (no owned work): still-missing stripes are
+            # re-probed once per tick, so the tick scales with the
+            # heartbeat cadence to bound shared-FS metadata traffic while
+            # the slowest peer computes
+            time.sleep(min(5.0, max(0.05, hb.cadence)))
+
+    # canonical assembly: own computed stripes from memory, the rest from
+    # the shard store. A shard that reads corrupt (disk trouble) — or
+    # vanishes because a peer is healing the same corruption — is
+    # recomputed locally INTO ITS OWN PATH (idempotent; heals even when
+    # the remove fails) and assembly restarts.
+    healed = False
+    while True:
+        all_ii: list[np.ndarray] = []
+        all_jj: list[np.ndarray] = []
+        all_dd: list[np.ndarray] = []
+        bad = None  # (bi, corrupt path | None when a peer removed it)
+        for p in range(pc):
+            for bi in range(n_blocks):
+                if stripe_owner(bi, n_blocks, pc) != p:
+                    continue
+                if bi in mem:
+                    s_ii, s_jj, s_dd = mem[bi]
+                else:
+                    path = shard_of.get(bi) or _find_shard(checkpoint_dir, bi)
+                    if path is None:
+                        bad = (bi, None)
+                        break
+                    loaded = _load_shard(path)  # warns + removes on corrupt
+                    if loaded is None:
+                        bad = (bi, path)
+                        break
+                    s_ii, s_jj, s_dd = loaded
+                all_ii.append(s_ii)
+                all_jj.append(s_jj)
+                all_dd.append(s_dd)
+            if bad is not None:
+                break
+        if bad is None:
+            break
+        bi_bad, path_bad = bad
+        shard_of.pop(bi_bad, None)
+        # recompute INTO the corrupt shard's own path (heals even when its
+        # remove failed); a vanished path means a peer is healing it —
+        # recompute too, idempotently, at the current epoch
+        heal_epoch = _shard_epoch(path_bad) if path_bad is not None else hb.epoch
+        mem[bi_bad] = compute_stripe(bi_bad, epoch=heal_epoch)
+        shard_of[bi_bad] = os.path.join(
+            checkpoint_dir, _shard_name(bi_bad, heal_epoch)
+        )
+        healed = True
+
+    if healed:
+        # healing dispatched pairs AFTER the done-note was published —
+        # refresh it so every survivor's pairs total converges on the
+        # same numbers (peers that already summed keep the smaller count:
+        # best-effort honesty, never an overcount)
+        hb.mark_done(own_pairs())
+
+    if hb.epoch > 0 and pid == min(hb.live):
+        # the lowest live process stamps degradation provenance into the
+        # store's meta: a later resume sees HOW these shards were produced
+        # (extra keys never invalidate the subset meta match)
+        from drep_tpu.utils.ckptmeta import stamp_checkpoint_meta
+
+        stamp_checkpoint_meta(
+            checkpoint_dir,
+            {"pod_epochs": hb.epoch + 1, "dead_processes": hb.dead},
+        )
+    if hb.epoch > 0:
         logger.warning(
-            "streaming primary: finished with device slot(s) %s quarantined "
-            "(of %d local devices) — see fault_tolerance counters",
-            ft.quarantined(), len(devices),
+            "streaming primary: completed DEGRADED — pod member(s) %s died "
+            "mid-stage; survivors %s finished their stripes across %d "
+            "ownership epoch(s)",
+            hb.dead, hb.live, hb.epoch + 1,
         )
-    if tiles_full:
-        from drep_tpu.utils.profiling import counters
-
-        counters.add_tiles("primary_compare", computed=tiles_done, total=tiles_full)
-    ii = np.concatenate(all_ii) if all_ii else np.empty(0, np.int64)
-    jj = np.concatenate(all_jj) if all_jj else np.empty(0, np.int64)
-    dd = np.concatenate(all_dd) if all_dd else np.empty(0, np.float32)
-    if pc > 1:
-        ii, jj, dd, pairs_computed = _allgather_edges(ii, jj, dd, pairs_computed)
-    return ii, jj, dd, pairs_computed
+    # survivor-set total: own dispatched pairs + every CURRENT done-note's
+    # (a member that died mid-stage takes its uncheckpointed pair count
+    # with it — the counter stays honest about who computed; previous-call
+    # notes never count)
+    pairs_total = own_pairs() + sum(
+        int((hb.done_payload(p) or {}).get("pairs", 0))
+        for p in range(pc) if p != pid
+    )
+    return all_ii, all_jj, all_dd, pairs_total
 
 
 def _cpu_fallback_tile(

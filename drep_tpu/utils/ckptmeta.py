@@ -113,21 +113,29 @@ def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tup
     resumable). Otherwise clears stale shards (files ending in any of
     `clear_suffixes`, plus the meta) and atomically writes the new meta.
 
-    Multi-process runs (shared checkpoint dir on a pod): only process 0
-    clears stale shards / rewrites the meta; peers wait on a barrier and
-    then open against the now-matching meta, so the remove loop never runs
-    concurrently. Callers must invoke this in replicated control flow on
-    every process (true for both shard stores — streaming row blocks and
-    secondary per-cluster results).
+    Multi-process runs (shared checkpoint dir on a pod): only one leader
+    process clears stale shards / rewrites the meta; peers wait on a
+    barrier and then open against the now-matching meta, so the remove
+    loop never runs concurrently. The leader is process 0 on a healthy
+    pod, the lowest LIVE process once the elastic protocol has declared a
+    member dead (parallel/faulttol.py pod state) — a dead process 0 must
+    not leave every later checkpoint-store open waiting on it. Callers
+    must invoke this in replicated control flow on every (live) process
+    (true for both shard stores — streaming row blocks and secondary
+    per-cluster results).
     """
     import jax
 
     if jax.process_count() > 1:
+        from drep_tpu.parallel.faulttol import pod_live
+
+        live = pod_live()
+        leader = 0 if live is None else min(live)
         resume = False
-        if jax.process_index() == 0:
+        if jax.process_index() == leader:
             resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
         barrier_with_timeout("drep_tpu_ckpt_open:" + os.path.abspath(ckpt_dir), ckpt_dir)
-        if jax.process_index() != 0:
+        if jax.process_index() != leader:
             resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
         return resume
     return _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
@@ -157,16 +165,27 @@ def barrier_with_timeout(tag: str, note_dir: str) -> None:
     itself cannot say). Note names start with ``.barrier-`` and end in a
     process suffix, so shard-store resume globs (``*.npz``) and
     ``clear_suffixes`` scans never see them.
+
+    On a DEGRADED pod (the elastic protocol declared a member dead —
+    parallel/faulttol.py pod state) the jax collective is unusable: it
+    spans the full original pod and would wait on the corpse. The same
+    sentinel notes then BECOME the barrier — each survivor publishes its
+    sequence number and polls for every live peer's, with the collective
+    timeout bounding the wait (:func:`_file_barrier`).
     """
     import jax
     from jax.experimental import multihost_utils as mhu
 
-    from drep_tpu.parallel.faulttol import run_with_timeout
+    from drep_tpu.parallel.faulttol import pod_live, run_with_timeout
 
     pid, pc = jax.process_index(), jax.process_count()
     seq = _BARRIER_SEQ.get(tag, 0) + 1
     _BARRIER_SEQ[tag] = seq
     os.makedirs(note_dir, exist_ok=True)
+    live = pod_live()
+    if live is not None:
+        _file_barrier(tag, note_dir, live, pid, seq)
+        return
     atomic_write_bytes(_barrier_note(note_dir, tag, pid), str(seq).encode())
 
     def diagnose() -> str:
@@ -207,8 +226,82 @@ def barrier_with_timeout(tag: str, note_dir: str) -> None:
             os.remove(_barrier_note(note_dir, tag, pid))
 
 
+def _file_barrier(tag: str, note_dir: str, live: list[int], pid: int, seq: int) -> None:
+    """Sentinel-note barrier over the SURVIVOR set of a degraded pod.
+
+    Each live process atomically publishes its per-tag sequence number and
+    polls for every live peer's note to reach that sequence. Notes are
+    not removed by the barrier itself (the sequence is monotone under
+    replicated control flow, so barrier k's note satisfies any waiter at
+    <= k); a peer's note counts once SEEN — a process deletes its barrier
+    notes only at a later stage's heartbeat start, i.e. strictly after
+    passing this barrier, so a vanished-after-seen note means the peer
+    already arrived. A previous run's stale notes are rejected two ways:
+    each process deletes its own at heartbeat start (pre-barrier), and
+    nothing with an mtime older than this run's heartbeat stage
+    (faulttol.pod_t0, minus a clock-skew margin) can satisfy the wait."""
+    import time
+
+    from drep_tpu.parallel.faulttol import CollectiveTimeout, collective_timeout_s, pod_t0
+
+    atomic_write_bytes(_barrier_note(note_dir, tag, pid), str(seq).encode())
+    fresh_after = pod_t0() - 60.0
+    timeout = collective_timeout_s()
+    deadline = time.time() + timeout if timeout > 0 else None
+    seen: set[int] = set()
+    while True:
+        missing = []
+        for p in live:
+            if p == pid or p in seen:
+                continue
+            loc = _barrier_note(note_dir, tag, p)
+            try:
+                st = os.stat(loc)
+                with open(loc) as f:
+                    ok = int(f.read().strip()) >= seq and st.st_mtime >= fresh_after
+            except (OSError, ValueError):
+                ok = False
+            if ok:
+                seen.add(p)
+            else:
+                missing.append(p)
+        if not missing:
+            return
+        if deadline is not None and time.time() > deadline:
+            raise CollectiveTimeout(
+                f"degraded-pod file barrier {tag!r}: live process(es) {missing} "
+                f"of survivor set {live} never arrived within {timeout:.0f}s — "
+                f"a second failure after the epoch bump. Restart the pod; "
+                f"shard-level checkpoints will resume finished work."
+            )
+        # cadence-scaled poll (same backoff as the elastic wait loop): a
+        # slow peer can take minutes, and a 20 Hz stat+read per peer
+        # would hammer the very shared FS this protocol defends against
+        from drep_tpu.parallel.faulttol import heartbeat_cadence_s
+
+        time.sleep(min(1.0, max(0.05, heartbeat_cadence_s() / 5)))
+
+
+# the ONLY stored-meta keys a resume is allowed to ignore: pure
+# provenance stamped after the fact (stamp_checkpoint_meta), describing
+# HOW shards were produced, never WHAT they were computed from. Any other
+# unexpected stored key means the store was written by code pinning
+# something this version does not — resuming would silently accept shards
+# computed under a different contract, so it must invalidate.
+META_PROVENANCE_KEYS = ("pod_epochs", "dead_processes")
+
+
 def checkpoint_meta_matches(ckpt_dir: str, meta: dict[str, Any]) -> bool:
-    """Read-only probe: does `ckpt_dir` hold a meta equal to `meta`?
+    """Read-only probe: does `ckpt_dir` hold a meta equal to `meta`, up
+    to the known provenance keys?
+
+    Every EXPECTED key must be present with an equal value, and the
+    stored meta may carry nothing extra beyond ``META_PROVENANCE_KEYS`` —
+    the elastic streaming path stamps degradation provenance
+    (``pod_epochs``, ``dead_processes``) into a completed store's meta,
+    and that record must not invalidate a later resume of the very shards
+    it describes; every other extra key invalidates exactly as strict
+    equality did.
 
     Unlike open_checkpoint_dir this never creates the directory, clears
     shards, or writes a meta — safe for pre-checks that only want to know
@@ -222,7 +315,29 @@ def checkpoint_meta_matches(ckpt_dir: str, meta: dict[str, Any]) -> bool:
             stored = json.load(f)
     except Exception:
         return False  # corrupt meta -> not resumable
-    return stored == meta
+    if not isinstance(stored, dict):
+        return False
+    if set(stored) - set(meta) - set(META_PROVENANCE_KEYS):
+        return False  # pinned under keys this version does not know
+    return all(stored.get(k) == v for k, v in meta.items())
+
+
+def stamp_checkpoint_meta(ckpt_dir: str, extra: dict[str, Any]) -> None:
+    """Merge provenance keys into an existing meta.json (read-modify-
+    atomic-write). Best-effort: a completed stage must never die on its
+    own bookkeeping — failures log and return."""
+    loc = os.path.join(ckpt_dir, META_NAME)
+    try:
+        with open(loc) as f:
+            stored = json.load(f)
+        if not isinstance(stored, dict):
+            raise ValueError(f"meta at {loc} is not a dict")
+        stored.update(extra)
+        atomic_write_bytes(loc, json.dumps(stored, sort_keys=True, default=str).encode())
+    except Exception as e:  # noqa: BLE001
+        from drep_tpu.utils.logger import get_logger
+
+        get_logger().warning("could not stamp checkpoint meta %s with %s: %s", loc, extra, e)
 
 
 def _open_checkpoint_dir_local(

@@ -27,7 +27,15 @@ Spec syntax (``DREP_TPU_FAULTS`` env var, or :func:`configure`)::
   per-rule ``random.Random(seed)`` stream, so runs are reproducible.
 - ``key=value`` — ``seed=N`` (default 0), ``secs=F`` (sleep duration),
   ``device=N`` (fire only when the caller reports that device slot),
-  ``max=N`` (stop after N fires — e.g. tear exactly two shards).
+  ``max=N`` (stop after N fires — e.g. tear exactly two shards),
+  ``proc=N`` (fire only on jax process N of a pod — one spec can be
+  shared by every pod member), ``skip=N`` (ignore the first N matching
+  calls — e.g. let a process finish two stripes before killing it).
+
+The ``kill`` mode (``process_death`` site) SIGKILLs the calling process —
+the pod-member death the elastic streaming protocol survives, made
+deterministic for chaos tests (indistinguishable from an external
+SIGKILL: no cleanup, no atexit, heartbeats simply stop).
 
 Zero overhead when unset: the spec parses once (lazily, from the env);
 every :func:`fire` call thereafter is a no-op behind one falsy check.
@@ -52,9 +60,10 @@ SITES = (
     "shard_write",  # atomic shard publish, utils/ckptmeta.py (torn)
     "allgather",  # multi-host edge allgather, parallel/streaming.py
     "barrier",  # checkpoint-dir open barrier, utils/ckptmeta.py
+    "process_death",  # per-stripe suicide point, parallel/streaming.py (kill)
 )
 
-MODES = ("raise", "hang", "sleep", "torn")
+MODES = ("raise", "hang", "sleep", "torn", "kill")
 
 
 class InjectedFault(RuntimeError):
@@ -75,8 +84,11 @@ class _Rule:
     seed: int = 0
     secs: float | None = None
     device: int | None = None
+    proc: int | None = None
+    skip: int = 0
     max_fires: int | None = None
     fired: int = 0
+    seen: int = 0
     rng: random.Random = field(init=False)
 
     def __post_init__(self) -> None:
@@ -86,6 +98,14 @@ class _Rule:
         if self.max_fires is not None and self.fired >= self.max_fires:
             return False
         if self.device is not None and device != self.device:
+            return False
+        if self.proc is not None:
+            import jax  # lazy: the registry must import without a backend
+
+            if jax.process_index() != self.proc:
+                return False
+        self.seen += 1
+        if self.seen <= self.skip:
             return False
         # draw unconditionally so the stream position depends only on the
         # number of matching calls, not on earlier rules' outcomes
@@ -113,6 +133,10 @@ def _parse(spec: str) -> dict[str, list[_Rule]]:
                     rule.secs = float(val)
                 elif key == "device":
                     rule.device = int(val)
+                elif key == "proc":
+                    rule.proc = int(val)
+                elif key == "skip":
+                    rule.skip = int(val)
                 elif key == "max":
                     rule.max_fires = int(val)
                 else:
@@ -182,6 +206,13 @@ def fire(site: str, device: int | None = None) -> None:
             raise InjectedFault(f"injected hang at {site} woke up (device={device})")
         if rule.mode == "sleep":
             time.sleep(0.05 if rule.secs is None else rule.secs)
+        if rule.mode == "kill":
+            # SIGKILL self: the chaos-test stand-in for a pod member dying
+            # (preemption, OOM-kill, host loss) — no cleanup runs, exactly
+            # like the real event. Counters die with the process.
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
         # 'torn' rules are polled via torn_write(), never fired here
 
 

@@ -48,6 +48,11 @@ class Counters:
     # 40 retries or benched a chip is not the same measurement as a clean
     # one, and bench records must be able to tell them apart.
     faults: dict[str, int] = field(default_factory=dict)
+    # derived operational values (not event counts): e.g. the auto-derived
+    # per-dispatch watchdog deadline the run actually used when
+    # --dispatch_timeout was left at 0 (parallel/faulttol.py) — reported so
+    # an operator can pin an explicit value from evidence.
+    gauges: dict[str, float] = field(default_factory=dict)
 
     @contextlib.contextmanager
     def stage(self, name: str, pairs: int = 0) -> Iterator[None]:
@@ -79,8 +84,13 @@ class Counters:
 
     def add_fault(self, kind: str, n: int = 1) -> None:
         """Count one fault-tolerance event (retry, watchdog trip, device
-        quarantine, CPU-fallback tile, or an injected fault firing)."""
+        quarantine, CPU-fallback tile, pod-member death, or an injected
+        fault firing)."""
         self.faults[kind] = self.faults.get(kind, 0) + int(n)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Record a derived operational value (last write wins)."""
+        self.gauges[name] = float(value)
 
     def report(self) -> dict[str, Any]:
         import jax
@@ -113,6 +123,8 @@ class Counters:
         }
         if self.faults:
             out["fault_tolerance"] = dict(sorted(self.faults.items()))
+        if self.gauges:
+            out["gauges"] = dict(sorted(self.gauges.items()))
         return out
 
     def write(self, log_dir: str) -> str:
@@ -124,6 +136,7 @@ class Counters:
     def reset(self) -> None:
         self.stages.clear()
         self.faults.clear()
+        self.gauges.clear()
 
 
 counters = Counters()  # the process-global instance used by the pipeline

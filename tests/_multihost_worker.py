@@ -42,13 +42,36 @@ def main() -> None:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except (AttributeError, ValueError):
         pass
-    jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+    init_kwargs = {}
+    if mode == "elastic":
+        # the elastic case kills a pod member ON PURPOSE: the jax
+        # coordination service's own death detection must stay far beyond
+        # the test horizon, or it broadcasts the death as a fatal error
+        # and the client layer abort()s the very survivors under test
+        # (client.h: "Terminating process..."). The repo's heartbeat
+        # protocol is the detector being exercised, not jax's.
+        init_kwargs = dict(
+            service_heartbeat_interval_seconds=10,
+            service_max_missing_heartbeats=600,
+        )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc, process_id=pid,
+            **init_kwargs,
+        )
+    except TypeError:  # newer jax dropped the heartbeat kwargs
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nproc, process_id=pid
+        )
     assert jax.process_count() == nproc, jax.process_count()
     assert len(jax.devices()) == 2 * nproc, jax.devices()
     assert len(jax.local_devices()) == 2
 
     if mode == "barrier_timeout":
         _barrier_timeout_case(pid, nproc, outdir)
+        return
+    if mode == "elastic":
+        _elastic_case(pid, nproc, outdir, sys.argv[6])
         return
 
     from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
@@ -236,6 +259,89 @@ def _barrier_timeout_case(pid: int, nproc: int, outdir: str) -> None:
         # distributed client — exit hard, the ok-file is the verdict
         os._exit(0)
     raise AssertionError("open_checkpoint_dir returned despite a dead peer")
+
+
+# --- elastic pod: epoch-coordinated stripe re-assignment ------------------
+
+# 9 row blocks at block 8: under the mirror-paired epoch-0 deal over 3
+# processes, p0 owns {0,3,5,8}, p1 owns {1,4,7}, p2 owns {2,6} — killing
+# p1 at its SECOND stripe leaves one finished shard (stripe 1, the
+# survivors must reuse it) and two unfinished stripes (4, 7) that re-deal
+# one to each survivor under live=[0, 2].
+ELASTIC_N, ELASTIC_S, ELASTIC_BLOCK = 72, 64, 8
+
+
+def _elastic_packed():
+    """Deterministic group-structured sketches, identical in every process
+    (the replicated-ingest contract the stripe deal assumes)."""
+    from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+
+    rng = np.random.default_rng(5)
+    ids = np.full((ELASTIC_N, ELASTIC_S), PAD_ID, dtype=np.int32)
+    counts = np.full(ELASTIC_N, ELASTIC_S, dtype=np.int32)
+    pools = [
+        np.sort(rng.choice(2**20, size=ELASTIC_S * 2, replace=False).astype(np.int32))
+        for _ in range(5)
+    ]
+    for i in range(ELASTIC_N):
+        ids[i] = np.sort(rng.choice(pools[i % 5], size=ELASTIC_S, replace=False))
+    return PackedSketches(
+        ids=ids, counts=counts, names=[f"g{i}" for i in range(ELASTIC_N)]
+    )
+
+
+def _elastic_case(pid: int, nproc: int, outdir: str, ckpt_dir: str) -> None:
+    """One checkpointed streaming edge pass under the elastic-pod protocol
+    (heartbeat cadence from the parent's DREP_TPU_HEARTBEAT_S env; the
+    killed run's parent also installs a process_death:kill fault on one
+    member). Publishes this process's final edges + fault counters for
+    the parent to compare bit-for-bit against the healthy pod."""
+    import json
+
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils.ckptmeta import open_checkpoint_dir
+    from drep_tpu.utils.profiling import counters
+
+    packed = _elastic_packed()
+    ii, jj, dd, pairs = streaming_mash_edges(
+        packed, k=21, cutoff=0.2, block=ELASTIC_BLOCK, checkpoint_dir=ckpt_dir
+    )
+    # degraded-pod plumbing downstream of the streaming stage: the next
+    # checkpoint-store open (the secondary loop's shape) must coordinate
+    # over the survivor set — file barrier, lowest-live leader — instead
+    # of hanging on the dead member until the collective timeout
+    open_checkpoint_dir(
+        os.path.join(outdir, "sec_store"), {"probe": 1}, clear_suffixes=(".npz",)
+    )
+    np.savez(
+        os.path.join(outdir, f"edges_{pid}.npz"), ii=ii, jj=jj, dd=dd, pairs=pairs
+    )
+    with open(os.path.join(outdir, f"counters_{pid}.json"), "w") as f:
+        json.dump(counters.faults, f)
+    with open(os.path.join(outdir, f"ok_{pid}"), "w") as f:
+        f.write("ok")
+    if pid == 0:
+        # process 0 hosts the jax coordination service: it must exit LAST,
+        # or every still-running peer's error poll sees the service socket
+        # close and abort()s. Wait for the ok-file of every process the
+        # pod still believes alive, then linger past their write->exit
+        # window.
+        import time
+
+        from drep_tpu.parallel.faulttol import pod_dead
+
+        want = [p for p in range(nproc) if p != 0 and p not in set(pod_dead())]
+        deadline = time.time() + 120
+        while time.time() < deadline and not all(
+            os.path.exists(os.path.join(outdir, f"ok_{p}")) for p in want
+        ):
+            time.sleep(0.05)
+        time.sleep(1.0)
+    # a killed peer leaves the jax coordination service in an error state;
+    # interpreter teardown can wedge on the distributed client — exit
+    # hard, the ok-file + artifacts are the verdict (same pattern as the
+    # barrier-timeout case)
+    os._exit(0)
 
 
 INGEST_N = 12

@@ -108,7 +108,15 @@ def test_scale_workdir_survives_sigkill_and_warm_starts(tmp_path):
     deadline = time.time() + 600
     killed = False
     while time.time() < deadline and p.poll() is None:
-        if len([f for f in os.listdir(shard_dir)] if os.path.isdir(shard_dir) else []) > 1:
+        # count actual row-block shards, not directory entries: the store
+        # also holds meta.json and heartbeat/sentinel notes, which would
+        # trip the kill before any shard exists (warm start impossible)
+        shards_now = (
+            [f for f in os.listdir(shard_dir) if f.startswith("row_") and f.endswith(".npz")]
+            if os.path.isdir(shard_dir)
+            else []
+        )
+        if len(shards_now) >= 1:
             p.send_signal(signal.SIGKILL)
             killed = True
             break

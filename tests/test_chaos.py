@@ -14,6 +14,7 @@ Everything here is seconds-scale and tier-1 (marker `chaos`); the
 multi-host dead-peer case lives in test_multihost.py (same marker).
 """
 
+import json
 import logging
 import os
 import signal
@@ -27,8 +28,13 @@ import pytest
 
 import _chaos_worker as cw
 from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+from drep_tpu.parallel import faulttol
 from drep_tpu.parallel.faulttol import FaultTolConfig, FaultTolError
-from drep_tpu.parallel.streaming import streaming_mash_edges, stripe_owner
+from drep_tpu.parallel.streaming import (
+    streaming_mash_edges,
+    stripe_owner,
+    stripe_owner_live,
+)
 from drep_tpu.utils import faults
 from drep_tpu.utils.logger import get_logger
 from drep_tpu.utils.profiling import counters
@@ -41,13 +47,18 @@ pytestmark = pytest.mark.chaos
 
 @pytest.fixture(autouse=True)
 def _clean_faults():
-    """Every test starts and ends with injection disabled and counters
-    clean — a leaked spec would poison the rest of the suite."""
+    """Every test starts and ends with injection disabled, counters clean,
+    and the elastic pod state healthy — a leaked spec or a unit-test
+    'degraded pod' would poison the rest of the suite."""
     faults.configure(None)
     counters.reset()
+    faulttol.reset_pod()
+    faulttol._HB_SEQ.clear()
     yield
     faults.configure(None)
     counters.reset()
+    faulttol.reset_pod()
+    faulttol._HB_SEQ.clear()
 
 
 @contextmanager
@@ -189,6 +200,9 @@ def test_single_bad_device_is_quarantined_and_run_completes():
     _assert_edges_equal(got, want)
     assert counters.faults.get("quarantined_devices", 0) >= 1
     assert counters.faults.get("retries", 0) > 0
+    # the benched device's resident pack copy must be freed the moment it
+    # is quarantined (ROADMAP follow-up): ids + counts buffers dropped
+    assert counters.faults.get("pack_buffers_freed", 0) >= 2
     assert any("quarantining device slot 1" in r.getMessage() for r in records)
     assert any("finished with device slot(s) [1] quarantined" in r.getMessage() for r in records)
 
@@ -326,3 +340,375 @@ def test_resume_log_reports_owned_stripes(tmp_path):
         streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
     msgs = [r.getMessage() for r in records]
     assert any("resumed 6/6 owned row-block shards (process 0/1)" in m for m in msgs), msgs
+
+
+# --- elastic pod: epoch-scoped ownership + note lifecycle ----------------
+# (the 3-process SIGKILL end-to-end case lives in test_multihost.py)
+
+
+def test_stripe_owner_live_redeal_balances_and_partitions():
+    """The epoch-scoped deal must (a) reduce to the healthy stripe_owner
+    on the full live list and (b) keep the mirror-pair balance bound over
+    ANY survivor subset — the re-deal after a death is as balanced as the
+    original deal over the remaining processes."""
+    for n_blocks in (9, 16, 40):
+        for pc in (1, 2, 3, 4):
+            live = list(range(pc))
+            for bi in range(n_blocks):
+                assert stripe_owner(bi, n_blocks, pc) == stripe_owner_live(
+                    bi, n_blocks, live
+                )
+        for live in ([0, 2], [1, 3, 5], [2], [0, 1, 3]):
+            loads = {p: 0 for p in live}
+            for bi in range(n_blocks):
+                o = stripe_owner_live(bi, n_blocks, live)
+                assert o in live  # every stripe owned by a survivor
+                loads[o] += n_blocks - bi
+            assert max(loads.values()) - min(loads.values()) <= n_blocks + 1, (
+                n_blocks, live, loads,
+            )
+            assert sum(loads.values()) == n_blocks * (n_blocks + 1) // 2
+
+
+def test_heartbeat_note_lifecycle(tmp_path):
+    """The note protocol itself, single-process with planted peers: beats
+    appear, stale peers die (epoch bump + honest counters), done-notes
+    immunize however stale the beat, max_dead aborts, close removes the
+    beat but leaves the done-note, and a NEW run's start() cleans this
+    process's stale notes — a crashed-then-restarted pod must never
+    diagnose a previous run's state."""
+    from drep_tpu.parallel.faulttol import HeartbeatManager
+
+    d = str(tmp_path)
+    hb = HeartbeatManager(d, cadence=0.1, max_dead=1, pc=3, pid=0)
+    hb.start()
+    try:
+        assert os.path.exists(hb.beat_path(0))
+        for p in (1, 2):
+            with open(hb.beat_path(p), "w") as f:
+                f.write("1")
+        assert hb.check() is False
+        assert hb.live == [0, 1, 2] and hb.epoch == 0
+
+        old = time.time() - 60
+        os.utime(hb.beat_path(1), (old, old))
+        # staleness must be CONFIRMED across a cadence before the verdict
+        # (one transient failed stat must never fence a healthy member)
+        assert hb.check() is False
+        time.sleep(0.25)
+        assert hb.check() is True
+        assert hb.live == [0, 2] and hb.dead == [1] and hb.epoch == 1
+        assert counters.faults["dead_processes"] == 1
+        assert counters.faults["pod_epoch_bumps"] == 1
+        assert faulttol.pod_live() == [0, 2]  # published for barrier routing
+
+        # a peer with a CURRENT done-note is finished, never dead
+        with open(hb.done_path(2), "w") as f:
+            f.write('{"pairs": 5, "epoch": 1, "seq": 1}')
+        os.utime(hb.beat_path(2), (old, old))
+        assert hb.check() is False
+        assert hb.live == [0, 2]
+        assert hb.peer_finished(2) and hb.done_payload(2)["pairs"] == 5
+        # a PREVIOUS call's leftover note does not count as finished...
+        with open(hb.done_path(2), "w") as f:
+            f.write('{"pairs": 5, "epoch": 0, "seq": 0}')
+        assert not hb.peer_finished(2)
+        # ...a racing-ahead peer's NEXT-call note does (it finished ours)
+        with open(hb.done_path(2), "w") as f:
+            f.write('{"pairs": 0, "epoch": 0, "seq": 2}')
+        assert hb.peer_finished(2)
+        with open(hb.done_path(2), "w") as f:
+            f.write('{"pairs": 5, "epoch": 1, "seq": 1}')
+
+        # a second death exceeds max_dead=1: abort, not silent shrink
+        os.remove(hb.done_path(2))
+        hb.check()  # first observation only suspects
+        time.sleep(0.25)
+        with pytest.raises(FaultTolError, match="max_dead_processes"):
+            hb.check()
+
+        hb.mark_done(7)
+        with open(hb.done_path(0)) as f:
+            assert json.load(f)["pairs"] == 7
+    finally:
+        hb.close()
+    assert not os.path.exists(hb.beat_path(0))  # close removes the beat
+    assert os.path.exists(hb.done_path(0))  # done-note stays for peers
+
+    # a LATER call of the same run keeps the previous call's note (a peer
+    # may still be consuming it — deleting it deadlocked real pods) and
+    # ignores it as not-current
+    faulttol.reset_pod()
+    hb2 = HeartbeatManager(d, cadence=0.1, max_dead=1, pc=3, pid=0)
+    hb2.start()
+    try:
+        assert hb2.seq == 2
+        assert os.path.exists(hb2.done_path(0)), (
+            "an earlier call's own done-note must survive start()"
+        )
+        assert not hb2.peer_finished(0)  # but it is not current
+    finally:
+        hb2.close()
+
+    # a RESTARTED process (fresh sequence counter) clears its previous
+    # incarnation's note at start, so a crashed-then-restarted pod never
+    # trusts previous-run state
+    faulttol.reset_pod()
+    faulttol._HB_SEQ.clear()  # what a process restart does implicitly
+    hb3 = HeartbeatManager(d, cadence=0.1, max_dead=1, pc=3, pid=0)
+    hb3.start()
+    try:
+        assert hb3.seq == 1
+        assert not os.path.exists(hb3.done_path(0)), (
+            "start() must clean the previous incarnation's done-note"
+        )
+        assert os.path.exists(hb3.beat_path(0))
+    finally:
+        hb3.close()
+
+
+def test_death_verdicts_converge_and_fence(tmp_path):
+    """The first detector PUBLISHES its death verdict as a sentinel note;
+    peers adopt it (the survivor view converges even when their own view
+    of the beat mtimes disagrees — NFS attribute caching), and the
+    subject itself fences on a verdict naming it instead of continuing
+    as a zombie. A restarted process clears its stale verdict at start."""
+    from drep_tpu.parallel.faulttol import HeartbeatManager
+
+    d = str(tmp_path)
+    a = HeartbeatManager(d, cadence=0.1, max_dead=2, pc=3, pid=0)
+    a.start()
+    b = HeartbeatManager(d, cadence=0.1, max_dead=2, pc=3, pid=2)
+    b.start()
+    try:
+        for p in (1, 2):
+            with open(a.beat_path(p), "w") as f:
+                f.write("1")
+        old = time.time() - 60
+        os.utime(a.beat_path(1), (old, old))
+        assert a.check() is False  # suspected, not yet confirmed
+        time.sleep(0.25)
+        assert a.check() is True
+        assert os.path.exists(a.verdict_path(1))  # verdict published
+        # B's own view of 1's beat is FRESH — it adopts A's verdict anyway
+        with open(b.beat_path(1), "w") as f:
+            f.write("2")
+        assert b.check() is True
+        assert b.live == [0, 2] and b.dead == [1]
+        # the subject fences on a verdict naming itself (mid-run check)
+        c = HeartbeatManager(d, cadence=0.1, max_dead=2, pc=3, pid=1)
+        with pytest.raises(FaultTolError, match="fencing"):
+            c.check()
+        # restart path: start() clears the previous incarnation's verdict
+        faulttol._HB_SEQ.clear()
+        c2 = HeartbeatManager(d, cadence=0.1, max_dead=2, pc=3, pid=1)
+        c2.start()
+        try:
+            assert not os.path.exists(c2.verdict_path(1))
+            c2.check()  # no fence, no deaths
+        finally:
+            c2.close()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_heartbeat_start_inherits_degraded_pod(tmp_path):
+    """A heartbeat-managed stage starting on an ALREADY-degraded pod
+    (e.g. the resume leg of a run whose first leg lost a member) must
+    keep the survivor view — resetting to the full pod would route its
+    barriers over the corpse."""
+    from drep_tpu.parallel.faulttol import HeartbeatManager, mark_pod_degraded
+
+    mark_pod_degraded(1, [0, 2], [1])
+    faulttol._POD["t0"] = time.time() - 5
+    hb = HeartbeatManager(str(tmp_path), cadence=0.1, max_dead=2, pc=3, pid=0)
+    hb.start()
+    try:
+        assert hb.live == [0, 2] and hb.dead == [1] and hb.epoch == 1
+        assert faulttol.pod_live() == [0, 2]
+    finally:
+        hb.close()
+
+
+def test_auto_dispatch_timeout_derivation():
+    """--dispatch_timeout 0 + auto: the executor derives the watchdog from
+    its own finalize-wait latencies (warmup-excluded, floored); explicit
+    positive values stay authoritative; nothing trips on a healthy run."""
+    import jax
+    import jax.numpy as jnp
+
+    from drep_tpu.parallel.faulttol import (
+        AUTO_TIMEOUT_FLOOR_S,
+        AUTO_TIMEOUT_WARMUP,
+        AUTO_TIMEOUT_WARMUP_CAP_S,
+        TileExecutor,
+    )
+
+    ft = TileExecutor(jax.local_devices()[:1], FaultTolConfig(auto_timeout=True))
+    assert ft.derived_timeout_s() is None  # still warming up — nothing
+    # derived yet, but NOT unprotected: an early wedge runs under the cap
+    assert ft._effective_timeout() == AUTO_TIMEOUT_WARMUP_CAP_S
+    for _ in range(AUTO_TIMEOUT_WARMUP + 8):
+        ft.finalize(ft.submit(lambda slot: jnp.zeros(())))
+    # pipelined waits are ~0 ms -> the floor IS the derived deadline
+    assert ft.derived_timeout_s() == AUTO_TIMEOUT_FLOOR_S
+    assert counters.faults.get("watchdog_trips", 0) == 0
+
+    ft2 = TileExecutor(
+        jax.local_devices()[:1],
+        FaultTolConfig(dispatch_timeout_s=0.5, auto_timeout=True),
+    )
+    assert ft2.derived_timeout_s() is None  # explicit value governs
+    assert ft2._effective_timeout() == 0.5
+
+    ft3 = TileExecutor(jax.local_devices()[:1], FaultTolConfig())  # auto off
+    assert ft3._effective_timeout() == 0.0 and ft3.derived_timeout_s() is None
+
+
+def test_streaming_reports_derived_watchdog_gauge():
+    packed = _packed()
+    streaming_mash_edges(
+        packed, k=21, cutoff=0.2, block=8,
+        ft_config=FaultTolConfig(auto_timeout=True),
+    )
+    from drep_tpu.parallel.faulttol import AUTO_TIMEOUT_FLOOR_S
+
+    assert counters.gauges.get("derived_dispatch_timeout_s", 0) >= AUTO_TIMEOUT_FLOOR_S
+    assert counters.faults.get("watchdog_trips", 0) == 0
+    assert counters.report()["gauges"]["derived_dispatch_timeout_s"] >= AUTO_TIMEOUT_FLOOR_S
+
+
+def test_quarantine_invokes_free_callback():
+    """The executor must tell its caller WHICH slot was benched, exactly
+    once, so per-slot device-resident operands can be freed."""
+    import jax.numpy as jnp
+
+    from drep_tpu.parallel.faulttol import TileExecutor
+
+    freed: list[int] = []
+
+    def compute(slot):
+        if slot == 0:
+            raise RuntimeError("boom")
+        return jnp.zeros(())
+
+    ft = TileExecutor(
+        [object(), object()],
+        FaultTolConfig(max_retries=1, backoff_s=0.0, quarantine_after=1),
+        on_quarantine=freed.append,
+    )
+    ft.finalize(ft.submit(compute))  # slot 0 fails -> benched; retry on 1
+    assert freed == [0]
+    assert ft.quarantined() == [0]
+
+
+def test_degraded_pod_clamps_secondary_mesh_to_local_devices():
+    """On a degraded pod the secondary engines must never build a global
+    mesh (a sharded dispatch over it would wait on the dead member's
+    chips forever) — only this process's local devices qualify."""
+    import jax
+
+    from drep_tpu.cluster.engines import MESH_MIN_GENOMES, _mesh_or_none
+    from drep_tpu.parallel.faulttol import mark_pod_degraded
+
+    healthy = _mesh_or_none(None, MESH_MIN_GENOMES)
+    assert healthy is not None  # conftest forces 8 virtual devices
+    mark_pod_degraded(1, [0], [1])
+    degraded = _mesh_or_none(None, MESH_MIN_GENOMES)
+    assert degraded is not None
+    assert set(degraded.devices.flat) == set(jax.local_devices())
+    assert _mesh_or_none(None, 2) is None  # small clusters: no mesh at all
+
+
+def test_checkpoint_meta_subset_match_and_stamp(tmp_path):
+    """Degradation provenance stamped into a completed store's meta
+    (pod_epochs / dead_processes) must never invalidate a resume of the
+    very shards it describes; changed EXPECTED keys still mismatch."""
+    from drep_tpu.utils.ckptmeta import (
+        checkpoint_meta_matches,
+        open_checkpoint_dir,
+        stamp_checkpoint_meta,
+    )
+
+    d = str(tmp_path / "store")
+    meta = {"n": 3, "fingerprint": "abc"}
+    assert open_checkpoint_dir(d, meta, clear_suffixes=(".npz",)) is False
+    assert open_checkpoint_dir(d, meta, clear_suffixes=(".npz",)) is True
+    stamp_checkpoint_meta(d, {"pod_epochs": 2, "dead_processes": [1]})
+    assert checkpoint_meta_matches(d, meta)
+    assert open_checkpoint_dir(d, meta, clear_suffixes=(".npz",)) is True
+    with open(os.path.join(d, "meta.json")) as f:
+        stored = json.load(f)
+    assert stored["pod_epochs"] == 2 and stored["dead_processes"] == [1]
+    assert not checkpoint_meta_matches(d, {"n": 4, "fingerprint": "abc"})
+    # ONLY the known provenance keys are tolerated: a store written by a
+    # version that pinned an extra parameter must invalidate, not resume
+    stamp_checkpoint_meta(d, {"future_pinned_param": 7})
+    assert not checkpoint_meta_matches(d, meta)
+
+
+def test_epoch_stamped_shards_resume(tmp_path):
+    """A shard written under a bumped epoch (row_XXXXX.eNN.npz) must be
+    found and resumed by a later healthy run exactly like an epoch-0
+    shard — a resume that crosses the epoch bump replays deterministically."""
+    packed = _packed(n=48)
+    ckpt = str(tmp_path / "ckpt")
+    r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    # rename one shard to its epoch-1 name (what a degraded run's re-deal
+    # would have produced — identical content by construction)
+    os.replace(
+        os.path.join(ckpt, "row_00002.npz"),
+        os.path.join(ckpt, "row_00002.e01.npz"),
+    )
+    r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    _assert_edges_equal(r2, r1)
+    assert r2[3] == 0  # nothing recomputed: the .e01 shard resumed
+
+
+def test_process_death_spec_fields():
+    """proc= targets one pod member (no-op elsewhere); skip= defers the
+    fire past the first N matching calls (kill after K stripes)."""
+    faults.configure("process_death:kill:1.0:proc=7:skip=1")  # parses
+    faults.fire("process_death")  # proc 7 != this process: no-op
+    faults.fire("process_death")
+    assert counters.faults.get("injected_process_death_kill", 0) == 0
+    faults.configure("process_death:raise:1.0:skip=2")
+    faults.fire("process_death")  # skipped
+    faults.fire("process_death")  # skipped
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("process_death")
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("process_death:kill:1.0:bogus=1")
+
+
+def test_missing_stages_refuses_degraded_records():
+    """bench stamps pod_epochs/dead_processes into a degraded e2e record;
+    the recovery tooling must keep such stages on the re-measure list —
+    correct results on fewer chips are not measured perf."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "missing_stages", os.path.join(REPO, "tools", "missing_stages.py")
+    )
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+
+    link = {"h2d_gbps": 1.0, "d2h_gbps": 1.0}
+
+    def merged(rec):
+        return {
+            "stages": {"e2e_50k": rec},
+            "stage_provenance": {"e2e_50k": {"link": link}},
+        }
+
+    clean = {"pairs_per_sec_per_chip": 1.0}
+    assert "scale" not in ms.missing(merged(clean))
+    assert "scale" in ms.missing(merged({**clean, "dead_processes": 1}))
+    assert "scale" in ms.missing(merged({**clean, "pod_epochs": 2}))
+    assert "scale" in ms.missing(
+        merged({**clean, "fault_tolerance": {"pod_epoch_bumps": 1}})
+    )
+    assert "scale" in ms.missing(
+        merged({**clean, "fault_tolerance": {"dead_processes": 1}})
+    )

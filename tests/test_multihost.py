@@ -11,11 +11,14 @@ row-block stripes — yields the same Cdb partition as a single-process run,
 and resumes from the shared shards without rewriting them.
 """
 
+import json
 import os
+import signal
 import socket
 import subprocess
 import sys
 
+import numpy as np
 import pandas as pd
 import pytest
 
@@ -91,6 +94,144 @@ def test_distributed_matches_single(tmp_path, nproc, single_cdb):
     assert w.partition(pod_cdb, "primary_cluster") == w.partition(
         single_cdb, "primary_cluster"
     )
+
+
+def _run_elastic_pod(outdir, ckpt, faults=None, expect_dead=None, nproc=3):
+    """Launch an nproc-process jax.distributed CPU pod running the elastic
+    streaming worker mode against a shared checkpoint dir. Returns the
+    per-worker outputs; asserts exit codes (the `expect_dead` member must
+    die by SIGKILL, everyone else must succeed and leave artifacts)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # fast cadence so death detection (5x cadence staleness) is ~1.25 s,
+    # and a bounded collective timeout so a protocol bug fails the test
+    # quickly instead of wedging it for the default 15 minutes
+    env["DREP_TPU_HEARTBEAT_S"] = "0.25"
+    env["DREP_TPU_COLLECTIVE_TIMEOUT_S"] = "90"
+    if faults:
+        env["DREP_TPU_FAULTS"] = faults
+    os.makedirs(outdir, exist_ok=True)
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, WORKER, str(i), str(nproc),
+                f"localhost:{port}", str(outdir), "elastic", str(ckpt),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=REPO,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, p in enumerate(procs):
+        if expect_dead is not None and i == expect_dead:
+            assert p.returncode == -signal.SIGKILL, (
+                f"worker {i} should have been SIGKILLed:\n{outs[i]}"
+            )
+            assert not os.path.exists(os.path.join(outdir, f"ok_{i}"))
+            continue
+        assert p.returncode == 0, f"worker {i} failed:\n{outs[i]}"
+        assert os.path.exists(os.path.join(outdir, f"ok_{i}")), (
+            f"worker {i} wrote no ok-file:\n{outs[i]}"
+        )
+    return outs
+
+
+def _elastic_edges(outdir, pid):
+    with np.load(os.path.join(outdir, f"edges_{pid}.npz")) as z:
+        return z["ii"].copy(), z["jj"].copy(), z["dd"].copy(), int(z["pairs"])
+
+
+def _elastic_counters(outdir, pid) -> dict:
+    with open(os.path.join(outdir, f"counters_{pid}.json")) as f:
+        return json.load(f)
+
+
+@pytest.mark.chaos
+def test_elastic_pod_survives_sigkilled_member(tmp_path):
+    """The elastic-pod tentpole, end to end on a 3-process CPU pod:
+
+    1. healthy pod — the oracle run (every process returns the full edge
+       set, all shards epoch-0-named, no deaths diagnosed);
+    2. killed pod — process 1 SIGKILLs itself (process_death:kill fault)
+       at its SECOND owned stripe, mid-streaming: the survivors must
+       detect the death by heartbeat staleness, bump the ownership epoch,
+       re-deal the two unfinished stripes, reuse the dead member's
+       FINISHED shard, complete — with edges bit-identical to the healthy
+       pod — and stamp the degradation into the store's meta; a follow-up
+       checkpoint-store open must coordinate over the survivor set;
+    3. resume pod — a fresh healthy 3-process pod over the degraded run's
+       checkpoint dir: resumes every shard (including the epoch-stamped
+       ones) computing nothing, reproduces the edges bit-for-bit, and —
+       the stale-note lifecycle — never diagnoses the PREVIOUS run's dead
+       process from its leftover heartbeat/sentinel files."""
+    healthy_dir, killed_dir, resume_dir = (
+        str(tmp_path / d) for d in ("healthy", "killed", "resume")
+    )
+    ckpt_a, ckpt_b = str(tmp_path / "ckpt_a"), str(tmp_path / "ckpt_b")
+
+    _run_elastic_pod(healthy_dir, ckpt_a)
+    h = _elastic_edges(healthy_dir, 0)
+    for pid in (1, 2):  # every process assembled the identical full set
+        e = _elastic_edges(healthy_dir, pid)
+        assert all(a.tobytes() == b.tobytes() for a, b in zip(e[:3], h[:3]))
+        assert e[3] == h[3]
+    from _multihost_worker import ELASTIC_N
+
+    assert h[3] == ELASTIC_N * (ELASTIC_N - 1) // 2
+    assert not any(
+        ".e" in f for f in os.listdir(ckpt_a) if f.startswith("row_")
+    ), "healthy run produced epoch-stamped shards"
+    for pid in range(3):
+        assert "dead_processes" not in _elastic_counters(healthy_dir, pid)
+
+    # 2) SIGKILL process 1 mid-streaming (after its first owned stripe)
+    _run_elastic_pod(
+        killed_dir, ckpt_b,
+        faults="process_death:kill:1.0:proc=1:skip=1", expect_dead=1,
+    )
+    for pid in (0, 2):
+        e = _elastic_edges(killed_dir, pid)
+        assert all(
+            a.tobytes() == b.tobytes() for a, b in zip(e[:3], h[:3])
+        ), f"survivor {pid}'s edges differ from the healthy pod"
+        # the dead member's dispatched-but-unreported pairs die with it;
+        # its FINISHED shard is reused, so survivors computed strictly
+        # fewer pairs than the full grid (and more than none)
+        assert 0 < e[3] < h[3], (e[3], h[3])
+        ctr = _elastic_counters(killed_dir, pid)
+        assert ctr.get("dead_processes") == 1, ctr
+        assert ctr.get("pod_epoch_bumps") == 1, ctr
+    shards_b = sorted(f for f in os.listdir(ckpt_b) if f.startswith("row_"))
+    assert any(".e01." in f for f in shards_b), shards_b  # re-dealt stripes
+    with open(os.path.join(ckpt_b, "meta.json")) as f:
+        meta_b = json.load(f)
+    assert meta_b.get("pod_epochs") == 2, meta_b
+    assert meta_b.get("dead_processes") == [1], meta_b
+
+    # 3) fresh healthy pod resumes the degraded run's store
+    _run_elastic_pod(resume_dir, ckpt_b)
+    for pid in range(3):
+        e = _elastic_edges(resume_dir, pid)
+        assert all(a.tobytes() == b.tobytes() for a, b in zip(e[:3], h[:3]))
+        assert e[3] == 0, "resume recomputed stripes despite complete shards"
+        # the previous run's stale heartbeat/sentinel notes (including the
+        # dead process 1's) must never be diagnosed as a CURRENT death
+        assert "dead_processes" not in _elastic_counters(resume_dir, pid)
 
 
 @pytest.mark.chaos

@@ -7,6 +7,7 @@ fails decisively. The streaming guard pins the fault-tolerance layer's
 zero-overhead-when-unset contract (ISSUE 2).
 """
 
+import os
 import time
 
 import numpy as np
@@ -68,12 +69,15 @@ def test_pick_winners_vectorized_at_2e5_genomes(rng):
     assert wdb.set_index("cluster").loc["0_1", "genome"] == best["genome"]
 
 
-def test_streaming_fault_layer_zero_overhead_when_unset(rng):
+def test_streaming_fault_layer_zero_overhead_when_unset(rng, tmp_path):
     """With DREP_TPU_FAULTS unset and the watchdog disabled (the
     defaults), the retrying executor must add no meaningful per-tile cost:
     no watchdog threads, no fault events, and a many-tile streaming pass
     inside a wall bound that a per-tile synchronization or thread-spawn
-    regression (~ms x 1e3 tiles at scale) would blow decisively."""
+    regression (~ms x 1e3 tiles at scale) would blow decisively. A second
+    leg runs with elastic heartbeats ENABLED (checkpoint dir present, the
+    default cadence): the beat writer must cost nothing measurable,
+    record no fault events, and clean its notes up on healthy completion."""
     from drep_tpu.ops.minhash import PAD_ID, PackedSketches
     from drep_tpu.parallel.streaming import streaming_mash_edges
     from drep_tpu.utils import faults
@@ -95,3 +99,27 @@ def test_streaming_fault_layer_zero_overhead_when_unset(rng):
     dt = time.perf_counter() - t0
     assert counters.faults == before, "fault events recorded with injection unset"
     assert dt < 20.0, f"528-tile warm streaming pass took {dt:.1f}s — executor overhead?"
+
+    # heartbeats enabled, no failures: same pass with a checkpoint dir
+    # (shard IO rides along — the bound stays generous)
+    ckpt = str(tmp_path / "hb_ckpt")
+    t0 = time.perf_counter()
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    dt_hb = time.perf_counter() - t0
+    assert counters.faults == before, "fault events recorded with heartbeats on"
+    assert dt_hb < 25.0, f"heartbeat-enabled pass took {dt_hb:.1f}s"
+    leftover = [f for f in os.listdir(ckpt) if f.startswith(".pod")]
+    assert not leftover, f"heartbeat notes survived healthy completion: {leftover}"
+
+    # auto-derived watchdog (the CLI default, --dispatch_timeout 0): once
+    # warmed it runs every finalize wait under a watchdog thread — that
+    # per-tile spawn must stay inside the same generous bound, with no
+    # trips and no fault events on a healthy run
+    from drep_tpu.parallel.faulttol import FaultTolConfig
+
+    cfg = FaultTolConfig(auto_timeout=True)
+    t0 = time.perf_counter()
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, ft_config=cfg)
+    dt_auto = time.perf_counter() - t0
+    assert counters.faults == before, "fault events recorded under the auto watchdog"
+    assert dt_auto < 20.0, f"auto-watchdog warm pass took {dt_auto:.1f}s — thread-spawn overhead?"

@@ -53,6 +53,21 @@ def _has_error(rec) -> bool:
     return "error" in rec or any(_has_error(v) for v in rec.values())
 
 
+def _degraded(rec: dict) -> bool:
+    """A record from a run that lost pod member(s) and completed via the
+    elastic ownership-epoch protocol: results are correct, but the
+    wall-clock was produced on fewer chips than the record claims — not
+    measured perf (same contract as fault-stamped records). bench_e2e
+    stamps the top-level keys; the fault_tolerance sub-dict catches any
+    record that carried the raw counters without the stamp."""
+    return bool(
+        rec.get("dead_processes")
+        or rec.get("pod_epochs", 1) > 1
+        or rec.get("fault_tolerance", {}).get("dead_processes")
+        or rec.get("fault_tolerance", {}).get("pod_epoch_bumps")
+    )
+
+
 def missing(merged: dict) -> list[str]:
     stages = merged.get("stages", {})
     prov = merged.get("stage_provenance", {})
@@ -66,6 +81,9 @@ def missing(merged: dict) -> list[str]:
             # emits: a chaos-mode run exercised the fault layer, it did
             # NOT measure clean hardware throughput — never count it done
             and not rec.get("faults_injected")
+            # a degraded-pod run (dead member survived via an epoch bump)
+            # finished on fewer chips than it claims — refuse as measured
+            and not _degraded(rec)
             # a wedge between the fresh e2e leg and its resume leg
             # publishes the fresh number with this marker — keep the
             # stage on the re-measure list until the resume evidence lands
