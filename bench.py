@@ -1090,6 +1090,29 @@ def _emit(stages: dict) -> None:
         for st in stages.values():
             if isinstance(st, dict):
                 st["faults_injected"] = fault_spec
+    # degraded-pod provenance, stamped into EVERY stage record (ISSUE 4 —
+    # previously only the streaming e2e stage stamped it): a DENSE ring or
+    # SECONDARY stage that survived a pod-member death via the elastic
+    # protocol produced correct numbers on fewer chips, and
+    # tools/missing_stages.py must refuse every such record as measured
+    # perf, not just the streaming one. DELIBERATELY CONSERVATIVE: the
+    # process-global pod state cannot attribute the death to a stage, so
+    # once the pod is degraded at emission time every un-stamped stage in
+    # the run is marked for re-measure — stages that happened to finish
+    # before the death are sacrificed rather than risk laundering a
+    # degraded number as clean (bench_e2e's own per-stage ft_events diff
+    # already stamped the precise stage, and "pod_epochs" not in st keeps
+    # that finer stamp authoritative).
+    try:
+        from drep_tpu.parallel.faulttol import pod_dead, pod_epoch, pod_live
+
+        if pod_live() is not None:
+            for st in stages.values():
+                if isinstance(st, dict) and "pod_epochs" not in st:
+                    st["pod_epochs"] = pod_epoch() + 1
+                    st["dead_processes"] = len(pod_dead())
+    except Exception:  # provenance must never block the record
+        pass
     head = stages.get("primary", {})
     value = head.get("pairs_per_sec_per_chip") if isinstance(head, dict) else None
     vs = head.get("vs_baseline") if isinstance(head, dict) else None

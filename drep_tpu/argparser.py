@@ -103,11 +103,23 @@ def build_parser() -> argparse.ArgumentParser:
                               "perf_counters.json); explicit positive values "
                               "are authoritative; negative disables")
         tpu.add_argument("--max_dead_processes", type=int, default=1,
-                         help="pod-member deaths the elastic streaming protocol "
-                              "tolerates per run (heartbeat detection + "
-                              "ownership-epoch stripe re-assignment across the "
-                              "survivors) before aborting; heartbeat cadence "
-                              "via DREP_TPU_HEARTBEAT_S (0 disables)")
+                         help="pod-member deaths the elastic protocol tolerates "
+                              "per run (heartbeat detection + ownership-epoch "
+                              "re-assignment across the survivors — streaming "
+                              "stripes AND dense-ring blocks) before aborting; "
+                              "heartbeat cadence via DREP_TPU_HEARTBEAT_S "
+                              "(0 disables)")
+        tpu.add_argument("--ring_monolithic", action="store_true",
+                         help="run the dense all-pairs ring as ONE collective "
+                              "program (the pre-elastic reference) instead of "
+                              "the default host-stepped schedule (one dispatch "
+                              "per ring step, per-step block checkpoints under "
+                              "<wd>/data/dense_ring, individually redoable "
+                              "blocks, pod-death survival; per-step watchdog "
+                              "auto-derived like the streaming tiles, reported "
+                              "as derived_ring_step_timeout_s). Results are "
+                              "bit-identical either way; env "
+                              "DREP_TPU_RING_MONOLITHIC=1 also forces it")
         tpu.add_argument("--profile", nargs="?", const="auto", default=None,
                          help="record a jax.profiler trace of the compare stage "
                               "(optionally to the given directory; default "

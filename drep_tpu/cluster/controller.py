@@ -75,6 +75,12 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "fault_retries": 2,
     "dispatch_timeout": 0.0,
     "max_dead_processes": 1,
+    # dense-ring execution: False (default) runs the host-stepped elastic
+    # schedule (parallel/allpairs.py — per-step block checkpoints, redoable
+    # blocks, pod-death survival); True forces the monolithic single
+    # collective program kept as the bit-equality reference. Results are
+    # bit-identical either way, so it never invalidates a workdir.
+    "ring_monolithic": False,
 }
 
 _RESUME_KEYS = [
@@ -307,6 +313,18 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     logger = get_logger()
     kw = _fill_defaults(kwargs)
     ft_cfg = _ft_config(kw)  # install the run's fault-tolerance defaults
+    from drep_tpu.parallel.allpairs import configure_ring
+
+    # run-wide dense-ring execution config: the step-wise ring checkpoints
+    # its per-step block tiles under the workdir (lazily — the directory
+    # is only created when a mesh ring actually runs), making the dense
+    # primary/secondary rings kill-resumable and pod-death elastic.
+    # --ring_monolithic False maps to None so DREP_TPU_RING_MONOLITHIC
+    # can still force the reference program for an A/B check.
+    configure_ring(
+        monolithic=True if kw["ring_monolithic"] else None,
+        checkpoint_base=os.path.join(wd.location, "data", "dense_ring"),
+    )
     snapshot = {k: kw.get(k) for k in _RESUME_KEYS if k != "genomes"}
     # normalize: CLI passes 0.25 explicitly, library callers omit it — the
     # effective value must snapshot identically from both entry points
@@ -515,13 +533,18 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                     # a transient device failure on one big cluster must
                     # not kill a run that already banked thousands of
                     # per-cluster checkpoint shards — bounded retries,
-                    # same knobs as the streaming tile executor
+                    # same knobs as the streaming tile executor.
+                    # local_only: the secondary engines clamp their mesh
+                    # to this process's devices on pods (engines.py), so
+                    # a per-process retry cannot desync the pod — a
+                    # mid-batch failure retries instead of killing the run
                     results[pc] = retrying_call(
                         lambda indices=indices, pc=pc: _secondary_for_cluster(
                             gs, bdb, indices, pc, kw
                         ),
                         site="secondary_batch",
                         config=ft_cfg,
+                        local_only=True,
                     )
                 ckpt.save(pc, *results[pc])
 
@@ -552,6 +575,9 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                     ),
                     site="secondary_batch",
                     config=ft_cfg,
+                    # process-local by the secondary-mesh contract
+                    # (engines._mesh_or_none local_only): retryable on pods
+                    local_only=True,
                 )
             with counters.stage("secondary_postprocess"):
                 for (pc, indices), (ani, cov) in zip(batch, outs, strict=True):
@@ -565,6 +591,22 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
                         results[pc] = _secondary_postprocess(gs, indices, pc, kw, ani, cov)
                     ckpt.save(pc, *results[pc])
 
+        if pod_live() is not None and ckpt.dir is not None:
+            # the pod lost member(s) somewhere before/inside the secondary
+            # loop: stamp the degradation provenance into the secondary
+            # checkpoint store's meta (same contract as the streaming and
+            # ring stores — extra keys never invalidate a resume), stamped
+            # by the lowest live process only so replicated survivors do
+            # not race the read-modify-write
+            import jax
+
+            from drep_tpu.utils.ckptmeta import stamp_checkpoint_meta
+
+            if jax.process_index() == min(pod_live()):
+                stamp_checkpoint_meta(
+                    ckpt.dir,
+                    {"pod_epochs": pod_epoch() + 1, "dead_processes": pod_dead()},
+                )
         for pc, indices in multi:  # assemble in cluster order (deterministic)
             ndb, labels, link = results[pc]
             ndb_parts.append(ndb)

@@ -37,18 +37,24 @@ from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
 MESH_MIN_GENOMES = 64
 
 
-def _mesh_or_none(mesh_shape: int | None, n: int):
+def _mesh_or_none(mesh_shape: int | None, n: int, local_only: bool = False):
     import jax
 
     from drep_tpu.parallel.faulttol import pod_live
     from drep_tpu.parallel.mesh import make_local_mesh, make_mesh
 
-    if pod_live() is not None:
-        # degraded pod (elastic protocol lost a member): a global mesh
-        # spans the dead process's chips and a sharded dispatch over it
-        # would wait on the corpse forever — no timeout guards the
-        # collective itself. Survivors instead run this work REPLICATED
-        # on their local chips: slower, never hung, same numbers.
+    if pod_live() is not None or (local_only and jax.process_count() > 1):
+        # LOCAL-mesh regimes: (a) degraded pod (elastic protocol lost a
+        # member) — a global mesh spans the dead process's chips and a
+        # sharded dispatch over it would wait on the corpse forever, no
+        # timeout guards the collective itself; (b) `local_only` on any
+        # multi-process pod — the SECONDARY engines run their dispatches
+        # process-local BY CONTRACT (ISSUE 4), which is what makes every
+        # per-batch call independently retryable (retrying_call
+        # local_only in cluster/controller.py): a per-process retry of a
+        # process-local program cannot desync the pod. Either way the
+        # work runs REPLICATED on each process's chips: slower than a
+        # pod-wide ring, never hung, same numbers.
         local = len(jax.local_devices())
         if local > 1 and n >= MESH_MIN_GENOMES:
             return make_local_mesh()
@@ -221,9 +227,23 @@ def _count_path(path: str) -> None:
     SECONDARY_PATH_COUNTS[path] = SECONDARY_PATH_COUNTS.get(path, 0) + 1
 
 
-def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: int = 128):
+def containment_matrices(
+    packed,
+    k: int,
+    mesh_shape: int | None = None,
+    tile: int = 128,
+    local_only: bool = True,
+):
     """(symmetric max-containment ani, directional cov) with automatic
     path selection.
+
+    ``local_only`` (default) clamps the mesh to THIS process's devices on
+    multi-process pods — the retryable-sharded-secondary contract
+    (ISSUE 4): a secondary batch whose dispatch is process-local can be
+    retried by retrying_call without desyncing the pod, so a transient
+    device failure mid-batch costs one retry instead of the whole run.
+    Pass ``local_only=False`` only for a caller that is NOT wrapped in a
+    per-process retry and genuinely wants the pod-wide ring.
 
     Every path is triangle-only (intersection counts are symmetric; the
     directional cov derives from counts on host): the matmul paths run
@@ -256,7 +276,7 @@ def containment_matrices(packed, k: int, mesh_shape: int | None = None, tile: in
     if one_shot_fits(packed.n, v_pad):
         _count_path("one_shot")
         return all_vs_all_containment_matmul(packed, k=k, v_pad=v_pad)
-    mesh = _mesh_or_none(mesh_shape, packed.n)
+    mesh = _mesh_or_none(mesh_shape, packed.n, local_only=local_only)
     if mesh is not None:
         from drep_tpu.parallel.allpairs import sharded_containment_allpairs
 

@@ -176,7 +176,9 @@ def greedy_secondary_cluster(
     if use_matmul:
         from drep_tpu.cluster.engines import _mesh_or_none
 
-        mesh = _mesh_or_none(kw.get("mesh_shape"), m)
+        # secondary work: live-clamped to local devices on pods (the
+        # retryable-secondary contract — engines._mesh_or_none)
+        mesh = _mesh_or_none(kw.get("mesh_shape"), m, local_only=True)
         if mesh is not None:
             # candidate blocks shard over the mesh rows (reps replicate —
             # they are the small append-only side); a D-device mesh

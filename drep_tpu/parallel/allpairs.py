@@ -29,11 +29,31 @@ directions derive from ``counts`` on host.
 The jitted shard_map programs are cached per (kernel kind, k, mesh,
 schedule), so repeated calls — e.g. one per large primary cluster during
 secondary clustering — recompile only when shapes actually change.
+
+Step-wise execution (ISSUE 4): the DEFAULT ring is host-stepped — one
+shard_map dispatch per ring step instead of one monolithic
+``fori_loop`` program — which gives the dense engine a REDOABLE UNIT:
+every step's per-device block tile can be checkpointed to a shard store
+(``blk_AAA_BBB.npz``, epoch-stamped ``.eNN`` after a pod degradation,
+utils/ckptmeta.py machinery) and any block can be recomputed
+independently by the per-block tile executor (parallel/faulttol.py
+TileExecutor) on the local devices — bit-identically, because the tile
+kernels are pure fixed-shape functions whose results do not depend on
+which program dispatched them (pinned by tests/test_triangular.py). On a
+multi-process pod this is what makes the dense ring ELASTIC: a
+HeartbeatManager death verdict between steps makes the survivors abandon
+the (now unusable) full-pod collective, re-deal every missing block
+across the live set, and assemble a distance matrix bit-identical to a
+healthy run from the shared shard store. The monolithic single-program
+ring is kept behind ``monolithic=True`` / ``--ring_monolithic`` /
+``DREP_TPU_RING_MONOLITHIC=1`` as the bit-equality reference.
 """
 
 from __future__ import annotations
 
 import functools
+import os
+import time
 from typing import Callable
 
 import jax
@@ -46,6 +66,39 @@ from drep_tpu.ops.containment import ani_cov_from_intersections, containment_int
 from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
 from drep_tpu.parallel.mesh import AXIS, make_mesh
 from drep_tpu.utils.jaxcompat import pcast, shard_map
+from drep_tpu.utils.logger import get_logger
+
+# monolithic-reference opt-in: explicit argument > configure_ring() >
+# env var > step-wise default
+RING_MONOLITHIC_ENV = "DREP_TPU_RING_MONOLITHIC"
+
+# process-wide ring execution config, set once per run by the cluster
+# controller from the CLI flags (same pattern as faulttol's
+# configure_defaults): engines call ring_allpairs deep inside replicated
+# control flow and cannot thread a workdir down to it.
+_RING_CONFIG: dict = {"monolithic": None, "checkpoint_base": None}
+
+
+def configure_ring(
+    monolithic: bool | None = None, checkpoint_base: str | None = None
+) -> None:
+    """Install run-wide ring defaults: `monolithic` forces the single
+    collective reference program; `checkpoint_base` roots the step-wise
+    ring's per-call block shard stores (one subdirectory per distinct
+    input fingerprint, created lazily when a ring actually runs).
+
+    This REPLACES the whole config — an omitted argument resets that knob
+    to its default (None), it does not preserve the previous value; a
+    bare ``configure_ring()`` is the full reset (tests rely on it). To
+    flip one knob mid-run, pass both."""
+    _RING_CONFIG["monolithic"] = monolithic
+    _RING_CONFIG["checkpoint_base"] = checkpoint_base
+
+
+def ring_monolithic_default() -> bool:
+    if _RING_CONFIG["monolithic"] is not None:
+        return bool(_RING_CONFIG["monolithic"])
+    return os.environ.get(RING_MONOLITHIC_ENV, "") not in ("", "0", "false")
 
 
 def half_ring_steps(n_devices: int) -> int:
@@ -234,12 +287,144 @@ def _ring_fn(kind: str, k: int, mesh, half: bool) -> tuple[Callable, int]:
     return fn, n_outputs
 
 
+# -- step-wise (host-stepped) ring: the redoable-unit schedule ------------
+
+
+def ring_schedule(n_devices: int, half: bool) -> list[tuple[int, int]]:
+    """The ordered block list the schedule stores: (row block a, col block
+    b) pairs, canonical (a-major) order. This order is the assembly order
+    AND the deterministic recovery-ownership index, so every process
+    derives identical ownership from it."""
+    return [
+        (a, b)
+        for a in range(n_devices)
+        for b in range(n_devices)
+        if not half or _ring_block_computed(a, b, n_devices)
+    ]
+
+
+def _ring_step_shard(a_ids, a_counts, b_ids, b_counts, tile_fn, n_devices, rotate):
+    """One ring step under shard_map: compute this step's tile from the
+    resident A block and the CURRENT B operand, then rotate B one hop.
+    The tile lands as a direct program output (not a dynamic_update_slice
+    into a carry), which is exactly what keeps its bits identical to a
+    standalone per-block recompute — the recovery path depends on it."""
+    tiles = tile_fn(a_ids, a_counts, b_ids, b_counts)
+    if not isinstance(tiles, tuple):
+        tiles = (tiles,)
+    tiles = tuple(t.astype(jnp.float32) for t in tiles)
+    if rotate:
+        perm = [(j, (j + 1) % n_devices) for j in range(n_devices)]
+        b_ids = lax.ppermute(b_ids, AXIS, perm)
+        b_counts = lax.ppermute(b_counts, AXIS, perm)
+    return (*tiles, b_ids, b_counts)
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_step_fn(kind: str, k: int, mesh, rotate: bool) -> tuple[Callable, int]:
+    """One jitted per-step program per (kind, k, mesh, rotate) — two
+    compilations per schedule (the last step skips the dead rotation's
+    ICI hop, same optimization as the monolithic program's lax.cond)."""
+    make_tile, n_outputs = _TILE_KINDS[kind]
+    fn = jax.jit(
+        shard_map(
+            functools.partial(
+                _ring_step_shard,
+                tile_fn=make_tile(k),
+                n_devices=mesh.devices.size,
+                rotate=rotate,
+            ),
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS, None), P(AXIS)),
+            out_specs=(
+                *[P(AXIS, None) for _ in range(n_outputs)],
+                P(AXIS, None),
+                P(AXIS),
+            ),
+        )
+    )
+    return fn, n_outputs
+
+
+@functools.lru_cache(maxsize=None)
+def _block_tile_fn(kind: str, k: int) -> tuple[Callable, int]:
+    """Standalone jitted per-block tile — the step-wise ring's REDOABLE
+    UNIT, used to recompute any missing block (resume gaps, a dead pod
+    member's unfinished work, failed steps) on a local device. Applies the
+    same f32 cast as the step program so a recovered block is bit-
+    identical to its in-ring twin (pinned by test_triangular)."""
+    make_tile, n_outputs = _TILE_KINDS[kind]
+    tile_fn = make_tile(k)
+
+    @jax.jit
+    def fn(a_ids, a_counts, b_ids, b_counts):
+        tiles = tile_fn(a_ids, a_counts, b_ids, b_counts)
+        if not isinstance(tiles, tuple):
+            tiles = (tiles,)
+        return tuple(t.astype(jnp.float32) for t in tiles)
+
+    return fn, n_outputs
+
+
+def _block_name(a: int, b: int, epoch: int) -> str:
+    """Block (a, b)'s checkpoint shard filename, epoch-stamped exactly
+    like the streaming row shards: ``blk_AAA_BBB.npz`` healthy, the
+    ownership epoch in the name once a degraded run (or a local heal)
+    produced it under a bump. Content is identical whichever
+    process/epoch computed it (deterministic tiles)."""
+    base = f"blk_{a:03d}_{b:03d}"
+    return f"{base}.npz" if epoch == 0 else f"{base}.e{epoch:02d}.npz"
+
+
+def _find_block(checkpoint_dir: str, a: int, b: int) -> str | None:
+    """Existing shard for block (a, b) under ANY ownership epoch."""
+    loc = os.path.join(checkpoint_dir, _block_name(a, b, 0))
+    if os.path.exists(loc):
+        return loc
+    import glob
+
+    hits = sorted(
+        glob.glob(os.path.join(checkpoint_dir, f"blk_{a:03d}_{b:03d}.e*.npz"))
+    )
+    return hits[0] if hits else None
+
+
+def _load_block(path: str, n_outputs: int):
+    """Tuple of `n_outputs` arrays from a block shard, or None when it
+    reads corrupt — warned and best-effort removed; callers recompute
+    into the same path (the streaming shard store's healing contract)."""
+    import contextlib
+
+    try:
+        with np.load(path) as z:
+            return tuple(z[f"o{i}"] for i in range(n_outputs))
+    except Exception:
+        get_logger().warning("dense ring: corrupt block shard %s — recomputing", path)
+        with contextlib.suppress(OSError):
+            os.remove(path)
+        return None
+
+
+def _ring_store_dir(kind: str, k: int, n_devices: int, fingerprint: str) -> str | None:
+    """The per-call block store under the configured base (None when no
+    base is configured): one subdirectory per distinct (kind, D, input
+    fingerprint), so interleaved ring calls — e.g. per-cluster secondary
+    rings — never invalidate each other's shards."""
+    base = _RING_CONFIG["checkpoint_base"]
+    if base is None:
+        return None
+    return os.path.join(base, f"ring_{kind}_k{k}_d{n_devices}_{fingerprint[:12]}")
+
+
 def ring_allpairs(
     packed: PackedSketches,
     kind: str,
     k: int,
     mesh=None,
     full_grid: bool = False,
+    monolithic: bool | None = None,
+    checkpoint_dir: str | None = None,
+    ft_config=None,
 ) -> tuple[np.ndarray, ...]:
     """Run the `kind` tile kernel over every pair of rows, sharded over the
     mesh. Returns full [N, N] float32 matrices (one per kernel output),
@@ -249,12 +434,48 @@ def ring_allpairs(
     kernel is symmetric (see _TILE_KINDS). ``full_grid=True`` forces the
     original D-step ring; it exists as the equality reference for tests
     and for any future asymmetric kernel.
+
+    Execution is HOST-STEPPED by default (one dispatch per ring step,
+    per-step block tiles checkpointable and individually redoable — the
+    elastic dense engine, module docstring); ``monolithic=True`` (or the
+    run-wide flag / env) forces the original single collective program,
+    kept as the bit-equality reference. `checkpoint_dir` overrides the
+    configured per-call block store location (None + no configured base =
+    in-memory only).
     """
     if mesh is None:
         mesh = make_mesh()
     n_devices = mesh.devices.size
     half = not full_grid
     n = packed.n
+    if monolithic is None:
+        monolithic = ring_monolithic_default()
+    from drep_tpu.utils.profiling import counters
+
+    if not monolithic:
+        # honest accounting: the step-wise path reports the block tiles
+        # THIS process actually computed this call — a full store resume
+        # reports 0, a pod member reports only its share — against the
+        # full-grid total (the monolithic reference genuinely computes
+        # its whole schedule every call and books it)
+        outs, tiles_computed = _ring_allpairs_stepwise(
+            packed, kind, k, mesh, half, checkpoint_dir, ft_config
+        )
+    else:
+        outs = _ring_allpairs_monolithic(packed, kind, k, mesh, half)
+        tiles_computed = ring_tiles_computed(n_devices, half)
+    counters.add_tiles(
+        "primary_compare" if kind == "mash" else "secondary_compare",
+        computed=tiles_computed,
+        total=n_devices * n_devices,
+    )
+    return tuple(g[:n, :n] for g in outs)
+
+
+def _ring_allpairs_monolithic(packed, kind, k, mesh, half):
+    """The original one-program ring (the bit-equality reference the
+    step-wise schedule is pinned against)."""
+    n_devices = mesh.devices.size
     ids, counts = pad_packed_rows(packed.ids, packed.counts, n_devices)
 
     ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
@@ -267,7 +488,8 @@ def ring_allpairs(
     # On a >1-process pod retrying_call runs the dispatch BARE: a
     # per-process retry of a collective program would desync the pod
     # (see its docstring); multi-host live failures abort loudly via the
-    # collective timeouts instead.
+    # collective timeouts instead. The step-wise default has a redoable
+    # unit and survives those deaths — this reference path does not.
     from drep_tpu.parallel.faulttol import retrying_call
 
     outs = retrying_call(
@@ -280,33 +502,501 @@ def ring_allpairs(
     if half:
         for g in gathered:
             mirror_half_ring(g, n_devices)
+    return gathered
+
+
+def _exchange_rows_no_store(
+    mem: dict, mesh, schedule, n_outputs: int, n_local: int, n_pad: int,
+    pid: int, kind: str,
+) -> None:
+    """Store-less pod completion: allgather each process's computed block
+    rows (host arrays, equal shapes — the mesh spans the pod with equal
+    local device counts) and place peers' blocks into `mem`. Values are
+    the same host copies a shard store would have round-tripped, so the
+    assembly stays bit-identical to both the store path and the
+    monolithic gather."""
+    from jax.experimental import multihost_utils as mhu
+
+    from drep_tpu.parallel.faulttol import (
+        DEFAULT_ALLGATHER_TIMEOUT_S,
+        collective_timeout_s,
+        run_with_timeout,
+    )
+
+    proc_rows: dict[int, list[int]] = {}
+    for m, d in enumerate(mesh.devices.flat):
+        proc_rows.setdefault(d.process_index, []).append(m)
+    counts = {len(v) for v in proc_rows.values()}
+    if len(counts) != 1:
+        raise ValueError(
+            f"dense ring: uneven device rows per process {proc_rows} — the "
+            f"store-less pod exchange needs equal shapes; configure a block "
+            f"store instead"
+        )
+    mine = proc_rows.get(pid, [])
+    blocks_by_row: dict[int, list[tuple[int, int]]] = {}
+    for a, b in schedule:
+        blocks_by_row.setdefault(a, []).append((a, b))
+    gathered: dict[tuple[int, int], list] = {}
+    for oi in range(n_outputs):
+        rows_mat = np.zeros((len(mine), n_local, n_pad), np.float32)
+        for ri, m in enumerate(mine):
+            for a, b in blocks_by_row.get(m, ()):
+                rows_mat[ri][:, b * n_local : (b + 1) * n_local] = mem[(a, b)][oi]
+        g = np.asarray(
+            run_with_timeout(
+                lambda rows_mat=rows_mat: mhu.process_allgather(rows_mat),
+                what=f"dense ring row exchange ({kind} output {oi})",
+                site="allgather",
+                timeout_s=collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S),
+            )
+        )  # [pc, rows_per_proc, n_local, n_pad], rebuilt per output
+        for p, rows_p in sorted(proc_rows.items()):
+            if p == pid:
+                continue
+            for ri, m in enumerate(rows_p):
+                for a, b in blocks_by_row.get(m, ()):
+                    tile = g[p, ri][:, b * n_local : (b + 1) * n_local].copy()
+                    gathered.setdefault((a, b), [None] * n_outputs)[oi] = tile
+    for blk, tiles in gathered.items():
+        mem[blk] = tuple(tiles)
+
+
+def _ring_allpairs_stepwise(
+    packed, kind, k, mesh, half, checkpoint_dir, ft_config
+) -> tuple[list[np.ndarray], int]:
+    """The host-stepped elastic ring (module docstring): one dispatch per
+    ring step, per-step block tiles checkpointed to a shard store, missing
+    blocks individually redoable via the per-block tile executor, and —
+    on a multi-process pod — a HeartbeatManager death verdict between
+    steps re-dealing the dead member's blocks across the survivors with a
+    bit-identical final matrix. Returns (full padded matrices, block
+    tiles this process actually computed — the honest tiles_computed)."""
+    from drep_tpu.parallel.faulttol import (
+        DEFAULT_ALLGATHER_TIMEOUT_S,
+        DEFAULT_CONFIG,
+        AutoTimeout,
+        CollectiveTimeout,
+        FaultTolError,
+        HeartbeatManager,
+        TileExecutor,
+        WatchdogTimeout,
+        _wait_ready,
+        collective_timeout_s,
+        heartbeat_cadence_s,
+        wait_elastic,
+    )
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.ckptmeta import atomic_savez, content_fingerprint
     from drep_tpu.utils.profiling import counters
 
-    counters.add_tiles(
-        "primary_compare" if kind == "mash" else "secondary_compare",
-        computed=ring_tiles_computed(n_devices, half),
-        total=n_devices * n_devices,
-    )
-    return tuple(g[:n, :n] for g in gathered)
+    logger = get_logger()
+    cfg = ft_config if ft_config is not None else DEFAULT_CONFIG
+    D = mesh.devices.size
+    _make_tile, n_outputs = _TILE_KINDS[kind]
+    ids, counts = pad_packed_rows(packed.ids, packed.counts, D)
+    n_pad = ids.shape[0]
+    n_local = n_pad // D
+    n_steps = half_ring_steps(D) if half else D
+    schedule = ring_schedule(D, half)
+    sched_idx = {blk: i for i, blk in enumerate(schedule)}
+    pid, pc = jax.process_index(), jax.process_count()
+    local_mesh = all(d.process_index == pid for d in mesh.devices.flat)
+
+    # fingerprint only when a store exists — SHA-1 over the full pack is
+    # wasted work for the store-less (memory-only) execution
+    fp = None
+    store = checkpoint_dir
+    if store is not None or _RING_CONFIG["checkpoint_base"] is not None:
+        fp = content_fingerprint(packed.names, packed.counts, packed.ids)
+        if store is None:
+            store = _ring_store_dir(kind, k, D, fp)
+    if store is not None and pc > 1 and local_mesh:
+        # replicated LOCAL ring on a multi-process pod (the degraded-pod
+        # secondary shape, engines._mesh_or_none): a shared store would
+        # put pod barriers inside per-process retry scopes (retrying_call
+        # local_only) and desync the barrier sequence — run memory-only;
+        # every survivor computes the same numbers on its own chips
+        store = None
+
+    hb = None
+    resume = False
+    if store is not None:
+        cadence = heartbeat_cadence_s()
+        if cadence > 0:
+            # started BEFORE the store-open barrier (the stale-note
+            # cleanup ordering the heartbeat protocol requires) — which
+            # also makes the barrier itself heartbeat-aware: a peer that
+            # dies before ever reaching it is admitted as a pod death
+            # (utils/ckptmeta.py), not a CollectiveTimeout abort
+            hb = HeartbeatManager(store, cadence, max_dead=cfg.max_dead_processes)
+            hb.start()
+        meta = {
+            "kind": kind,
+            "k": k,
+            "n": packed.n,
+            "n_devices": D,
+            "half": half,
+            "schedule": "stepwise1",
+            "fingerprint": fp,
+        }
+        from drep_tpu.utils.ckptmeta import open_checkpoint_dir
+
+        try:
+            resume = open_checkpoint_dir(store, meta, clear_suffixes=(".npz",))
+        except BaseException:
+            if hb is not None:
+                hb.close()
+            raise
+
+    elastic = hb is not None and pc > 1 and not local_mesh
+
+    # blocks this call computed stay in memory; the rest resolve from the
+    # shard store (found blocks cached so they are never re-statted).
+    # n_computed counts the block tiles THIS process actually produced
+    # (ring steps + per-block recovery) for the honest tiles_computed
+    # accounting — a resume reports 0, never the full schedule.
+    mem: dict[tuple[int, int], tuple] = {}
+    shard_of: dict[tuple[int, int], str] = {}
+    n_computed = 0
+
+    def _missing_blocks() -> list[tuple[int, int]]:
+        out = []
+        for blk in schedule:
+            if blk in mem or blk in shard_of:
+                continue
+            if store is not None:
+                loc = _find_block(store, *blk)
+                if loc is not None:
+                    shard_of[blk] = loc
+                    continue
+            out.append(blk)
+        return out
+
+    def _save_block(blk: tuple[int, int], tiles: tuple, epoch: int) -> None:
+        if store is None:
+            return
+        path = os.path.join(store, _block_name(blk[0], blk[1], epoch))
+        atomic_savez(path, **{f"o{oi}": t for oi, t in enumerate(tiles)})
+        shard_of[blk] = path
+
+    def _store_step(i: int, outs) -> None:
+        """Host copies of this process's addressable shards of step `i`,
+        placed at their (row block, col block) coordinates and published
+        to the store. The even-D half-ring middle step keeps only the
+        canonical device half (the mirrored twin owns the unordered pair)."""
+        rows: dict[int, list] = {}
+        for oi, o in enumerate(outs):
+            for sh in o.addressable_shards:
+                m = (sh.index[0].start or 0) // n_local
+                rows.setdefault(m, [None] * n_outputs)[oi] = np.asarray(sh.data)
+        nonlocal n_computed
+        for m, tiles in sorted(rows.items()):
+            if half and D % 2 == 0 and D > 1 and i == D // 2 and m >= D // 2:
+                continue
+            blk = (m, (m - i) % D)
+            mem[blk] = tuple(tiles)
+            n_computed += 1
+            _save_block(blk, mem[blk], hb.epoch if hb is not None else 0)
+
+    # recovery executor (lazy): the per-block redoable unit — round-robin
+    # retrying dispatch over the LOCAL devices, CPU recompute last
+    ex: TileExecutor | None = None
+    devices = jax.local_devices()
+    tile_jit, _ = _block_tile_fn(kind, k)
+
+    def _compute_block(blk: tuple[int, int]) -> tuple:
+        nonlocal ex, n_computed
+        n_computed += 1
+        if ex is None:
+            ex = TileExecutor(devices, cfg, fault_site="ring_dispatch")
+        a, b = blk
+        asl = slice(a * n_local, (a + 1) * n_local)
+        bsl = slice(b * n_local, (b + 1) * n_local)
+
+        def dispatch(slot: int):
+            dev = devices[slot]
+            return tile_jit(
+                jax.device_put(ids[asl], dev),
+                jax.device_put(counts[asl], dev),
+                jax.device_put(ids[bsl], dev),
+                jax.device_put(counts[bsl], dev),
+            )
+
+        def cpu_fallback():
+            cpu = jax.local_devices(backend="cpu")[0]
+            with jax.default_device(cpu):
+                return tile_jit(ids[asl], counts[asl], ids[bsl], counts[bsl])
+
+        out = ex.finalize(ex.submit(dispatch), cpu_fallback=cpu_fallback)
+        counters.add_fault("ring_blocks_recovered")
+        return tuple(np.asarray(t) for t in out)
+
+    try:
+        missing0 = _missing_blocks() if resume else list(schedule)
+        # the collective step loop is entered only when EVERY process will
+        # (fresh store scan is replicated state) and the pod is whole — a
+        # partial resume or an inherited degradation goes straight to the
+        # per-block path, which needs no full-pod collective at all
+        run_ring = len(missing0) == len(schedule) and (hb is None or not hb.dead)
+        aborted = None
+        if run_ring:
+            ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
+            counts_d = put_global(counts, NamedSharding(mesh, P(AXIS)))
+            auto = AutoTimeout(cfg)
+            # dispatch every step up front: JAX dispatch is async and each
+            # step consumes the previous step's device-resident B operand,
+            # so the queue keeps the devices as busy as the monolithic
+            # program's fori_loop did — the host only pays one python
+            # round per step
+            def _dispatch_all() -> list[tuple[int, list]]:
+                out_pending: list[tuple[int, list]] = []
+                b_ids, b_counts = ids_d, counts_d
+                for i in range(n_steps):
+                    fn, _ = _ring_step_fn(kind, k, mesh, i < n_steps - 1)
+                    *outs, b_ids, b_counts = fn(ids_d, counts_d, b_ids, b_counts)
+                    out_pending.append((i, outs))
+                return out_pending
+
+            pending: list[tuple[int, list]] = []
+            if elastic:
+                # the enqueue itself can block inside the collective
+                # transport when a peer dies mid-rendezvous (observed:
+                # a survivor wedged INSIDE dispatch, never reaching the
+                # monitored finalize loop) — so the dispatch loop runs
+                # under heartbeat monitoring too; on a confirmed death
+                # everything falls to per-block recovery
+                ok, res = wait_elastic(
+                    _dispatch_all,
+                    hb,
+                    collective_timeout_s(),
+                    what=f"dense ring step dispatch ({kind}, {n_steps} steps)",
+                    site="ring_dispatch",
+                )
+                if ok:
+                    pending = res
+                else:
+                    aborted = "pod degraded during step dispatch"
+            else:
+                try:
+                    pending = _dispatch_all()
+                except Exception as e:  # noqa: BLE001 — recovery recomputes
+                    aborted = e
+            for i, outs in pending:
+                if aborted is not None:
+                    break
+                # the elastic chaos tests SIGKILL a pod member here — at a
+                # step boundary, with finished steps' blocks already durable
+                faults.fire("ring_step")
+                t0 = time.perf_counter()
+                try:
+                    if elastic:
+                        def wait(outs=outs):
+                            faults.fire("ring_dispatch")
+                            jax.block_until_ready(outs)
+
+                        ok, _ = wait_elastic(
+                            wait,
+                            hb,
+                            collective_timeout_s(),
+                            what=f"dense ring step {i + 1}/{n_steps} ({kind})",
+                            site="ring_dispatch",
+                        )
+                        if not ok:
+                            aborted = "pod degraded"
+                            break
+                    else:
+                        _wait_ready(outs, auto.effective(), "ring_dispatch", None)
+                except WatchdogTimeout as e:
+                    counters.add_fault("ring_step_failures")
+                    logger.warning(
+                        "dense ring: step %d/%d tripped the %ss watchdog — "
+                        "recomputing its blocks per-tile",
+                        i + 1, n_steps, round(auto.effective(), 1),
+                    )
+                    aborted = e
+                    break
+                except (CollectiveTimeout, FaultTolError):
+                    raise  # wedged peer / max_dead exceeded: abort loudly
+                except Exception as e:  # noqa: BLE001 — per-block recovery
+                    counters.add_fault("ring_step_failures")
+                    logger.warning(
+                        "dense ring: step %d/%d failed (%s) — recomputing "
+                        "its blocks per-tile", i + 1, n_steps, e,
+                    )
+                    aborted = e
+                    break
+                auto.note(time.perf_counter() - t0)
+                _store_step(i, outs)
+            derived = auto.derived()
+            if derived is not None:
+                # the per-step watchdog deadline the run derived from its
+                # own step latencies (same rule as the streaming tiles)
+                counters.set_gauge("derived_ring_step_timeout_s", round(derived, 3))
+
+        if pc > 1 and not local_mesh and store is None:
+            # store-less pod ring: peers' rows cannot come from a shard
+            # store, and recomputing them locally would be D x redundant —
+            # exchange host rows once instead (the monolithic gather's
+            # equivalent; bit-identical values, same bytes over the wire).
+            # A failed step cannot be recovered here (no shared medium to
+            # coordinate per-block re-deals): abort with guidance.
+            if aborted is not None:
+                raise FaultTolError(
+                    f"dense ring: a ring step failed on a multi-process pod "
+                    f"with no shared block store — per-block recovery needs "
+                    f"one (configure_ring / checkpoint_dir). Original "
+                    f"failure: {aborted!r}"
+                ) from (aborted if isinstance(aborted, BaseException) else None)
+            _exchange_rows_no_store(
+                mem, mesh, schedule, n_outputs, n_local, n_pad, pid, kind
+            )
+
+        # per-block completion: anything still missing — resume gaps, an
+        # aborted ring, a dead member's unfinished rows — is recomputed
+        # block-by-block. Elastic pods deal missing blocks across the
+        # CURRENT live set (re-dealing on every epoch bump) and need no
+        # full-pod collective; completion is file-based over the store.
+        if not elastic:
+            for blk in _missing_blocks():
+                mem[blk] = _compute_block(blk)
+                _save_block(blk, mem[blk], hb.epoch if hb is not None else 0)
+        else:
+            stall_budget = collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S)
+            done_written = False
+            last_progress = time.time()
+            progress_sig = None
+            while True:
+                live = list(hb.live)
+                missing = _missing_blocks()
+                computed = False
+                for blk in list(missing):
+                    if live[sched_idx[blk] % len(live)] != pid:
+                        continue
+                    computed = True
+                    mem[blk] = _compute_block(blk)
+                    missing.remove(blk)
+                    _save_block(blk, mem[blk], hb.epoch)
+                    if hb.maybe_check():
+                        break  # epoch bumped mid-pass: re-deal promptly
+                if not missing and not done_written:
+                    # publish completion BEFORE leaving: a done-note peer
+                    # is never declared dead however stale its beats go
+                    hb.mark_done(len(mem))
+                    done_written = True
+                sig = (len(missing), tuple(hb.live))
+                if computed or sig != progress_sig:
+                    progress_sig = sig
+                    last_progress = time.time()
+                if not missing:
+                    break
+                if hb.maybe_check():
+                    continue
+                if time.time() - last_progress > stall_budget:
+                    raise CollectiveTimeout(
+                        f"dense ring completion stalled for {stall_budget:.0f}s:"
+                        f" block(s) {missing[:8]}{'...' if len(missing) > 8 else ''}"
+                        f" unfinished on live set {hb.live} whose heartbeats are"
+                        f" still fresh — a peer is wedged, not dead. Restart the"
+                        f" pod; block-level checkpoints will resume finished"
+                        f" work."
+                    )
+                if not computed:
+                    time.sleep(min(5.0, max(0.05, hb.cadence)))
+
+        # canonical assembly: schedule order, own blocks from memory, the
+        # rest from the store; a corrupt/vanished shard is recomputed INTO
+        # ITS OWN PATH (idempotent heal, streaming's contract)
+        mats = [np.zeros((n_pad, n_pad), np.float32) for _ in range(n_outputs)]
+        for blk in schedule:
+            tiles = mem.get(blk)
+            if tiles is None:
+                path = shard_of.get(blk) or (
+                    _find_block(store, *blk) if store is not None else None
+                )
+                tiles = _load_block(path, n_outputs) if path is not None else None
+                if tiles is None:
+                    from drep_tpu.parallel.streaming import _shard_epoch
+
+                    heal_epoch = (
+                        _shard_epoch(path)
+                        if path is not None
+                        else (hb.epoch if hb is not None else 0)
+                    )
+                    tiles = _compute_block(blk)
+                    mem[blk] = tiles
+                    _save_block(blk, tiles, heal_epoch)
+            a, b = blk
+            for oi in range(n_outputs):
+                mats[oi][
+                    a * n_local : (a + 1) * n_local, b * n_local : (b + 1) * n_local
+                ] = tiles[oi]
+        if half:
+            for g in mats:
+                mirror_half_ring(g, D)
+
+        if hb is not None and hb.epoch > 0:
+            if elastic:
+                # stamped by EVERY survivor that observed the degradation,
+                # not a designated leader: a survivor can legitimately
+                # finish without ever learning of the death (a peer
+                # detected and covered the missing blocks first), so the
+                # "lowest live process" may hold a healthy view and never
+                # stamp. Concurrent stampers write the same keys — the
+                # read-modify-atomic-write race is benign.
+                from drep_tpu.utils.ckptmeta import stamp_checkpoint_meta
+
+                stamp_checkpoint_meta(
+                    store, {"pod_epochs": hb.epoch + 1, "dead_processes": hb.dead}
+                )
+            logger.warning(
+                "dense ring: completed DEGRADED — pod member(s) %s died "
+                "mid-ring; survivors %s recomputed the missing blocks "
+                "per-tile across %d ownership epoch(s)",
+                hb.dead, hb.live, hb.epoch + 1,
+            )
+        return mats, n_computed
+    finally:
+        if hb is not None:
+            hb.close()
 
 
 def sharded_mash_allpairs(
-    packed: PackedSketches, k: int = 21, mesh=None, full_grid: bool = False
+    packed: PackedSketches,
+    k: int = 21,
+    mesh=None,
+    full_grid: bool = False,
+    monolithic: bool | None = None,
+    checkpoint_dir: str | None = None,
+    ft_config=None,
 ) -> np.ndarray:
     """[N, N] Mash distance matrix, ring-sharded over the mesh (half-ring
-    triangular schedule unless ``full_grid``)."""
-    (dist,) = ring_allpairs(packed, "mash", k, mesh=mesh, full_grid=full_grid)
+    triangular schedule unless ``full_grid``; host-stepped elastic
+    execution unless ``monolithic``)."""
+    (dist,) = ring_allpairs(
+        packed, "mash", k, mesh=mesh, full_grid=full_grid,
+        monolithic=monolithic, checkpoint_dir=checkpoint_dir, ft_config=ft_config,
+    )
     np.fill_diagonal(dist, 0.0)
     return dist
 
 
 def sharded_containment_allpairs(
-    packed: PackedSketches, k: int = 21, mesh=None, full_grid: bool = False
+    packed: PackedSketches,
+    k: int = 21,
+    mesh=None,
+    full_grid: bool = False,
+    monolithic: bool | None = None,
+    checkpoint_dir: str | None = None,
+    ft_config=None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """([N,N] symmetric max-containment ani, [N,N] directional cov),
     ring-sharded over the mesh. The ring ships symmetric raw intersection
     sizes (half-ring schedule); both cov directions derive from `counts`
     on host — same directional-cov contract as every other containment
     path."""
-    (inter,) = ring_allpairs(packed, "containment", k, mesh=mesh, full_grid=full_grid)
+    (inter,) = ring_allpairs(
+        packed, "containment", k, mesh=mesh, full_grid=full_grid,
+        monolithic=monolithic, checkpoint_dir=checkpoint_dir, ft_config=ft_config,
+    )
     return ani_cov_from_intersections(inter, packed.counts, k)

@@ -22,21 +22,33 @@ crash story (atomic shards + Cdb resume):
   so a degraded run is honest about how it finished.
 - :func:`retrying_call` — the same bounded-retry/watchdog contract for
   coarse-grained dispatches that manage their own devices (the secondary
-  engine calls in cluster/controller.py, the dense ring in
-  parallel/allpairs.py).
+  engine calls in cluster/controller.py, the monolithic reference ring
+  in parallel/allpairs.py). ``local_only=True`` is the caller's promise
+  that the dispatch is process-local (the pod-clamped secondary mesh),
+  which makes per-batch retries safe even on multi-process pods.
 - :func:`run_with_timeout` — a watchdog for multi-host collectives
   (the streaming edge allgather, the checkpoint-dir barrier): a dead
   peer produces an actionable error in minutes instead of an infinite
   hang. The abandoned waiter thread is a daemon — XLA gives no way to
   cancel an in-flight collective, so the process can still exit.
+- :func:`wait_elastic` — the elastic counterpart: a bounded collective
+  wait that consults the heartbeat manager while blocked, so a confirmed
+  pod death ABANDONS the collective into the caller's re-deal path (the
+  step-wise ring's block recovery, the stage-open barrier's degraded
+  admission) instead of aborting.
+- :class:`AutoTimeout` — the shared auto-derived watchdog rule (k x
+  rolling median, warmup-excluded, floored) used by both the streaming
+  TileExecutor and the step-wise ring's per-step waits.
 - :class:`HeartbeatManager` + the module pod state — the elastic-pod
-  protocol for the streaming primary: per-process heartbeat files in the
-  shared checkpoint dir (cadence ``DREP_TPU_HEARTBEAT_S``), staleness-based
-  death detection, and an ownership EPOCH that survivors bump to re-deal
-  the dead member's unfinished stripes (parallel/streaming.py drives it;
-  utils/ckptmeta.py routes degraded-pod barriers over the survivor set).
-  A dead pod member no longer aborts the run at the collective timeout —
-  the survivors finish the stage with a bit-identical edge list.
+  protocol: per-process heartbeat files in the shared checkpoint dir
+  (cadence ``DREP_TPU_HEARTBEAT_S``), staleness-based death detection,
+  and an ownership EPOCH that survivors bump to re-deal the dead
+  member's unfinished work — streaming stripes (parallel/streaming.py)
+  and dense-ring blocks (parallel/allpairs.py) alike; utils/ckptmeta.py
+  routes degraded-pod barriers over the survivor set and admits
+  pre-barrier deaths via :func:`current_heartbeat`. A dead pod member no
+  longer aborts the run at the collective timeout — the survivors finish
+  the stage bit-identically.
 
 Fault-injection points (utils/faults.py) fire INSIDE the watched
 regions, so injected hangs trip the same watchdogs real wedges do.
@@ -139,6 +151,49 @@ AUTO_TIMEOUT_MIN_SAMPLES = 4
 AUTO_TIMEOUT_WARMUP_CAP_S = 300.0
 
 
+class AutoTimeout:
+    """The auto-derived per-dispatch watchdog deadline, shared by the
+    streaming TileExecutor and the step-wise dense ring (one rule so the
+    two derivations can never drift): k x the rolling median of the
+    caller's own finalize-wait latencies, warmup-excluded, floored at
+    ``AUTO_TIMEOUT_FLOOR_S`` — and under the generous warmup cap until
+    enough samples exist, so even an early wedge cannot hang forever.
+    An explicit positive ``dispatch_timeout_s`` in the config is always
+    authoritative; auto off means disabled (0.0)."""
+
+    def __init__(self, config: "FaultTolConfig") -> None:
+        self.config = config
+        self._waits: deque[float] = deque(maxlen=64)
+        self._n_waits = 0
+
+    def note(self, dt: float) -> None:
+        self._n_waits += 1
+        if self._n_waits > AUTO_TIMEOUT_WARMUP:
+            self._waits.append(dt)
+
+    def effective(self) -> float:
+        if self.config.dispatch_timeout_s > 0:
+            return self.config.dispatch_timeout_s
+        if not self.config.auto_timeout:
+            return 0.0
+        if len(self._waits) < AUTO_TIMEOUT_MIN_SAMPLES:
+            return AUTO_TIMEOUT_WARMUP_CAP_S
+        return max(
+            AUTO_TIMEOUT_MULT * statistics.median(self._waits),
+            AUTO_TIMEOUT_FLOOR_S,
+        )
+
+    def derived(self) -> float | None:
+        """The derived deadline, or None when an explicit value governs /
+        auto is off / still warming up (the warmup cap is a bound, not a
+        derivation)."""
+        if self.config.dispatch_timeout_s > 0 or not self.config.auto_timeout:
+            return None
+        if len(self._waits) < AUTO_TIMEOUT_MIN_SAMPLES:
+            return None
+        return self.effective()
+
+
 # process-wide defaults, set once per run by the cluster controller from
 # the CLI flags; paths without explicit config (the dense ring) read this
 DEFAULT_CONFIG = FaultTolConfig()
@@ -189,6 +244,20 @@ def reset_pod(t0: float | None = None) -> None:
 
 def mark_pod_degraded(epoch: int, live: list[int], dead: list[int]) -> None:
     _POD.update(epoch=int(epoch), live=list(live), dead=list(dead))
+
+
+# the heartbeat manager of the CURRENTLY running heartbeat-managed stage
+# (set by HeartbeatManager.start, cleared by close). Registered process-
+# globally so code that cannot thread the manager — the stage-open barrier
+# in utils/ckptmeta.py — can still consult peer liveness while it waits:
+# a peer that dies BEFORE ever reaching the barrier is diagnosed from its
+# missing/stale heartbeat note and, within max_dead, the survivors
+# continue degraded instead of raising at the collective timeout.
+_CURRENT_HB: "HeartbeatManager | None" = None
+
+
+def current_heartbeat() -> "HeartbeatManager | None":
+    return _CURRENT_HB
 
 
 # per-(note_dir) count of heartbeat-managed stages THIS process has run —
@@ -354,6 +423,8 @@ class HeartbeatManager:
         else:
             reset_pod(t0=self._started_at)
         self._beat()
+        global _CURRENT_HB
+        _CURRENT_HB = self
         if self.cadence > 0:
             self._thread = threading.Thread(
                 target=self._beat_loop, daemon=True, name="drep-heartbeat"
@@ -506,6 +577,9 @@ class HeartbeatManager:
     def close(self) -> None:
         import contextlib
 
+        global _CURRENT_HB
+        if _CURRENT_HB is self:
+            _CURRENT_HB = None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=max(1.0, 2 * self.cadence))
@@ -562,6 +636,87 @@ def _wait_ready(value: Any, timeout_s: float, site: str, device: int | None) -> 
     )
 
 
+def wait_elastic(
+    fn: Callable[[], Any],
+    hb: "HeartbeatManager",
+    timeout_s: float,
+    what: str,
+    site: str = "allgather",
+) -> tuple[bool, Any]:
+    """Bounded wait on a (possibly collective) blocking call with live
+    heartbeat monitoring — THE primitive that turns "a peer died inside /
+    before our collective" from an infinite hang into an elastic re-deal.
+
+    Runs `fn` on a disposable daemon thread and polls the heartbeat
+    manager while waiting:
+
+    - `fn` completes -> ``(True, value)`` (a raise from `fn` with the pod
+      still healthy at the deadline is re-raised).
+    - the pod DEGRADES (``hb.check()`` bumps the ownership epoch, or this
+      process adopts a peer's published death verdict) -> ``(False, None)``
+      immediately — the caller abandons the collective (the worker thread
+      stays parked inside the runtime; XLA collectives are not
+      cancellable) and re-deals the dead member's work. A collective-layer
+      ERROR from `fn` (a dead peer resets the transport) does NOT abort by
+      itself: the death verdict needs a full staleness window to mature,
+      so the error is held until the heartbeat evidence confirms it (or
+      the deadline passes — then it surfaces).
+    - `timeout_s` passes with every heartbeat fresh -> CollectiveTimeout
+      (a peer is wedged, not dead — re-dealing cannot help).
+
+    ``hb.check()`` raising (max_dead exceeded, or a verdict fencing THIS
+    process) propagates."""
+    from drep_tpu.utils.profiling import counters
+
+    box: dict[str, Any] = {}
+    done = threading.Event()
+
+    def work() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed/held below
+            box["err"] = e
+        finally:
+            done.set()
+
+    threading.Thread(target=work, daemon=True, name=f"drep-elastic-{site}").start()
+    epoch0 = hb.epoch
+    deadline = time.time() + timeout_s if timeout_s > 0 else None
+    poll = min(1.0, max(0.05, hb.cadence if hb.cadence > 0 else 0.25))
+    held: BaseException | None = None
+    while True:
+        if done.wait(poll):
+            if "err" not in box:
+                return True, box["value"]
+            held = box["err"]
+            if deadline is None:
+                # timeout disabled (the module's t<=0 convention — run
+                # bare): there is no deadline at which a held error would
+                # ever surface, so propagate it immediately instead of
+                # silently polling forever
+                raise held
+            done.clear()  # keep polling: the death verdict must mature
+        hb.check()
+        if hb.epoch != epoch0:
+            return False, None
+        if deadline is not None and time.time() > deadline:
+            counters.add_fault("watchdog_trips")
+            if held is not None:
+                raise CollectiveTimeout(
+                    f"{what} failed at the collective layer ({held!r}) and no "
+                    f"pod-member death was confirmed within {timeout_s:.0f}s — "
+                    f"restart the pod; shard-level checkpoints resume finished "
+                    f"work."
+                ) from held
+            raise CollectiveTimeout(
+                f"{what} did not complete within {timeout_s:.0f}s and every "
+                f"peer's heartbeat is still fresh — a peer is wedged, not "
+                f"dead. Restart the pod; shard-level checkpoints resume "
+                f"finished work. (Timeout via {COLLECTIVE_TIMEOUT_ENV}; "
+                f"heartbeat cadence via {HEARTBEAT_ENV}.)"
+            )
+
+
 class TileExecutor:
     """Retrying round-robin dispatcher over the local devices.
 
@@ -599,8 +754,7 @@ class TileExecutor:
         self._rr = 0
         # rolling finalize-wait latencies for the auto-derived watchdog
         # (dispatch_timeout_s == 0 + auto_timeout): warmup-excluded, capped
-        self._waits: deque[float] = deque(maxlen=64)
-        self._n_waits = 0
+        self._auto = AutoTimeout(self.config)
 
     # -- scheduling -------------------------------------------------------
     def next_slot(self, exclude: frozenset | set = frozenset()) -> int:
@@ -620,11 +774,10 @@ class TileExecutor:
     def quarantined(self) -> list[int]:
         return [i for i in range(len(self.devices)) if i not in self.active]
 
-    # -- auto-derived watchdog -------------------------------------------
+    # -- auto-derived watchdog (AutoTimeout — one rule shared with the
+    # step-wise ring loop in parallel/allpairs.py) ------------------------
     def _note_wait(self, dt: float) -> None:
-        self._n_waits += 1
-        if self._n_waits > AUTO_TIMEOUT_WARMUP:
-            self._waits.append(dt)
+        self._auto.note(dt)
 
     def _effective_timeout(self) -> float:
         """The per-dispatch watchdog this finalize runs under: an explicit
@@ -633,27 +786,14 @@ class TileExecutor:
         warmup-excluded samples exist — and before then runs under the
         generous warmup cap, so an early wedge still cannot hang the run
         forever; auto off = disabled."""
-        if self.config.dispatch_timeout_s > 0:
-            return self.config.dispatch_timeout_s
-        if not self.config.auto_timeout:
-            return 0.0
-        if len(self._waits) < AUTO_TIMEOUT_MIN_SAMPLES:
-            return AUTO_TIMEOUT_WARMUP_CAP_S
-        return max(
-            AUTO_TIMEOUT_MULT * statistics.median(self._waits),
-            AUTO_TIMEOUT_FLOOR_S,
-        )
+        return self._auto.effective()
 
     def derived_timeout_s(self) -> float | None:
         """The auto-derived deadline, or None when an explicit value
         governs / auto is off / still warming up (the warmup cap is a
         bound, not a derivation). Reported into perf_counters.json
         (gauges) by the streaming loop."""
-        if self.config.dispatch_timeout_s > 0 or not self.config.auto_timeout:
-            return None
-        if len(self._waits) < AUTO_TIMEOUT_MIN_SAMPLES:
-            return None
-        return self._effective_timeout()
+        return self._auto.derived()
 
     def _record_failure(self, slot: int, exc: BaseException) -> None:
         from drep_tpu.utils.profiling import counters
@@ -744,27 +884,32 @@ def retrying_call(
     fn: Callable[[], Any],
     site: str,
     config: FaultTolConfig | None = None,
+    local_only: bool = False,
 ):
     """Bounded-retry wrapper for coarse dispatches that pick their own
-    devices (secondary engine calls, the dense ring). The watchdog (when
-    configured) bounds each attempt; retries re-run the whole call.
+    devices (secondary engine calls, the dense ring's monolithic
+    reference). The watchdog (when configured) bounds each attempt;
+    retries re-run the whole call.
 
-    Multi-process pods run the wrapped call BARE: the call may be a
-    collective (mesh ring / sharded secondary), and a per-process retry
-    or watchdog trip is a LOCAL decision — one process re-entering a
-    collective program (or abandoning it) while its peers sit at a
-    different program point desyncs the pod into exactly the infinite
-    hang this layer exists to remove. The streaming primary has a shared
-    ownership epoch for exactly this (HeartbeatManager + the stripe
-    re-deal in parallel/streaming.py) because its unit of work — a stripe
-    shard — is independently redoable; the dense ring and sharded
-    secondary calls are single collective programs with no such unit, so
-    their multi-host live-failure guards stay the collective timeouts
-    (run_with_timeout), which abort loudly instead of retrying.
+    Multi-process pods run the wrapped call BARE unless the caller
+    declares it ``local_only``: the call may be a full-pod collective,
+    and a per-process retry or watchdog trip is a LOCAL decision — one
+    process re-entering a collective program (or abandoning it) while its
+    peers sit at a different program point desyncs the pod into exactly
+    the infinite hang this layer exists to remove. ``local_only=True`` is
+    the caller's PROMISE that the wrapped call dispatches only on this
+    process's devices (the secondary engines clamp their mesh to local
+    chips on pods — cluster/engines.py — exactly so their batches become
+    independently retryable): a local retry then cannot desync anyone,
+    and a per-batch failure retries instead of killing the pod. The
+    step-wise dense ring has its own redoable unit (per-step block
+    shards + the elastic recovery in parallel/allpairs.py); only the
+    monolithic reference ring still runs bare here on pods, guarded by
+    the collective timeouts.
     """
     import jax
 
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and not local_only:
         return fn()
     from drep_tpu.utils.profiling import counters
 

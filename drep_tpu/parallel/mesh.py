@@ -27,8 +27,24 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 
 def make_local_mesh() -> Mesh:
     """1-D mesh over THIS process's devices only — no cross-process
-    collectives can arise from it. The degraded-pod secondary path uses
-    it so a computation never waits on a dead member's chips."""
+    collectives can arise from it.
+
+    Two regimes run on it (cluster/engines.py::_mesh_or_none):
+
+    - degraded pods — after the elastic protocol declared a member dead,
+      a global mesh would dispatch collectives that wait on the corpse
+      forever, so survivors run replicated-local instead;
+    - the SECONDARY engines on ANY multi-process pod (the `local_only`
+      contract, ISSUE 4) — a process-local dispatch is independently
+      retryable (parallel/faulttol.py retrying_call `local_only`), so a
+      mid-batch failure retries on this process instead of desyncing the
+      pod. The step-wise dense ring keeps the global mesh (it has its
+      own per-block redoable unit — parallel/allpairs.py).
+
+    A shard_map program over this mesh sees axis size = local device
+    count, so its block decomposition matches any OTHER live process
+    running the same program — replicated results are bit-identical
+    across the pod."""
     devices = jax.local_devices()
     return jax.make_mesh((len(devices),), (AXIS,), devices=devices)
 

@@ -454,9 +454,16 @@ def streaming_mash_edges(
         }
         # leader-only clear + barrier on >1 process lives inside
         # open_checkpoint_dir (shared with the secondary shard store).
-        # A raising open (dead peer at the stage-open barrier) must not
-        # leak the beat writer: a zombie beat would keep this process
-        # looking alive in the store forever.
+        # Because the heartbeat manager above started BEFORE this open,
+        # the barrier is heartbeat-aware (utils/ckptmeta.py): a peer that
+        # dies before ever reaching it — even the leader — is admitted as
+        # a pod death within --max_dead_processes, the open completes
+        # over the survivor set, and the elastic loop below starts
+        # DEGRADED instead of this call aborting (ISSUE 4; previously any
+        # pre-barrier death raised at the collective timeout). A raising
+        # open (death budget exceeded, heartbeats disabled, wedged peer)
+        # must not leak the beat writer: a zombie beat would keep this
+        # process looking alive in the store forever.
         try:
             resume = open_checkpoint_dir(checkpoint_dir, meta, clear_suffixes=(".npz",))
         except BaseException:

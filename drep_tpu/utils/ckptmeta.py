@@ -123,21 +123,45 @@ def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tup
     must invoke this in replicated control flow on every (live) process
     (true for both shard stores — streaming row blocks and secondary
     per-cluster results).
+
+    Pre-barrier death admission (ISSUE 4): when the caller started a
+    HeartbeatManager before this open (the streaming primary and the
+    step-wise dense ring both do), a peer that dies BEFORE ever reaching
+    the barrier — including the leader itself — is diagnosed from its
+    missing/stale heartbeat note while the survivors wait; within
+    ``--max_dead_processes`` the pod degrades (ownership epoch bump) and
+    the open completes over the survivor set instead of raising at the
+    collective timeout. A dead LEADER is replaced: the open restarts with
+    the lowest live process leading the clear.
     """
     import jax
 
     if jax.process_count() > 1:
         from drep_tpu.parallel.faulttol import pod_live
 
-        live = pod_live()
-        leader = 0 if live is None else min(live)
-        resume = False
-        if jax.process_index() == leader:
-            resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
-        barrier_with_timeout("drep_tpu_ckpt_open:" + os.path.abspath(ckpt_dir), ckpt_dir)
-        if jax.process_index() != leader:
-            resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
-        return resume
+        tag = "drep_tpu_ckpt_open:" + os.path.abspath(ckpt_dir)
+        # the barrier may degrade the pod mid-wait (pre-barrier death
+        # admission); each pass re-reads the live set, and a pass whose
+        # LEADER died before clearing restarts under the new leader — at
+        # most max_dead_processes + 1 passes, bounded by process count
+        for _ in range(jax.process_count()):
+            live = pod_live()
+            leader = 0 if live is None else min(live)
+            resume = False
+            if jax.process_index() == leader:
+                resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
+            barrier_with_timeout(tag, ckpt_dir)
+            live2 = pod_live()
+            new_leader = 0 if live2 is None else min(live2)
+            if new_leader != leader:
+                continue  # leader died at/before this barrier: redo under it
+            if jax.process_index() != leader:
+                resume = _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
+            return resume
+        raise RuntimeError(
+            f"open_checkpoint_dir({ckpt_dir!r}): leadership never stabilized "
+            f"across {jax.process_count()} passes — pod state is inconsistent"
+        )
     return _open_checkpoint_dir_local(ckpt_dir, meta, clear_suffixes)
 
 
@@ -172,11 +196,30 @@ def barrier_with_timeout(tag: str, note_dir: str) -> None:
     sentinel notes then BECOME the barrier — each survivor publishes its
     sequence number and polls for every live peer's, with the collective
     timeout bounding the wait (:func:`_file_barrier`).
+
+    Heartbeat-aware ADMISSION on a healthy pod (ISSUE 4): when a
+    HeartbeatManager is live (faulttol.current_heartbeat — the streaming
+    primary and the step-wise ring start theirs BEFORE opening their
+    store), the barrier never enters a jax collective AT ALL: the
+    sentinel-note file barrier runs from the start, with peer liveness
+    monitored while it waits. A peer that dies before ever reaching the
+    barrier is declared dead from its missing/stale heartbeat note, the
+    pod degrades (within ``max_dead``), and the barrier COMPLETES over
+    the survivor set instead of raising at the collective timeout. The
+    jax collective is deliberately avoided here even on a healthy pod: a
+    sync the dead peer never JOINS blocks forever inside the runtime, and
+    an abandoned never-joined collective can wedge the local device
+    queues — poisoning the survivor's own post-degradation dispatches
+    (observed on the CPU backend; a torn collective from a SIGKILLed
+    peer errors out instead, which is why the mid-stage paths may still
+    abandon theirs). Without a live heartbeat manager the pre-elastic
+    contract stands: a dead peer produces the actionable
+    CollectiveTimeout below.
     """
     import jax
     from jax.experimental import multihost_utils as mhu
 
-    from drep_tpu.parallel.faulttol import pod_live, run_with_timeout
+    from drep_tpu.parallel.faulttol import current_heartbeat, pod_live, run_with_timeout
 
     pid, pc = jax.process_index(), jax.process_count()
     seq = _BARRIER_SEQ.get(tag, 0) + 1
@@ -185,6 +228,13 @@ def barrier_with_timeout(tag: str, note_dir: str) -> None:
     live = pod_live()
     if live is not None:
         _file_barrier(tag, note_dir, live, pid, seq)
+        return
+    hb = current_heartbeat()
+    if hb is not None and hb.cadence > 0 and pc > 1:
+        from drep_tpu.utils import faults
+
+        faults.fire("barrier")  # same injection point as the bare path
+        _file_barrier(tag, note_dir, None, pid, seq, hb=hb)
         return
     atomic_write_bytes(_barrier_note(note_dir, tag, pid), str(seq).encode())
 
@@ -226,11 +276,18 @@ def barrier_with_timeout(tag: str, note_dir: str) -> None:
             os.remove(_barrier_note(note_dir, tag, pid))
 
 
-def _file_barrier(tag: str, note_dir: str, live: list[int], pid: int, seq: int) -> None:
-    """Sentinel-note barrier over the SURVIVOR set of a degraded pod.
+def _file_barrier(
+    tag: str,
+    note_dir: str,
+    live: list[int] | None,
+    pid: int,
+    seq: int,
+    hb=None,
+) -> None:
+    """Sentinel-note barrier over a process set.
 
-    Each live process atomically publishes its per-tag sequence number and
-    polls for every live peer's note to reach that sequence. Notes are
+    Each process atomically publishes its per-tag sequence number and
+    polls for every peer's note to reach that sequence. Notes are
     not removed by the barrier itself (the sequence is monotone under
     replicated control flow, so barrier k's note satisfies any waiter at
     <= k); a peer's note counts once SEEN — a process deletes its barrier
@@ -239,7 +296,20 @@ def _file_barrier(tag: str, note_dir: str, live: list[int], pid: int, seq: int) 
     already arrived. A previous run's stale notes are rejected two ways:
     each process deletes its own at heartbeat start (pre-barrier), and
     nothing with an mtime older than this run's heartbeat stage
-    (faulttol.pod_t0, minus a clock-skew margin) can satisfy the wait."""
+    (faulttol.pod_t0, minus a clock-skew margin) can satisfy the wait.
+
+    Two modes:
+
+    - `live` given, `hb` None — the degraded-pod barrier: waits on the
+      fixed survivor set; a no-show within the collective timeout is a
+      SECOND failure and raises.
+    - `hb` given (live derived from ``hb.live`` each poll) — the
+      heartbeat-ADMISSION barrier on a healthy pod: while waiting, peer
+      liveness is checked; a peer whose heartbeat note never appears (it
+      died before ever reaching this barrier) is declared dead within
+      ``max_dead``, drops out of the awaited set, and the barrier
+      completes over the survivors — pre-barrier death admission.
+    """
     import time
 
     from drep_tpu.parallel.faulttol import CollectiveTimeout, collective_timeout_s, pod_t0
@@ -250,8 +320,9 @@ def _file_barrier(tag: str, note_dir: str, live: list[int], pid: int, seq: int) 
     deadline = time.time() + timeout if timeout > 0 else None
     seen: set[int] = set()
     while True:
+        waiting_on = list(hb.live) if hb is not None else live
         missing = []
-        for p in live:
+        for p in waiting_on:
             if p == pid or p in seen:
                 continue
             loc = _barrier_note(note_dir, tag, p)
@@ -267,12 +338,20 @@ def _file_barrier(tag: str, note_dir: str, live: list[int], pid: int, seq: int) 
                 missing.append(p)
         if not missing:
             return
+        if hb is not None:
+            # admission: a no-show that stopped (or never started)
+            # heartbeating is declared dead within max_dead — the next
+            # poll waits on the shrunken live set. Raises past the death
+            # budget, or when a verdict fences THIS process.
+            hb.maybe_check()
         if deadline is not None and time.time() > deadline:
             raise CollectiveTimeout(
-                f"degraded-pod file barrier {tag!r}: live process(es) {missing} "
-                f"of survivor set {live} never arrived within {timeout:.0f}s — "
-                f"a second failure after the epoch bump. Restart the pod; "
-                f"shard-level checkpoints will resume finished work."
+                f"checkpoint file barrier {tag!r}: process(es) {missing} of "
+                f"awaited set {waiting_on} never arrived within {timeout:.0f}s "
+                f"and their heartbeats are "
+                f"{'still fresh — wedged, not dead' if hb is not None else 'not monitored here'}. "
+                f"Restart the pod; shard-level checkpoints will resume "
+                f"finished work."
             )
         # cadence-scaled poll (same backoff as the elastic wait loop): a
         # slow peer can take minutes, and a 20 Hz stat+read per peer

@@ -32,9 +32,10 @@ Spec syntax (``DREP_TPU_FAULTS`` env var, or :func:`configure`)::
   shared by every pod member), ``skip=N`` (ignore the first N matching
   calls — e.g. let a process finish two stripes before killing it).
 
-The ``kill`` mode (``process_death`` site) SIGKILLs the calling process —
-the pod-member death the elastic streaming protocol survives, made
-deterministic for chaos tests (indistinguishable from an external
+The ``kill`` mode (``process_death`` site, fired per streaming stripe;
+``ring_step`` site, fired per dense-ring step boundary) SIGKILLs the
+calling process — the pod-member death the elastic protocols survive,
+made deterministic for chaos tests (indistinguishable from an external
 SIGKILL: no cleanup, no atexit, heartbeats simply stop).
 
 Zero overhead when unset: the spec parses once (lazily, from the env);
@@ -55,7 +56,8 @@ ENV = "DREP_TPU_FAULTS"
 # cannot silently inject nothing and "pass"
 SITES = (
     "streaming_tile",  # per-tile watchdog'd wait, parallel/streaming.py
-    "ring_dispatch",  # dense all-pairs ring shard_map call, parallel/allpairs.py
+    "ring_dispatch",  # ring step/recovery dispatch waits, parallel/allpairs.py
+    "ring_step",  # per-ring-step host boundary, parallel/allpairs.py (kill)
     "secondary_batch",  # secondary engine calls, cluster/controller.py
     "shard_write",  # atomic shard publish, utils/ckptmeta.py (torn)
     "allgather",  # multi-host edge allgather, parallel/streaming.py

@@ -42,11 +42,14 @@ class Counters:
 
     stages: dict[str, _Stage] = field(default_factory=dict)
     # fault-tolerance accounting (parallel/faulttol.py): retries,
-    # watchdog_trips, quarantined_devices, cpu_fallback_tiles, plus
-    # injected_<site>_<mode> counts from utils/faults.py. A degraded run
-    # must be honest about HOW it finished — a completed run that burned
-    # 40 retries or benched a chip is not the same measurement as a clean
-    # one, and bench records must be able to tell them apart.
+    # watchdog_trips, quarantined_devices, cpu_fallback_tiles,
+    # dead_processes / pod_epoch_bumps (elastic pod), ring_step_failures /
+    # ring_blocks_recovered (step-wise dense ring, parallel/allpairs.py),
+    # plus injected_<site>_<mode> counts from utils/faults.py. A degraded
+    # run must be honest about HOW it finished — a completed run that
+    # burned 40 retries, benched a chip, or recomputed ring blocks
+    # per-tile is not the same measurement as a clean one, and bench
+    # records must be able to tell them apart.
     faults: dict[str, int] = field(default_factory=dict)
     # derived operational values (not event counts): e.g. the auto-derived
     # per-dispatch watchdog deadline the run actually used when

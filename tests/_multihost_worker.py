@@ -43,8 +43,8 @@ def main() -> None:
     except (AttributeError, ValueError):
         pass
     init_kwargs = {}
-    if mode == "elastic":
-        # the elastic case kills a pod member ON PURPOSE: the jax
+    if mode in ("elastic", "elastic_prebarrier", "ring", "secondary_retry"):
+        # these cases kill (or early-exit) a pod member ON PURPOSE: the jax
         # coordination service's own death detection must stay far beyond
         # the test horizon, or it broadcasts the death as a fatal error
         # and the client layer abort()s the very survivors under test
@@ -72,6 +72,15 @@ def main() -> None:
         return
     if mode == "elastic":
         _elastic_case(pid, nproc, outdir, sys.argv[6])
+        return
+    if mode == "elastic_prebarrier":
+        _elastic_case(pid, nproc, outdir, sys.argv[6], die_prebarrier=True)
+        return
+    if mode == "ring":
+        _ring_case(pid, nproc, outdir, sys.argv[6])
+        return
+    if mode == "secondary_retry":
+        _secondary_retry_case(pid, nproc, outdir)
         return
 
     from drep_tpu.ops.minhash import all_vs_all_mash, pack_sketches
@@ -290,18 +299,80 @@ def _elastic_packed():
     )
 
 
-def _elastic_case(pid: int, nproc: int, outdir: str, ckpt_dir: str) -> None:
+def _finish_pod_case(pid: int, nproc: int, outdir: str) -> None:
+    """Shared pod-case epilogue: write the ok-file, keep process 0 (the
+    jax coordination service host) alive until every still-live peer has
+    published its ok-file, then exit hard — a killed peer leaves the
+    coordination service in an error state and interpreter teardown can
+    wedge on the distributed client; the artifacts are the verdict."""
+    with open(os.path.join(outdir, f"ok_{pid}"), "w") as f:
+        f.write("ok")
+    if pid == 0:
+        # process 0 hosts the jax coordination service: it must exit LAST,
+        # or every still-running peer's error poll sees the service socket
+        # close and abort()s. Wait for the ok-file of every process the
+        # pod still believes alive, then linger past their write->exit
+        # window. The deadline must sit WELL BELOW the jax coordination
+        # service's own ~100s unhealthy-task horizon: this process may
+        # legitimately finish without ever learning of a peer's death (a
+        # survivor can detect and cover the dead member's work before this
+        # one's next liveness check, so pod_dead() here can be empty) and
+        # would then wait for an ok-file that never comes — past the
+        # horizon the service aborts THIS process and fails the test.
+        import time
+
+        from drep_tpu.parallel.faulttol import pod_dead
+
+        want = [p for p in range(nproc) if p != 0 and p not in set(pod_dead())]
+        deadline = time.time() + 45
+        while time.time() < deadline and not all(
+            os.path.exists(os.path.join(outdir, f"ok_{p}")) for p in want
+        ):
+            time.sleep(0.05)
+        time.sleep(1.0)
+    os._exit(0)
+
+
+def _elastic_case(
+    pid: int, nproc: int, outdir: str, ckpt_dir: str, die_prebarrier: bool = False
+) -> None:
     """One checkpointed streaming edge pass under the elastic-pod protocol
     (heartbeat cadence from the parent's DREP_TPU_HEARTBEAT_S env; the
     killed run's parent also installs a process_death:kill fault on one
     member). Publishes this process's final edges + fault counters for
-    the parent to compare bit-for-bit against the healthy pod."""
+    the parent to compare bit-for-bit against the healthy pod.
+
+    ``die_prebarrier``: process 1 exits BEFORE the streaming call — i.e.
+    before it ever starts heartbeating or reaches the stage-open barrier.
+    The survivors must diagnose it from the missing heartbeat note during
+    the barrier wait (pre-barrier death admission, utils/ckptmeta.py),
+    continue degraded, and compute the FULL edge set between them."""
     import json
 
     from drep_tpu.parallel.streaming import streaming_mash_edges
     from drep_tpu.utils.ckptmeta import open_checkpoint_dir
     from drep_tpu.utils.profiling import counters
 
+    if die_prebarrier and pid == 1:
+        # "dead before the stage-open barrier" FROM THE PROTOCOL'S VIEW:
+        # this process never writes a heartbeat note and never reaches the
+        # barrier, which is everything the admission path diagnoses (a
+        # missing/stale note). It stays OS-alive, parked, because the jax
+        # coordination service on this jax version has no tunable service
+        # heartbeat horizon (the init kwargs fall back via TypeError) and
+        # would otherwise declare the task unhealthy after ~100 s and
+        # abort() the very survivors under test — jax's detector is not
+        # the one being exercised. Exit 0 the moment the survivors have
+        # published their verdict artifacts (before process 0, the service
+        # host, exits — lingering past it would abort this process too).
+        import time
+
+        deadline = time.time() + 300
+        while time.time() < deadline and not all(
+            os.path.exists(os.path.join(outdir, f"ok_{p}")) for p in (0, 2)
+        ):
+            time.sleep(0.05)
+        os._exit(0)
     packed = _elastic_packed()
     ii, jj, dd, pairs = streaming_mash_edges(
         packed, k=21, cutoff=0.2, block=ELASTIC_BLOCK, checkpoint_dir=ckpt_dir
@@ -318,30 +389,77 @@ def _elastic_case(pid: int, nproc: int, outdir: str, ckpt_dir: str) -> None:
     )
     with open(os.path.join(outdir, f"counters_{pid}.json"), "w") as f:
         json.dump(counters.faults, f)
-    with open(os.path.join(outdir, f"ok_{pid}"), "w") as f:
-        f.write("ok")
-    if pid == 0:
-        # process 0 hosts the jax coordination service: it must exit LAST,
-        # or every still-running peer's error poll sees the service socket
-        # close and abort()s. Wait for the ok-file of every process the
-        # pod still believes alive, then linger past their write->exit
-        # window.
-        import time
+    _finish_pod_case(pid, nproc, outdir)
 
-        from drep_tpu.parallel.faulttol import pod_dead
 
-        want = [p for p in range(nproc) if p != 0 and p not in set(pod_dead())]
-        deadline = time.time() + 120
-        while time.time() < deadline and not all(
-            os.path.exists(os.path.join(outdir, f"ok_{p}")) for p in want
-        ):
-            time.sleep(0.05)
-        time.sleep(1.0)
-    # a killed peer leaves the jax coordination service in an error state;
-    # interpreter teardown can wedge on the distributed client — exit
-    # hard, the ok-file + artifacts are the verdict (same pattern as the
-    # barrier-timeout case)
-    os._exit(0)
+def _ring_case(pid: int, nproc: int, outdir: str, ckpt_dir: str) -> None:
+    """One dense mash ring over the FULL pod mesh with a shared block
+    store — the step-wise elastic ring (parallel/allpairs.py). The killed
+    run's parent installs ``ring_step:kill`` on one member: it dies at a
+    step boundary with its first step's blocks durable; the survivors
+    must detect the death between steps, re-deal the missing blocks, and
+    assemble a distance matrix bit-identical to the healthy pod's."""
+    import json
+
+    from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+    from drep_tpu.utils.profiling import counters
+
+    packed = _elastic_packed()
+    dist = sharded_mash_allpairs(
+        packed, k=21, mesh=make_mesh(), checkpoint_dir=ckpt_dir
+    )
+    np.save(os.path.join(outdir, f"ring_{pid}.npy"), dist)
+    with open(os.path.join(outdir, f"counters_{pid}.json"), "w") as f:
+        json.dump(counters.faults, f)
+    _finish_pod_case(pid, nproc, outdir)
+
+
+def _secondary_retry_case(pid: int, nproc: int, outdir: str) -> None:
+    """The retryable sharded secondary (ISSUE 4): on a pod the secondary
+    mesh is clamped to THIS process's devices (engines._mesh_or_none
+    local_only — asserted), so a mid-batch failure is a process-local
+    event that retrying_call can retry without desyncing the pod. The
+    parent injects ``secondary_batch:raise`` on process 1 only: its first
+    attempt fails, the retry completes, and every process ends with
+    bit-identical ANI matrices."""
+    import json
+
+    import jax
+
+    from drep_tpu.cluster.engines import MESH_MIN_GENOMES, _mesh_or_none
+    from drep_tpu.ops.containment import pack_scaled_sketches
+    from drep_tpu.parallel.allpairs import sharded_containment_allpairs
+    from drep_tpu.parallel.faulttol import FaultTolConfig, retrying_call
+    from drep_tpu.utils.profiling import counters
+
+    mesh = _mesh_or_none(None, MESH_MIN_GENOMES, local_only=True)
+    assert mesh is not None, "pod worker has 2 local devices — expected a mesh"
+    assert all(
+        d.process_index == jax.process_index() for d in mesh.devices.flat
+    ), "secondary mesh must be live-clamped to local devices on a pod"
+
+    rng = np.random.default_rng(11)
+    n, s = 72, 96
+    base = np.unique(rng.integers(0, 2**62, size=6 * s * n, dtype=np.uint64))
+    rng.shuffle(base)
+    sketches = []
+    for i in range(n):
+        own = base[s * (i + 1) : s * (i + 2)]
+        mix = int(s * 0.4)
+        sketches.append(np.sort(np.unique(np.concatenate([base[:mix], own[: s - mix]]))[:s]))
+    packed = pack_scaled_sketches(sketches, [f"s{i}" for i in range(n)], pad_multiple=32)
+
+    ani, cov = retrying_call(
+        lambda: sharded_containment_allpairs(packed, k=21, mesh=mesh),
+        site="secondary_batch",
+        config=FaultTolConfig(backoff_s=0.0),
+        local_only=True,
+    )
+    np.savez(os.path.join(outdir, f"secondary_{pid}.npz"), ani=ani, cov=cov)
+    with open(os.path.join(outdir, f"counters_{pid}.json"), "w") as f:
+        json.dump(counters.faults, f)
+    _finish_pod_case(pid, nproc, outdir)
 
 
 INGEST_N = 12

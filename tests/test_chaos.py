@@ -48,17 +48,22 @@ pytestmark = pytest.mark.chaos
 @pytest.fixture(autouse=True)
 def _clean_faults():
     """Every test starts and ends with injection disabled, counters clean,
-    and the elastic pod state healthy — a leaked spec or a unit-test
-    'degraded pod' would poison the rest of the suite."""
+    the elastic pod state healthy, and the ring config reset — a leaked
+    spec, a unit-test 'degraded pod', or an earlier controller test's
+    workdir-scoped ring store base would poison the rest of the suite."""
+    from drep_tpu.parallel.allpairs import configure_ring
+
     faults.configure(None)
     counters.reset()
     faulttol.reset_pod()
     faulttol._HB_SEQ.clear()
+    configure_ring()
     yield
     faults.configure(None)
     counters.reset()
     faulttol.reset_pod()
     faulttol._HB_SEQ.clear()
+    configure_ring()
 
 
 @contextmanager
@@ -682,6 +687,143 @@ def test_process_death_spec_fields():
         faults.configure("process_death:kill:1.0:bogus=1")
 
 
+# --- elastic dense ring: step-wise schedule, block store, recovery -------
+
+
+def _ring_packed(n=21, s=64, seed=3):
+    from drep_tpu.ops.minhash import pack_sketches
+
+    rng = np.random.default_rng(seed)
+    base = np.unique(rng.integers(0, 2**62, size=6 * s * n, dtype=np.uint64))
+    rng.shuffle(base)
+    sk = []
+    for i in range(n):
+        own = base[s * (i + 1) : s * (i + 2)]
+        mix = int(s * rng.random() * 0.8)
+        sk.append(np.sort(np.unique(np.concatenate([base[:mix], own[: s - mix]]))[:s]))
+    return pack_sketches(sk, [f"g{i}" for i in range(n)], s)
+
+
+def test_ring_block_store_resume_and_heal(tmp_path):
+    """The step-wise ring's redoable unit: a run with a block store
+    publishes one shard per schedule block; deleting (or truncating) a
+    block makes the next run recompute ONLY it — via the per-block tile
+    executor, bit-identically — and heal the store."""
+    from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+
+    packed = _ring_packed()
+    mesh = make_mesh(3)
+    ckpt = str(tmp_path / "ring")
+    r1 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    blocks = sorted(f for f in os.listdir(ckpt) if f.startswith("blk_"))
+    assert len(blocks) == 3 * 4 // 2, blocks  # D*(D+1)/2 half-ring blocks
+
+    # full resume: nothing recomputed, bit-identical assembly from shards
+    counters.reset()
+    r2 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    assert r2.tobytes() == r1.tobytes()
+    assert counters.faults.get("ring_blocks_recovered", 0) == 0
+
+    # gap resume: one block deleted -> exactly one per-block recompute
+    os.remove(os.path.join(ckpt, blocks[1]))
+    counters.reset()
+    r3 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    assert r3.tobytes() == r1.tobytes()
+    assert counters.faults.get("ring_blocks_recovered") == 1, counters.faults
+
+    # torn block: detected as corrupt at assembly, recomputed into its
+    # own path (the streaming shard store's healing contract)
+    loc = os.path.join(ckpt, blocks[2])
+    data = open(loc, "rb").read()
+    with open(loc, "wb") as f:
+        f.write(data[: len(data) // 2])
+    counters.reset()
+    with _capture_log() as records:
+        r4 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    assert r4.tobytes() == r1.tobytes()
+    assert any("corrupt block shard" in r.getMessage() for r in records)
+    r5 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    assert r5.tobytes() == r1.tobytes()
+    assert counters.faults.get("ring_blocks_recovered") == 1  # healed once
+
+
+def test_ring_step_failure_recovers_per_block():
+    """An injected failure inside a ring step's wait aborts the collective
+    schedule and recomputes the remaining blocks per-tile — completing
+    with a bit-identical matrix and honest counters."""
+    from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+
+    packed = _ring_packed()
+    mesh = make_mesh(3)
+    want = sharded_mash_allpairs(packed, k=21, mesh=mesh)
+    counters.reset()
+    faults.configure("ring_dispatch:raise:1.0:max=1")
+    got = sharded_mash_allpairs(packed, k=21, mesh=mesh)
+    assert got.tobytes() == want.tobytes()
+    assert counters.faults.get("ring_step_failures", 0) >= 1, counters.faults
+    assert counters.faults.get("ring_blocks_recovered", 0) >= 1, counters.faults
+
+
+def test_ring_step_watchdog_trips_into_recovery():
+    """A hung ring step trips the per-step watchdog (explicit timeout
+    config here; the auto-derivation shares AutoTimeout with streaming)
+    and the run completes via per-block recovery."""
+    from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+
+    packed = _ring_packed()
+    mesh = make_mesh(3)
+    want = sharded_mash_allpairs(packed, k=21, mesh=mesh)
+    counters.reset()
+    faults.configure("ring_dispatch:hang:1.0:max=1:secs=30")
+    got = sharded_mash_allpairs(
+        packed, k=21, mesh=mesh,
+        ft_config=FaultTolConfig(dispatch_timeout_s=0.5),
+    )
+    assert got.tobytes() == want.tobytes()
+    assert counters.faults.get("watchdog_trips", 0) >= 1
+    assert counters.faults.get("ring_blocks_recovered", 0) >= 1
+
+
+def test_ring_step_site_spec_fields():
+    """ring_step parses like every other site (the kill chaos test's
+    proc=/skip= shape) and unknown fields still raise."""
+    faults.configure("ring_step:kill:1.0:proc=7:skip=1")  # parses
+    faults.fire("ring_step")  # proc 7 != this process: no-op
+    assert counters.faults.get("injected_ring_step_kill", 0) == 0
+    faults.configure("ring_step:raise:1.0:skip=1")
+    faults.fire("ring_step")  # skipped
+    with pytest.raises(faults.InjectedFault):
+        faults.fire("ring_step")
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("ring_step:kill:1.0:bogus=1")
+
+
+def test_auto_timeout_shared_rule():
+    """AutoTimeout (the factored derivation) must reproduce the executor
+    constants: warmup cap before enough samples, floor after, explicit
+    authority, off when auto is off."""
+    from drep_tpu.parallel.faulttol import (
+        AUTO_TIMEOUT_FLOOR_S,
+        AUTO_TIMEOUT_MIN_SAMPLES,
+        AUTO_TIMEOUT_WARMUP,
+        AUTO_TIMEOUT_WARMUP_CAP_S,
+        AutoTimeout,
+    )
+
+    auto = AutoTimeout(FaultTolConfig(auto_timeout=True))
+    assert auto.derived() is None
+    assert auto.effective() == AUTO_TIMEOUT_WARMUP_CAP_S
+    for _ in range(AUTO_TIMEOUT_WARMUP + AUTO_TIMEOUT_MIN_SAMPLES):
+        auto.note(0.001)
+    assert auto.derived() == AUTO_TIMEOUT_FLOOR_S
+    assert auto.effective() == AUTO_TIMEOUT_FLOOR_S
+    assert AutoTimeout(FaultTolConfig(dispatch_timeout_s=2.0)).effective() == 2.0
+    assert AutoTimeout(FaultTolConfig()).effective() == 0.0
+
+
 def test_missing_stages_refuses_degraded_records():
     """bench stamps pod_epochs/dead_processes into a degraded e2e record;
     the recovery tooling must keep such stages on the re-measure list —
@@ -696,10 +838,10 @@ def test_missing_stages_refuses_degraded_records():
 
     link = {"h2d_gbps": 1.0, "d2h_gbps": 1.0}
 
-    def merged(rec):
+    def merged(rec, key="e2e_50k"):
         return {
-            "stages": {"e2e_50k": rec},
-            "stage_provenance": {"e2e_50k": {"link": link}},
+            "stages": {key: rec},
+            "stage_provenance": {key: {"link": link}},
         }
 
     clean = {"pairs_per_sec_per_chip": 1.0}
@@ -712,3 +854,19 @@ def test_missing_stages_refuses_degraded_records():
     assert "scale" in ms.missing(
         merged({**clean, "fault_tolerance": {"dead_processes": 1}})
     )
+    # DENSE and SECONDARY records get the same refusal (ISSUE 4): a dense
+    # ring that survived a pod death via per-block recovery, or a
+    # secondary stage that lost a member, finished on fewer chips than
+    # the record claims — never measured perf
+    for plan, key in (("primary", "primary"), ("secondary", "secondary_matmul")):
+        assert plan not in ms.missing(merged(clean, key))
+        assert plan in ms.missing(merged({**clean, "pod_epochs": 2}, key))
+        assert plan in ms.missing(merged({**clean, "dead_processes": 1}, key))
+        assert plan in ms.missing(
+            merged({**clean, "fault_tolerance": {"dead_processes": 1}}, key)
+        )
+        # a ring that finished via per-block recovery after step failures
+        # also wants a clean re-measure: recovery serializes block compute
+        assert plan in ms.missing(
+            merged({**clean, "fault_tolerance": {"ring_step_failures": 1}}, key)
+        )

@@ -96,11 +96,16 @@ def test_distributed_matches_single(tmp_path, nproc, single_cdb):
     )
 
 
-def _run_elastic_pod(outdir, ckpt, faults=None, expect_dead=None, nproc=3):
-    """Launch an nproc-process jax.distributed CPU pod running the elastic
-    streaming worker mode against a shared checkpoint dir. Returns the
-    per-worker outputs; asserts exit codes (the `expect_dead` member must
-    die by SIGKILL, everyone else must succeed and leave artifacts)."""
+def _run_elastic_pod(
+    outdir, ckpt=None, faults=None, expect_dead=None, nproc=3, mode="elastic",
+    expect_exit0=(),
+):
+    """Launch an nproc-process jax.distributed CPU pod running an elastic
+    worker mode against a shared checkpoint dir. Returns the per-worker
+    outputs; asserts exit codes (the `expect_dead` member must die by
+    SIGKILL, `expect_exit0` members exit 0 without artifacts — the
+    pre-barrier early-exit cases — everyone else must succeed and leave
+    artifacts)."""
     port = _free_port()
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
@@ -114,12 +119,10 @@ def _run_elastic_pod(outdir, ckpt, faults=None, expect_dead=None, nproc=3):
     if faults:
         env["DREP_TPU_FAULTS"] = faults
     os.makedirs(outdir, exist_ok=True)
+    args = [str(outdir), mode] + ([str(ckpt)] if ckpt is not None else [])
     procs = [
         subprocess.Popen(
-            [
-                sys.executable, WORKER, str(i), str(nproc),
-                f"localhost:{port}", str(outdir), "elastic", str(ckpt),
-            ],
+            [sys.executable, WORKER, str(i), str(nproc), f"localhost:{port}", *args],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
@@ -145,6 +148,8 @@ def _run_elastic_pod(outdir, ckpt, faults=None, expect_dead=None, nproc=3):
             assert not os.path.exists(os.path.join(outdir, f"ok_{i}"))
             continue
         assert p.returncode == 0, f"worker {i} failed:\n{outs[i]}"
+        if i in expect_exit0:
+            continue  # early-exit member: clean exit, no artifacts expected
         assert os.path.exists(os.path.join(outdir, f"ok_{i}")), (
             f"worker {i} wrote no ok-file:\n{outs[i]}"
         )
@@ -232,6 +237,128 @@ def test_elastic_pod_survives_sigkilled_member(tmp_path):
         # the previous run's stale heartbeat/sentinel notes (including the
         # dead process 1's) must never be diagnosed as a CURRENT death
         assert "dead_processes" not in _elastic_counters(resume_dir, pid)
+
+
+def _ring_matrix(outdir, pid):
+    return np.load(os.path.join(outdir, f"ring_{pid}.npy"))
+
+
+@pytest.mark.chaos
+def test_elastic_ring_survives_sigkilled_member(tmp_path):
+    """The step-wise dense-ring tentpole, end to end on a 3-process CPU
+    pod (6-device mesh):
+
+    1. healthy pod — the oracle ring (every process assembles the full
+       distance matrix from the shared block store, all blocks epoch-0,
+       no deaths);
+    2. killed pod — process 1 SIGKILLs itself at a ring-step boundary
+       (``ring_step:kill`` with skip=1: its FIRST step's blocks are
+       already durable in the store): the survivors must detect the death
+       by heartbeat staleness between steps, bump the ownership epoch,
+       recompute the missing blocks per-tile across themselves (reusing
+       the dead member's durable step-0 blocks), and assemble a matrix
+       BIT-IDENTICAL to the healthy pod — with the degradation stamped
+       into the store's meta and honest counters."""
+    healthy_dir, killed_dir = str(tmp_path / "healthy"), str(tmp_path / "killed")
+    ckpt_a, ckpt_b = str(tmp_path / "ring_a"), str(tmp_path / "ring_b")
+
+    _run_elastic_pod(healthy_dir, ckpt_a, mode="ring")
+    h = _ring_matrix(healthy_dir, 0)
+    for pid in (1, 2):
+        assert _ring_matrix(healthy_dir, pid).tobytes() == h.tobytes()
+    blocks_a = sorted(f for f in os.listdir(ckpt_a) if f.startswith("blk_"))
+    assert len(blocks_a) == 6 * 7 // 2, blocks_a  # D*(D+1)/2 half-ring blocks
+    assert not any(".e" in f for f in blocks_a), blocks_a
+    for pid in range(3):
+        ctr = _elastic_counters(healthy_dir, pid)
+        assert "dead_processes" not in ctr, ctr
+
+    _run_elastic_pod(
+        killed_dir, ckpt_b,
+        faults="ring_step:kill:1.0:proc=1:skip=1", expect_dead=1, mode="ring",
+    )
+    for pid in (0, 2):
+        got = _ring_matrix(killed_dir, pid)
+        assert got.tobytes() == h.tobytes(), (
+            f"survivor {pid}'s ring matrix differs from the healthy pod"
+        )
+    # pod-level verdicts, not per-survivor: a survivor can legitimately
+    # finish WITHOUT ever diagnosing the death (its peer detected first
+    # and covered the missing blocks before its next liveness check) —
+    # the protocol converges either way. At least one survivor must have
+    # diagnosed it, and the dead member's unfinished blocks must have
+    # been recomputed per-tile by someone.
+    ctrs = [_elastic_counters(killed_dir, pid) for pid in (0, 2)]
+    assert any(c.get("dead_processes") == 1 for c in ctrs), ctrs
+    assert any(c.get("pod_epoch_bumps") == 1 for c in ctrs), ctrs
+    recovered = sum(c.get("ring_blocks_recovered", 0) for c in ctrs)
+    assert recovered >= 1, "no blocks recovered despite a mid-ring death"
+    blocks_b = sorted(f for f in os.listdir(ckpt_b) if f.startswith("blk_"))
+    assert any(".e01." in f for f in blocks_b), blocks_b
+    with open(os.path.join(ckpt_b, "meta.json")) as f:
+        meta_b = json.load(f)
+    assert meta_b.get("pod_epochs") == 2, meta_b
+    assert meta_b.get("dead_processes") == [1], meta_b
+
+
+@pytest.mark.chaos
+def test_streaming_prebarrier_death_continues_degraded(tmp_path):
+    """Death BEFORE the stage-open barrier (the ROADMAP hard case): a pod
+    member that exits before ever heartbeating or reaching
+    open_checkpoint_dir's barrier is diagnosed from its missing heartbeat
+    note during the barrier wait; the survivors continue degraded and
+    compute the FULL edge set between them — bit-identical to a healthy
+    pod's — instead of aborting at the collective timeout."""
+    healthy_dir, pre_dir = str(tmp_path / "healthy"), str(tmp_path / "pre")
+    ckpt_a, ckpt_b = str(tmp_path / "ckpt_a"), str(tmp_path / "ckpt_b")
+
+    _run_elastic_pod(healthy_dir, ckpt_a)
+    h = _elastic_edges(healthy_dir, 0)
+
+    _run_elastic_pod(
+        pre_dir, ckpt_b, mode="elastic_prebarrier", expect_exit0=(1,),
+    )
+    for pid in (0, 2):
+        e = _elastic_edges(pre_dir, pid)
+        assert all(a.tobytes() == b.tobytes() for a, b in zip(e[:3], h[:3])), (
+            f"survivor {pid}'s edges differ from the healthy pod"
+        )
+        # the dead member never computed anything: the survivors between
+        # them did ALL the pair work
+        ctr = _elastic_counters(pre_dir, pid)
+        assert ctr.get("dead_processes") == 1, ctr
+        assert ctr.get("pod_epoch_bumps") == 1, ctr
+    pairs_total = _elastic_edges(pre_dir, 0)[3]
+    assert pairs_total == h[3], (pairs_total, h[3])
+
+
+@pytest.mark.chaos
+def test_secondary_batch_retries_locally_on_pod(tmp_path):
+    """The retryable sharded secondary: on a pod the secondary mesh is
+    live-clamped to each process's local devices (asserted in the
+    worker), so an injected mid-batch failure on ONE process retries
+    locally and completes — instead of desyncing the pod — with
+    bit-identical ANI matrices everywhere and honest retry counters on
+    the injected member only."""
+    outdir = str(tmp_path / "sec")
+    _run_elastic_pod(
+        outdir, mode="secondary_retry",
+        faults="secondary_batch:raise:1.0:max=1:proc=1",
+    )
+    mats = {}
+    for pid in range(3):
+        with np.load(os.path.join(outdir, f"secondary_{pid}.npz")) as z:
+            mats[pid] = (z["ani"].copy(), z["cov"].copy())
+    for pid in (1, 2):
+        assert mats[pid][0].tobytes() == mats[0][0].tobytes()
+        assert mats[pid][1].tobytes() == mats[0][1].tobytes()
+    ctr1 = _elastic_counters(outdir, 1)
+    assert ctr1.get("retries", 0) >= 1, ctr1
+    assert ctr1.get("injected_secondary_batch_raise") == 1, ctr1
+    for pid in (0, 2):
+        ctr = _elastic_counters(outdir, pid)
+        assert "injected_secondary_batch_raise" not in ctr, ctr
+        assert "retries" not in ctr, ctr
 
 
 @pytest.mark.chaos

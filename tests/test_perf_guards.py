@@ -123,3 +123,55 @@ def test_streaming_fault_layer_zero_overhead_when_unset(rng, tmp_path):
     dt_auto = time.perf_counter() - t0
     assert counters.faults == before, "fault events recorded under the auto watchdog"
     assert dt_auto < 20.0, f"auto-watchdog warm pass took {dt_auto:.1f}s — thread-spawn overhead?"
+
+
+def test_stepwise_ring_overhead_within_10pct_of_monolithic(rng):
+    """The host-stepped elastic ring (ISSUE 4) pays one python dispatch
+    round per ring step instead of one per schedule — that overhead must
+    stay within 10% of the monolithic reference on a warm 3-device mesh
+    (best-of-3 per variant; the steps are dispatched ahead, so device
+    pipelining is identical), and the zero-overhead-when-unset contract
+    holds: no fault events, no store IO without a configured store."""
+    from drep_tpu.ops.minhash import pack_sketches
+    from drep_tpu.parallel.allpairs import configure_ring, sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.profiling import counters
+
+    faults.configure(None)
+    configure_ring()  # no store: measure the pure dispatch schedule
+    n, s = 384, 64
+    base = np.unique(rng.integers(0, 2**62, size=6 * s * n, dtype=np.uint64))
+    rng.shuffle(base)
+    sketches = []
+    for i in range(n):
+        own = base[s * (i + 1) : s * (i + 2)]
+        mix = int(s * rng.random() * 0.8)
+        sketches.append(np.sort(np.unique(np.concatenate([base[:mix], own[: s - mix]]))[:s]))
+    packed = pack_sketches(sketches, [f"g{i}" for i in range(n)], s)
+    mesh = make_mesh(3)
+
+    # warm both program caches, then time best-of-3 each
+    want = sharded_mash_allpairs(packed, k=21, mesh=mesh, monolithic=True)
+    got = sharded_mash_allpairs(packed, k=21, mesh=mesh)
+    assert got.tobytes() == want.tobytes()
+
+    def best_of(fn, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    before = dict(counters.faults)
+    dt_mono = best_of(lambda: sharded_mash_allpairs(packed, k=21, mesh=mesh, monolithic=True))
+    dt_step = best_of(lambda: sharded_mash_allpairs(packed, k=21, mesh=mesh))
+    assert counters.faults == before, "fault events recorded with injection unset"
+    # 10% + a small absolute floor so micro-runs on noisy CI machines
+    # cannot flake on scheduler jitter while a real per-step sync
+    # regression (2 steps here, ~100s of steps at pod scale) still fails
+    assert dt_step <= 1.10 * dt_mono + 0.05, (
+        f"step-wise ring {dt_step:.3f}s vs monolithic {dt_mono:.3f}s — "
+        f"more than 10% dispatch overhead"
+    )

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Executable survivability matrix: site x mode over fault-injection specs.
+
+The README "Failure model" section claims a survivability verdict per
+(injection site, failure mode) cell — this tool RUNS those cells and
+prints a pass/fail grid, so the documented matrix can never silently
+drift from what the code actually survives.
+
+Two tiers:
+
+- in-process cells (default): single-process scenarios over the real
+  engines (streaming tiles, the step-wise dense ring, retrying secondary
+  calls, torn shard writes) with ``utils/faults.py`` specs installed —
+  seconds each, CPU-only, no pod required.
+- pod cells (``--pod``): the multi-process kill/death cells (SIGKILL
+  mid-streaming / mid-ring, pre-barrier death, dead-peer barrier
+  diagnosis, mid-secondary-batch retry) delegate to their pytest chaos
+  tests in tests/test_multihost.py — minutes, still CPU-only.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py          # in-process grid
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod    # + pod cells
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _packed(n=48, s=64, seed=0):
+    import numpy as np
+
+    from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+
+    rng = np.random.default_rng(seed)
+    ids = np.full((n, s), PAD_ID, dtype=np.int32)
+    cts = np.full(n, s, dtype=np.int32)
+    pools = [
+        np.sort(rng.choice(2**20, size=s * 2, replace=False).astype(np.int32))
+        for _ in range(5)
+    ]
+    for i in range(n):
+        ids[i] = np.sort(rng.choice(pools[i % 5], size=s, replace=False))
+    return PackedSketches(ids=ids, counts=cts, names=[f"g{i}" for i in range(n)])
+
+
+def _streaming(spec, ft_config=None, checkpoint_dir=None):
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+
+    packed = _packed()
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    faults.configure(spec)
+    try:
+        got = streaming_mash_edges(
+            packed, k=21, cutoff=0.2, block=8,
+            ft_config=ft_config, checkpoint_dir=checkpoint_dir,
+        )
+    finally:
+        faults.configure(None)
+    assert all(
+        a.tobytes() == b.tobytes() for a, b in zip(got[:3], want[:3])
+    ), "edges differ under injection"
+
+
+def _ring(spec, ft_config=None):
+    from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+    from drep_tpu.utils import faults
+
+    packed = _packed(n=21)
+    mesh = make_mesh(3)
+    want = sharded_mash_allpairs(packed, k=21, mesh=mesh)
+    faults.configure(spec)
+    try:
+        got = sharded_mash_allpairs(packed, k=21, mesh=mesh, ft_config=ft_config)
+    finally:
+        faults.configure(None)
+    assert got.tobytes() == want.tobytes(), "ring matrix differs under injection"
+
+
+def _torn_shard(spec):
+    import tempfile
+
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+
+    packed = _packed()
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "ckpt")
+        faults.configure(spec)
+        try:
+            r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+        finally:
+            faults.configure(None)
+        r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+        assert all(a.tobytes() == b.tobytes() for a, b in zip(r1[:3], r2[:3]))
+
+
+def _secondary_retry(spec, retries=2):
+    from drep_tpu.parallel.faulttol import FaultTolConfig, retrying_call
+    from drep_tpu.utils import faults
+
+    faults.configure(spec)
+    try:
+        out = retrying_call(
+            lambda: 42, site="secondary_batch",
+            config=FaultTolConfig(max_retries=retries, backoff_s=0.0),
+        )
+    finally:
+        faults.configure(None)
+    assert out == 42
+
+
+def _ft(**kw):
+    from drep_tpu.parallel.faulttol import FaultTolConfig
+
+    return FaultTolConfig(**kw)
+
+
+# (site, mode, scenario label, expected, runner) — expected "survive"
+# means the cell must complete with results identical to a clean run;
+# "abort" means it must raise (loudly, with the documented error type)
+def _cells():
+    from drep_tpu.parallel.faulttol import FaultTolError
+
+    return [
+        ("streaming_tile", "raise", "5% tile failures -> retries",
+         "survive", lambda: _streaming("streaming_tile:raise:0.05:seed=7")),
+        ("streaming_tile", "raise", "one dead device -> quarantine",
+         "survive", lambda: _streaming("streaming_tile:raise:1.0:device=1")),
+        ("streaming_tile", "raise", "all devices failing -> CPU fallback",
+         "survive", lambda: _streaming(
+             "streaming_tile:raise:1.0", _ft(max_retries=1, backoff_s=0.0))),
+        ("streaming_tile", "hang", "wedged dispatch -> watchdog retry",
+         "survive", lambda: _streaming(
+             "streaming_tile:hang:1.0:device=2:secs=30",
+             _ft(dispatch_timeout_s=0.5))),
+        ("shard_write", "torn", "truncated shard -> resume heals",
+         "survive", lambda: _torn_shard("shard_write:torn:1.0:max=2")),
+        ("ring_dispatch", "raise", "failed ring step -> per-block recovery",
+         "survive", lambda: _ring("ring_dispatch:raise:1.0:max=1")),
+        ("ring_dispatch", "hang", "wedged ring step -> watchdog + recovery",
+         "survive", lambda: _ring(
+             "ring_dispatch:hang:1.0:max=1:secs=30", _ft(dispatch_timeout_s=0.5))),
+        ("secondary_batch", "raise", "one failed batch -> local retry",
+         "survive", lambda: _secondary_retry("secondary_batch:raise:1.0:max=1")),
+        ("secondary_batch", "raise", "beyond retry budget -> abort",
+         "abort", lambda: _expect_raise(
+             FaultTolError,
+             lambda: _secondary_retry("secondary_batch:raise:1.0", retries=1))),
+    ]
+
+
+def _expect_raise(exc_type, fn):
+    try:
+        fn()
+    except exc_type:
+        return
+    raise AssertionError(f"expected {exc_type.__name__}, nothing raised")
+
+
+# pod cells delegate to the pytest chaos tests (site x mode -> test id)
+POD_CELLS = [
+    ("process_death", "kill", "SIGKILL mid-streaming -> epoch re-deal",
+     "survive", "tests/test_multihost.py::test_elastic_pod_survives_sigkilled_member"),
+    ("ring_step", "kill", "SIGKILL between ring steps -> block re-deal",
+     "survive", "tests/test_multihost.py::test_elastic_ring_survives_sigkilled_member"),
+    ("barrier", "death", "death BEFORE the stage-open barrier -> admission",
+     "survive", "tests/test_multihost.py::test_streaming_prebarrier_death_continues_degraded"),
+    ("secondary_batch", "raise", "mid-batch failure on a pod -> local retry",
+     "survive", "tests/test_multihost.py::test_secondary_batch_retries_locally_on_pod"),
+    ("barrier", "death", "dead peer, NO heartbeats -> named diagnosis + abort",
+     "abort", "tests/test_multihost.py::test_dead_peer_barrier_raises_actionable_timeout"),
+]
+
+
+def main() -> int:
+    pod = "--pod" in sys.argv
+    from drep_tpu.parallel import faulttol
+    from drep_tpu.utils.profiling import counters
+
+    rows = []
+    failures = 0
+    for site, mode, label, expected, run in _cells():
+        counters.reset()
+        faulttol.reset_pod()
+        try:
+            run()
+            verdict = "PASS"
+        except Exception as e:  # noqa: BLE001 — the grid reports, never dies
+            verdict = f"FAIL ({type(e).__name__}: {e})"
+            failures += 1
+        rows.append((site, mode, label, expected, verdict))
+    if pod:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        for site, mode, label, expected, test_id in POD_CELLS:
+            rc = subprocess.call(
+                [sys.executable, "-m", "pytest", test_id, "-q", "-p", "no:cacheprovider"],
+                cwd=REPO, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            verdict = "PASS" if rc == 0 else f"FAIL (pytest rc={rc})"
+            failures += rc != 0
+            rows.append((site, mode, label, expected, verdict))
+    else:
+        for site, mode, label, expected, test_id in POD_CELLS:
+            rows.append((site, mode, label, expected, f"SKIP (--pod runs {test_id})"))
+
+    w_site = max(len(r[0]) for r in rows)
+    w_mode = max(len(r[1]) for r in rows)
+    w_label = max(len(r[2]) for r in rows)
+    print(f"{'site':<{w_site}}  {'mode':<{w_mode}}  {'scenario':<{w_label}}  expected  verdict")
+    print("-" * (w_site + w_mode + w_label + 24))
+    for site, mode, label, expected, verdict in rows:
+        print(f"{site:<{w_site}}  {mode:<{w_mode}}  {label:<{w_label}}  {expected:<8}  {verdict}")
+    print(
+        f"\n{sum(1 for r in rows if r[4] == 'PASS')} passed, {failures} failed, "
+        f"{sum(1 for r in rows if r[4].startswith('SKIP'))} skipped"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
