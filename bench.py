@@ -1113,6 +1113,27 @@ def _emit(stages: dict) -> None:
                     st["dead_processes"] = len(pod_dead())
     except Exception:  # provenance must never block the record
         pass
+    # storage-side I/O provenance (ISSUE 5), stamped into EVERY stage
+    # record: a run that healed corrupt shards RECOMPUTED work the record
+    # does not time-attribute (healing == recompute, the same refusal
+    # contract as pod degradation — tools/missing_stages.py), and a run
+    # that burned transient-I/O retries ran against a degraded filesystem.
+    # Conservative like the pod stamp: the process-global counters cannot
+    # attribute a heal to one stage, so every record in the run carries it.
+    try:
+        from drep_tpu.utils.profiling import counters as _io_counters
+
+        io_retries = int(_io_counters.faults.get("io_retries", 0))
+        healed = int(_io_counters.faults.get("corrupt_shards_healed", 0))
+        unrecoverable = int(_io_counters.faults.get("io_unrecoverable", 0))
+        if io_retries or healed or unrecoverable:
+            for st in stages.values():
+                if isinstance(st, dict) and "corrupt_shards_healed" not in st:
+                    st["io_retries"] = io_retries
+                    st["corrupt_shards_healed"] = healed
+                    st["io_unrecoverable"] = unrecoverable
+    except Exception:  # provenance must never block the record
+        pass
     head = stages.get("primary", {})
     value = head.get("pairs_per_sec_per_chip") if isinstance(head, dict) else None
     vs = head.get("vs_baseline") if isinstance(head, dict) else None

@@ -120,6 +120,21 @@ def build_parser() -> argparse.ArgumentParser:
                               "as derived_ring_step_timeout_s). Results are "
                               "bit-identical either way; env "
                               "DREP_TPU_RING_MONOLITHIC=1 also forces it")
+        tpu.add_argument("--io_retries", type=int, default=None,
+                         help="transient shared-filesystem I/O errors "
+                              "(EIO/ESTALE/ETIMEDOUT) retried per durable "
+                              "read/write with exponential backoff before "
+                              "giving up (utils/durableio.py; default from "
+                              "DREP_TPU_IO_RETRIES, 3). Retries are counted "
+                              "honestly (io_retries in perf_counters.json); "
+                              "ENOSPC never retries — it raises an actionable "
+                              "error naming the store and bytes needed")
+        tpu.add_argument("--fsync", action="store_true",
+                         help="fsync every durable publish (tmp file before "
+                              "the rename, directory after) so a host power "
+                              "loss cannot revert a checkpoint the run "
+                              "already trusted — some IOPS cost on shared "
+                              "filesystems; DREP_TPU_FSYNC=1 is equivalent")
         tpu.add_argument("--profile", nargs="?", const="auto", default=None,
                          help="record a jax.profiler trace of the compare stage "
                               "(optionally to the given directory; default "

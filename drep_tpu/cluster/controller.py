@@ -75,6 +75,12 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "fault_retries": 2,
     "dispatch_timeout": 0.0,
     "max_dead_processes": 1,
+    # durable-I/O knobs (utils/durableio.py): transient shared-FS retry
+    # budget (None = DREP_TPU_IO_RETRIES / default 3) and fsync-on-publish
+    # (False = DREP_TPU_FSYNC). Pure durability policy — never results —
+    # so neither joins _RESUME_KEYS.
+    "io_retries": None,
+    "fsync": False,
     # dense-ring execution: False (default) runs the host-stepped elastic
     # schedule (parallel/allpairs.py — per-step block checkpoints, redoable
     # blocks, pod-death survival); True forces the monolithic single
@@ -129,6 +135,14 @@ def _ft_config(kw: dict[str, Any]):
         max_dead_processes=int(kw["max_dead_processes"]),
     )
     configure_defaults(cfg)
+    # the storage-side twin: install the run's durable-I/O policy
+    # (--io_retries / --fsync; None falls through to the env knobs) so
+    # every shard/meta/note publish in the run honors the same budget
+    from drep_tpu.utils import durableio
+
+    durableio.configure(
+        retries=kw.get("io_retries"), fsync=bool(kw.get("fsync")) or None
+    )
     return cfg
 
 
@@ -655,8 +669,15 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         wd.store_db(schemas.validate(cdb, "Cdb"), "Cdb")
 
         cf_dir = wd.get_dir(os.path.join("data", "Clustering_files"))
-        with open(os.path.join(cf_dir, "clustering.pickle"), "wb") as f:
-            pickle.dump(clustering_files, f)
+        # atomic (utils/durableio.py): a SIGKILL mid-dump must not leave a
+        # torn pickle that poisons a later resume's Clustering_files load
+        from drep_tpu.utils.ckptmeta import atomic_write
+
+        def _dump(tmp: str) -> None:
+            with open(tmp, "wb") as f:
+                pickle.dump(clustering_files, f)
+
+        atomic_write(os.path.join(cf_dir, "clustering.pickle"), _dump)
 
     wd.store_arguments("cluster", snapshot)
     logger.info(

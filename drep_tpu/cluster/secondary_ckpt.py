@@ -52,22 +52,20 @@ class SecondaryCheckpoint:
         loc = self._loc(pc)
         if not os.path.exists(loc):
             return None
-        try:
-            with np.load(loc, allow_pickle=False) as z:
-                cols = [str(c) for c in z["ndb_columns"]]
-                ndb = pd.DataFrame({c: z[f"ndb_col_{c}"] for c in cols})
-                result = ndb, z["labels"], z["link"]
-            self.n_resumed += 1  # only after the payload fully validates
-            return result
-        except Exception:
-            get_logger().warning("secondary checkpoint: unreadable %s — recomputing", loc)
-            # the remove may itself fail (EACCES, flaky NFS) — degrade to
-            # recompute either way; a checkpoint must never kill the run
-            import contextlib
+        from drep_tpu.utils import durableio
 
-            with contextlib.suppress(OSError):
-                os.remove(loc)
-            return None
+        def convert(z):
+            cols = [str(c) for c in z["ndb_columns"]]
+            ndb = pd.DataFrame({c: z[f"ndb_col_{c}"] for c in cols})
+            return ndb, z["labels"], z["link"]
+
+        result = durableio.load_npz_or_none(
+            loc, what="secondary checkpoint", convert=convert,
+            warn="secondary checkpoint: unreadable %s — recomputing",
+        )
+        if result is not None:
+            self.n_resumed += 1  # only after the payload fully validates
+        return result
 
     def save(self, pc: int, ndb: pd.DataFrame, labels: np.ndarray, link: np.ndarray) -> None:
         if self.dir is None:

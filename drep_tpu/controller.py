@@ -25,6 +25,16 @@ class Controller:
         kwargs = {k: v for k, v in vars(args).items() if k not in ("operation",)}
         if kwargs.pop("debug", False):
             setup_logger(None, verbosity=logging.DEBUG)
+        # install the run's durable-I/O policy (--io_retries / --fsync)
+        # BEFORE any stage runs: ingest's sketch shards and the workdir
+        # sketch cache publish through utils/durableio.py long before the
+        # cluster stage re-installs the same knobs in _ft_config
+        from drep_tpu.utils import durableio
+
+        durableio.configure(
+            retries=kwargs.get("io_retries"),
+            fsync=bool(kwargs.get("fsync")) or None,
+        )
         wd_loc = kwargs.pop("work_directory")
         genomes = kwargs.pop("genomes", None)
         if op == "compare":

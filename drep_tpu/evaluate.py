@@ -107,8 +107,10 @@ def d_evaluate_wrapper(wd: WorkDirectory, **kwargs) -> list[str]:
 
     warnings = evaluate_warnings(mdb, ndb, cdb, wdb, **kwargs)
     path = wd.get_loc("warnings")
-    with open(path, "w") as f:
-        for w in warnings:
-            f.write(w + "\n")
+    # atomic (utils/durableio.py): a SIGKILL mid-write must not leave a
+    # torn warnings.txt a resumed run trusts as the stage's full output
+    from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
+    atomic_write_bytes(path, "".join(w + "\n" for w in warnings).encode())
     logger.info("evaluate: %d warnings -> %s", len(warnings), path)
     return warnings

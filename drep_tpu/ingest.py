@@ -130,7 +130,13 @@ def sketch_cache_will_hit(
         except Exception:
             pass  # unreadable Gdb: let the shard probe decide
     shard_dir = _sketch_shard_dir(wd)
-    if not checkpoint_meta_matches(shard_dir, _sketch_shard_meta(snapshot)):
+    try:
+        if not checkpoint_meta_matches(shard_dir, _sketch_shard_meta(snapshot)):
+            return False
+    except OSError:
+        # transient budget exhausted reading the meta: this probe is
+        # advisory (a wrong answer only costs the warmup overlap) — the
+        # brownout error belongs to sketch_genomes' own open, not here
         return False
     covered: set[str] = set()
     for f in glob.glob(os.path.join(shard_dir, "*.npz")):
@@ -163,9 +169,7 @@ def _unpack_ragged(flat: np.ndarray, offs: np.ndarray, n: int) -> list[np.ndarra
 
 
 def _save_sketch_shard(path: str, batch: dict[str, dict]) -> None:
-    import io
-
-    from drep_tpu.utils.ckptmeta import atomic_write_bytes
+    from drep_tpu.utils.ckptmeta import atomic_savez
 
     names = list(batch)
     payload: dict[str, np.ndarray] = {
@@ -177,27 +181,28 @@ def _save_sketch_shard(path: str, batch: dict[str, dict]) -> None:
         payload[key], payload[f"{key}_offsets"] = _pack_ragged(
             [batch[g][key] for g in names]
         )
-    # serialize in memory and write through the atomic helper: its tmp
-    # suffix does NOT end in .npz, so a crash artifact can never be picked
-    # up by the resume glob as a (corrupt-looking) shard
-    buf = io.BytesIO()
-    np.savez_compressed(buf, **payload)
-    atomic_write_bytes(path, buf.getvalue())
+    # the durable savez: in-memory serialize, in-band __crc__, atomic tmp
+    # whose suffix does NOT end in .npz (a crash artifact can never be
+    # picked up by the resume glob as a corrupt-looking shard), transient
+    # I/O retries — one recipe with every other shard store
+    atomic_savez(path, **payload)
 
 
 def _load_sketch_shard(path: str) -> dict[str, dict]:
+    from drep_tpu.utils.durableio import load_npz_checked
+
     out: dict[str, dict] = {}
-    with np.load(path, allow_pickle=False) as z:
-        names = [str(x) for x in z["names"]]
-        scalars = {key: z[key] for key in _SHARD_SCALARS}
-        bottom = _unpack_ragged(z["bottom"], z["bottom_offsets"], len(names))
-        scaled = _unpack_ragged(z["scaled"], z["scaled_offsets"], len(names))
-        for i, g in enumerate(names):
-            out[g] = {
-                **{key: int(scalars[key][i]) for key in _SHARD_SCALARS},
-                "bottom": bottom[i].copy(),
-                "scaled": scaled[i].copy(),
-            }
+    z = load_npz_checked(path, what="sketch shard")
+    names = [str(x) for x in z["names"]]
+    scalars = {key: z[key] for key in _SHARD_SCALARS}
+    bottom = _unpack_ragged(z["bottom"], z["bottom_offsets"], len(names))
+    scaled = _unpack_ragged(z["scaled"], z["scaled_offsets"], len(names))
+    for i, g in enumerate(names):
+        out[g] = {
+            **{key: int(scalars[key][i]) for key in _SHARD_SCALARS},
+            "bottom": bottom[i].copy(),
+            "scaled": scaled[i].copy(),
+        }
     return out
 
 
@@ -259,9 +264,23 @@ def sketch_genomes(
                 try:
                     shard = _load_sketch_shard(f)
                     resume_loaded.add(f)
+                except FileNotFoundError:
+                    # a peer healed (removed) it between our glob and the
+                    # read — merely missing, NOT corruption: counting it
+                    # would book phantom heals across ingest peers
+                    continue
+                except OSError:
+                    # transient retry budget exhausted: the shard may be
+                    # intact — re-sketch its genomes WITHOUT deleting it
+                    # or booking a heal (durableio.load_npz_or_none's
+                    # brownout invariant; the re-sketch rewrites in place)
+                    logger.warning("ingest: unreadable sketch shard %s — recomputing its genomes", f)
+                    continue
                 except Exception:
+                    from drep_tpu.utils.durableio import quarantine_corrupt
+
                     logger.warning("ingest: corrupt sketch shard %s — recomputing its genomes", f)
-                    os.remove(f)
+                    quarantine_corrupt(f)  # counted heal; re-sketch rewrites
                     continue
                 # drop zero-kmer entries written before validation existed:
                 # resuming one by name would re-raise the input error even
@@ -358,12 +377,12 @@ def sketch_genomes(
         # would stall their full timeout on a genome that never arrives)
         bad = sorted(g for g, r in results.items() if r["n_kmers"] == 0)
         if bad:
-            import json as _json
+            from drep_tpu.utils.durableio import atomic_write_json
 
             with contextlib.suppress(OSError):
-                atomic_write_bytes(
+                atomic_write_json(
                     os.path.join(shard_dir, f"ingest_error_{pid}.json"),
-                    _json.dumps({"pid": pid, "genomes": bad[:10], "n": len(bad)}).encode(),
+                    {"pid": pid, "genomes": bad[:10], "n": len(bad)},
                 )
             shown = ", ".join(bad[:10]) + (" ..." if len(bad) > 10 else "")
             raise UserInputError(
@@ -400,13 +419,12 @@ def sketch_genomes(
             if not (need - set(results)):
                 break
             for f in glob.glob(os.path.join(shard_dir, "ingest_error_*.json")):
-                import json as _json
+                from drep_tpu.utils.durableio import read_json_checked
 
                 try:
-                    with open(f) as fh:
-                        info = _json.load(fh)
+                    info = read_json_checked(f, what="ingest poison marker")
                 except Exception:
-                    continue
+                    continue  # torn/rotted marker: the data barrier decides
                 shown = ", ".join(info.get("genomes", []))
                 raise UserInputError(
                     f"ingest peer process {info.get('pid')} reported "

@@ -391,18 +391,19 @@ def _find_block(checkpoint_dir: str, a: int, b: int) -> str | None:
 
 def _load_block(path: str, n_outputs: int):
     """Tuple of `n_outputs` arrays from a block shard, or None when it
-    reads corrupt — warned and best-effort removed; callers recompute
-    into the same path (the streaming shard store's healing contract)."""
-    import contextlib
+    reads corrupt — warned, counted (``corrupt_shards_healed``), and
+    best-effort removed; callers recompute into the same path (the
+    streaming shard store's healing contract). The checked read
+    (utils/durableio.py) retries transient I/O errors and verifies the
+    in-band ``__crc__``, so a zero-byte/truncated/bit-rotted block
+    classifies exactly like a missing one."""
+    from drep_tpu.utils import durableio
 
-    try:
-        with np.load(path) as z:
-            return tuple(z[f"o{i}"] for i in range(n_outputs))
-    except Exception:
-        get_logger().warning("dense ring: corrupt block shard %s — recomputing", path)
-        with contextlib.suppress(OSError):
-            os.remove(path)
-        return None
+    return durableio.load_npz_or_none(
+        path, what="ring block shard",
+        convert=lambda z: tuple(z[f"o{i}"] for i in range(n_outputs)),
+        warn="dense ring: corrupt block shard %s — recomputing",
+    )
 
 
 def _ring_store_dir(kind: str, k: int, n_devices: int, fingerprint: str) -> str | None:

@@ -56,7 +56,6 @@ regions, so injected hangs trip the same watchdogs real wedges do.
 
 from __future__ import annotations
 
-import json
 import os
 import statistics
 import threading
@@ -439,11 +438,17 @@ class HeartbeatManager:
                 pass  # one missed beat is well inside the miss window
 
     def read_done(self, pid: int) -> dict | None:
-        """Raw done-note payload, no sequence validation."""
+        """Raw done-note payload, no sequence validation. Checked read
+        (utils/durableio.py): transient I/O errors retry, a corrupt note
+        (truncated / crc mismatch) reads as ABSENT — the peer then counts
+        as not-finished and its heartbeat staleness decides, never a
+        crash on a half-written note."""
+        from drep_tpu.utils import durableio
+
         try:
-            with open(self.done_path(pid)) as f:
-                return json.load(f)
-        except (OSError, ValueError):
+            note = durableio.read_json_checked(self.done_path(pid), what="done-note")
+            return note if isinstance(note, dict) else None
+        except (OSError, ValueError, durableio.CorruptPayloadError):
             return None
 
     def done_payload(self, pid: int) -> dict | None:
@@ -473,7 +478,6 @@ class HeartbeatManager:
         process's view of the beat mtimes is skewed (NFS attribute
         caching): whoever detects first publishes, everyone else follows,
         and the subject — if actually alive — fences itself."""
-        from drep_tpu.utils.ckptmeta import atomic_write_bytes
         from drep_tpu.utils.profiling import counters
 
         now = time.time()
@@ -542,11 +546,11 @@ class HeartbeatManager:
             # publish the verdict so every peer adopts THIS view (and the
             # subject fences itself if it was a false positive)
             try:
-                atomic_write_bytes(
+                from drep_tpu.utils.durableio import atomic_write_json
+
+                atomic_write_json(
                     self.verdict_path(p),
-                    json.dumps(
-                        {"by": self.pid, "seq": self.seq, "at": now}
-                    ).encode(),
+                    {"by": self.pid, "seq": self.seq, "at": now},
                 )
             except OSError:  # best-effort: peers can still detect on
                 pass  # their own staleness clock
@@ -565,13 +569,11 @@ class HeartbeatManager:
         return True
 
     def mark_done(self, pairs_computed: int) -> None:
-        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+        from drep_tpu.utils.durableio import atomic_write_json
 
-        atomic_write_bytes(
+        atomic_write_json(
             self.done_path(),
-            json.dumps(
-                {"pairs": int(pairs_computed), "epoch": self.epoch, "seq": self.seq}
-            ).encode(),
+            {"pairs": int(pairs_computed), "epoch": self.epoch, "seq": self.seq},
         )
 
     def close(self) -> None:

@@ -195,22 +195,21 @@ def _find_shard(checkpoint_dir: str, bi: int) -> str | None:
 
 def _load_shard(path: str):
     """(ii, jj, dist) from a checkpoint shard, or None when it reads
-    corrupt — warned and best-effort removed (the remove itself may fail
-    on EACCES/flaky NFS; callers recompute regardless). ALL members are
-    read before returning: zip members are read lazily, so a partially-
-    corrupt shard must not hand back ii while jj/dist would raise
-    (misaligned edge arrays). ONE implementation for the resume loop and
-    the elastic assembly so the corruption contract cannot drift."""
-    import contextlib
+    corrupt — warned, counted (``corrupt_shards_healed``), and best-effort
+    removed (the remove itself may fail on EACCES/flaky NFS; callers
+    recompute regardless). The checked read (utils/durableio.py) retries
+    transient I/O errors and verifies the in-band ``__crc__`` — a
+    zero-byte, truncated, or bit-rotted shard classifies exactly like a
+    MISSING one and the store self-heals. ONE implementation for the
+    resume loop and the elastic assembly so the corruption contract
+    cannot drift."""
+    from drep_tpu.utils import durableio
 
-    try:
-        with np.load(path) as z:
-            return z["ii"], z["jj"], z["dist"]
-    except Exception:
-        get_logger().warning("streaming primary: corrupt shard %s — recomputing", path)
-        with contextlib.suppress(OSError):
-            os.remove(path)
-        return None
+    return durableio.load_npz_or_none(
+        path, what="row shard",
+        convert=lambda z: (z["ii"], z["jj"], z["dist"]),
+        warn="streaming primary: corrupt shard %s — recomputing",
+    )
 
 
 def _shard_epoch(path: str) -> int:

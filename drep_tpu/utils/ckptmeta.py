@@ -6,18 +6,30 @@ a ``meta.json`` pins the exact inputs the shards were computed from; on
 open, a matching meta means existing shards are resumable, a mismatch (or
 corrupt meta) clears the directory and atomically writes the new meta.
 One implementation so invalidation semantics can never drift apart.
+
+The write primitives (atomic_write / atomic_write_bytes / atomic_savez)
+live in utils/durableio.py — the durable-I/O funnel that adds in-band
+checksums, transient-error retries, and optional fsync — and are
+re-exported here so the many existing call sites stay on one path.
 """
 
 from __future__ import annotations
 
 import contextlib
 import hashlib
-import json
 import os
-import uuid
 from typing import Any, Iterable
 
 import numpy as np
+
+# THE atomic/durable write primitives (checksummed, retried, fsync-able) —
+# re-exported so every pre-durableio import site keeps funneling through
+# the one implementation (utils/durableio.py has the contract).
+from drep_tpu.utils.durableio import (  # noqa: F401 — re-exports
+    atomic_savez,
+    atomic_write,
+    atomic_write_bytes,
+)
 
 META_NAME = "meta.json"
 
@@ -34,76 +46,6 @@ def content_fingerprint(names: Iterable[str], *arrays: np.ndarray) -> str:
     for arr in arrays:
         h.update(np.ascontiguousarray(arr).tobytes())
     return h.hexdigest()
-
-
-def atomic_write(path: str, write_fn, keep_suffix: bool = False) -> None:
-    """THE whole-file-or-nothing write primitive (kills mid-write must not
-    leave torn files a later resume trusts; replicated multi-host writers
-    of the same target must never interleave — uuid tmp names because pids
-    collide ACROSS hosts/containers of a pod). `write_fn(tmp)` produces
-    the content; a raising write_fn leaves no orphan tmp behind.
-
-    `keep_suffix` picks the tmp-name shape, and the two shapes serve
-    CONFLICTING invariants — choose deliberately:
-
-    - False (default): ``<path>.tmp-<uuid>`` — the tmp shares no suffix
-      with the target, so shard-store resume globs (``*.npz``) can never
-      pick up a crash artifact as a corrupt-looking shard (the ingest
-      shard store depends on this).
-    - True: ``<base>.tmp-<uuid><suffix>`` — required when write_fn derives
-      the real output name from the suffix (``np.savez_compressed``
-      appends ``.npz`` to names without it, which would orphan the
-      suffixless tmp). Only safe where nothing globs the target's suffix
-      (the workdir array store).
-    """
-    base, suffix = os.path.splitext(path)
-    tmp = (
-        f"{base}.tmp-{uuid.uuid4().hex}{suffix}"
-        if keep_suffix
-        else f"{path}.tmp-{uuid.uuid4().hex}"
-    )
-    try:
-        write_fn(tmp)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            with contextlib.suppress(OSError):
-                os.remove(tmp)
-
-
-def atomic_write_bytes(path: str, data) -> None:
-    def write(tmp: str) -> None:
-        with open(tmp, "wb") as f:
-            f.write(data)
-
-    atomic_write(path, write)
-
-
-def atomic_savez(path: str, compressed: bool = True, **arrays) -> None:
-    """Serialize arrays to `.npz` IN MEMORY and publish through
-    atomic_write: uuid tmp (two writers of one target on a shared pod
-    filesystem must never interleave) whose name does NOT end in .npz —
-    crash artifacts must stay outside the shard namespace that resume
-    globs and `clear_suffixes` scan. One helper for every shard store
-    (streaming row blocks, per-cluster secondary results) so the
-    atomicity recipe cannot drift between them. `compressed=False` for
-    thousands-of-tiny-files stores where zlib is a measured hot spot."""
-    import io
-
-    buf = io.BytesIO()
-    (np.savez_compressed if compressed else np.savez)(buf, **arrays)
-    from drep_tpu.utils import faults
-
-    if faults.torn_write("shard_write"):
-        # chaos injection: publish a truncated file AT the target path,
-        # bypassing the atomic tmp+rename — the on-disk state a mid-write
-        # kill on a non-atomic filesystem would leave. Resume must detect
-        # it as corrupt and recompute (the path this injection tests).
-        data = bytes(buf.getbuffer())
-        with open(path, "wb") as f:
-            f.write(data[: max(1, len(data) // 2)])
-        return
-    atomic_write_bytes(path, buf.getbuffer())
 
 
 def open_checkpoint_dir(ckpt_dir: str, meta: dict[str, Any], clear_suffixes: tuple[str, ...]) -> bool:
@@ -390,8 +332,21 @@ def checkpoint_meta_matches(ckpt_dir: str, meta: dict[str, Any]) -> bool:
     if not os.path.exists(loc):
         return False
     try:
-        with open(loc) as f:
-            stored = json.load(f)
+        # checked read: transient I/O errors retry, a truncated/bit-rotted
+        # meta (checksum mismatch) classifies as corrupt — not resumable,
+        # exactly like a missing meta (the open clears + rewrites)
+        from drep_tpu.utils.durableio import read_json_checked
+
+        stored = read_json_checked(loc, what="checkpoint meta")
+    except FileNotFoundError:
+        return False  # removed since the exists() check — not resumable
+    except OSError:
+        # transient retry budget exhausted (NFS brownout): the meta — and
+        # the store behind it — may be perfectly intact. Returning False
+        # here would let open_checkpoint_dir CLEAR every finished shard;
+        # surface the error instead (a brownout must never destroy an
+        # intact store — same invariant as durableio.load_npz_or_none)
+        raise
     except Exception:
         return False  # corrupt meta -> not resumable
     if not isinstance(stored, dict):
@@ -407,12 +362,13 @@ def stamp_checkpoint_meta(ckpt_dir: str, extra: dict[str, Any]) -> None:
     own bookkeeping — failures log and return."""
     loc = os.path.join(ckpt_dir, META_NAME)
     try:
-        with open(loc) as f:
-            stored = json.load(f)
+        from drep_tpu.utils.durableio import atomic_write_json, read_json_checked
+
+        stored = read_json_checked(loc, what="checkpoint meta")
         if not isinstance(stored, dict):
             raise ValueError(f"meta at {loc} is not a dict")
         stored.update(extra)
-        atomic_write_bytes(loc, json.dumps(stored, sort_keys=True, default=str).encode())
+        atomic_write_json(loc, stored)
     except Exception as e:  # noqa: BLE001
         from drep_tpu.utils.logger import get_logger
 
@@ -430,5 +386,7 @@ def _open_checkpoint_dir_local(
         if f == META_NAME or any(f.endswith(s) for s in clear_suffixes):
             with contextlib.suppress(FileNotFoundError):
                 os.remove(os.path.join(ckpt_dir, f))  # a peer may have won the race
-    atomic_write_bytes(loc, json.dumps(meta, sort_keys=True, default=str).encode())
+    from drep_tpu.utils.durableio import atomic_write_json
+
+    atomic_write_json(loc, meta)
     return False

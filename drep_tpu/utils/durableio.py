@@ -1,0 +1,558 @@
+"""Durable shared-filesystem I/O: checksums, atomic publishes, retry/backoff.
+
+Every elastic protocol in this repo — heartbeat notes, sentinel-note
+barriers, epoch-stamped row/block shard stores, checkpoint meta — rides on
+a shared filesystem that production runs mount as NFS or a FUSE-fronted
+object store: transient ``EIO``/``ESTALE``/``ETIMEDOUT`` errors, stale
+reads, quota exhaustion, and post-write corruption are operating reality,
+not edge cases. dRep itself treats its work-directory tables as the
+durable contract between pipeline stages (Mdb/Ndb/Cdb); our shard stores
+play that role, so their integrity gets the same first-class treatment the
+compute path's fault tolerance (parallel/faulttol.py) gave live device
+failures. This module is THE funnel all shared-filesystem traffic goes
+through (utils/ckptmeta.py re-exports the write primitives so no call
+site drifts off it):
+
+- **Atomic publishes** (:func:`atomic_write` / :func:`atomic_write_bytes`
+  / :func:`atomic_savez`): uuid-tmp + rename, whole-file-or-nothing, with
+  optional fsync of the tmp file AND its directory (``DREP_TPU_FSYNC=1``)
+  so a host power loss cannot revert a rename the run already trusted.
+- **In-band checksums**: every npz payload carries a ``__crc__`` member
+  (crc32 over member names, dtypes, shapes, and bytes), every JSON note
+  a ``"crc"`` key — verified on read (:func:`load_npz_checked`,
+  :func:`read_json_checked`). A mismatch raises
+  :class:`CorruptPayloadError`, which shard-store readers treat exactly
+  like a MISSING shard: the existing recompute paths (streaming row
+  stripes, ring blocks, secondary per-cluster results) fire and the store
+  self-heals instead of crashing with ``BadZipFile``. Payloads written
+  before checksums existed (no ``__crc__``/``"crc"``) stay readable —
+  legacy-accepted, flagged by the scrubber (tools/scrub_store.py) but
+  never invalidated.
+- **Transient-error retries**: ``EIO``/``ESTALE``/``ETIMEDOUT`` on read
+  or write retry with bounded exponential backoff
+  (``DREP_TPU_IO_RETRIES``, default 3; first delay
+  ``DREP_TPU_IO_BACKOFF_S``), counted honestly (``io_retries``; an op
+  that fails past the budget books ``io_unrecoverable`` and raises — the
+  shard READ paths still degrade to recompute, the honest counters say
+  how the run really went). ``ENOSPC`` never retries: it degrades into an
+  actionable :class:`StoreFullError` naming the store and the bytes the
+  write needed.
+- **Chaos injection**: the ``io`` fault site (utils/faults.py) fires
+  inside the retried regions — ``io_error`` (EIO on read+write),
+  ``stale_read`` (ESTALE on read), ``enospc`` (ENOSPC on write), and
+  ``corrupt`` (bit-flip the published npz AFTER the atomic rename — the
+  post-write corruption a checksum exists to catch) — so the whole layer
+  is testable on CPU, including multi-process pod runs.
+
+Zero overhead when nothing fails: the fault check is one falsy lookup,
+retries only spin on an actual OSError, and the crc32 cost is pinned at
+<= 5% of a warm streaming pass by tests/test_perf_guards.py
+(``DREP_TPU_IO_CRC=0`` disables checksum embed+verify as the escape
+hatch / guard baseline).
+
+This module must stay importable without a JAX backend (the scrubber runs
+standalone); jax is never imported here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import errno
+import io
+import json
+import os
+import time
+import uuid
+import zlib
+from typing import Any, Callable
+
+import numpy as np
+
+IO_RETRIES_ENV = "DREP_TPU_IO_RETRIES"
+DEFAULT_IO_RETRIES = 3
+IO_BACKOFF_ENV = "DREP_TPU_IO_BACKOFF_S"
+DEFAULT_IO_BACKOFF_S = 0.05
+FSYNC_ENV = "DREP_TPU_FSYNC"
+CRC_ENV = "DREP_TPU_IO_CRC"
+
+# in-band checksum carriers: an npz member / a JSON key, stored INSIDE the
+# payload so no side-car file can go missing independently
+CRC_KEY = "__crc__"
+JSON_CRC_KEY = "crc"
+
+# errno classes retried as transient (NFS / FUSE object stores): EIO
+# (flaky backend), ESTALE (handle invalidated by a server-side rename
+# window), ETIMEDOUT (slow metadata server). Everything else — ENOENT,
+# EACCES, EROFS — is a real answer and surfaces immediately.
+TRANSIENT_ERRNOS = frozenset({errno.EIO, errno.ESTALE, errno.ETIMEDOUT})
+
+# process-wide overrides installed by the CLI (cluster/controller.py);
+# None = fall through to the env var / default
+_CONFIG: dict[str, Any] = {"retries": None, "fsync": None}
+
+
+def configure(retries: int | None = None, fsync: bool | None = None) -> None:
+    """Install run-wide I/O knobs (the CLI's --io_retries / --fsync).
+    Replaces the whole config: an omitted argument resets that knob to
+    env/default resolution — same contract as allpairs.configure_ring."""
+    _CONFIG["retries"] = retries
+    _CONFIG["fsync"] = fsync
+
+
+def io_retries() -> int:
+    if _CONFIG["retries"] is not None:
+        return max(0, int(_CONFIG["retries"]))
+    return max(0, int(os.environ.get(IO_RETRIES_ENV, DEFAULT_IO_RETRIES)))
+
+
+def io_backoff_s() -> float:
+    return float(os.environ.get(IO_BACKOFF_ENV, DEFAULT_IO_BACKOFF_S))
+
+
+def fsync_enabled() -> bool:
+    if _CONFIG["fsync"] is not None:
+        return bool(_CONFIG["fsync"])
+    return os.environ.get(FSYNC_ENV, "") not in ("", "0", "false")
+
+
+def crc_enabled() -> bool:
+    return os.environ.get(CRC_ENV, "") not in ("0", "false")
+
+
+class StoreFullError(OSError):
+    """ENOSPC, degraded into an actionable error naming the store and the
+    bytes the write needed — quota exhaustion on a shared checkpoint store
+    must tell the operator WHAT to grow, not print a bare errno."""
+
+
+class CorruptPayloadError(Exception):
+    """A payload read back corrupt: truncated/zero-byte/unparseable, or an
+    in-band checksum mismatch. Shard-store readers treat this exactly like
+    a missing shard (recompute + heal); it is deliberately NOT an OSError
+    so the transient-retry loop never spins on it."""
+
+
+def _count(kind: str, n: int = 1) -> None:
+    # lazy: profiling must stay importable without this module and vice
+    # versa, and the scrubber imports durableio with no pipeline around
+    from drep_tpu.utils.profiling import counters
+
+    counters.add_fault(kind, n)
+
+
+def retry_io(
+    fn: Callable[[], Any],
+    what: str,
+    path: str,
+    bytes_needed: int | None = None,
+):
+    """Run `fn`, retrying transient OSErrors (TRANSIENT_ERRNOS) with
+    bounded exponential backoff. ENOSPC raises StoreFullError immediately
+    (retrying a full filesystem burns the backoff for nothing); past the
+    retry budget the op books ``io_unrecoverable`` and the last error
+    surfaces."""
+    retries = io_retries()
+    last: OSError | None = None
+    for attempt in range(retries + 1):
+        if attempt:
+            time.sleep(io_backoff_s() * (2 ** (attempt - 1)))
+            _count("io_retries")
+        try:
+            return fn()
+        except OSError as e:
+            if e.errno == errno.ENOSPC:
+                need = (
+                    f"~{bytes_needed} bytes"
+                    if bytes_needed is not None
+                    else "an unknown payload size"
+                )
+                raise StoreFullError(
+                    errno.ENOSPC,
+                    f"{what}: filesystem full (ENOSPC) publishing {path} — "
+                    f"the store at {os.path.dirname(os.path.abspath(path))} "
+                    f"needs {need} free. Grow the quota / free space and "
+                    f"rerun; finished shards resume.",
+                ) from e
+            if e.errno not in TRANSIENT_ERRNOS:
+                raise
+            last = e
+            from drep_tpu.utils.logger import get_logger
+
+            get_logger().warning(
+                "%s: transient I/O error (%s) on %s — attempt %d/%d",
+                what, errno.errorcode.get(e.errno, e.errno), path,
+                attempt + 1, retries + 1,
+            )
+    _count("io_unrecoverable")
+    raise last  # type: ignore[misc]  # loop ran >= once with a transient error
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write(
+    path: str,
+    write_fn: Callable[[str], None],
+    keep_suffix: bool = False,
+    bytes_needed: int | None = None,
+) -> None:
+    """THE whole-file-or-nothing write primitive (kills mid-write must not
+    leave torn files a later resume trusts; replicated multi-host writers
+    of the same target must never interleave — uuid tmp names because pids
+    collide ACROSS hosts/containers of a pod). `write_fn(tmp)` produces
+    the content; a raising write_fn leaves no orphan tmp behind. Transient
+    I/O errors retry the WHOLE attempt (write_fn is re-run — every caller
+    produces deterministic content, so a retry is idempotent); with
+    ``DREP_TPU_FSYNC=1`` the tmp file is fsynced before the rename and the
+    directory after it, so a host power loss cannot revert a publish.
+
+    `keep_suffix` picks the tmp-name shape, and the two shapes serve
+    CONFLICTING invariants — choose deliberately:
+
+    - False (default): ``<path>.tmp-<uuid>`` — the tmp shares no suffix
+      with the target, so shard-store resume globs (``*.npz``) can never
+      pick up a crash artifact as a corrupt-looking shard (the ingest
+      shard store depends on this).
+    - True: ``<base>.tmp-<uuid><suffix>`` — required when write_fn derives
+      the real output name from the suffix (``np.savez_compressed``
+      appends ``.npz`` to names without it, which would orphan the
+      suffixless tmp). Only safe where nothing globs the target's suffix
+      (the workdir array store).
+    """
+    from drep_tpu.utils import faults
+
+    def attempt() -> None:
+        base, suffix = os.path.splitext(path)
+        tmp = (
+            f"{base}.tmp-{uuid.uuid4().hex}{suffix}"
+            if keep_suffix
+            else f"{path}.tmp-{uuid.uuid4().hex}"
+        )
+        try:
+            faults.fire_io("write", path=path)
+            write_fn(tmp)
+            if fsync_enabled():
+                _fsync_path(tmp)
+            os.replace(tmp, path)
+            if fsync_enabled():
+                with contextlib.suppress(OSError):  # dirs may refuse fsync
+                    _fsync_path(os.path.dirname(os.path.abspath(path)) or ".")
+        finally:
+            if os.path.exists(tmp):
+                with contextlib.suppress(OSError):
+                    os.remove(tmp)
+
+    retry_io(attempt, what="atomic write", path=path, bytes_needed=bytes_needed)
+
+
+def atomic_write_bytes(path: str, data) -> None:
+    def write(tmp: str) -> None:
+        with open(tmp, "wb") as f:
+            f.write(data)
+
+    atomic_write(path, write, bytes_needed=len(data))
+
+
+# -- in-band checksums ------------------------------------------------------
+
+
+def checksum_arrays(arrays: dict[str, np.ndarray]) -> int:
+    """crc32 over member names, dtypes, shapes, and raw bytes (sorted by
+    name, CRC_KEY excluded) — pinned to the decoded arrays, not the zip
+    container, so the same content checks equal whether it was stored
+    compressed or raw."""
+    crc = 0
+    for name in sorted(arrays):
+        if name == CRC_KEY:
+            continue
+        a = np.ascontiguousarray(arrays[name])
+        crc = zlib.crc32(str(name).encode(), crc)
+        crc = zlib.crc32(str(a.dtype).encode(), crc)
+        crc = zlib.crc32(str(a.shape).encode(), crc)
+        try:
+            # hash the buffer in place: a.tobytes() would transiently copy
+            # the payload, doubling peak memory on the GB-scale sketch cache
+            buf = memoryview(a).cast("B")
+        except (TypeError, ValueError):
+            buf = a.tobytes()  # exotic dtypes without a flat buffer view
+        crc = zlib.crc32(buf, crc)
+    return crc & 0xFFFFFFFF
+
+
+def with_checksum(arrays: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """The arrays plus their in-band ``__crc__`` member (a no-op pass-
+    through when checksums are disabled). A payload that already carries
+    the reserved member raises — same loud contract as
+    :func:`dump_json_checked`'s ``"crc"`` key: silently replacing the
+    caller's array would lose data AND strip it again on every read."""
+    if CRC_KEY in arrays:
+        raise ValueError(
+            f"npz payload already carries the reserved in-band checksum "
+            f"member {CRC_KEY!r} — rename that array (utils/durableio.py "
+            f"owns the member in every checked payload)"
+        )
+    if not crc_enabled():
+        return arrays
+    out = dict(arrays)
+    out[CRC_KEY] = np.array([checksum_arrays(arrays)], dtype=np.uint32)
+    return out
+
+
+def verify_npz_payload(loaded: dict[str, np.ndarray], path: str, what: str) -> dict:
+    """Strip + verify the in-band checksum of an already-decoded payload.
+    Payloads with no ``__crc__`` are legacy-accepted (pre-checksum stores
+    must stay resumable); a present-but-wrong crc raises."""
+    if CRC_KEY not in loaded:
+        return loaded
+    try:
+        stored = int(np.asarray(loaded.pop(CRC_KEY)).ravel()[0])
+    except (IndexError, TypeError, ValueError) as e:
+        # a rotted/empty __crc__ member is itself corruption — it must
+        # classify, never crash (the corruption-never-crashes contract)
+        raise CorruptPayloadError(
+            f"{what} {path}: unreadable in-band checksum ({e!r})"
+        ) from e
+    if crc_enabled() and checksum_arrays(loaded) != stored:
+        raise CorruptPayloadError(
+            f"{what} {path}: in-band checksum mismatch — the payload was "
+            f"corrupted after it was written"
+        )
+    return loaded
+
+
+def _flip_bit(path: str) -> None:
+    """Chaos helper for the ``io:corrupt`` mode: flip one bit of the
+    PUBLISHED file — the post-atomic-rename corruption (disk rot, a
+    misbehaving object-store cache) a checksum exists to catch. The
+    atomic path is untouched; only the durable bytes rot. For zip/npz
+    payloads the flipped bit lands INSIDE a member's data region
+    (mid-file on a tiny payload can hit a structure field zipfile
+    ignores, which would make the injection a silent no-op)."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return
+    off = None
+    try:
+        import zipfile
+
+        with zipfile.ZipFile(path) as zf:
+            info = max(zf.infolist(), key=lambda i: i.compress_size)
+        if info.compress_size > 0:
+            with open(path, "rb") as f:
+                f.seek(info.header_offset)
+                hdr = f.read(30)  # local file header: lengths at 26/28
+            name_len = int.from_bytes(hdr[26:28], "little")
+            extra_len = int.from_bytes(hdr[28:30], "little")
+            off = (
+                info.header_offset + 30 + name_len + extra_len
+                + info.compress_size // 2
+            )
+    except Exception:  # noqa: BLE001 — not a zip: rot the middle byte
+        off = None
+    if off is None or off >= size:
+        off = size // 2
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0x01]))
+
+
+def atomic_savez(
+    path: str, compressed: bool = True, fault_site: str = "shard_write", **arrays
+) -> None:
+    """Serialize arrays (plus their in-band ``__crc__``) to `.npz` IN
+    MEMORY and publish through atomic_write: uuid tmp (two writers of one
+    target on a shared pod filesystem must never interleave) whose name
+    does NOT end in .npz — crash artifacts must stay outside the shard
+    namespace that resume globs and ``clear_suffixes`` scan. One helper
+    for every shard store (streaming row blocks, ring block tiles,
+    per-cluster secondary results, ingest sketch shards) so the
+    atomicity+checksum recipe cannot drift between them.
+    `compressed=False` for thousands-of-tiny-files stores where zlib is a
+    measured hot spot."""
+    from drep_tpu.utils import faults
+
+    buf = io.BytesIO()
+    (np.savez_compressed if compressed else np.savez)(buf, **with_checksum(arrays))
+    if faults.torn_write(fault_site, path=path):
+        # chaos injection: publish a truncated file AT the target path,
+        # bypassing the atomic tmp+rename — the on-disk state a mid-write
+        # kill on a non-atomic filesystem would leave. Resume must detect
+        # it as corrupt and recompute (the path this injection tests).
+        data = bytes(buf.getbuffer())
+        with open(path, "wb") as f:
+            f.write(data[: max(1, len(data) // 2)])
+        return
+    atomic_write_bytes(path, buf.getbuffer())
+    if faults.corrupt_write(path=path):
+        # chaos injection: the atomic publish SUCCEEDED, then the durable
+        # bytes rotted — exactly what the in-band checksum defends against
+        _flip_bit(path)
+
+
+def read_npz_unverified(path: str, what: str = "payload") -> dict[str, np.ndarray]:
+    """Retried read + full decode with corrupt classification, but NO
+    checksum verification — the returned dict still carries its
+    ``__crc__`` member. The scrubber reads through this so it can
+    classify legacy (crc-less) payloads without a second open; everything
+    else wants :func:`load_npz_checked`."""
+    from drep_tpu.utils import faults
+
+    def read() -> dict[str, np.ndarray]:
+        faults.fire_io("read", path=path)
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    try:
+        return retry_io(read, what=f"read {what}", path=path)
+    except (OSError, CorruptPayloadError):
+        raise
+    except Exception as e:  # noqa: BLE001 — BadZipFile / EOF / pickle guard
+        raise CorruptPayloadError(f"{what} {path}: unreadable ({e!r})") from e
+
+
+def load_npz_checked(path: str, what: str = "payload") -> dict[str, np.ndarray]:
+    """Read an npz payload with transient-error retries and in-band
+    checksum verification. Raises :class:`CorruptPayloadError` for
+    anything the WRITER's atomicity cannot explain — zero-byte, truncated,
+    unparseable, or checksum-mismatched bytes — which shard-store callers
+    treat exactly like a missing shard (recompute + heal). OSErrors that
+    survive the retry budget surface as themselves (missing file, real
+    permission trouble — answers, not corruption)."""
+    return verify_npz_payload(read_npz_unverified(path, what), path, what)
+
+
+def load_npz_or_none(path: str, what: str, convert: Callable[[dict], Any], warn: str) -> Any:
+    """THE corrupt-vs-missing classifier every shard-store reader shares
+    (streaming row shards, ring blocks, secondary per-cluster results —
+    one implementation so the heal-accounting contract cannot drift):
+    `convert(payload)` builds the caller's result (member indexing inside
+    it counts as corruption — a shard missing its members IS rot);
+    a missing file returns None UNCOUNTED (a peer may have healed it
+    first — booking it would report phantom heals across survivors);
+    anything else warns with `warn` (%s = path), books one
+    ``corrupt_shards_healed``, best-effort removes the payload, and
+    returns None so the caller recomputes."""
+    try:
+        return convert(load_npz_checked(path, what=what))
+    except FileNotFoundError:
+        return None
+    except OSError:
+        # transient retry budget exhausted (io_unrecoverable already
+        # booked by retry_io) or real FS trouble: the shard ITSELF may be
+        # perfectly intact — recompute without deleting it and without
+        # booking a heal. Deleting here would let an NFS brownout destroy
+        # a fully-computed store the moment a resume walks it. Its own
+        # message, NOT the caller's corrupt-shard one: telling an operator
+        # an intact shard is "corrupt" invites a --delete that destroys it.
+        from drep_tpu.utils.logger import get_logger
+
+        get_logger().warning(
+            "%s %s: unreadable after transient I/O retries — recomputing, "
+            "shard left in place", what, path,
+        )
+        return None
+    except Exception:  # noqa: BLE001 — any unreadable shard degrades to recompute
+        from drep_tpu.utils.logger import get_logger
+
+        get_logger().warning(warn, path)
+        quarantine_corrupt(path)
+        return None
+
+
+def quarantine_corrupt(path: str) -> None:
+    """Book one corrupt-shard heal (the caller is about to recompute) and
+    best-effort remove the bad payload — the remove itself may fail on
+    EACCES/flaky NFS; the recompute's atomic rewrite replaces it either
+    way (the idempotent self-heal invariant)."""
+    _count("corrupt_shards_healed")
+    with contextlib.suppress(OSError):
+        os.remove(path)
+
+
+# -- checked JSON notes -----------------------------------------------------
+
+
+def dump_json_checked(obj: dict[str, Any], default=str) -> bytes:
+    """Canonical JSON bytes with an in-band ``"crc"`` key — crc32 of the
+    canonical dump WITHOUT it. The verify side recomputes the crc from
+    the PARSED body, so any `default` serializer is consistent (canonical
+    json round-trips: dump(parse(dump(x))) == dump(x)). A payload that
+    already carries a ``"crc"`` key raises: silently replacing the
+    caller's value would lose data AND make every later read classify
+    the note as rotted — the key is reserved, loudly."""
+    if JSON_CRC_KEY in obj:
+        raise ValueError(
+            f"JSON payload already carries the reserved in-band checksum "
+            f"key {JSON_CRC_KEY!r} — rename that field (utils/durableio.py "
+            f"owns the key on every checked note)"
+        )
+    body = dict(obj)
+    if crc_enabled():
+        canon = json.dumps(body, sort_keys=True, default=default).encode()
+        body[JSON_CRC_KEY] = zlib.crc32(json.dumps(json.loads(canon), sort_keys=True).encode()) & 0xFFFFFFFF
+    return json.dumps(body, sort_keys=True, default=default).encode()
+
+
+def atomic_write_json(path: str, obj: dict[str, Any], default=str) -> None:
+    atomic_write_bytes(path, dump_json_checked(obj, default=default))
+
+
+def read_json_unverified(path: str, what: str = "note"):
+    """Retried read + parse with corrupt classification, but NO checksum
+    verification — a present ``"crc"`` key stays in the returned document.
+    The scrubber reads through this so it can classify legacy (crc-less)
+    notes without a second parse; everything else wants
+    :func:`read_json_checked`."""
+    from drep_tpu.utils import faults
+
+    def read() -> bytes:
+        # binary read: a note bit-rotted into invalid UTF-8 must classify
+        # as corrupt below, not blow up as UnicodeDecodeError mid-read
+        faults.fire_io("read", path=path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    raw = retry_io(read, what=f"read {what}", path=path)
+    try:
+        return json.loads(raw.decode())
+    except ValueError as e:  # includes UnicodeDecodeError
+        raise CorruptPayloadError(f"{what} {path}: unparseable JSON ({e})") from e
+
+
+def verify_json_payload(body, path: str, what: str = "note"):
+    """Strip + verify the in-band ``"crc"`` of an already-parsed JSON
+    document (consumers compare payload keys — meta matching must never
+    see the checksum as a pinned parameter). Documents with no crc key
+    are legacy-accepted, and non-dict documents pass through untouched
+    (callers validate shape). Raises CorruptPayloadError on a mismatch."""
+    if not isinstance(body, dict) or JSON_CRC_KEY not in body:
+        return body
+    stored = body.pop(JSON_CRC_KEY)
+    if crc_enabled():
+        try:
+            want = int(stored)
+        except (TypeError, ValueError) as e:
+            # the crc value itself rotted (null, string garbage): that IS
+            # corruption and must classify, never crash the reader
+            raise CorruptPayloadError(
+                f"{what} {path}: unreadable in-band checksum ({stored!r})"
+            ) from e
+        canon = json.dumps(body, sort_keys=True, default=str).encode()
+        if (zlib.crc32(canon) & 0xFFFFFFFF) != want:
+            raise CorruptPayloadError(f"{what} {path}: in-band checksum mismatch")
+    return body
+
+
+def read_json_checked(path: str, what: str = "note"):
+    """Read + verify a checked JSON note; the ``"crc"`` key is stripped
+    from the returned dict. Notes written before checksums existed (no
+    crc key) are legacy-accepted. Raises CorruptPayloadError on
+    unparseable bytes or a crc mismatch."""
+    return verify_json_payload(read_json_unverified(path, what), path, what)

@@ -22,7 +22,9 @@ Spec syntax (``DREP_TPU_FAULTS`` env var, or :func:`configure`)::
   default 3600 — trips watchdogs/collective timeouts), ``sleep``
   (sleep ``secs`` then continue — paces a run so a chaos test can kill
   it mid-flight), ``torn`` (write sites only: publish a truncated file
-  in place of the atomic write).
+  in place of the atomic write), and the ``io``-site storage modes
+  (``io_error``/``stale_read``/``enospc``/``corrupt`` — see MODES and
+  utils/durableio.py).
 - ``prob``   — per-call fire probability (default 1.0), drawn from a
   per-rule ``random.Random(seed)`` stream, so runs are reproducible.
 - ``key=value`` — ``seed=N`` (default 0), ``secs=F`` (sleep duration),
@@ -30,7 +32,9 @@ Spec syntax (``DREP_TPU_FAULTS`` env var, or :func:`configure`)::
   ``max=N`` (stop after N fires — e.g. tear exactly two shards),
   ``proc=N`` (fire only on jax process N of a pod — one spec can be
   shared by every pod member), ``skip=N`` (ignore the first N matching
-  calls — e.g. let a process finish two stripes before killing it).
+  calls — e.g. let a process finish two stripes before killing it),
+  ``path=S`` (fire only when the target path contains S — e.g.
+  ``path=.e01`` corrupts only an epoch-1-stamped shard; I/O sites only).
 
 The ``kill`` mode (``process_death`` site, fired per streaming stripe;
 ``ring_step`` site, fired per dense-ring step boundary) SIGKILLs the
@@ -59,13 +63,21 @@ SITES = (
     "ring_dispatch",  # ring step/recovery dispatch waits, parallel/allpairs.py
     "ring_step",  # per-ring-step host boundary, parallel/allpairs.py (kill)
     "secondary_batch",  # secondary engine calls, cluster/controller.py
-    "shard_write",  # atomic shard publish, utils/ckptmeta.py (torn)
+    "shard_write",  # atomic shard publish, utils/durableio.py (torn)
     "allgather",  # multi-host edge allgather, parallel/streaming.py
     "barrier",  # checkpoint-dir open barrier, utils/ckptmeta.py
     "process_death",  # per-stripe suicide point, parallel/streaming.py (kill)
+    "io",  # durable read/write paths, utils/durableio.py (io modes below)
 )
 
-MODES = ("raise", "hang", "sleep", "torn", "kill")
+# io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):
+# io_error = transient OSError(EIO) on read AND write (retried by the
+# bounded-backoff loop); stale_read = OSError(ESTALE) on read only;
+# enospc = OSError(ENOSPC) on write only (degrades into the actionable
+# StoreFullError); corrupt = flip one bit of the published npz AFTER the
+# atomic rename — the post-write rot the in-band checksum self-heals.
+IO_MODES = ("io_error", "stale_read", "enospc", "corrupt")
+MODES = ("raise", "hang", "sleep", "torn", "kill") + IO_MODES
 
 
 class InjectedFault(RuntimeError):
@@ -89,6 +101,7 @@ class _Rule:
     proc: int | None = None
     skip: int = 0
     max_fires: int | None = None
+    path_sub: str | None = None
     fired: int = 0
     seen: int = 0
     rng: random.Random = field(init=False)
@@ -96,10 +109,12 @@ class _Rule:
     def __post_init__(self) -> None:
         self.rng = random.Random(self.seed)
 
-    def should_fire(self, device: int | None) -> bool:
+    def should_fire(self, device: int | None, path: str | None = None) -> bool:
         if self.max_fires is not None and self.fired >= self.max_fires:
             return False
         if self.device is not None and device != self.device:
+            return False
+        if self.path_sub is not None and (path is None or self.path_sub not in path):
             return False
         if self.proc is not None:
             import jax  # lazy: the registry must import without a backend
@@ -125,6 +140,24 @@ def _parse(spec: str) -> dict[str, list[_Rule]]:
             raise FaultSpecError(f"unknown fault site {site!r} (known: {', '.join(SITES)})")
         if mode not in MODES:
             raise FaultSpecError(f"unknown fault mode {mode!r} (known: {', '.join(MODES)})")
+        if mode in IO_MODES and site != "io":
+            # a typo like barrier:enospc would parse, book its injected_*
+            # counter at fire() time, then act on nothing — the chaos run
+            # would silently test nothing while claiming it injected
+            raise FaultSpecError(
+                f"mode {mode!r} is io-site-only (got site {site!r}); "
+                f"storage faults fire inside utils/durableio.py via the "
+                f"'io' site"
+            )
+        if site == "io" and mode in ("torn", "kill"):
+            # the symmetric no-op: fire_io skips these outright (torn is
+            # the shard_write site's poll, kill belongs to the death
+            # sites), so io:torn would claim coverage and inject nothing
+            raise FaultSpecError(
+                f"mode {mode!r} has no 'io' site semantics — use "
+                f"shard_write:torn for torn publishes, or "
+                f"process_death/ring_step:kill for deaths"
+            )
         rule = _Rule(site=site, mode=mode)
         for f in fields[2:]:
             if "=" in f:
@@ -141,6 +174,22 @@ def _parse(spec: str) -> dict[str, list[_Rule]]:
                     rule.skip = int(val)
                 elif key == "max":
                     rule.max_fires = int(val)
+                elif key == "path":
+                    # substring match on the target path — deterministic
+                    # targeting of ONE shard family (e.g. path=.e01 hits
+                    # only epoch-1-stamped shards). Only the durable-I/O
+                    # call sites supply a path (fire_io/corrupt_write for
+                    # 'io', torn_write for 'shard_write'); on any other
+                    # site should_fire would see path=None and the rule
+                    # would silently never fire — reject the spec instead
+                    if site not in ("io", "shard_write"):
+                        raise FaultSpecError(
+                            f"path= is only meaningful on the io/"
+                            f"shard_write sites (got {site!r}); other "
+                            f"sites never supply a target path, so the "
+                            f"rule would never fire"
+                        )
+                    rule.path_sub = val
                 else:
                     raise FaultSpecError(f"unknown fault field {key!r} in {entry!r}")
             else:
@@ -218,7 +267,7 @@ def fire(site: str, device: int | None = None) -> None:
         # 'torn' rules are polled via torn_write(), never fired here
 
 
-def torn_write(site: str = "shard_write") -> bool:
+def torn_write(site: str = "shard_write", path: str | None = None) -> bool:
     """Should the caller tear this write? (write sites poll this instead
     of fire(): tearing is an action the WRITER performs, not an
     exception)."""
@@ -228,7 +277,64 @@ def torn_write(site: str = "shard_write") -> bool:
     if not rules:
         return False
     for rule in rules.get(site, ()):
-        if rule.mode == "torn" and rule.should_fire(None):
+        if rule.mode == "torn" and rule.should_fire(None, path=path):
             _record(rule)
             return True
     return False
+
+
+def corrupt_write(site: str = "io", path: str | None = None) -> bool:
+    """Should the caller bit-flip this freshly-PUBLISHED payload? (the
+    ``io:corrupt`` mode — like torn_write, corruption is an action the
+    writer performs after the atomic rename, not an exception)."""
+    rules = _RULES
+    if rules is None:
+        rules = _rules()
+    if not rules:
+        return False
+    for rule in rules.get(site, ()):
+        if rule.mode == "corrupt" and rule.should_fire(None, path=path):
+            _record(rule)
+            return True
+    return False
+
+
+def fire_io(op: str, path: str | None = None) -> None:
+    """Run the ``io`` site's error-raising rules for one durable I/O
+    attempt (utils/durableio.py calls this INSIDE its retried regions, so
+    injected transient errors exercise the real backoff loop). `op` is
+    ``"read"`` or ``"write"``: ``stale_read`` fires on reads only,
+    ``enospc`` on writes only, ``io_error`` on both; ``corrupt`` is
+    polled via :func:`corrupt_write`, never raised here."""
+    import errno as _errno
+
+    rules = _RULES
+    if rules is None:
+        rules = _rules()
+    if not rules:
+        return
+    for rule in rules.get("io", ()):
+        if rule.mode in ("corrupt", "torn", "kill"):
+            continue  # corrupt is polled via corrupt_write; torn/kill have no io semantics
+        if rule.mode == "stale_read" and op != "read":
+            continue
+        if rule.mode == "enospc" and op != "write":
+            continue
+        if not rule.should_fire(None, path=path):
+            continue
+        _record(rule)
+        if rule.mode == "io_error":
+            raise OSError(_errno.EIO, f"injected EIO at io ({op}: {path})")
+        if rule.mode == "stale_read":
+            raise OSError(_errno.ESTALE, f"injected ESTALE at io (read: {path})")
+        if rule.mode == "enospc":
+            raise OSError(_errno.ENOSPC, f"injected ENOSPC at io (write: {path})")
+        if rule.mode == "raise":
+            raise InjectedFault(f"injected fault at io ({op}: {path})")
+        if rule.mode == "hang":
+            # a wedged NFS call: sleep the hang, then surface as EIO so
+            # the retry/backoff layer (not a watchdog) handles it
+            time.sleep(3600.0 if rule.secs is None else rule.secs)
+            raise OSError(_errno.EIO, f"injected hang at io woke up ({op}: {path})")
+        if rule.mode == "sleep":
+            time.sleep(0.05 if rule.secs is None else rule.secs)

@@ -50,6 +50,10 @@ class Counters:
     # burned 40 retries, benched a chip, or recomputed ring blocks
     # per-tile is not the same measurement as a clean one, and bench
     # records must be able to tell them apart.
+    # the durable-I/O layer (utils/durableio.py) adds its own honest
+    # counters here: io_retries (transient EIO/ESTALE/ETIMEDOUT retried),
+    # corrupt_shards_healed (checksum/truncation detections recomputed
+    # into their own path), io_unrecoverable (ops failed past the budget).
     faults: dict[str, int] = field(default_factory=dict)
     # derived operational values (not event counts): e.g. the auto-derived
     # per-dispatch watchdog deadline the run actually used when
@@ -131,9 +135,16 @@ class Counters:
         return out
 
     def write(self, log_dir: str) -> str:
+        # atomic (utils/durableio.py): a SIGKILL mid-write must not leave
+        # a torn perf_counters.json that poisons the next run's tooling —
+        # the counters are the honesty record, they get the same
+        # durability as the shards they describe
+        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
         path = os.path.join(log_dir, "perf_counters.json")
-        with open(path, "w") as f:
-            json.dump(self.report(), f, indent=1, sort_keys=True)
+        atomic_write_bytes(
+            path, json.dumps(self.report(), indent=1, sort_keys=True).encode()
+        )
         return path
 
     def reset(self) -> None:

@@ -99,13 +99,21 @@ class WorkDirectory:
         """`compressed=False` for high-entropy payloads (the MinHash sketch
         cache: uniform 64-bit hashes are incompressible, and zlib over the
         ~GB-scale cache was pure CPU on both the save AND the timed-resume
-        load path — cf. ckptmeta.atomic_savez's same knob)."""
+        load path — cf. ckptmeta.atomic_savez's same knob). Payloads carry
+        the in-band ``__crc__`` (utils/durableio.py) so a bit-rotted cache
+        is detected at load, never silently trusted; the write streams to
+        the tmp file directly (no in-memory serialize — the sketch cache
+        is ~GB at 100k genomes)."""
+        from drep_tpu.utils.durableio import with_checksum
+
+        arrays = with_checksum(arrays)
         writer = np.savez_compressed if compressed else np.savez
         _atomic_write(self._array_loc(name), lambda tmp: writer(tmp, **arrays))
 
     def get_arrays(self, name: str) -> dict[str, np.ndarray]:
-        with np.load(self._array_loc(name), allow_pickle=False) as z:
-            return {k: z[k] for k in z.files}
+        from drep_tpu.utils.durableio import load_npz_checked
+
+        return load_npz_checked(self._array_loc(name), what=f"workdir array {name}")
 
     def has_arrays(self, name: str) -> bool:
         return os.path.exists(self._array_loc(name))
@@ -115,18 +123,29 @@ class WorkDirectory:
         return os.path.join(self.location, "log", f"{stage}_arguments.json")
 
     def store_arguments(self, stage: str, kwargs: dict[str, Any]) -> None:
-        def write(tmp: str) -> None:
-            with open(tmp, "w") as f:
-                json.dump(kwargs, f, indent=1, sort_keys=True, default=_json_default)
+        # checked JSON (utils/durableio.py): the snapshot carries an
+        # in-band "crc" so a bit-rotted snapshot is DETECTED at read and
+        # classified as absent (stage recomputes) instead of either
+        # crashing the resume or silently mis-matching
+        from drep_tpu.utils.durableio import atomic_write_json
 
-        _atomic_write(self._args_loc(stage), write)
+        atomic_write_json(self._args_loc(stage), kwargs, default=_json_default)
 
     def get_arguments(self, stage: str) -> dict[str, Any] | None:
         loc = self._args_loc(stage)
         if not os.path.exists(loc):
             return None
-        with open(loc) as f:
-            return json.load(f)
+        from drep_tpu.utils.durableio import CorruptPayloadError, read_json_checked
+
+        try:
+            out = read_json_checked(loc, what=f"{stage} argument snapshot")
+        except CorruptPayloadError:
+            get_logger().warning(
+                "corrupt argument snapshot %s — treating as absent (the "
+                "stage recomputes and rewrites it)", loc,
+            )
+            return None
+        return out if isinstance(out, dict) else None
 
     def arguments_match(self, stage: str, kwargs: dict[str, Any], keys: list[str] | None = None) -> bool:
         """True iff a stored snapshot exists and agrees with `kwargs`.

@@ -48,22 +48,26 @@ pytestmark = pytest.mark.chaos
 @pytest.fixture(autouse=True)
 def _clean_faults():
     """Every test starts and ends with injection disabled, counters clean,
-    the elastic pod state healthy, and the ring config reset — a leaked
-    spec, a unit-test 'degraded pod', or an earlier controller test's
-    workdir-scoped ring store base would poison the rest of the suite."""
+    the elastic pod state healthy, and the ring + durable-I/O configs
+    reset — a leaked spec, a unit-test 'degraded pod', or an earlier
+    controller test's workdir-scoped ring store base would poison the
+    rest of the suite."""
     from drep_tpu.parallel.allpairs import configure_ring
+    from drep_tpu.utils.durableio import configure as configure_io
 
     faults.configure(None)
     counters.reset()
     faulttol.reset_pod()
     faulttol._HB_SEQ.clear()
     configure_ring()
+    configure_io()
     yield
     faults.configure(None)
     counters.reset()
     faulttol.reset_pod()
     faulttol._HB_SEQ.clear()
     configure_ring()
+    configure_io()
 
 
 @contextmanager
@@ -822,6 +826,376 @@ def test_auto_timeout_shared_rule():
     assert auto.effective() == AUTO_TIMEOUT_FLOOR_S
     assert AutoTimeout(FaultTolConfig(dispatch_timeout_s=2.0)).effective() == 2.0
     assert AutoTimeout(FaultTolConfig()).effective() == 0.0
+
+
+# --- durable storage (ISSUE 5): checksums, retries, scrubber -------------
+
+
+def test_zero_byte_and_truncated_row_shards_heal_on_resume(tmp_path):
+    """The no-registry real-world case: a zero-byte and a truncated
+    ``row_*.npz`` planted DIRECTLY on disk (no fault injection — the way
+    a real NFS outage or disk-full rot actually presents) must be
+    classified exactly like missing shards at resume: recomputed,
+    bit-identical to a clean run, healed in place, and counted honestly
+    (``corrupt_shards_healed``)."""
+    packed = _packed(n=48)
+    ckpt = str(tmp_path / "ckpt")
+    r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    shards = sorted(f for f in os.listdir(ckpt) if f.startswith("row_"))
+    zero, trunc = os.path.join(ckpt, shards[0]), os.path.join(ckpt, shards[2])
+    with open(zero, "wb"):
+        pass  # zero-byte
+    data = open(trunc, "rb").read()
+    with open(trunc, "wb") as f:
+        f.write(data[: len(data) // 3])  # truncated
+    counters.reset()
+    with _capture_log() as records:
+        r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    _assert_edges_equal(r2, r1)
+    assert 0 < r2[3] < r1[3]  # only the two damaged stripes recomputed
+    assert counters.faults.get("corrupt_shards_healed") == 2, counters.faults
+    assert sum("corrupt shard" in r.getMessage() for r in records) == 2
+    # the heal is real: a third run resumes everything, computing nothing
+    r3 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    assert r3[3] == 0
+    _assert_edges_equal(r3, r1)
+    # honest reporting: the heal surfaces in the perf_counters report
+    assert counters.report()["fault_tolerance"]["corrupt_shards_healed"] == 2
+
+
+def test_zero_byte_and_truncated_ring_blocks_heal_on_resume(tmp_path):
+    """Same no-registry case for the dense ring's block store: a
+    zero-byte and a truncated ``blk_*.npz`` are recomputed per-block at
+    resume, bit-identical, with honest heal counters."""
+    from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.mesh import make_mesh
+
+    packed = _ring_packed()
+    mesh = make_mesh(3)
+    ckpt = str(tmp_path / "ring")
+    r1 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    blocks = sorted(f for f in os.listdir(ckpt) if f.startswith("blk_"))
+    with open(os.path.join(ckpt, blocks[0]), "wb"):
+        pass  # zero-byte
+    loc = os.path.join(ckpt, blocks[3])
+    data = open(loc, "rb").read()
+    with open(loc, "wb") as f:
+        f.write(data[: len(data) // 3])  # truncated
+    counters.reset()
+    r2 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    assert r2.tobytes() == r1.tobytes()
+    assert counters.faults.get("corrupt_shards_healed") == 2, counters.faults
+    assert counters.faults.get("ring_blocks_recovered") == 2, counters.faults
+    r3 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt)
+    assert r3.tobytes() == r1.tobytes()
+
+    # injected post-publish bit rot on ONE block write (io:corrupt,
+    # path-targeted at the block namespace) heals identically at resume
+    counters.reset()
+    faults.configure("io:corrupt:1.0:path=blk_:max=1")
+    ckpt2 = str(tmp_path / "ring2")
+    r4 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt2)
+    faults.configure(None)
+    assert r4.tobytes() == r1.tobytes()  # run 1's results are unaffected
+    assert counters.faults.get("injected_io_corrupt") == 1
+    counters.reset()
+    r5 = sharded_mash_allpairs(packed, k=21, mesh=mesh, checkpoint_dir=ckpt2)
+    assert r5.tobytes() == r1.tobytes()
+    assert counters.faults.get("corrupt_shards_healed") == 1, counters.faults
+
+
+def test_bit_rotted_shard_detected_by_checksum_and_healed(tmp_path):
+    """Post-write corruption the zip container alone might miss: the
+    ``io:corrupt`` injection flips one bit of a PUBLISHED shard (the
+    atomic rename already succeeded); the resume must detect it — in-band
+    ``__crc__`` or container CRC, whichever trips first — recompute the
+    stripe, and end bit-identical with corrupt_shards_healed reported."""
+    packed = _packed(n=48)
+    ckpt = str(tmp_path / "ckpt")
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    faults.configure("io:corrupt:1.0:max=1")
+    r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    faults.configure(None)
+    _assert_edges_equal(r1, want)  # run 1's RESULTS are unaffected
+    assert counters.faults.get("injected_io_corrupt") == 1
+    counters.reset()
+    r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    _assert_edges_equal(r2, want)
+    assert counters.faults.get("corrupt_shards_healed") == 1, counters.faults
+    assert 0 < r2[3] < r1[3]
+    r3 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    assert r3[3] == 0  # healed: full resume
+    _assert_edges_equal(r3, want)
+
+
+def test_transient_io_errors_retry_with_honest_counters(tmp_path):
+    """EIO on write and ESTALE on read are retried with bounded backoff
+    (DREP_TPU_IO_RETRIES) — the run completes bit-identical with
+    io_retries counted, and nothing is recorded when nothing fails."""
+    packed = _packed(n=48)
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+
+    # write-side EIO, twice transient
+    ckpt = str(tmp_path / "ckpt_w")
+    faults.configure("io:io_error:1.0:max=2")
+    r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    faults.configure(None)
+    _assert_edges_equal(r1, want)
+    assert counters.faults.get("io_retries", 0) >= 2, counters.faults
+    assert counters.faults.get("injected_io_io_error") == 2
+
+    # read-side ESTALE at resume
+    counters.reset()
+    faults.configure("io:stale_read:1.0:max=1")
+    r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    faults.configure(None)
+    _assert_edges_equal(r2, want)
+    assert r2[3] == 0  # the retried read SUCCEEDED: no recompute
+    assert counters.faults.get("io_retries", 0) >= 1, counters.faults
+
+    # exhausted budget on SHARD reads (path= keeps the meta readable):
+    # the op books io_unrecoverable and the shard read path degrades to
+    # recompute — but the on-disk shard is NOT deleted and NOT counted
+    # as a heal (it may be perfectly intact; a filesystem brownout must
+    # never destroy a fully-computed store). The store survives a
+    # persistently sick read side at the price of recompute, never a
+    # crash, and the counters tell the truth: unrecoverable, not corrupt.
+    counters.reset()
+    faults.configure("io:stale_read:1.0:path=row_")
+    r3 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    faults.configure(None)
+    _assert_edges_equal(r3, want)
+    assert counters.faults.get("io_unrecoverable", 0) >= 1, counters.faults
+    assert counters.faults.get("corrupt_shards_healed", 0) == 0, counters.faults
+    import glob as _glob
+
+    assert _glob.glob(os.path.join(ckpt, "row_*.npz")), "brownout deleted intact shards"
+
+
+def test_enospc_degrades_into_actionable_store_full_error(tmp_path):
+    """Quota exhaustion must not burn the retry budget or print a bare
+    errno: the error names the store and the bytes the write needed."""
+    from drep_tpu.utils.durableio import StoreFullError
+
+    packed = _packed(n=48)
+    ckpt = str(tmp_path / "ckpt")
+    faults.configure("io:enospc:1.0")
+    with pytest.raises(StoreFullError, match="ENOSPC") as ei:
+        streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    assert str(tmp_path) in str(ei.value)  # names the store
+    assert "bytes" in str(ei.value)  # names the need
+    assert counters.faults.get("io_retries", 0) == 0  # never retried
+
+
+def test_checked_payload_roundtrip_and_json_notes(tmp_path):
+    """The durable-I/O contract at the unit level: npz payloads carry an
+    in-band __crc__ verified on read (legacy payloads without one stay
+    readable), JSON notes carry a "crc" key stripped by the reader, and
+    a checkpoint meta survives the checksum round-trip without the crc
+    ever counting as a pinned parameter."""
+    import json as _json
+
+    import zipfile
+
+    from drep_tpu.utils import durableio
+    from drep_tpu.utils.ckptmeta import checkpoint_meta_matches, open_checkpoint_dir
+
+    p = str(tmp_path / "row_00000.npz")
+    durableio.atomic_savez(p, ii=np.arange(4), jj=np.arange(4))
+    assert f"{durableio.CRC_KEY}.npy" in zipfile.ZipFile(p).namelist()
+    z = durableio.load_npz_checked(p)
+    assert durableio.CRC_KEY not in z  # stripped after verification
+    durableio._flip_bit(p)
+    with pytest.raises(durableio.CorruptPayloadError):
+        durableio.load_npz_checked(p)
+
+    # legacy npz (pre-checksum) stays readable
+    legacy = str(tmp_path / "legacy.npz")
+    np.savez(legacy, a=np.arange(3))
+    assert list(durableio.load_npz_checked(legacy)) == ["a"]
+
+    # JSON notes: crc embedded, verified, stripped; legacy accepted
+    note = str(tmp_path / ".pod-done.p0")
+    durableio.atomic_write_json(note, {"pairs": 7, "seq": 1})
+    raw = _json.load(open(note))
+    assert durableio.JSON_CRC_KEY in raw
+    assert durableio.read_json_checked(note) == {"pairs": 7, "seq": 1}
+    with open(note, "w") as f:
+        f.write('{"pairs": 7, "seq": 1}')  # legacy, no crc
+    assert durableio.read_json_checked(note) == {"pairs": 7, "seq": 1}
+    with open(note, "w") as f:
+        f.write('{"pairs": 7, "seq": 1, "crc": 12345}')  # rotted
+    with pytest.raises(durableio.CorruptPayloadError):
+        durableio.read_json_checked(note)
+    # a rotted CHECKSUM VALUE (null / garbage) classifies, never crashes
+    with open(note, "w") as f:
+        f.write('{"pairs": 7, "crc": null}')
+    with pytest.raises(durableio.CorruptPayloadError):
+        durableio.read_json_checked(note)
+    # an npz whose __crc__ member itself rotted to empty classifies too
+    rotted = str(tmp_path / "rotted.npz")
+    np.savez(rotted, a=np.arange(3), **{durableio.CRC_KEY: np.empty(0, np.uint32)})
+    with pytest.raises(durableio.CorruptPayloadError):
+        durableio.load_npz_checked(rotted)
+    # the in-band key is reserved — a colliding payload raises loudly
+    # instead of silently dropping the caller's value
+    with pytest.raises(ValueError, match="reserved"):
+        durableio.atomic_write_json(str(tmp_path / "x.json"), {"crc": 1, "a": 2})
+
+    # meta round-trip: the embedded crc never pins the meta match
+    store = str(tmp_path / "store")
+    meta = {"n": 3, "fingerprint": "abc"}
+    assert open_checkpoint_dir(store, meta, clear_suffixes=(".npz",)) is False
+    assert checkpoint_meta_matches(store, meta)
+    assert open_checkpoint_dir(store, meta, clear_suffixes=(".npz",)) is True
+    # a bit-rotted meta classifies as corrupt -> not resumable (reopen
+    # clears + rewrites instead of trusting rotted pins)
+    durableio._flip_bit(os.path.join(store, "meta.json"))
+    assert not checkpoint_meta_matches(store, meta)
+
+
+def test_durableio_knobs_fsync_and_configure(tmp_path, monkeypatch):
+    """The policy knobs: DREP_TPU_FSYNC routes publishes through the
+    fsync path (content identical), configure() overrides beat the env
+    (the CLI wiring), and a bare configure() resets to env resolution."""
+    from drep_tpu.utils import durableio
+
+    monkeypatch.setenv(durableio.FSYNC_ENV, "1")
+    assert durableio.fsync_enabled()
+    p = str(tmp_path / "row_00000.npz")
+    durableio.atomic_savez(p, a=np.arange(4))  # fsync'd publish
+    assert list(durableio.load_npz_checked(p)) == ["a"]
+    monkeypatch.delenv(durableio.FSYNC_ENV)
+    assert not durableio.fsync_enabled()
+
+    monkeypatch.setenv(durableio.IO_RETRIES_ENV, "7")
+    assert durableio.io_retries() == 7
+    durableio.configure(retries=1, fsync=True)  # the CLI's installer
+    try:
+        assert durableio.io_retries() == 1 and durableio.fsync_enabled()
+    finally:
+        durableio.configure()  # full reset: env resolution again
+    assert durableio.io_retries() == 7
+
+
+def test_corrupt_done_note_reads_as_absent(tmp_path):
+    """A half-written/rotted done-note must read as ABSENT (the peer's
+    heartbeat staleness then decides) — never crash the survivor."""
+    from drep_tpu.parallel.faulttol import HeartbeatManager
+
+    hb = HeartbeatManager(str(tmp_path), cadence=0.1, max_dead=1, pc=2, pid=0)
+    hb.start()
+    try:
+        with open(hb.done_path(1), "w") as f:
+            f.write('{"pairs": 5, "seq": 1, "crc": 99}')  # checksum mismatch
+        assert hb.read_done(1) is None
+        assert not hb.peer_finished(1)
+        with open(hb.done_path(1), "w") as f:
+            f.write('{"pairs": 5, "se')  # torn
+        assert hb.read_done(1) is None
+    finally:
+        hb.close()
+
+
+def test_scrub_store_detects_deletes_and_resume_heals(tmp_path):
+    """The standalone verifier: clean store -> exit 0; planted damage
+    (hand truncation) -> nonzero exit naming the shard; --delete removes
+    it; the next resume recomputes it bit-identically (the acceptance
+    loop: scrub-then-resume)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "scrub_store", os.path.join(REPO, "tools", "scrub_store.py")
+    )
+    ss = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ss)
+
+    packed = _packed(n=48)
+    ckpt = str(tmp_path / "ckpt")
+    r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    assert ss.main([ckpt]) == 0  # clean store: exit 0, CLI path exercised
+    rep = ss.scrub([ckpt])
+    assert rep["verified"] > 0 and not rep["damaged"]
+
+    shard = sorted(f for f in os.listdir(ckpt) if f.startswith("row_"))[1]
+    loc = os.path.join(ckpt, shard)
+    data = open(loc, "rb").read()
+    with open(loc, "wb") as f:
+        f.write(data[: len(data) // 2])
+    assert ss.main([ckpt]) == 1  # damage: nonzero exit
+    rep = ss.scrub([ckpt], delete=True)
+    assert [p for p, _ in rep["damaged"]] == [loc]
+    assert not os.path.exists(loc)
+
+    r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    _assert_edges_equal(r2, r1)
+    assert os.path.exists(loc), "resume did not heal the scrubbed shard"
+    assert ss.main([ckpt]) == 0
+
+
+def test_io_fault_spec_fields_and_path_targeting():
+    """The io site parses like every other site; op filtering (stale_read
+    fires on reads only, enospc on writes only) and the new path=
+    substring targeting are deterministic."""
+    import errno as _errno
+
+    faults.configure("io:stale_read:1.0")
+    faults.fire_io("write")  # read-only mode: no-op on writes
+    with pytest.raises(OSError) as ei:
+        faults.fire_io("read")
+    assert ei.value.errno == _errno.ESTALE
+
+    faults.configure("io:enospc:1.0")
+    faults.fire_io("read")  # write-only mode: no-op on reads
+    with pytest.raises(OSError) as ei:
+        faults.fire_io("write")
+    assert ei.value.errno == _errno.ENOSPC
+
+    faults.configure("io:corrupt:1.0:path=.e01")
+    assert not faults.corrupt_write(path="/store/row_00004.npz")
+    assert faults.corrupt_write(path="/store/row_00004.e01.npz")
+    faults.configure("io:io_error:1.0:proc=7")
+    faults.fire_io("write", path="/x")  # other process: no-op
+    assert counters.faults.get("injected_io_io_error", 0) == 0
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("io:not_a_mode")
+    with pytest.raises(faults.FaultSpecError):
+        faults.configure("io:corrupt:1.0:bogus=1")
+
+
+def test_missing_stages_refuses_healed_corruption():
+    """bench stamps io_retries/corrupt_shards_healed into every stage
+    record; a record with healed corruption is NOT measured perf (healing
+    implies recompute — same contract as degradation), while transient
+    io_retries alone stay measured."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "missing_stages", os.path.join(REPO, "tools", "missing_stages.py")
+    )
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+
+    link = {"h2d_gbps": 1.0, "d2h_gbps": 1.0}
+
+    def merged(rec):
+        return {
+            "stages": {"e2e_50k": rec},
+            "stage_provenance": {"e2e_50k": {"link": link}},
+        }
+
+    clean = {"pairs_per_sec_per_chip": 1.0}
+    assert "scale" not in ms.missing(merged(clean))
+    assert "scale" in ms.missing(merged({**clean, "corrupt_shards_healed": 1}))
+    assert "scale" in ms.missing(
+        merged({**clean, "fault_tolerance": {"corrupt_shards_healed": 2}})
+    )
+    # retried-but-clean I/O is still a measurement (retries cost ms, not
+    # recompute); a zero-valued heal stamp must not refuse either
+    assert "scale" not in ms.missing(merged({**clean, "io_retries": 3}))
+    assert "scale" not in ms.missing(
+        merged({**clean, "io_retries": 3, "corrupt_shards_healed": 0})
+    )
 
 
 def test_missing_stages_refuses_degraded_records():

@@ -120,6 +120,10 @@ def test_resume_warns_on_estimator_boundary(tmp_path, genome_paths):
     args["primary_estimator_resolved"] = (
         "matmul" if args["primary_estimator_resolved"] != "matmul" else "sort"
     )
+    # snapshots carry an in-band checksum (utils/durableio.py); a hand
+    # edit must drop the now-stale crc — a crc-less snapshot is
+    # legacy-accepted, a mismatched one is (correctly) treated as rot
+    args.pop("crc", None)
     with open(loc, "w") as f:
         json.dump(args, f)
     cdb = compare_wrapper(wd, genome_paths, skip_plots=True)

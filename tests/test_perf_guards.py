@@ -125,6 +125,52 @@ def test_streaming_fault_layer_zero_overhead_when_unset(rng, tmp_path):
     assert dt_auto < 20.0, f"auto-watchdog warm pass took {dt_auto:.1f}s — thread-spawn overhead?"
 
 
+def test_checksummed_store_overhead_within_5pct(rng, tmp_path, monkeypatch):
+    """The durable-I/O layer's checksum+atomic-write cost on the 528-tile
+    warm checkpointed pass must stay <= 5% of the same pass with checksums
+    disabled (DREP_TPU_IO_CRC=0, the escape-hatch baseline), with ZERO
+    fault events — integrity must be effectively free on the hot path.
+    Best-of-3 per variant, fresh store per rep (a resumed store would
+    measure nothing), small absolute floor so CI scheduler jitter cannot
+    flake while a real per-shard regression (hashing the pack per tile,
+    a sync fsync sneaking in) still fails decisively."""
+    from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.profiling import counters
+
+    n, s = 256, 64
+    ids = np.full((n, s), PAD_ID, np.int32)
+    cts = np.full(n, s, np.int32)
+    pools = [np.sort(rng.choice(2**20, size=s * 2, replace=False).astype(np.int32)) for _ in range(5)]
+    for i in range(n):
+        ids[i] = np.sort(rng.choice(pools[i % 5], size=s, replace=False))
+    packed = PackedSketches(ids=ids, counts=cts, names=[f"g{i}" for i in range(n)])
+
+    faults.configure(None)
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)  # warm the jits
+    before = dict(counters.faults)
+
+    def best_of(tag: str, reps: int = 3) -> float:
+        best = float("inf")
+        for r in range(reps):
+            ckpt = str(tmp_path / f"{tag}_{r}")
+            t0 = time.perf_counter()
+            streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    monkeypatch.setenv("DREP_TPU_IO_CRC", "0")
+    dt_off = best_of("nocrc")
+    monkeypatch.delenv("DREP_TPU_IO_CRC")
+    dt_on = best_of("crc")
+    assert counters.faults == before, "fault events recorded on a healthy run"
+    assert dt_on <= 1.05 * dt_off + 0.25, (
+        f"checksummed pass {dt_on:.3f}s vs checksum-free {dt_off:.3f}s — "
+        f"more than 5% durable-I/O overhead on the warm 528-tile pass"
+    )
+
+
 def test_stepwise_ring_overhead_within_10pct_of_monolithic(rng):
     """The host-stepped elastic ring (ISSUE 4) pays one python dispatch
     round per ring step instead of one per schedule — that overhead must

@@ -14,12 +14,19 @@ Two tiers:
   seconds each, CPU-only, no pod required.
 - pod cells (``--pod``): the multi-process kill/death cells (SIGKILL
   mid-streaming / mid-ring, pre-barrier death, dead-peer barrier
-  diagnosis, mid-secondary-batch retry) delegate to their pytest chaos
-  tests in tests/test_multihost.py — minutes, still CPU-only.
+  diagnosis, mid-secondary-batch retry, post-bump shard corruption)
+  delegate to their pytest chaos tests in tests/test_multihost.py —
+  minutes, still CPU-only.
+- storage cells (``--io``): the durable-I/O layer (ISSUE 5,
+  utils/durableio.py) — transient EIO retries, post-write bit rot healed
+  on resume, ENOSPC degrading into the actionable StoreFullError, and
+  the scrub-then-resume loop (tools/scrub_store.py detects, ``--delete``
+  quarantines, the next run recomputes) — seconds each, in-process.
 
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py          # in-process grid
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --io     # + storage cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod    # + pod cells
 """
 
@@ -173,6 +180,120 @@ def _expect_raise(exc_type, fn):
     raise AssertionError(f"expected {exc_type.__name__}, nothing raised")
 
 
+# --- storage cells (--io): the durable-I/O layer, ISSUE 5 -----------------
+
+
+def _streaming_ckpt(spec, td):
+    """Clean oracle vs (injected run -> clean resume) over a shard store;
+    both runs' edges must match the oracle bit-for-bit."""
+    import os as _os
+
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+
+    packed = _packed()
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    ckpt = _os.path.join(td, "ckpt")
+    faults.configure(spec)
+    try:
+        r1 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    finally:
+        faults.configure(None)
+    assert all(a.tobytes() == b.tobytes() for a, b in zip(r1[:3], want[:3]))
+    r2 = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+    assert all(a.tobytes() == b.tobytes() for a, b in zip(r2[:3], want[:3]))
+    return ckpt
+
+
+def _io_transient(spec):
+    import tempfile
+
+    from drep_tpu.utils.profiling import counters as _c
+
+    with tempfile.TemporaryDirectory() as td:
+        _streaming_ckpt(spec, td)
+        assert _c.faults.get("io_retries", 0) >= 1, _c.faults
+
+
+def _io_corrupt(spec):
+    import tempfile
+
+    from drep_tpu.utils.profiling import counters as _c
+
+    with tempfile.TemporaryDirectory() as td:
+        # run 1 publishes one bit-rotted shard; the resume must detect it
+        # via the in-band checksum, recompute it, and heal the store
+        _streaming_ckpt(spec, td)
+        assert _c.faults.get("corrupt_shards_healed", 0) >= 1, _c.faults
+
+
+def _io_enospc(spec):
+    import tempfile
+
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.durableio import StoreFullError
+
+    with tempfile.TemporaryDirectory() as td:
+        faults.configure(spec)
+        try:
+            streaming_mash_edges(
+                _packed(), k=21, cutoff=0.2, block=8,
+                checkpoint_dir=os.path.join(td, "ckpt"),
+            )
+        except StoreFullError as e:
+            assert "ENOSPC" in str(e) and td in str(e), e
+            return
+        finally:
+            faults.configure(None)
+    raise AssertionError("expected StoreFullError, nothing raised")
+
+
+def _scrub_then_resume():
+    import importlib.util
+    import tempfile
+
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+
+    spec = importlib.util.spec_from_file_location(
+        "scrub_store", os.path.join(REPO, "tools", "scrub_store.py")
+    )
+    ss = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ss)
+    with tempfile.TemporaryDirectory() as td:
+        packed = _packed()
+        ckpt = os.path.join(td, "ckpt")
+        want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+        assert not ss.scrub([ckpt])["damaged"], "clean store reported damaged"
+        shard = sorted(f for f in os.listdir(ckpt) if f.startswith("row_"))[1]
+        loc = os.path.join(ckpt, shard)
+        data = open(loc, "rb").read()
+        with open(loc, "wb") as f:
+            f.write(data[: len(data) // 2])
+        assert ss.scrub([ckpt])["damaged"], "scrub missed a truncated shard"
+        ss.scrub([ckpt], delete=True)
+        assert not os.path.exists(loc)
+        got = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt)
+        assert all(a.tobytes() == b.tobytes() for a, b in zip(got[:3], want[:3]))
+        assert os.path.exists(loc), "resume did not heal the deleted shard"
+
+
+# (site, mode, scenario, expected, runner) — appended under --io
+def _io_cells():
+    return [
+        ("io", "io_error", "transient EIO on shard write -> retries",
+         "survive", lambda: _io_transient("io:io_error:1.0:max=2")),
+        ("io", "stale_read", "transient ESTALE on read -> retries",
+         "survive", lambda: _io_transient("io:stale_read:1.0:max=1")),
+        ("io", "corrupt", "bit-rot after publish -> checksum heal on resume",
+         "survive", lambda: _io_corrupt("io:corrupt:1.0:max=1")),
+        ("io", "enospc", "filesystem full -> actionable StoreFullError",
+         "abort", lambda: _io_enospc("io:enospc:1.0")),
+        ("io", "scrub", "scrub detects damage; --delete + resume heals",
+         "survive", _scrub_then_resume),
+    ]
+
+
 # pod cells delegate to the pytest chaos tests (site x mode -> test id)
 POD_CELLS = [
     ("process_death", "kill", "SIGKILL mid-streaming -> epoch re-deal",
@@ -185,17 +306,23 @@ POD_CELLS = [
      "survive", "tests/test_multihost.py::test_secondary_batch_retries_locally_on_pod"),
     ("barrier", "death", "dead peer, NO heartbeats -> named diagnosis + abort",
      "abort", "tests/test_multihost.py::test_dead_peer_barrier_raises_actionable_timeout"),
+    ("io", "corrupt", "survivor shard bit-rotted after epoch bump -> peer heals",
+     "survive", "tests/test_multihost.py::test_elastic_pod_heals_corrupt_shard_after_epoch_bump"),
 ]
 
 
 def main() -> int:
     pod = "--pod" in sys.argv
+    io_cells = "--io" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
 
+    cells = _cells()
+    if io_cells:
+        cells += _io_cells()
     rows = []
     failures = 0
-    for site, mode, label, expected, run in _cells():
+    for site, mode, label, expected, run in cells:
         counters.reset()
         faulttol.reset_pod()
         try:

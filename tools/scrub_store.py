@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Checkpoint-store scrubber: walk a store, verify every payload, report
+(and optionally delete) damage. Exit status 1 when anything is damaged.
+
+The shard stores ARE the durable contract between pipeline stages (the
+rebuild's Mdb/Ndb/Cdb-equivalent), and they live on shared filesystems
+where bytes rot after the atomic rename. Every payload carries an in-band
+checksum (utils/durableio.py: a ``__crc__`` npz member, a ``"crc"`` JSON
+key); this tool is the offline verifier — run it against a workdir (or any
+single store) before trusting a resume, or from cron against a long-lived
+checkpoint tree::
+
+    python tools/scrub_store.py <wd>/data                 # report damage
+    python tools/scrub_store.py <wd>/data --delete        # + remove bad shards
+    python tools/scrub_store.py ckpt_dir another_dir ...  # multiple roots
+
+Verified payload families (everything else is left alone):
+
+- ``*.npz`` shards — streaming row stripes (``row_*.npz``), dense-ring
+  blocks (``blk_*.npz``), secondary per-cluster results (``pc_*.npz``),
+  ingest sketch shards, workdir arrays. Zero-byte, truncated, unparseable,
+  or checksum-mismatched shards are DAMAGE.
+- ``meta.json`` and the pod protocol's JSON notes (``.pod-done.*``,
+  ``.pod-dead.*``) — unparseable or checksum-mismatched is DAMAGE.
+
+Payloads written before checksums existed verify structurally (a full
+decode catches truncation) and are counted ``legacy`` — readable, but
+carrying no checksum to prove rot hasn't touched them.
+
+``--delete`` removes each damaged payload so the NEXT resume treats it as
+missing and recomputes it — the self-heal path the stores already
+implement (parallel/streaming.py, parallel/allpairs.py,
+cluster/secondary_ckpt.py); deleting a damaged ``meta.json`` invalidates
+the store wholesale (open clears + recomputes). CPU-only, no JAX backend
+required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from drep_tpu.utils import durableio  # noqa: E402
+
+
+def _is_json_note(name: str) -> bool:
+    # every checked-JSON family the pipeline publishes: store meta, the
+    # pod protocol's done/death notes, workdir argument snapshots, and
+    # ingest poison markers — all carry the in-band "crc" key
+    return (
+        name == "meta.json"
+        or name.startswith((".pod-done.", ".pod-dead.", "ingest_error_"))
+        or name.endswith("_arguments.json")
+    )
+
+
+def scrub(roots: list[str], delete: bool = False, out=sys.stdout) -> dict:
+    """Walk `roots`; returns {"verified": n, "legacy": n, "damaged": [...]}.
+    With `delete`, damaged payloads are removed (the next resume recomputes
+    them). Checksum verification is forced ON for the walk even when the
+    hot-path escape hatch (DREP_TPU_IO_CRC=0) is exported — a scrub that
+    silently skipped the compare while printing "checksum-verified" would
+    be worse than no scrub — and the caller's setting is restored after
+    (scrub() runs in-process from tools/chaos_matrix.py and tests)."""
+    saved_crc = os.environ.get(durableio.CRC_ENV)
+    os.environ[durableio.CRC_ENV] = "1"
+    try:
+        return _scrub(roots, delete=delete, out=out)
+    finally:
+        if saved_crc is None:
+            os.environ.pop(durableio.CRC_ENV, None)
+        else:
+            os.environ[durableio.CRC_ENV] = saved_crc
+
+
+def _scrub(roots: list[str], delete: bool, out) -> dict:
+    verified = legacy = 0
+    damaged: list[tuple[str, str]] = []
+    artifacts: list[str] = []
+
+    def check(path: str, name: str) -> None:
+        nonlocal verified, legacy
+        if ".tmp-" in name:
+            # an orphaned atomic-write tmp (SIGKILL mid-publish — the
+            # cleanup `finally` never ran): garbage no reader ever
+            # consults, NOT store damage. Reported separately and never
+            # affecting exit status — a crash artifact crying "DAMAGED"
+            # forever would train operators to ignore the scrubber.
+            artifacts.append(path)
+            return
+        try:
+            if name.endswith(".npz"):
+                if os.path.getsize(path) == 0:
+                    raise durableio.CorruptPayloadError("zero-byte shard")
+                # one read: the unverified decode still carries __crc__
+                # (classifies legacy payloads), then verify in place
+                loaded = durableio.read_npz_unverified(path, what="shard")
+                has_crc = durableio.CRC_KEY in loaded
+                durableio.verify_npz_payload(loaded, path, "shard")  # raises on damage
+            elif _is_json_note(name):
+                body = durableio.read_json_unverified(path, what="note")
+                has_crc = isinstance(body, dict) and durableio.JSON_CRC_KEY in body
+                durableio.verify_json_payload(body, path, "note")  # raises on damage
+            else:
+                return
+        except durableio.CorruptPayloadError as e:
+            damaged.append((path, str(e)))
+            return
+        except OSError as e:
+            damaged.append((path, f"unreadable: {e}"))
+            return
+        if has_crc:
+            verified += 1
+        else:
+            legacy += 1
+
+    for root in roots:
+        if os.path.isfile(root):
+            check(root, os.path.basename(root))
+            continue
+        for dirpath, _dirs, files in os.walk(root):
+            for name in sorted(files):
+                check(os.path.join(dirpath, name), name)
+
+    for path, reason in damaged:
+        action = ""
+        if delete:
+            try:
+                os.remove(path)
+                action = " [deleted — next resume recomputes it]"
+            except OSError as e:
+                action = f" [delete failed: {e}]"
+        print(f"DAMAGED  {path}: {reason}{action}", file=out)
+    for path in artifacts:
+        action = ""
+        if delete:
+            try:
+                os.remove(path)
+                action = " [deleted]"
+            except OSError as e:
+                action = f" [delete failed: {e}]"
+        print(f"ARTIFACT {path}: orphaned atomic-write tmp (crash leftover, "
+              f"never read by resume){action}", file=out)
+    print(
+        f"scrub: {verified} payload(s) checksum-verified, {legacy} legacy "
+        f"(readable, no in-band checksum), {len(damaged)} damaged"
+        + (" (deleted)" if delete and damaged else "")
+        + (f", {len(artifacts)} crash artifact(s)" if artifacts else ""),
+        file=out,
+    )
+    return {"verified": verified, "legacy": legacy, "damaged": damaged,
+            "artifacts": artifacts}
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("roots", nargs="+", help="store directories (or files) to scrub")
+    ap.add_argument(
+        "--delete", action="store_true",
+        help="remove damaged payloads so the next resume recomputes them",
+    )
+    args = ap.parse_args(argv)
+    report = scrub(args.roots, delete=args.delete)
+    return 1 if report["damaged"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
