@@ -1192,6 +1192,117 @@ def _clear_partial() -> None:
         pass
 
 
+# --- durable per-stage records (ROADMAP bench self-resilience, slice 1) ----
+# BENCH r03-r05 lost entire rounds to a single wedged stage because the only
+# record was the end-of-run JSON line. Now every stage record ALSO lands in
+# its own durable (atomic + checksummed, utils/durableio.py) file the moment
+# the stage completes, and the partial-merge runs automatically at exit —
+# a wedged stage costs one cell, not the round, and the merged artifact
+# never has to be hand-made again (BENCH_r04_merged.json was).
+
+STAGE_DIR = ".bench_stages"
+
+
+def _version() -> str | None:
+    try:
+        from drep_tpu import __version__
+
+        return __version__
+    except Exception:
+        return None
+
+
+_MERGE_TOOL = None
+
+
+def _merge_tool():
+    """tools/merge_bench_partials.py, loaded by path once (tools/ is not
+    a package) — its prefer_new() is THE record-preference rule, shared
+    so the per-stage store and the attempt-partial merge cannot drift."""
+    global _MERGE_TOOL
+    if _MERGE_TOOL is None:
+        import importlib.util
+
+        loc = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools", "merge_bench_partials.py"
+        )
+        spec = importlib.util.spec_from_file_location("merge_bench_partials", loc)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _MERGE_TOOL = mod
+    return _MERGE_TOOL
+
+
+def _persist_stages(stages: dict) -> None:
+    """Write each stage's current record to .bench_stages/<key>.json —
+    durable (atomic publish, in-band checksum) so an external SIGKILL
+    between stages can never take completed measurements with it. Records
+    from an OLDER code version are replaced unconditionally (new code =
+    new measurements); within a version the shared prefer_new rule keeps
+    the better record. Best-effort: persistence must never break a run."""
+    try:
+        from drep_tpu.utils.durableio import atomic_write_json, read_json_checked
+
+        os.makedirs(STAGE_DIR, exist_ok=True)
+        mbp = _merge_tool()
+        version = _version()
+        for key, rec in dict(stages).items():
+            loc = os.path.join(STAGE_DIR, f"{key}.json")
+            if os.path.exists(loc):
+                try:
+                    old = read_json_checked(loc, what="bench stage record")
+                    if old.get("version") == version:
+                        old_rec = old.get("record")
+                        if old_rec == rec:
+                            continue  # unchanged: no rewrite churn
+                        new_err = isinstance(rec, dict) and "error" in rec
+                        old_err = isinstance(old_rec, dict) and "error" in old_rec
+                        if new_err and not old_err:
+                            continue  # a failure never shadows a success
+                        if not mbp.prefer_new(old_rec, rec):
+                            continue
+                except Exception:
+                    pass  # unreadable old record: replace it
+            atomic_write_json(loc, {"stage": key, "version": version, "record": rec})
+    except Exception:
+        pass
+
+
+def _auto_merge() -> None:
+    """Union the durable per-stage records into BENCH_merged.json — run
+    at EVERY exit (normal completion AND the wedge bail), so the merged
+    artifact always reflects everything any attempt of this code version
+    measured. Best-effort."""
+    import glob as _glob
+
+    try:
+        from drep_tpu.utils.durableio import atomic_write, read_json_checked
+
+        stages: dict = {}
+        for f in sorted(_glob.glob(os.path.join(STAGE_DIR, "*.json"))):
+            try:
+                doc = read_json_checked(f, what="bench stage record")
+            except Exception:
+                continue  # rotted stage record: its stage re-measures
+            if doc.get("version") != _version():
+                continue  # stale round / older code: never merged forward
+            if doc.get("stage"):
+                stages[doc["stage"]] = doc.get("record")
+        if not stages:
+            return
+        merged = _merge_tool().merge([(1, {"drep_tpu_version": _version(), "stages": stages})])
+        merged["merged_from"] = ["durable stage records (.bench_stages/)"]
+
+        def write(tmp: str) -> None:
+            with open(tmp, "w") as f:
+                json.dump(merged, f, indent=1)
+                f.write("\n")
+
+        atomic_write("BENCH_merged.json", write)
+    except Exception:
+        pass
+
+
 def main() -> None:
     import os
     import sys
@@ -1244,7 +1355,10 @@ def main() -> None:
         want = []
     else:
         want = [s for s in args.stages.split(",") if s]
-    unknown = set(want) - set(default_order)
+    # "link" is accepted explicitly (not in the default plan order — it is
+    # auto-prepended): `--stages link` is the cheapest real-stage run, used
+    # by the durable-stage-record contract test
+    unknown = set(want) - set(default_order) - {"link"}
     if unknown:
         print(f"bench: unknown stages {sorted(unknown)}", file=sys.stderr)
         sys.exit(2)
@@ -1358,7 +1472,7 @@ def main() -> None:
     plan: list[tuple[str, float, object]] = []
     if want:
         plan.append(("link", 120, lambda: stages.__setitem__("link", link_health())))
-    plan.extend((label, *registry[label]) for label in want)
+    plan.extend((label, *registry[label]) for label in want if label != "link")
 
     for label, budget, thunk in plan:
         t0 = time.perf_counter()
@@ -1404,6 +1518,11 @@ def main() -> None:
             )
             print(f"bench: {label} WEDGED after {budget:.0f}s, bailing", file=sys.stderr, flush=True)
             _emit(snap)
+            # the wedge costs ONE cell: everything measured so far (plus
+            # the wedged stage's error record) lands durably and the
+            # merged artifact refreshes before the hard exit
+            _persist_stages(snap)
+            _auto_merge()
             _clear_partial()  # the emitted line carries everything
             os._exit(3)
         print(
@@ -1414,8 +1533,12 @@ def main() -> None:
         # incremental partial record: if the PROCESS is killed externally
         # (driver timeout — distinct from the wedge path above, which
         # emits), the completed measurements survive on disk for the next
-        # session instead of vanishing with stdout. Atomic replace so a
-        # kill mid-write can't destroy the previous stage's record.
+        # session instead of vanishing with stdout. Two layers: the
+        # durable per-stage store (atomic + checksummed, survives across
+        # attempts and auto-merges at exit) and the legacy whole-run
+        # partial below. Atomic replace so a kill mid-write can't destroy
+        # the previous stage's record.
+        _persist_stages(stages)
         tmp = f"BENCH_PARTIAL.json.tmp{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -1433,7 +1556,10 @@ def main() -> None:
     _emit(stages)
     # a COMPLETED run's results are in the emitted line (and the driver's
     # record); remove the partial so a later killed run can never be
-    # misattributed this run's stages
+    # misattributed this run's stages. The durable per-stage records stay
+    # (they are version-gated and feed the auto-merged artifact).
+    _persist_stages(stages)
+    _auto_merge()
     _clear_partial()
     if "primary" in want and "pairs_per_sec_per_chip" not in stages.get("primary", {}):
         # headline failed by exception (its stage entry is an {"error": ...}

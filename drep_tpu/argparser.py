@@ -169,6 +169,66 @@ def build_parser() -> argparse.ArgumentParser:
             sc.add_argument("-centW", "--centrality_weight", type=float, default=1.0)
             sc.add_argument("--extra_weight_table", default=None)
 
+    def add_index_io(p: argparse.ArgumentParser):
+        p.add_argument("index_directory", help="the long-lived genome index")
+        p.add_argument("-g", "--genomes", nargs="*", default=None, help="genome FASTA files")
+        p.add_argument("-p", "--processes", type=int, default=6)
+        p.add_argument("-d", "--debug", action="store_true")
+        p.add_argument("--io_retries", type=int, default=None,
+                       help="transient shared-filesystem I/O retry budget "
+                            "(utils/durableio.py; same knob as the pipeline)")
+        p.add_argument("--fsync", action="store_true",
+                       help="fsync every durable publish (DREP_TPU_FSYNC=1 equivalent)")
+
+    idx_p = sub.add_parser(
+        "index",
+        help="incremental service mode: a long-lived genome index with "
+             "build/update/classify entrypoints",
+    )
+    isub = idx_p.add_subparsers(dest="index_op", required=True)
+
+    b = isub.add_parser(
+        "build",
+        help="create generation 0: snapshot a completed run's workdir "
+             "(--work_directory) or bootstrap from FASTAs (-g)",
+    )
+    add_index_io(b)
+    b.add_argument("--work_directory", default=None,
+                   help="completed compare/dereplicate workdir to snapshot "
+                        "(sketches, edge graph, labels, winners); omit to "
+                        "bootstrap from -g FASTAs instead")
+    bp = b.add_argument_group("INDEX PARAMETERS (bootstrap build only; "
+                              "workdir builds pin the source run's)")
+    bp.add_argument("-pa", "--P_ani", type=float, default=None)
+    bp.add_argument("-sa", "--S_ani", type=float, default=None)
+    bp.add_argument("-nc", "--cov_thresh", type=float, default=None)
+    bp.add_argument("--clusterAlg", default=None, choices=["average", "single"])
+    bp.add_argument("-ms", "--MASH_sketch", type=int, default=None)
+    bp.add_argument("--scale", type=int, default=None)
+    bp.add_argument("-k", "--kmer_size", type=int, default=None)
+    bp.add_argument("--hash", default=None, choices=["splitmix64", "murmur3"])
+    bp.add_argument("--warn_dist", type=float, default=None)
+    bp.add_argument("-l", "--length", type=int, default=None,
+                    help="minimum genome length admitted (the filter stage's rule)")
+    bp.add_argument("--streaming_block", type=int, default=None)
+
+    u = isub.add_parser(
+        "update",
+        help="admit K new genomes: sketch K, compare K x N through the "
+             "streaming tile executor, re-cluster only touched clusters, "
+             "publish the next generation (crash-resumable; with no -g "
+             "this is a pure heal pass)",
+    )
+    add_index_io(u)
+
+    c = isub.add_parser(
+        "classify",
+        help="membership query: the cluster/winner each FASTA would join, "
+             "answered from the index alone (read-only, no re-sketching "
+             "of indexed genomes)",
+    )
+    add_index_io(c)
+
     cmp_p = sub.add_parser("compare", help="cluster genomes without dereplicating")
     add_common(cmp_p, with_filter=False, with_scoring=False)
 

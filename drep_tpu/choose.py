@@ -124,6 +124,25 @@ def pick_winners(sdb_full: pd.DataFrame) -> pd.DataFrame:
     )
 
 
+def score_and_pick(
+    cdb: pd.DataFrame,
+    stats: pd.DataFrame,
+    ndb: pd.DataFrame,
+    quality: pd.DataFrame | None = None,
+    extra_weights: pd.DataFrame | None = None,
+    **kwargs,
+) -> tuple[pd.DataFrame, pd.DataFrame]:
+    """(scored table, winners) — the choose stage's core, shared by the
+    batch pipeline (d_choose_wrapper) and the incremental genome index
+    (drep_tpu/index/update.py, which re-scores only touched clusters).
+    Scores are row-local (own stats + centrality to co-members), so
+    calling this on a subset of clusters yields exactly the rows a full
+    run would — the property the index's incremental==from-scratch
+    invariant leans on."""
+    sdb_full = score_genomes(cdb, stats, quality, ndb, extra_weights=extra_weights, **kwargs)
+    return sdb_full, pick_winners(sdb_full)
+
+
 def d_choose_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.DataFrame:
     """Score + pick winners; stores Sdb/Wdb; copies winners; returns Wdb."""
     logger = get_logger()
@@ -136,7 +155,7 @@ def d_choose_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.DataF
     if kwargs.get("extra_weight_table"):
         extra = pd.read_csv(kwargs["extra_weight_table"], sep=None, engine="python")
 
-    sdb_full = score_genomes(cdb, stats, quality, ndb, extra_weights=extra, **kwargs)
+    sdb_full, wdb = score_and_pick(cdb, stats, ndb, quality, extra_weights=extra, **kwargs)
     sdb = sdb_full[["genome", "score"]].copy()
     # the reference ABORTS dereplicate without quality info; we proceed with
     # the quality terms scoring 0 (documented delta) — but the Sdb must say
@@ -144,7 +163,6 @@ def d_choose_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.DataF
     sdb["quality_informed"] = quality is not None
     wd.store_db(schemas.validate(sdb, "Sdb"), "Sdb")
 
-    wdb = pick_winners(sdb_full)
     wd.store_db(schemas.validate(wdb, "Wdb"), "Wdb")
 
     out_dir = wd.get_loc("dereplicated_genomes")

@@ -322,6 +322,15 @@ def _secondary_for_cluster(
     return _secondary_postprocess(gs, indices, pc, kw, ani, cov)
 
 
+# the incremental genome index (drep_tpu/index/update.py) re-runs the
+# secondary stage for exactly the primary clusters its update touched —
+# through THIS implementation, so a re-scored cluster's (Ndb rows, labels)
+# are bit-identical to what a from-scratch run computes for the same
+# member set. `kw` needs S_algorithm/S_ani/cov_thresh/clusterAlg/
+# processes/mesh_shape (fill via CLUSTER_DEFAULTS).
+secondary_for_cluster = _secondary_for_cluster
+
+
 def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.DataFrame:
     """Run (or resume) the full clustering stage; returns Cdb."""
     logger = get_logger()

@@ -35,6 +35,9 @@ class Controller:
             retries=kwargs.get("io_retries"),
             fsync=bool(kwargs.get("fsync")) or None,
         )
+        if op == "index":
+            self.index_operation(**kwargs)
+            return
         wd_loc = kwargs.pop("work_directory")
         genomes = kwargs.pop("genomes", None)
         if op == "compare":
@@ -49,6 +52,34 @@ class Controller:
 
     def dereplicate_operation(self, wd_loc, genomes, **kwargs):
         return dereplicate_wrapper(wd_loc, genomes, **kwargs)
+
+    def index_operation(self, **kwargs):
+        """`index build|update|classify` — the incremental service mode
+        (drep_tpu/index). classify prints one JSON verdict line per query
+        to stdout (the machine-readable contract a service front-end
+        consumes); build/update log their summaries."""
+        from drep_tpu.workflows import (
+            index_build_wrapper,
+            index_classify_wrapper,
+            index_update_wrapper,
+        )
+
+        sub = kwargs.pop("index_op")
+        index_loc = kwargs.pop("index_directory")
+        genomes = kwargs.pop("genomes", None)
+        if sub == "build":
+            return index_build_wrapper(index_loc, genomes, **kwargs)
+        if sub == "update":
+            return index_update_wrapper(index_loc, genomes, **kwargs)
+        if sub == "classify":
+            import json
+            import sys
+
+            verdicts = index_classify_wrapper(index_loc, genomes, **kwargs)
+            for v in verdicts:
+                print(json.dumps(v), file=sys.stdout, flush=True)
+            return verdicts
+        raise ValueError(f"unknown index operation {sub!r}")
 
     def check_dependencies_operation(self) -> None:
         setup_logger(None)

@@ -1,0 +1,16 @@
+"""Incremental service mode: a long-lived genome index (ISSUE 6).
+
+`build` snapshots (or bootstraps) generation 0; `update` admits K new
+genomes per batch — K x N rectangular compare through the streaming tile
+executor, dirty-component re-clustering, touched-cluster re-scoring —
+and atomically publishes the next generation; `classify` answers
+membership queries from the store without mutating it. Pinned invariant:
+incremental result == from-scratch rerun on the union set (same Cdb
+labels up to renumbering, same winners), property-tested over randomized
+update schedules in tests/test_index.py.
+"""
+
+from drep_tpu.index.build import build_from_paths, build_from_workdir  # noqa: F401
+from drep_tpu.index.classify import index_classify  # noqa: F401
+from drep_tpu.index.store import IndexStore, LoadedIndex, load_index  # noqa: F401
+from drep_tpu.index.update import index_update  # noqa: F401

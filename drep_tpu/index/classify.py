@@ -1,0 +1,122 @@
+"""`index classify`: membership queries answered from the index alone.
+
+Read-only by contract: the queries are sketched in memory (the indexed
+genomes are NEVER re-sketched — their sketches load from the store), the
+K x N compare runs with no checkpoint store, the hypothetical admission
+(the same dirty-component recluster `index update` would run) happens
+entirely in memory, and nothing under the index directory is written —
+the manifest generation is unchanged, asserted in tests. Because the
+answer runs through the exact update machinery, a classify verdict IS
+the assignment the genome would receive from `index update` (and, by
+the pinned invariant, from a from-scratch rerun on the union).
+
+Queries ride under internal ``query:``-prefixed names, so classifying a
+FASTA whose basename is already indexed (e.g. re-checking an indexed
+genome's own file) is a normal lookup, not a collision.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.errors import UserInputError
+from drep_tpu.index.store import load_index
+from drep_tpu.index.update import _admit_batch, _rect_edges, recluster
+from drep_tpu.utils.logger import get_logger
+
+
+def index_classify(
+    index_loc: str, genome_paths: list[str], processes: int = 1
+) -> list[dict]:
+    """One verdict dict per query: the primary/secondary cluster it would
+    join, that cluster's winner (would the query itself win?), its nearest
+    indexed genome by Mash distance, and whether it is novel (a cluster of
+    its own). Queries are classified jointly when several are given — the
+    single-query call is the pure membership lookup."""
+    from drep_tpu.ingest import sketch_paths
+
+    idx = load_index(index_loc, heal=False)
+    p = idx.params
+    n_old = idx.n
+    basenames = [os.path.basename(g) for g in genome_paths]
+    if len(set(basenames)) != len(basenames):
+        raise UserInputError("duplicate genome basenames in the query list")
+    bdb = pd.DataFrame(
+        {
+            "genome": [f"query:{b}" for b in basenames],
+            "location": [os.path.abspath(g) for g in genome_paths],
+        }
+    )
+    results = sketch_paths(
+        bdb, int(p["kmer_size"]), int(p["sketch_size"]), int(p["scale"]),
+        p["hash"], processes=processes,
+    )
+    min_len = int(p.get("filter_length", 0))
+    admitted = bdb[
+        [results[g]["length"] >= min_len for g in bdb["genome"]]
+    ].reset_index(drop=True)
+
+    out: list[dict] = []
+    if len(admitted):
+        _admit_batch(idx, admitted, results, idx.generation + 1)
+        # in-memory rectangular compare: checkpoint_dir None => no writes
+        ii, jj, dd, _pairs = _rect_edges(idx, n_old, None)
+        idx.edges = (
+            np.concatenate([idx.edges[0], ii]),
+            np.concatenate([idx.edges[1], jj]),
+            np.concatenate([idx.edges[2], dd]),
+        )
+        recluster(idx, n_old, processes=processes)
+        winner_of = dict(zip(idx.winners["cluster"], idx.winners["genome"]))
+        sec_names = idx.secondary_names()
+        # vectorized membership lookups: the per-query scans below must
+        # not walk all N indexed genomes in interpreted Python on the
+        # serving path
+        prim_old = idx.primary[:n_old]
+        sec_old = np.array(sec_names[:n_old], dtype=object)
+
+        def display(name: str) -> str:
+            return name[len("query:"):] if name.startswith("query:") else name
+
+        for q in range(n_old, idx.n):
+            pc = int(idx.primary[q])
+            members = np.nonzero(prim_old == pc)[0].tolist()
+            sec = sec_names[q]
+            co = np.nonzero(sec_old == sec)[0].tolist()
+            # nearest INDEXED genome among the query's retained edges
+            touch = (jj == q) & (ii < n_old)
+            nearest_i = nearest_d = None
+            if touch.any():
+                k = int(np.argmin(dd[touch]))
+                nearest_i = int(ii[touch][k])
+                nearest_d = float(dd[touch][k])
+            winner = winner_of.get(sec)
+            out.append(
+                {
+                    "genome": display(idx.names[q]),
+                    "primary_cluster": pc,
+                    "secondary_cluster": sec,
+                    "novel_primary": not members,
+                    "novel_secondary": not co,
+                    "cluster_members": [idx.names[i] for i in co],
+                    "winner": display(winner) if winner is not None else None,
+                    "would_win": winner == idx.names[q],
+                    "score": float(idx.score[q]),
+                    "nearest": idx.names[nearest_i] if nearest_i is not None else None,
+                    "nearest_dist": nearest_d,
+                }
+            )
+    dropped = set(bdb["genome"]) - set(admitted["genome"])
+    for g in sorted(dropped):
+        get_logger().warning("classify: %s below the index's filter length %d", g, min_len)
+        out.append(
+            {
+                "genome": g[len("query:"):],
+                "filtered": True,
+                "reason": f"below the index's filter length {min_len}",
+            }
+        )
+    return out

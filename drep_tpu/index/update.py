@@ -1,0 +1,428 @@
+"""Incremental admission: K new genomes -> the next index generation.
+
+The pinned invariant (ISSUE 6, property-tested): after any sequence of
+``index update`` batches, the index's cluster labels (up to renumbering)
+and winner sets are IDENTICAL to a from-scratch ``dereplicate`` over the
+union set. The incremental algorithm earns that exactly, not
+approximately, because every quantity the pipeline computes decomposes:
+
+- **sketches** are per-genome (bottom-k / scaled of the genome's own
+  hashes) — a new genome's sketch is what a union rerun would ingest.
+- **Mash distances** are pair-local (the union-bottom-s estimator reads
+  only the two rows), so the union's retained edge graph = stored edges
+  + the K x N rectangular compare's new edges (computed through the SAME
+  streaming tile executor, parallel/streaming.py ``min_col``).
+- **primary clustering** (sparse UPGMA / connected components) never
+  merges across connected components of the retained graph (a pair with
+  no retained edge has average-bound keep > cutoff), so only components
+  touched by a new genome ("dirty") can change — clean components keep
+  their partition verbatim, dirty ones re-cluster through the same
+  ops/linkage code the streaming primary runs.
+- **secondary clustering + scoring** depend only on a primary cluster's
+  member set (cluster-local ANI; row-local scores; centrality only to
+  co-members) — recomputed through cluster/controller.py's
+  ``secondary_for_cluster`` and choose.py's ``score_and_pick`` for
+  exactly the clusters whose member set changed, reused verbatim
+  (member-set-keyed) for the rest.
+
+Crash story: the rectangular compare checkpoints per-stripe shards under
+``<index>/pending/`` (the streaming store format), all new shards are
+written under deterministic generation-stamped names, and the mutation
+becomes visible only at the atomic manifest publish — a SIGKILL anywhere
+(the ``index_update`` fault site makes the worst points deterministic)
+leaves the previous generation intact and the rerun converges on the
+uninterrupted result (chaos-tested via tools/chaos_matrix.py --index).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.errors import UserInputError
+from drep_tpu.index.store import IndexStore, LoadedIndex, build_manifest, load_index
+from drep_tpu.utils.logger import get_logger
+
+_STAT_COLS = ("length", "N50", "contigs", "n_kmers")
+
+
+def _genome_sketches(idx: LoadedIndex):
+    """The union set as the GenomeSketches the secondary engines consume."""
+    from drep_tpu.ingest import GenomeSketches
+
+    p = idx.params
+    return GenomeSketches(
+        names=idx.names, gdb=idx.gdb, bottom=idx.bottom, scaled=idx.scaled,
+        k=int(p["kmer_size"]), sketch_size=int(p["sketch_size"]),
+        scale=int(p["scale"]),
+    )
+
+
+def _retention(params: dict) -> tuple[float, float]:
+    from drep_tpu.parallel.streaming import retention_bound
+
+    cutoff = 1.0 - float(params["P_ani"])
+    return cutoff, retention_bound(
+        cutoff, float(params["warn_dist"]), params["clusterAlg"]
+    )
+
+
+def _rect_edges(
+    idx: LoadedIndex, n_old: int, checkpoint_dir: str | None
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """New retained edges (jj >= n_old) of the union set, through the
+    streaming tile executor's rectangular schedule."""
+    from drep_tpu.ops.minhash import pack_sketches
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+
+    p = idx.params
+    _, keep = _retention(p)
+    packed = pack_sketches(idx.bottom, idx.names, int(p["sketch_size"]))
+    ii, jj, dd, pairs = streaming_mash_edges(
+        packed, int(p["kmer_size"]), keep,
+        block=int(p["streaming_block"]),
+        checkpoint_dir=checkpoint_dir, min_col=n_old,
+    )
+    sel = jj >= n_old  # boundary tiles emit a few old-old pairs: already stored
+    return ii[sel], jj[sel], dd[sel], pairs
+
+
+def _primary_partition(idx: LoadedIndex, n_old: int) -> tuple[np.ndarray, list[list[int]], int]:
+    """The union primary partition, re-clustering ONLY dirty components.
+
+    Returns (labels 1..C renumbered by first appearance — exactly the
+    from-scratch numbering, the member lists per label, and the number of
+    components actually re-clustered)."""
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components as _cc
+
+    n = idx.n
+    ii, jj, dd = idx.edges
+    cutoff, keep = _retention(idx.params)
+    graph = coo_matrix((np.ones(len(ii), np.int8), (ii, jj)), shape=(n, n))
+    _, comp = _cc(graph, directed=False)
+    dirty = np.zeros(int(comp.max()) + 1 if n else 0, dtype=bool)
+    if n_old < n:
+        dirty[np.unique(comp[n_old:])] = True
+    if idx.state_missing:
+        dirty[:] = True  # rotted state: every component re-clusters
+
+    group_of = np.full(n, -1, np.int64)
+    next_key = 0
+    # clean components: the stored partition restricted to them is the
+    # union answer verbatim — group by the OLD primary label
+    clean_nodes = np.nonzero(~dirty[comp])[0] if n else np.empty(0, np.int64)
+    if len(clean_nodes):
+        old_labels = idx.primary[clean_nodes]
+        uniq = np.unique(old_labels)
+        remap = {int(l): next_key + i for i, l in enumerate(uniq)}
+        group_of[clean_nodes] = [remap[int(l)] for l in old_labels]
+        next_key += len(uniq)
+
+    reclustered = 0
+    edge_comp = comp[ii] if len(ii) else np.empty(0, comp.dtype)
+    for c in np.nonzero(dirty)[0]:
+        members = np.nonzero(comp == c)[0]
+        reclustered += 1
+        if len(members) == 1:
+            group_of[members[0]] = next_key
+            next_key += 1
+            continue
+        local = np.full(n, -1, np.int64)
+        local[members] = np.arange(len(members))
+        sel = edge_comp == c
+        li, lj, ld = local[ii[sel]], local[jj[sel]], dd[sel]
+        if idx.params["clusterAlg"] == "single":
+            from drep_tpu.parallel.streaming import connected_components
+
+            inc = ld <= cutoff
+            sub = connected_components(len(members), li[inc], lj[inc])
+        else:
+            from drep_tpu.ops.linkage import sparse_average_linkage
+
+            sub, approx = sparse_average_linkage(
+                len(members), li, lj, ld, cutoff, keep
+            )
+            if approx:
+                get_logger().warning(
+                    "index update: %d accepted merges in a re-clustered "
+                    "component involved pairs beyond the %.3f retention "
+                    "bound — same caveat as the streaming primary",
+                    approx, keep,
+                )
+        group_of[members] = next_key + sub - 1  # sub is 1-based
+        next_key += int(sub.max())
+
+    # renumber by first appearance in genome order — the from-scratch rule
+    labels = np.zeros(n, np.int64)
+    members_of: dict[int, list[int]] = {}
+    order: list[int] = []
+    for i in range(n):
+        g = int(group_of[i])
+        if g not in members_of:
+            members_of[g] = []
+            order.append(g)
+        members_of[g].append(i)
+    groups: list[list[int]] = []
+    for new_id, g in enumerate(order, start=1):
+        labels[members_of[g]] = new_id
+        groups.append(members_of[g])
+    return labels, groups, reclustered
+
+
+def _score_cluster(
+    idx: LoadedIndex, members: list[int], sec_names: list[str], ndb: pd.DataFrame
+) -> np.ndarray:
+    """Choose-stage scores for one primary cluster's members — through the
+    same score_and_pick core the batch pipeline runs (row-local, so the
+    subset call equals the full run's rows)."""
+    from drep_tpu.choose import score_and_pick
+
+    names = [idx.names[i] for i in members]
+    cdb_sub = pd.DataFrame({"genome": names, "secondary_cluster": sec_names})
+    stats_sub = idx.gdb.iloc[members][["genome", "length", "N50"]]
+    w = idx.params["weights"]
+    sdb_full, _ = score_and_pick(
+        cdb_sub, stats_sub, ndb, None, S_ani=idx.params["S_ani"], **w
+    )
+    by = sdb_full.set_index("genome")["score"]
+    return np.array([float(by[g]) for g in names], np.float64)
+
+
+def recluster(idx: LoadedIndex, n_old: int, processes: int = 1) -> dict:
+    """Recompute the index's derived state after `idx` gained genomes
+    beyond `n_old` (sketches + edges already extended in memory). Mutates
+    idx.primary/suffix/score/winners; returns an honest summary."""
+    from drep_tpu.cluster.controller import secondary_for_cluster
+
+    t0 = time.perf_counter()
+    old_primary = idx.primary
+    old_suffix = idx.suffix
+    old_score = idx.score
+    # member-set-keyed reuse: any union primary cluster whose member set
+    # equals an old one has IDENTICAL secondary results and scores (they
+    # depend only on the members) — old indices are stable, so frozensets
+    # compare directly
+    old_groups: dict[frozenset, bool] = {}
+    if n_old and not idx.state_missing:
+        by_label: dict[int, list[int]] = {}
+        for i in range(n_old):
+            by_label.setdefault(int(old_primary[i]), []).append(i)
+        old_groups = {frozenset(v): True for v in by_label.values()}
+
+    labels, groups, reclustered_comps = _primary_partition(idx, n_old)
+    n = idx.n
+    suffix = np.zeros(n, np.int64)
+    score = np.zeros(n, np.float64)
+    gs = _genome_sketches(idx)
+    bdb = pd.DataFrame({"genome": idx.names, "location": idx.locations})
+    kw = {
+        "S_algorithm": idx.params["S_algorithm"],
+        "S_ani": idx.params["S_ani"],
+        "cov_thresh": idx.params["cov_thresh"],
+        "clusterAlg": idx.params["clusterAlg"],
+        "processes": processes,
+        "mesh_shape": None,
+    }
+    reused = recomputed = 0
+    for pc, members in enumerate(groups, start=1):
+        fs = frozenset(members)
+        if fs in old_groups:
+            suffix[members] = old_suffix[members]
+            score[members] = old_score[members]
+            reused += 1
+            continue
+        recomputed += 1
+        if len(members) == 1:
+            i = members[0]
+            suffix[i] = 1  # the pipeline's singleton convention ("pc_1")
+            score[i] = _score_cluster(
+                idx, members, [f"{pc}_1"], pd.DataFrame({"querry": [], "reference": [], "ani": []})
+            )[0]
+            continue
+        ndb, labs, _link = secondary_for_cluster(gs, bdb, list(members), pc, kw)
+        suffix[members] = labs
+        sec_names = [f"{pc}_{int(l)}" for l in labs]
+        score[members] = _score_cluster(idx, list(members), sec_names, ndb)
+
+    idx.primary = labels
+    idx.suffix = suffix
+    idx.score = score
+    # winners: one deterministic global pass over (cluster, score, name) —
+    # the same argmax/tie rule as choose.pick_winners
+    from drep_tpu.choose import pick_winners
+
+    sdb_like = pd.DataFrame(
+        {
+            "genome": idx.names,
+            "secondary_cluster": idx.secondary_names(),
+            "score": score,
+        }
+    )
+    idx.winners = pick_winners(sdb_like)[["cluster", "genome", "score"]]
+    return {
+        "primary_clusters": int(labels.max()) if n else 0,
+        "secondary_clusters": int(sdb_like["secondary_cluster"].nunique()),
+        "components_reclustered": reclustered_comps,
+        "clusters_reused": reused,
+        "clusters_recomputed": recomputed,
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
+def _admit_batch(
+    idx: LoadedIndex, batch: pd.DataFrame, results: dict[str, dict], gen_new: int
+) -> int:
+    """Extend idx in memory with the sketched batch; returns n_old."""
+    n_old = idx.n
+    names_new = list(batch["genome"])
+    idx.names.extend(names_new)
+    idx.locations.extend(batch["location"])
+    rows = pd.DataFrame(
+        {
+            "genome": names_new,
+            **{c: [results[g][c] for g in names_new] for c in _STAT_COLS},
+        }
+    )
+    idx.gdb = pd.concat([idx.gdb, rows], ignore_index=True)
+    idx.admitted = np.concatenate(
+        [idx.admitted, np.full(len(names_new), gen_new, np.int64)]
+    )
+    idx.bottom.extend(results[g]["bottom"] for g in names_new)
+    idx.scaled.extend(results[g]["scaled"] for g in names_new)
+    return n_old
+
+
+def sketch_batch(idx: LoadedIndex, genome_paths: list[str], processes: int = 1):
+    """make_bdb + duplicate check + length filter + sketch — the index's
+    ingest front door, shared by update and classify."""
+    from drep_tpu.ingest import make_bdb, sketch_paths
+
+    bdb = make_bdb(genome_paths)
+    dup = sorted(set(bdb["genome"]) & set(idx.names))
+    if dup:
+        raise UserInputError(
+            f"{len(dup)} genome basename(s) already indexed: {dup[:5]} — "
+            f"the index keys genomes by basename; rename the files or "
+            f"rebuild if they are replacements"
+        )
+    p = idx.params
+    results = sketch_paths(
+        bdb, int(p["kmer_size"]), int(p["sketch_size"]), int(p["scale"]),
+        p["hash"], processes=processes,
+    )
+    min_len = int(p.get("filter_length", 0))
+    dropped = [g for g in bdb["genome"] if results[g]["length"] < min_len]
+    if dropped:
+        get_logger().warning(
+            "index: %d genome(s) below the index's filter length %d — "
+            "not admitted (same rule the batch pipeline's filter stage "
+            "applies): %s", len(dropped), min_len, dropped[:5],
+        )
+        bdb = bdb[~bdb["genome"].isin(dropped)].reset_index(drop=True)
+    return bdb, results
+
+
+def publish_generation(
+    store: IndexStore,
+    idx: LoadedIndex,
+    gen_new: int,
+    n_old: int,
+    new_edges: tuple[np.ndarray, np.ndarray, np.ndarray],
+) -> None:
+    """Persist one admitted batch as generation `gen_new`: shards first
+    (deterministic names + content — a rerun after a kill rewrites them
+    identically), the manifest last (THE commit point), cleanup after.
+    Shared by `index update` and the fresh `index build` (whose batch is
+    the whole initial set at generation 0)."""
+    from drep_tpu.utils import faults
+
+    store.ensure_dirs()
+    sk_rel = store.sketch_shard_name(gen_new)
+    ed_rel = store.edge_shard_name(gen_new)
+    st_rel = store.state_name(gen_new)
+    store.write_sketch_shard(
+        sk_rel, idx.names[n_old:], idx.locations[n_old:], idx.gdb.iloc[n_old:],
+        idx.bottom[n_old:], idx.scaled[n_old:], gen_new,
+    )
+    ii, jj, dd = new_edges
+    store.write_edge_shard(ed_rel, ii, jj, dd)
+    store.write_state(st_rel, idx)
+    idx.generation = gen_new
+    idx.sketch_shards = idx.sketch_shards + [
+        {"file": sk_rel, "lo": n_old, "hi": idx.n, "generation": gen_new}
+    ]
+    idx.edge_shards = idx.edge_shards + [
+        {"file": ed_rel, "lo": n_old, "hi": idx.n, "generation": gen_new}
+    ]
+    faults.fire("index_update")  # pre-publish point (skip=1 targets it)
+    store.publish_manifest(build_manifest(idx, st_rel))
+    store.gc_states(st_rel)
+
+
+def index_update(
+    index_loc: str, genome_paths: list[str] | None, processes: int = 1
+) -> dict:
+    """`index update`: admit K new genomes (sketch K, compare K x N,
+    re-cluster dirty components, re-score touched clusters) and publish
+    the next generation. With no genomes this is a pure HEAL pass:
+    corrupt/missing shards repair and the generation stays put."""
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.profiling import counters
+
+    logger = get_logger()
+    store = IndexStore(index_loc)
+    idx = load_index(index_loc, heal=True)
+    faults.fire("index_update")  # batch admission point (chaos)
+    gen_new = idx.generation + 1
+
+    batch = results = None
+    if genome_paths:
+        batch, results = sketch_batch(idx, genome_paths, processes=processes)
+    if batch is None or not len(batch):
+        # heal-only pass: rotted state recomputes (all components dirty),
+        # healed shards were already rewritten by load_index — the
+        # generation does NOT bump (nothing was admitted)
+        summary = {"admitted": 0, "generation": idx.generation, "healed": idx.healed}
+        if idx.state_missing:
+            summary.update(recluster(idx, idx.n, processes=processes))
+            store.write_state(store.state_name(idx.generation), idx)
+            logger.warning("index: state payload healed via full recompute")
+        if idx.healed:
+            logger.info("index heal pass: repaired %s", idx.healed)
+        return summary
+
+    n_old = _admit_batch(idx, batch, results, gen_new)
+    with counters.stage("index_rect_compare"):
+        ii, jj, dd, pairs = _rect_edges(idx, n_old, store.pending_dir(gen_new))
+    counters.stages["index_rect_compare"].pairs += pairs
+    order = np.lexsort((jj, ii))
+    ii, jj, dd = ii[order], jj[order], dd[order]
+    idx.edges = (
+        np.concatenate([idx.edges[0], ii]),
+        np.concatenate([idx.edges[1], jj]),
+        np.concatenate([idx.edges[2], dd]),
+    )
+    summary = recluster(idx, n_old, processes=processes)
+
+    publish_generation(store, idx, gen_new, n_old, (ii, jj, dd))
+    summary.update(
+        {
+            "admitted": idx.n - n_old,
+            "n_genomes": idx.n,
+            "generation": gen_new,
+            "new_edges": int(len(ii)),
+            "pairs_compared": int(pairs),
+            "healed": idx.healed,
+        }
+    )
+    logger.info(
+        "index update: +%d genomes -> generation %d (%d genomes, %d primary / "
+        "%d secondary clusters; %d cluster(s) recomputed, %d reused)",
+        summary["admitted"], gen_new, idx.n, summary["primary_clusters"],
+        summary["secondary_clusters"], summary["clusters_recomputed"],
+        summary["clusters_reused"],
+    )
+    return summary

@@ -168,6 +168,52 @@ def _unpack_ragged(flat: np.ndarray, offs: np.ndarray, n: int) -> list[np.ndarra
     return [flat[offs[i] : offs[i + 1]] for i in range(n)]
 
 
+# the genome-index store (drep_tpu/index/store.py) serializes its sketch
+# shards in THE SAME ragged layout as the workdir cache and the ingest
+# shard store — public aliases so it cannot drift off the recipe
+pack_ragged = _pack_ragged
+unpack_ragged = _unpack_ragged
+
+
+def sketch_paths(
+    bdb: pd.DataFrame,
+    k: int,
+    sketch_size: int,
+    scale: int,
+    hash_name: str,
+    processes: int = 1,
+) -> dict[str, dict]:
+    """Sketch a Bdb's genomes with NO workdir/cache/shard machinery —
+    the incremental index's ingest path (drep_tpu/index/update.py), where
+    durability lives in the index store itself, not in a workdir. Returns
+    {name: {length, N50, contigs, n_kmers, bottom, scaled}} using the
+    exact per-genome kernel (sketch_worker.sketch_one) the pipeline runs,
+    so an index update's sketches are bit-identical to what a from-scratch
+    rerun would ingest. Raises UserInputError on unparseable inputs."""
+    jobs = [
+        (row.genome, row.location, k, sketch_size, scale, hash_name)
+        for row in bdb.itertuples()
+    ]
+    results: dict[str, dict] = {}
+    if processes > 1 and len(jobs) > 1:
+        ctx = multiprocessing.get_context("spawn")  # same rationale as sketch_genomes
+        with ProcessPoolExecutor(max_workers=processes, mp_context=ctx) as pool:
+            for name, res in pool.map(_sketch_one, jobs):
+                results[name] = res
+    else:
+        for job in jobs:
+            name, res = _sketch_one(job)
+            results[name] = res
+    bad = sorted(g for g, r in results.items() if r["n_kmers"] == 0)
+    if bad:
+        shown = ", ".join(bad[:10]) + (" ..." if len(bad) > 10 else "")
+        raise UserInputError(
+            f"no FASTA records with valid nucleotide {k}-mers in {len(bad)} "
+            f"input file(s) (not FASTA, empty, or shorter than k): {shown}"
+        )
+    return results
+
+
 def _save_sketch_shard(path: str, batch: dict[str, dict]) -> None:
     from drep_tpu.utils.ckptmeta import atomic_savez
 

@@ -311,6 +311,21 @@ def warmup_streaming_compile(
     jax.block_until_ready(out)
 
 
+def retention_bound(cutoff: float, keep_dist: float, cluster_alg: str) -> float:
+    """THE edge-retention bound shared by the streaming primary and the
+    incremental genome index (drep_tpu/index): edges survive up to
+    max(cutoff, keep_dist), widened for average linkage when the band
+    would degenerate to the cutoff (sparse UPGMA's discriminating
+    information IS the beyond-cutoff band — see
+    streaming_primary_clusters). One rule, so an index built today and a
+    from-scratch streaming rerun tomorrow retain the identical edge set.
+    """
+    keep = max(cutoff, keep_dist)
+    if cluster_alg == "average" and keep <= cutoff:
+        keep = min(1.0, 2.5 * cutoff)
+    return keep
+
+
 def streaming_mash_edges(
     packed: PackedSketches,
     k: int,
@@ -319,8 +334,19 @@ def streaming_mash_edges(
     checkpoint_dir: str | None = None,
     use_pallas: bool | None = None,
     ft_config=None,
+    min_col: int = 0,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """All unordered pairs (i < j) with Mash distance <= cutoff.
+
+    `min_col` restricts the tile walk to column blocks containing indices
+    >= min_col — the RECTANGULAR schedule the incremental genome index
+    uses for "K new genomes vs N indexed" compares: with the new genomes
+    appended at the tail, only tiles whose column block reaches the tail
+    are dispatched (every row stripe still runs, so old-row x new-col
+    pairs are covered), turning the O(N^2) triangle into O(K*N) work.
+    Tiles at the boundary block still emit a few old-old pairs; callers
+    filter on jj >= their true first-new index. Per-pair results are
+    identical to the full triangle's (the estimator is pair-local).
 
     Returns (ii, jj, dist, pairs_computed) — `pairs_computed` counts pair
     comparisons actually executed this call (resumed shards contribute 0),
@@ -372,6 +398,10 @@ def streaming_mash_edges(
     ids, counts = pad_packed_rows(packed.ids, packed.counts, block)
     nt = ids.shape[0]
     n_blocks = nt // block
+    # rectangular schedule: first column block the walk may touch (0 =
+    # the classic upper triangle). Computed AFTER the effective block so
+    # callers think in genome indices, not tile units.
+    first_col_block = max(0, min(int(min_col), max(n - 1, 0))) // block
     width = ids.shape[1]  # the estimator's `s` (pre-pow2-pad sketch width)
     if use_pallas:
         from drep_tpu.ops.pallas_mash import rows_per_iter
@@ -451,6 +481,12 @@ def streaming_mash_edges(
             # at identical N (the int32 ids are a run-specific vocab remap)
             "fingerprint": content_fingerprint(packed.names, packed.counts, packed.ids),
         }
+        if first_col_block:
+            # rectangular walks pin their column restriction — shards from
+            # a full-triangle pass must not resume a rect one (or vice
+            # versa); the key is omitted at 0 so pre-rect stores stay
+            # resumable unchanged
+            meta["min_col_block"] = first_col_block
         # leader-only clear + barrier on >1 process lives inside
         # open_checkpoint_dir (shared with the secondary shard store).
         # Because the heartbeat manager above started BEFORE this open,
@@ -511,7 +547,7 @@ def streaming_mash_edges(
         # points below (the dense [block, block] readback measured as the
         # composite bottleneck on slow d2h links)
         tiles = []
-        for bj in range(bi, n_blocks):
+        for bj in range(max(bi, first_col_block), n_blocks):
             j0 = bj * block
             diag = j0 == i0
 
@@ -1014,14 +1050,14 @@ def streaming_primary_clusters(
             f"to use the dense path)"
         )
     cutoff = 1.0 - p_ani
-    keep = max(cutoff, keep_dist)
-    if cluster_alg == "average" and keep <= cutoff:
+    keep = retention_bound(cutoff, keep_dist, cluster_alg)
+    if keep > max(cutoff, keep_dist):
         # UPGMA's discriminating information IS the retention band beyond
         # the cutoff: with keep == cutoff every candidate's bound is
         # <= cutoff and the partition silently degenerates to connected
         # components (exactly the single-linkage over-merge this linkage
-        # exists to prevent). Widen to the default warn_dist ratio.
-        keep = min(1.0, 2.5 * cutoff)
+        # exists to prevent). retention_bound widened it (shared rule with
+        # the incremental index) — warn so the operator knows why.
         get_logger().warning(
             "streaming average linkage needs edge retention beyond the "
             "%.3f cutoff to discriminate merges (--warn_dist was <= the "

@@ -68,6 +68,9 @@ SITES = (
     "barrier",  # checkpoint-dir open barrier, utils/ckptmeta.py
     "process_death",  # per-stripe suicide point, parallel/streaming.py (kill)
     "io",  # durable read/write paths, utils/durableio.py (io modes below)
+    "index_update",  # per-update-batch points, drep_tpu/index/update.py
+    # (fires at batch admission AND again just before the manifest
+    # publish — skip=1 targets the pre-publish point deterministically)
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):
@@ -157,6 +160,15 @@ def _parse(spec: str) -> dict[str, list[_Rule]]:
                 f"mode {mode!r} has no 'io' site semantics — use "
                 f"shard_write:torn for torn publishes, or "
                 f"process_death/ring_step:kill for deaths"
+            )
+        if mode == "torn" and site != "shard_write":
+            # tearing is an action the WRITER polls (torn_write), and only
+            # the shard_write site is ever polled — a spec like
+            # index_update:torn would parse, then silently inject nothing
+            # while the chaos run claims coverage
+            raise FaultSpecError(
+                f"mode 'torn' is shard_write-only (got site {site!r}); "
+                f"only the atomic shard publish polls torn_write()"
             )
         rule = _Rule(site=site, mode=mode)
         for f in fields[2:]:

@@ -86,6 +86,79 @@ def compare_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> 
     return cdb
 
 
+def _init_index(index_loc: str, write_logs: bool = True) -> None:
+    """Service-mode session setup: logging under the index's own log dir,
+    persistent compile cache, fresh counters — the index equivalents of
+    `_init`, minus workdir/Bdb machinery (the store IS the state).
+    `write_logs=False` (classify) keeps logging console-only: classify is
+    read-only by contract, and even a log line under the index dir would
+    violate the nothing-written assertion its tests pin."""
+    import os
+
+    from drep_tpu.utils.xla_cache import enable_persistent_cache
+    from drep_tpu.utils.profiling import counters
+
+    enable_persistent_cache()
+    log_dir = None
+    if write_logs:
+        log_dir = os.path.join(os.path.abspath(index_loc), "log")
+        os.makedirs(log_dir, exist_ok=True)
+    setup_logger(log_dir)
+    counters.reset()
+
+
+def index_build_wrapper(
+    index_loc: str, genomes: list[str] | None = None,
+    work_directory: str | None = None, **kwargs,
+) -> dict:
+    """`index build`: generation 0 from a completed workdir snapshot
+    (--work_directory) or bootstrapped from FASTAs (-g)."""
+    from drep_tpu.index import build_from_paths, build_from_workdir
+
+    _init_index(index_loc)
+    if work_directory and genomes:
+        raise UserInputError(
+            "index build takes --work_directory OR -g genomes, not both"
+        )
+    if work_directory:
+        return build_from_workdir(index_loc, work_directory)
+    if genomes:
+        return build_from_paths(
+            index_loc, genomes,
+            processes=kwargs.pop("processes", 1) or 1, **kwargs,
+        )
+    raise UserInputError(
+        "index build needs a source: --work_directory <completed run> or "
+        "-g <genome FASTAs>"
+    )
+
+
+def index_update_wrapper(
+    index_loc: str, genomes: list[str] | None = None, **kwargs
+) -> dict:
+    """`index update`: admit a batch (or heal, with no genomes)."""
+    from drep_tpu.index import index_update
+
+    _init_index(index_loc)
+    return index_update(
+        index_loc, genomes, processes=kwargs.get("processes", 1) or 1
+    )
+
+
+def index_classify_wrapper(
+    index_loc: str, genomes: list[str] | None = None, **kwargs
+) -> list[dict]:
+    """`index classify`: read-only membership verdicts."""
+    from drep_tpu.index import index_classify
+
+    if not genomes:
+        raise UserInputError("index classify needs -g <genome FASTAs>")
+    _init_index(index_loc, write_logs=False)
+    return index_classify(
+        index_loc, genomes, processes=kwargs.get("processes", 1) or 1
+    )
+
+
 def dereplicate_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> pd.DataFrame:
     """`dereplicate`: filter + cluster + choose + evaluate + analyze.
     Returns Wdb (the winners)."""

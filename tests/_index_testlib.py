@@ -1,0 +1,100 @@
+"""Shared helpers for the genome-index tests — NOT collected by pytest.
+
+write_genome_set plants small FASTA genomes with controlled group
+structure: members of a group are ~1% point-mutated copies of a common
+base sequence (well inside the default P_ani=0.9 / S_ani=0.95 gates),
+different groups are unrelated random sequences. Deterministic per seed,
+so every process (test, oracle, kill-victim subprocess) sees identical
+bytes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def write_genome_set(
+    out_dir: str,
+    groups: list[int],
+    seed: int = 0,
+    length: int = 6000,
+    mutation: float = 0.01,
+    prefix: str = "g",
+) -> list[str]:
+    """One FASTA per genome; `groups` lists member counts per group.
+    Returns the paths in genome order (group-major)."""
+    rng = np.random.default_rng(seed)
+    bases = np.frombuffer(b"ACGT", dtype=np.uint8)
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    gi = 0
+    for count in groups:
+        base = rng.integers(0, 4, size=length)
+        for m in range(count):
+            seq = base.copy()
+            if m:
+                pos = rng.random(length) < mutation
+                seq[pos] = (seq[pos] + rng.integers(1, 4, size=int(pos.sum()))) % 4
+            s = bases[seq].tobytes().decode()
+            p = os.path.join(out_dir, f"{prefix}{gi:02d}.fasta")
+            with open(p, "w") as f:
+                f.write(f">{prefix}{gi}\n")
+                for o in range(0, len(s), 80):
+                    f.write(s[o : o + 80] + "\n")
+            paths.append(p)
+            gi += 1
+    return paths
+
+
+def primary_partition(idx) -> set[frozenset]:
+    """The index's primary clustering as a set of genome-name frozensets."""
+    by: dict[int, set] = {}
+    for g, p in zip(idx.names, idx.primary):
+        by.setdefault(int(p), set()).add(g)
+    return set(map(frozenset, by.values()))
+
+
+def secondary_partition(idx) -> set[frozenset]:
+    by: dict[str, set] = {}
+    for g, s in zip(idx.names, idx.secondary_names()):
+        by.setdefault(s, set()).add(g)
+    return set(map(frozenset, by.values()))
+
+
+def winners_by_members(idx) -> dict[frozenset, str]:
+    """winner genome keyed by the member set of its secondary cluster —
+    the renumbering-proof comparison shape."""
+    sec = idx.secondary_names()
+    out = {}
+    for row in idx.winners.itertuples():
+        members = frozenset(g for g, s in zip(idx.names, sec) if s == row.cluster)
+        out[members] = row.genome
+    return out
+
+
+def tree_digest(root: str, exclude_dirs: tuple[str, ...] = ("log",)) -> dict[str, str]:
+    """sha256 of every file under root (relative path keyed), for
+    nothing-was-written assertions."""
+    import hashlib
+
+    out = {}
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d not in exclude_dirs]
+        for f in sorted(files):
+            p = os.path.join(dirpath, f)
+            rel = os.path.relpath(p, root)
+            with open(p, "rb") as fh:
+                out[rel] = hashlib.sha256(fh.read()).hexdigest()
+    return out
+
+
+def npz_payloads_equal(a: str, b: str) -> bool:
+    """Semantic npz equality (member names + exact array bytes) — the
+    'byte-identical modulo timestamps' comparison: zip containers embed
+    write times, the payload arrays must not differ."""
+    with np.load(a, allow_pickle=False) as za, np.load(b, allow_pickle=False) as zb:
+        if sorted(za.files) != sorted(zb.files):
+            return False
+        return all(np.array_equal(za[k], zb[k]) for k in za.files)

@@ -1,0 +1,199 @@
+"""Chaos cells for the incremental genome index (ISSUE 6).
+
+The acceptance contract: SIGKILL during `index update` followed by a
+rerun produces an index byte-identical (modulo npz zip timestamps) to an
+uninterrupted update, and a corrupted index shard heals via recompute —
+all CPU-only under the `chaos` marker, wired into
+``tools/chaos_matrix.py --index``.
+
+The kill cells run the real CLI (`python -m drep_tpu index update`) as a
+subprocess victim with a deterministic ``index_update:kill`` /
+``process_death:kill`` fault spec; the parent compares the recovered
+store against an uninterrupted control built from identical inputs.
+"""
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import build_from_paths, index_update, load_index  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(tmp_path, groups=(3, 2), batch_groups=(2,), seed=21, block=None):
+    """Base index + a batch of new genomes, plus an uninterrupted CONTROL
+    copy of the same update (identical inputs -> identical store)."""
+    base = lib.write_genome_set(str(tmp_path / "base"), list(groups), seed=seed)
+    batch = lib.write_genome_set(
+        str(tmp_path / "batch"), list(batch_groups), seed=seed + 1, prefix="n"
+    )
+    loc = str(tmp_path / "idx")
+    kw = {"length": 0}
+    if block is not None:
+        kw["streaming_block"] = block
+    build_from_paths(loc, base, **kw)
+    control = str(tmp_path / "control")
+    shutil.copytree(loc, control)
+    index_update(control, batch)
+    return loc, control, batch
+
+
+def _update_subprocess(loc: str, batch: list[str], fault_spec: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DREP_TPU_FAULTS"] = fault_spec
+    return subprocess.run(
+        [sys.executable, "-m", "drep_tpu", "index", "update", loc, "-g", *batch],
+        capture_output=True, text=True, cwd=REPO, timeout=300, env=env,
+    )
+
+
+def _assert_stores_equal(got: str, want: str) -> None:
+    """Byte-identical modulo timestamps: same relative file set, manifest
+    bytes equal (deterministic JSON), every npz payload array-equal
+    (including its in-band checksum member — only the zip container's
+    embedded write times may differ)."""
+
+    def files(root):
+        out = set()
+        for dirpath, dirs, fs in os.walk(root):
+            dirs[:] = [d for d in dirs if d != "log"]
+            for f in fs:
+                out.add(os.path.relpath(os.path.join(dirpath, f), root))
+        return out
+
+    assert files(got) == files(want)
+    with open(os.path.join(got, "manifest.json"), "rb") as a, open(
+        os.path.join(want, "manifest.json"), "rb"
+    ) as b:
+        assert a.read() == b.read()
+    for rel in sorted(files(got)):
+        if rel.endswith(".npz"):
+            assert lib.npz_payloads_equal(
+                os.path.join(got, rel), os.path.join(want, rel)
+            ), f"payload differs after recovery: {rel}"
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_update_rerun_is_identical(tmp_path):
+    """SIGKILL at the worst point — every shard written, manifest publish
+    not reached (index_update:kill:skip=1 fires the pre-publish site) —
+    leaves the old generation intact; the rerun converges on the
+    uninterrupted control exactly."""
+    loc, control, batch = _setup(tmp_path)
+    gen_before = load_index(loc).generation
+    res = _update_subprocess(loc, batch, "index_update:kill:1.0:skip=1")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    # the kill preceded the publish: readers still see the old generation
+    assert load_index(loc).generation == gen_before
+    summary = index_update(loc, batch)  # the rerun, no faults
+    assert summary["generation"] == gen_before + 1
+    _assert_stores_equal(loc, control)
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_rect_compare_resumes(tmp_path):
+    """SIGKILL in the middle of the K x N rectangular compare: finished
+    stripes are already durable in the pending checkpoint store, the
+    rerun resumes them (not recomputes) and converges on the control."""
+    # 9 base genomes -> 2 row-block stripes at the merge path's floor
+    # block of 8; process_death fires per stripe, skip=1 dies at stripe 2
+    # with stripe 1's shard already durable in pending/
+    loc, control, batch = _setup(
+        tmp_path, groups=(5, 4), batch_groups=(1, 1), seed=31, block=8
+    )
+    res = _update_subprocess(loc, batch, "process_death:kill:1.0:skip=1")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    pending = os.path.join(loc, "pending")
+    shards = [
+        f for _, _, fs in os.walk(pending) for f in fs if f.startswith("row_")
+    ]
+    assert shards, "the kill left no durable stripe shards to resume from"
+    index_update(loc, batch)
+    assert not os.path.exists(pending)  # publish reclaims the pending store
+    _assert_stores_equal(loc, control)
+
+
+@pytest.mark.chaos
+def test_corrupt_edge_shard_heals_on_update(tmp_path):
+    """io:corrupt bit-rots the freshly published edge shard (after the
+    atomic rename — the rot the in-band checksum exists to catch); the
+    NEXT update detects it, recomputes the exact column range, and the
+    final store equals a never-corrupted control."""
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.profiling import counters
+
+    base = lib.write_genome_set(str(tmp_path / "base"), [3, 2], seed=41)
+    b1 = lib.write_genome_set(str(tmp_path / "b1"), [2], seed=42, prefix="n")
+    b2 = lib.write_genome_set(str(tmp_path / "b2"), [1], seed=43, prefix="m")
+    loc = str(tmp_path / "idx")
+    build_from_paths(loc, base, length=0)
+    control = str(tmp_path / "control")
+    shutil.copytree(loc, control)
+    index_update(control, b1)
+    index_update(control, b2)
+
+    faults.configure("io:corrupt:1.0:path=edges_g000001:max=1")
+    try:
+        index_update(loc, b1)
+    finally:
+        faults.configure(None)
+    counters.reset()
+    summary = index_update(loc, b2)  # heals gen-1's edges, admits batch 2
+    assert any("edges_g000001" in h for h in summary["healed"])
+    assert counters.faults.get("corrupt_shards_healed", 0) >= 1
+    _assert_stores_equal(loc, control)
+
+
+@pytest.mark.chaos
+def test_corrupt_sketch_shard_heals_on_update(tmp_path):
+    """io:corrupt on a published sketch shard: the next update re-sketches
+    the range from the locations recorded in state and converges."""
+    from drep_tpu.utils import faults
+
+    base = lib.write_genome_set(str(tmp_path / "base"), [2, 1], seed=51)
+    b1 = lib.write_genome_set(str(tmp_path / "b1"), [1], seed=52, prefix="n")
+    b2 = lib.write_genome_set(str(tmp_path / "b2"), [1], seed=53, prefix="m")
+    loc = str(tmp_path / "idx")
+    build_from_paths(loc, base, length=0)
+    control = str(tmp_path / "control")
+    shutil.copytree(loc, control)
+    index_update(control, b1)
+    index_update(control, b2)
+
+    faults.configure("io:corrupt:1.0:path=sketch_g000001:max=1")
+    try:
+        index_update(loc, b1)
+    finally:
+        faults.configure(None)
+    summary = index_update(loc, b2)
+    assert any("sketch_g000001" in h for h in summary["healed"])
+    _assert_stores_equal(loc, control)
+
+
+@pytest.mark.chaos
+def test_changed_genome_file_refuses_heal(tmp_path):
+    """Healing a sketch shard re-sketches from the recorded FASTA paths —
+    if the file CONTENT drifted since indexing, the heal must refuse
+    loudly (stale edges would silently poison the index), not proceed."""
+    from drep_tpu.errors import UserInputError
+    from drep_tpu.utils.durableio import _flip_bit
+
+    base = lib.write_genome_set(str(tmp_path / "base"), [2], seed=61)
+    loc = str(tmp_path / "idx")
+    build_from_paths(loc, base, length=0)
+    _flip_bit(os.path.join(loc, "sketches", "sketch_g000000.npz"))
+    # rewrite genome 0 with different content at the same path
+    lib.write_genome_set(str(tmp_path / "base"), [2], seed=99)
+    with pytest.raises(UserInputError, match="changed since indexing"):
+        index_update(loc, None)

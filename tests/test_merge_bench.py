@@ -167,3 +167,48 @@ def test_duplicate_attempt_files_do_not_crash(tmp_path):
     assert [n for n, _ in attempts] == [3, 3]
     merged = mbp.merge(attempts)
     assert set(merged["stages"]) == {"ingest", "link"}
+
+
+def test_round_number_derived_from_newest_partials(tmp_path):
+    """The hardcoded r05 default is gone: with no --pattern the tool
+    derives the round from the NEWEST partials present, so it follows the
+    rounds instead of silently merging a stale one."""
+    for name in (
+        "BENCH_r04_attempt1_partial.json",
+        "BENCH_r07_attempt1_partial.json",
+        "BENCH_r07_attempt2_partial.json",
+    ):
+        (tmp_path / name).write_text(json.dumps(
+            {"stages": {"ingest": {"genomes_per_sec": 1.0}}}
+        ))
+    assert mbp.newest_round(str(tmp_path)) == 7
+    r = subprocess.run(
+        [sys.executable, _TOOL], capture_output=True, text=True, cwd=str(tmp_path)
+    )
+    assert r.returncode == 0, r.stderr
+    assert (tmp_path / "BENCH_r07_merged.json").exists()
+    merged = json.loads((tmp_path / "BENCH_r07_merged.json").read_text())
+    assert merged["merged_from"] == ["attempt1", "attempt2"]
+    # and with nothing present the tool fails actionably, not silently
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r2 = subprocess.run(
+        [sys.executable, _TOOL], capture_output=True, text=True, cwd=str(empty)
+    )
+    assert r2.returncode != 0
+    assert "no BENCH_r" in r2.stderr
+
+
+def test_prefer_new_is_shared_rule():
+    """bench.py's durable per-stage store reuses THIS preference rule;
+    pin its shape here so a drift is caught at the source."""
+    assert mbp.prefer_new({"pairs_per_sec_per_chip": 1.0}, {"pairs_per_sec_per_chip": 2.0})
+    assert not mbp.prefer_new({"pairs_per_sec_per_chip": 2.0}, {"pairs_per_sec_per_chip": 1.0})
+    assert not mbp.prefer_new(
+        {"pairs_per_sec_per_chip": 1.0},
+        {"pairs_per_sec_per_chip": 2.0, "resume_pending": True},
+    )
+    assert mbp.prefer_new(
+        {"pairs_per_sec_per_chip": 1.0, "warm_start_shards": 3},
+        {"pairs_per_sec_per_chip": 0.5},
+    )

@@ -22,11 +22,18 @@ Two tiers:
   on resume, ENOSPC degrading into the actionable StoreFullError, and
   the scrub-then-resume loop (tools/scrub_store.py detects, ``--delete``
   quarantines, the next run recomputes) — seconds each, in-process.
+- index cells (``--index``): the incremental service mode (ISSUE 6,
+  drep_tpu/index/) — SIGKILL mid-``index update`` (pre-publish and
+  mid-rect-compare) followed by a rerun converging on the uninterrupted
+  result, and ``io:corrupt`` bit rot on index shards self-healing
+  through recompute/re-sketch on the next update. Delegate to their
+  pytest chaos tests (tests/test_index_chaos.py), CPU-only.
 
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py          # in-process grid
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --io     # + storage cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --index  # + index cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod    # + pod cells
 """
 
@@ -294,6 +301,22 @@ def _io_cells():
     ]
 
 
+# index cells (--index): the incremental service mode's crash/rot story
+# (ISSUE 6). Both delegate to their pytest chaos tests — the SIGKILL cell
+# needs a subprocess victim, and the corrupt cell shares its oracle
+# machinery — CPU-only, seconds-to-minutes.
+INDEX_CELLS = [
+    ("index_update", "kill", "SIGKILL before manifest publish -> rerun converges",
+     "survive", "tests/test_index_chaos.py::test_sigkill_mid_update_rerun_is_identical"),
+    ("index_update", "kill", "SIGKILL mid rect-compare -> pending shards resume",
+     "survive", "tests/test_index_chaos.py::test_sigkill_mid_rect_compare_resumes"),
+    ("io", "corrupt", "bit-rot on an index edge shard -> update heals via recompute",
+     "survive", "tests/test_index_chaos.py::test_corrupt_edge_shard_heals_on_update"),
+    ("io", "corrupt", "bit-rot on an index sketch shard -> update re-sketches",
+     "survive", "tests/test_index_chaos.py::test_corrupt_sketch_shard_heals_on_update"),
+]
+
+
 # pod cells delegate to the pytest chaos tests (site x mode -> test id)
 POD_CELLS = [
     ("process_death", "kill", "SIGKILL mid-streaming -> epoch re-deal",
@@ -314,6 +337,7 @@ POD_CELLS = [
 def main() -> int:
     pod = "--pod" in sys.argv
     io_cells = "--io" in sys.argv
+    index_cells = "--index" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
 
@@ -332,10 +356,16 @@ def main() -> int:
             verdict = f"FAIL ({type(e).__name__}: {e})"
             failures += 1
         rows.append((site, mode, label, expected, verdict))
-    if pod:
+
+    def _pytest_cells(cell_list, flag: str, enabled: bool) -> None:
+        nonlocal failures
+        if not enabled:
+            for site, mode, label, expected, test_id in cell_list:
+                rows.append((site, mode, label, expected, f"SKIP ({flag} runs {test_id})"))
+            return
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
-        for site, mode, label, expected, test_id in POD_CELLS:
+        for site, mode, label, expected, test_id in cell_list:
             rc = subprocess.call(
                 [sys.executable, "-m", "pytest", test_id, "-q", "-p", "no:cacheprovider"],
                 cwd=REPO, env=env,
@@ -344,9 +374,9 @@ def main() -> int:
             verdict = "PASS" if rc == 0 else f"FAIL (pytest rc={rc})"
             failures += rc != 0
             rows.append((site, mode, label, expected, verdict))
-    else:
-        for site, mode, label, expected, test_id in POD_CELLS:
-            rows.append((site, mode, label, expected, f"SKIP (--pod runs {test_id})"))
+
+    _pytest_cells(INDEX_CELLS, "--index", index_cells)
+    _pytest_cells(POD_CELLS, "--pod", pod)
 
     w_site = max(len(r[0]) for r in rows)
     w_mode = max(len(r[1]) for r in rows)

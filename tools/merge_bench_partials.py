@@ -67,6 +67,37 @@ def load_attempts(pattern: str) -> list[tuple[int, dict]]:
     return out  # ascending attempt order; later overwrites earlier
 
 
+def prefer_new(old, new) -> bool:
+    """Should `new` replace `old` for the same stage key? The ONE record-
+    preference rule (complete beats pending, cold beats warm-started,
+    then best-of on rate) — shared by merge() below and bench.py's
+    durable per-stage records, so the two merge paths cannot drift."""
+    old_warm = isinstance(old, dict) and old.get("warm_start_shards", 0) > 0
+    new_warm = isinstance(new, dict) and new.get("warm_start_shards", 0) > 0
+    old_pend = isinstance(old, dict) and bool(
+        old.get("resume_pending") or old.get("measurement_pending")
+    )
+    new_pend = isinstance(new, dict) and bool(
+        new.get("resume_pending") or new.get("measurement_pending")
+    )
+    if old_pend != new_pend:
+        # completeness beats rate (ADVICE r4): an attempt that wedged
+        # mid-stage (pending marker still set) must not displace a
+        # complete record on a marginally higher fresh-leg rate — that
+        # drops the resume evidence and re-queues the stage, wasting a
+        # recovery window
+        return not new_pend
+    if old_warm != new_warm:
+        # a warm-started scale run's wall-clock rode a previous attempt's
+        # shards — its (inflated) rate never beats a cold measurement,
+        # and a cold one always replaces it
+        return not new_warm
+    old_rate, new_rate = _rate(old), _rate(new)
+    if old_rate is not None and new_rate is not None and new_rate < old_rate:
+        return False  # keep the faster measurement (best-of)
+    return True
+
+
 def merge(attempts: list[tuple[int, dict]]) -> dict:
     stages: dict[str, dict] = {}
     provenance: dict[str, dict] = {}
@@ -78,38 +109,8 @@ def merge(attempts: list[tuple[int, dict]]) -> dict:
                 errors.setdefault(key, {"attempt": n, "record": val})
                 errors[key] = {"attempt": n, "record": val}  # keep latest failure
                 continue
-            if key in stages:
-                old, new = stages[key], val
-                old_warm = isinstance(old, dict) and old.get("warm_start_shards", 0) > 0
-                new_warm = isinstance(new, dict) and new.get("warm_start_shards", 0) > 0
-                old_pend = isinstance(old, dict) and bool(
-                    old.get("resume_pending") or old.get("measurement_pending")
-                )
-                new_pend = isinstance(new, dict) and bool(
-                    new.get("resume_pending") or new.get("measurement_pending")
-                )
-                if old_pend != new_pend:
-                    # completeness beats rate (ADVICE r4): an attempt that
-                    # wedged mid-stage (pending marker still set) must not
-                    # displace a complete record on a marginally higher
-                    # fresh-leg rate — that drops the resume evidence and
-                    # re-queues the stage, wasting a recovery window
-                    if new_pend:
-                        continue
-                elif old_warm != new_warm:
-                    # a warm-started scale run's wall-clock rode a previous
-                    # attempt's shards — its (inflated) rate never beats a
-                    # cold measurement, and a cold one always replaces it
-                    if new_warm:
-                        continue
-                else:
-                    old_rate, new_rate = _rate(old), _rate(new)
-                    if (
-                        old_rate is not None
-                        and new_rate is not None
-                        and new_rate < old_rate
-                    ):
-                        continue  # keep the faster measurement (best-of)
+            if key in stages and not prefer_new(stages[key], val):
+                continue
             stages[key] = val
             provenance[key] = {"attempt": n, "link": link}
     # a failure entry survives only while no attempt succeeded there
@@ -134,14 +135,46 @@ def merge(attempts: list[tuple[int, dict]]) -> dict:
     }
 
 
+def newest_round(cwd: str = ".") -> int | None:
+    """The highest round number among BENCH_r<N>*_partial.json files
+    present — the default round, so the tool follows the rounds instead
+    of pinning one (the old hardcoded r05 default silently merged a
+    STALE round's partials once r06 started)."""
+    rounds = [
+        int(m.group(1))
+        for f in glob.glob(os.path.join(cwd, "BENCH_r*_partial.json"))
+        if (m := re.search(r"BENCH_r(\d+)", os.path.basename(f)))
+    ]
+    return max(rounds) if rounds else None
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--pattern", default="BENCH_r05_attempt*_partial.json",
-        help="glob of per-attempt partials (attempt number parsed from name)",
+        "--pattern", default=None,
+        help="glob of per-attempt partials (attempt number parsed from "
+             "name). Default: the NEWEST round's partials present "
+             "(BENCH_r<max>_attempt*_partial.json)",
     )
-    ap.add_argument("--out", default="BENCH_r05_merged.json")
+    ap.add_argument(
+        "--out", default=None,
+        help="merged artifact path (default BENCH_r<max>_merged.json for "
+             "the derived round)",
+    )
     args = ap.parse_args()
+    if args.pattern is None:
+        n = newest_round()
+        if n is None:
+            raise SystemExit(
+                "no BENCH_r*_partial.json files present — pass --pattern "
+                "explicitly to merge from elsewhere"
+            )
+        args.pattern = f"BENCH_r{n:02d}_attempt*_partial.json"
+        if args.out is None:
+            args.out = f"BENCH_r{n:02d}_merged.json"
+    if args.out is None:
+        m = re.search(r"BENCH_r(\d+)", args.pattern)
+        args.out = f"BENCH_r{int(m.group(1)):02d}_merged.json" if m else "BENCH_merged.json"
     attempts = load_attempts(args.pattern)
     if not attempts:
         raise SystemExit(f"no partials match {args.pattern}")

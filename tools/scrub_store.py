@@ -18,10 +18,18 @@ Verified payload families (everything else is left alone):
 
 - ``*.npz`` shards — streaming row stripes (``row_*.npz``), dense-ring
   blocks (``blk_*.npz``), secondary per-cluster results (``pc_*.npz``),
-  ingest sketch shards, workdir arrays. Zero-byte, truncated, unparseable,
-  or checksum-mismatched shards are DAMAGE.
-- ``meta.json`` and the pod protocol's JSON notes (``.pod-done.*``,
-  ``.pod-dead.*``) — unparseable or checksum-mismatched is DAMAGE.
+  ingest sketch shards, workdir arrays, and every genome-index family
+  (``sketch_g*.npz``, ``edges_g*.npz``, ``state_g*.npz`` — sketches,
+  edge graph, labels/winner table; drep_tpu/index/store.py). Zero-byte,
+  truncated, unparseable, or checksum-mismatched shards are DAMAGE.
+- ``meta.json``, the genome-index ``manifest.json``, and the pod
+  protocol's JSON notes (``.pod-done.*``, ``.pod-dead.*``) —
+  unparseable or checksum-mismatched is DAMAGE.
+
+For a genome index, a damaged shard removed by ``--delete`` is healed by
+the next ``drep-tpu index update`` (sketch shards re-sketch from the
+recorded FASTA locations, edge shards recompute their column range,
+state recomputes wholesale); only ``manifest.json`` is unhealable.
 
 Payloads written before checksums existed verify structurally (a full
 decode catches truncation) and are counted ``legacy`` — readable, but
@@ -49,10 +57,11 @@ from drep_tpu.utils import durableio  # noqa: E402
 
 def _is_json_note(name: str) -> bool:
     # every checked-JSON family the pipeline publishes: store meta, the
-    # pod protocol's done/death notes, workdir argument snapshots, and
-    # ingest poison markers — all carry the in-band "crc" key
+    # pod protocol's done/death notes, workdir argument snapshots, ingest
+    # poison markers, and the genome-index manifest
+    # (drep_tpu/index/store.py) — all carry the in-band "crc"
     return (
-        name == "meta.json"
+        name in ("meta.json", "manifest.json")
         or name.startswith((".pod-done.", ".pod-dead.", "ingest_error_"))
         or name.endswith("_arguments.json")
     )
