@@ -73,6 +73,28 @@ def build_parser() -> argparse.ArgumentParser:
         clus.add_argument("--streaming_threshold", type=int, default=30_000,
                           help="genome count beyond which the primary stage streams "
                                "instead of materializing the N^2 matrix")
+        clus.add_argument("--primary_prune", default="off",
+                          choices=["off", "lsh"],
+                          help="sub-quadratic streaming primary: 'lsh' bands the "
+                               "MinHash sketches into LSH buckets and dispatches "
+                               "only tiles containing a candidate pair (recall "
+                               "1.0 at the retention bound by construction — "
+                               "retained edges are bit-identical to the dense "
+                               "schedule; see README 'Candidate pruning'). "
+                               "Default off")
+        clus.add_argument("--prune_bands", type=int, default=0,
+                          help="LSH band count: 0 (default) buckets on individual "
+                               "sketch ids (tightest candidates; the derived "
+                               "shared-count threshold applies); B>0 splits the "
+                               "id space into B ranges (smaller join, coarser "
+                               "candidates, threshold pinned to 1)")
+        clus.add_argument("--prune_min_shared", type=int, default=0,
+                          help="conservative floor on the candidate threshold: "
+                               "0 (default) auto-derives the minimum shared-hash "
+                               "count from the retention bound; an explicit "
+                               "value lowers it (1 = most conservative). Values "
+                               "above the derivation are clamped down — they "
+                               "would break the recall-1.0 contract")
 
         warn = p.add_argument_group("WARNINGS")
         warn.add_argument("--warn_dist", type=float, default=0.25)
@@ -220,6 +242,19 @@ def build_parser() -> argparse.ArgumentParser:
              "this is a pure heal pass)",
     )
     add_index_io(u)
+    u.add_argument("--primary_prune", default="off", choices=["off", "lsh"],
+                   help="LSH-banded candidate pruning for the K x N rect "
+                        "compare: only column blocks containing a candidate "
+                        "pair are dispatched (K x N -> K x bucket_occupancy; "
+                        "recall 1.0 at the index's retention bound, results "
+                        "identical). Per-invocation knob — never pinned in "
+                        "the manifest")
+    u.add_argument("--prune_bands", type=int, default=0,
+                   help="LSH band count (0 = per-id buckets; same semantics "
+                        "as the pipeline flag)")
+    u.add_argument("--prune_min_shared", type=int, default=0,
+                   help="conservative candidate-threshold floor (0 = "
+                        "auto-derive; same semantics as the pipeline flag)")
 
     c = isub.add_parser(
         "classify",

@@ -65,6 +65,16 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "streaming_primary": False,
     "streaming_block": 1024,
     "streaming_threshold": 30_000,
+    # LSH-banded candidate pruning (ops/lsh.py): "lsh" makes the streaming
+    # primary's tile walk sparse (only tiles holding a candidate pair are
+    # dispatched — recall 1.0 at the retention bound by construction, so
+    # retained edges are bit-identical either way). Off by default until
+    # the equivalence suite has aged on real data; never a _RESUME_KEY
+    # (results identical) — but the streaming checkpoint meta pins the
+    # banding params, so a MID-RUN knob change refuses to resume loudly.
+    "primary_prune": "off",
+    "prune_bands": 0,
+    "prune_min_shared": 0,
     "overlap_ingest": True,
     # fault tolerance (parallel/faulttol.py): retries per failed device
     # dispatch, the per-dispatch watchdog (seconds; 0 = auto-derived from
@@ -259,6 +269,10 @@ def _primary_clusters(
         # runs sparse UPGMA over the retained edge graph, single runs
         # connected components; anything else raises with guidance — no
         # silent linkage-family switch at the streaming threshold
+        if kw["primary_prune"] not in ("off", "lsh"):
+            raise ValueError(
+                f"--primary_prune must be off or lsh, not {kw['primary_prune']!r}"
+            )
         labels, edges, pairs_computed = streaming_primary_clusters(
             packed,
             gs.k,
@@ -268,8 +282,21 @@ def _primary_clusters(
             keep_dist=_warn_dist(kw),  # evaluate-stage visibility
             cluster_alg=kw["clusterAlg"],
             ft_config=ft_cfg,
+            primary_prune=kw["primary_prune"],
+            prune_bands=kw["prune_bands"],
+            prune_min_shared=kw["prune_min_shared"],
         )
         return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names), pairs_computed
+    if kw["primary_prune"] != "off":
+        # the dense engines materialize every tile by design — pruning
+        # only exists on the streaming schedule (and the index's rect
+        # compare); silently "accepting" the flag would misreport
+        logger.warning(
+            "--primary_prune %s only applies to the streaming primary "
+            "(this run resolved to the dense path; lower "
+            "--streaming_threshold or pass --streaming_primary) — ignored",
+            kw["primary_prune"],
+        )
     engine = dispatch.get_primary(kw["primary_algorithm"])
     dist, _sim = engine(
         gs,

@@ -70,20 +70,39 @@ def _retention(params: dict) -> tuple[float, float]:
 
 
 def _rect_edges(
-    idx: LoadedIndex, n_old: int, checkpoint_dir: str | None
+    idx: LoadedIndex, n_old: int, checkpoint_dir: str | None, prune_cfg: dict | None = None
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """New retained edges (jj >= n_old) of the union set, through the
-    streaming tile executor's rectangular schedule."""
+    streaming tile executor's rectangular schedule.
+
+    `prune_cfg` ({"primary_prune": "lsh", "prune_bands": B,
+    "prune_min_shared": F}) feeds the SAME LSH candidate set the
+    streaming primary uses into the rectangular compare — K x N becomes
+    K x bucket_occupancy (ROADMAP service-mode follow-on (a)): the
+    candidate build runs over the union pack at the index's own
+    retention bound, restricted to pairs reaching the new-genome tail
+    (jj >= n_old), so recall 1.0 and the admitted edge set is identical
+    to the unpruned compare's."""
     from drep_tpu.ops.minhash import pack_sketches
     from drep_tpu.parallel.streaming import streaming_mash_edges
 
     p = idx.params
     _, keep = _retention(p)
     packed = pack_sketches(idx.bottom, idx.names, int(p["sketch_size"]))
+    prune = None
+    if prune_cfg and prune_cfg.get("primary_prune", "off") == "lsh":
+        from drep_tpu.ops.lsh import build_candidates
+
+        prune = build_candidates(
+            packed, keep=keep, k=int(p["kmer_size"]),
+            bands=int(prune_cfg.get("prune_bands", 0)),
+            min_shared=int(prune_cfg.get("prune_min_shared", 0)),
+            min_col=n_old,
+        )
     ii, jj, dd, pairs = streaming_mash_edges(
         packed, int(p["kmer_size"]), keep,
         block=int(p["streaming_block"]),
-        checkpoint_dir=checkpoint_dir, min_col=n_old,
+        checkpoint_dir=checkpoint_dir, min_col=n_old, prune=prune,
     )
     sel = jj >= n_old  # boundary tiles emit a few old-old pairs: already stored
     return ii[sel], jj[sel], dd[sel], pairs
@@ -363,12 +382,18 @@ def publish_generation(
 
 
 def index_update(
-    index_loc: str, genome_paths: list[str] | None, processes: int = 1
+    index_loc: str, genome_paths: list[str] | None, processes: int = 1,
+    primary_prune: str = "off", prune_bands: int = 0, prune_min_shared: int = 0,
 ) -> dict:
     """`index update`: admit K new genomes (sketch K, compare K x N,
     re-cluster dirty components, re-score touched clusters) and publish
     the next generation. With no genomes this is a pure HEAL pass:
-    corrupt/missing shards repair and the generation stays put."""
+    corrupt/missing shards repair and the generation stays put.
+
+    `primary_prune="lsh"` routes the rect compare through the LSH
+    candidate set (see _rect_edges) — a per-invocation execution knob,
+    never pinned in the manifest, because the admitted edges are
+    identical either way (recall 1.0 at the retention bound)."""
     from drep_tpu.utils import faults
     from drep_tpu.utils.profiling import counters
 
@@ -395,8 +420,15 @@ def index_update(
         return summary
 
     n_old = _admit_batch(idx, batch, results, gen_new)
+    prune_cfg = {
+        "primary_prune": primary_prune,
+        "prune_bands": prune_bands,
+        "prune_min_shared": prune_min_shared,
+    }
     with counters.stage("index_rect_compare"):
-        ii, jj, dd, pairs = _rect_edges(idx, n_old, store.pending_dir(gen_new))
+        ii, jj, dd, pairs = _rect_edges(
+            idx, n_old, store.pending_dir(gen_new), prune_cfg=prune_cfg
+        )
     counters.stages["index_rect_compare"].pairs += pairs
     order = np.lexsort((jj, ii))
     ii, jj, dd = ii[order], jj[order], dd[order]
@@ -418,6 +450,12 @@ def index_update(
             "healed": idx.healed,
         }
     )
+    if primary_prune == "lsh":
+        # pruning honesty rides into the update summary: what fraction of
+        # the rect schedule the candidate bitmap removed (the gauge the
+        # streaming walk just set), alongside the pairs actually compared
+        summary["primary_prune"] = "lsh"
+        summary["skip_fraction"] = counters.gauges.get("skip_fraction", 0.0)
     logger.info(
         "index update: +%d genomes -> generation %d (%d genomes, %d primary / "
         "%d secondary clusters; %d cluster(s) recomputed, %d reused)",

@@ -326,6 +326,41 @@ def retention_bound(cutoff: float, keep_dist: float, cluster_alg: str) -> float:
     return keep
 
 
+def _prune_meta_conflict(checkpoint_dir: str, meta: dict) -> tuple | None:
+    """Does the existing store differ from `meta` ONLY in its banding
+    parameters? Then a resume must REFUSE, never silently clear: the
+    shards themselves are bit-identical across banding configs (recall
+    1.0), but the store may hold hours of finished stripes, and the
+    operator changing a prune knob mid-run is far more likely a mistake
+    than an intent to recompute — and a silent clear would also launder
+    the new config's skip accounting over the old run's shards. Returns
+    (stored_prune, wanted_prune) on conflict, None otherwise (missing,
+    unreadable, or differently-keyed metas fall through to the normal
+    open-and-clear path)."""
+    from drep_tpu.utils.ckptmeta import META_NAME, META_PROVENANCE_KEYS
+
+    loc = os.path.join(checkpoint_dir, META_NAME)
+    if not os.path.exists(loc):
+        return None
+    try:
+        from drep_tpu.utils.durableio import read_json_checked
+
+        stored = read_json_checked(loc, what="checkpoint meta")
+    except Exception:
+        return None  # corrupt/unreadable meta: open_checkpoint_dir decides
+    if not isinstance(stored, dict):
+        return None
+    prune_keys = ("prune_scheme", "prune_bands", "prune_min_shared", "prune_keep")
+    drop = set(prune_keys) | set(META_PROVENANCE_KEYS)
+    stored_rest = {k: v for k, v in stored.items() if k not in drop}
+    meta_rest = {k: v for k, v in meta.items() if k not in prune_keys}
+    if stored_rest != meta_rest:
+        return None  # different inputs entirely: the normal clear applies
+    sp = {k: stored.get(k) for k in prune_keys}
+    mp = {k: meta.get(k) for k in prune_keys}
+    return (sp, mp) if sp != mp else None
+
+
 def streaming_mash_edges(
     packed: PackedSketches,
     k: int,
@@ -335,6 +370,7 @@ def streaming_mash_edges(
     use_pallas: bool | None = None,
     ft_config=None,
     min_col: int = 0,
+    prune=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """All unordered pairs (i < j) with Mash distance <= cutoff.
 
@@ -347,6 +383,22 @@ def streaming_mash_edges(
     Tiles at the boundary block still emit a few old-old pairs; callers
     filter on jj >= their true first-new index. Per-pair results are
     identical to the full triangle's (the estimator is pair-local).
+
+    `prune` (ops/lsh.py CandidateSet) makes the walk SPARSE: only tiles
+    containing at least one candidate pair are dispatched. Candidates
+    must have been built at (or beyond) this call's `cutoff` — then the
+    skipped tiles hold no retained pair by the recall-1.0 derivation and
+    the returned edges (and every checkpoint shard) are BIT-IDENTICAL to
+    the dense walk's. Accounting stays honest: `tiles_total` keeps the
+    dense-equivalent grid, pruned schedule tiles land in a separate
+    `tiles_skipped_pruned` counter plus a `skip_fraction` gauge, and
+    `pairs_computed` counts only dispatched tiles. The banding params are
+    pinned in the checkpoint meta — resuming a store whose only
+    difference is the banding config REFUSES with an actionable error
+    (never silently mixes or clears shards across configs). Composes
+    unchanged with `min_col` and the elastic protocol (the skip happens
+    inside the per-stripe tile loop; stripe ownership, re-dealing, and
+    shard names are untouched).
 
     Returns (ii, jj, dist, pairs_computed) — `pairs_computed` counts pair
     comparisons actually executed this call (resumed shards contribute 0),
@@ -402,6 +454,10 @@ def streaming_mash_edges(
     # the classic upper triangle). Computed AFTER the effective block so
     # callers think in genome indices, not tile units.
     first_col_block = max(0, min(int(min_col), max(n - 1, 0))) // block
+    # sparse schedule: the block-level tile-occupancy bitmap, built AFTER
+    # the effective block is known (candidates are genome-indexed, tiles
+    # are block-indexed). None = dense walk, bitmap untouched code path.
+    occ = prune.occupancy(block, n_blocks) if prune is not None else None
     width = ids.shape[1]  # the estimator's `s` (pre-pow2-pad sketch width)
     if use_pallas:
         from drep_tpu.ops.pallas_mash import rows_per_iter
@@ -487,6 +543,29 @@ def streaming_mash_edges(
             # versa); the key is omitted at 0 so pre-rect stores stay
             # resumable unchanged
             meta["min_col_block"] = first_col_block
+        if prune is not None:
+            # banding params pinned (keys absent when pruning is off, so
+            # pre-prune stores stay resumable); a store differing ONLY in
+            # these refuses below instead of silently clearing/mixing
+            meta.update(prune.params)
+        conflict = _prune_meta_conflict(checkpoint_dir, meta)
+        if conflict is not None:
+            stored_p, wanted_p = conflict
+            from drep_tpu.errors import UserInputError
+
+            if hb is not None:
+                hb.close()  # never leak the beat writer on a refusing open
+            raise UserInputError(
+                f"streaming checkpoint store {checkpoint_dir} was written "
+                f"under different candidate-pruning parameters "
+                f"({ {k: v for k, v in stored_p.items() if v is not None} or 'pruning off'}) "
+                f"than this run requests "
+                f"({ {k: v for k, v in wanted_p.items() if v is not None} or 'pruning off'}). "
+                f"Refusing to resume: shards must never mix banding configs. "
+                f"Either rerun with the original --primary_prune/--prune_bands/"
+                f"--prune_min_shared knobs, or delete the store directory to "
+                f"recompute under the new ones."
+            )
         # leader-only clear + barrier on >1 process lives inside
         # open_checkpoint_dir (shared with the secondary shard store).
         # Because the heartbeat manager above started BEFORE this open,
@@ -513,6 +592,7 @@ def streaming_mash_edges(
     pairs_computed = 0
     tiles_done = 0  # upper-triangle tiles actually dispatched this call
     tiles_full = 0  # full-grid tiles of the same stripes (resumed: 0/0)
+    tiles_skipped = 0  # schedule tiles pruned by the candidate bitmap
     # per-tile device->host budget for the compact threshold path
     budget = min(EDGE_BUDGET, block * block)
     compact = _compact_tile()
@@ -535,10 +615,26 @@ def streaming_mash_edges(
         """Dispatch + finalize one row-block stripe; publishes its shard
         (epoch-stamped name) when checkpointing. Returns the stripe's
         surviving edges."""
-        nonlocal pairs_computed, tiles_done, tiles_full
+        nonlocal pairs_computed, tiles_done, tiles_full, tiles_skipped
         # the elastic chaos tests SIGKILL a pod member here — at a stripe
         # boundary, with its finished shards already durable
         _faults.fire("process_death")
+        if occ is not None and not occ[bi, max(bi, first_col_block):n_blocks].any():
+            # fully-pruned stripe: no tile holds a candidate, so the dense
+            # walk would retain nothing here — publish the (empty) shard
+            # WITHOUT touching a device; the pack transfer itself is
+            # deferred until some stripe actually computes
+            tiles_skipped += n_blocks - max(bi, first_col_block)
+            tiles_full += n_blocks
+            empty = (np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.float32))
+            if checkpoint_dir is not None:
+                from drep_tpu.utils.ckptmeta import atomic_savez
+
+                atomic_savez(
+                    os.path.join(checkpoint_dir, _shard_name(bi, epoch)),
+                    ii=empty[0], jj=empty[1], dist=empty[2],
+                )
+            return empty
         _ensure_pack_on_devices()
         i0 = bi * block
         # dispatch the whole stripe asynchronously, one tile per device
@@ -548,6 +644,9 @@ def streaming_mash_edges(
         # composite bottleneck on slow d2h links)
         tiles = []
         for bj in range(max(bi, first_col_block), n_blocks):
+            if occ is not None and not occ[bi, bj]:
+                tiles_skipped += 1  # no candidate pair in this tile
+                continue
             j0 = bj * block
             diag = j0 == i0
 
@@ -682,7 +781,18 @@ def streaming_mash_edges(
                 ft.quarantined(), len(devices),
             )
         if tiles_full:
-            counters.add_tiles("primary_compare", computed=tiles_done, total=tiles_full)
+            counters.add_tiles(
+                "primary_compare", computed=tiles_done, total=tiles_full,
+                skipped=tiles_skipped,
+            )
+        if prune is not None:
+            # the headline pruning gauge: fraction of the triangle/rect
+            # SCHEDULE the candidate bitmap removed this call (resumed
+            # stripes contribute to neither side — honest across resumes)
+            sched = tiles_done + tiles_skipped
+            counters.set_gauge(
+                "skip_fraction", round(tiles_skipped / sched, 4) if sched else 0.0
+            )
         derived = ft.derived_timeout_s()
         if derived is not None:
             # the watchdog deadline the run actually derived from its own
@@ -1025,9 +1135,18 @@ def streaming_primary_clusters(
     keep_dist: float = 0.0,
     cluster_alg: str = "average",
     ft_config=None,
+    primary_prune: str = "off",
+    prune_bands: int = 0,
+    prune_min_shared: int = 0,
 ) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray], int]:
     """Streaming primary clustering: (labels 1..C, retained edges, pairs
     actually computed this call).
+
+    `primary_prune="lsh"` builds the LSH-banded candidate set at THIS
+    call's retention bound (ops/lsh.py — candidates and edge retention
+    derive from the same `keep`, so the recall-1.0 contract holds by
+    construction) and hands the sparse tile bitmap to the edge walk;
+    retained edges are bit-identical to the dense schedule's.
 
     Edges are retained up to max(1 - P_ani, keep_dist) — pass the evaluate
     stage's warn_dist so near-threshold winner pairs stay visible in the
@@ -1064,9 +1183,20 @@ def streaming_primary_clusters(
             "cutoff); widening retention to %.3f",
             cutoff, keep,
         )
+    if primary_prune not in ("off", "lsh"):
+        raise ValueError(
+            f"--primary_prune supports off or lsh, not {primary_prune!r}"
+        )
+    prune = None
+    if primary_prune == "lsh":
+        from drep_tpu.ops.lsh import build_candidates
+
+        prune = build_candidates(
+            packed, keep=keep, k=k, bands=prune_bands, min_shared=prune_min_shared
+        )
     ii, jj, dd, pairs_computed = streaming_mash_edges(
         packed, k, keep, block=block, checkpoint_dir=checkpoint_dir,
-        ft_config=ft_config,
+        ft_config=ft_config, prune=prune,
     )
     if cluster_alg == "single":
         in_cluster = dd <= cutoff

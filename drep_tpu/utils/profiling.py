@@ -32,6 +32,12 @@ class _Stage:
     # regression to full-grid scheduling shows up as fraction ~1.0.
     tiles_computed: int = 0
     tiles_total: int = 0
+    # LSH-banded candidate pruning (ops/lsh.py): upper-triangle schedule
+    # tiles SKIPPED because no candidate pair lands in them. Kept separate
+    # from tiles_total (which stays the dense-equivalent grid) so the
+    # record reports both the honest dense totals AND how much the sparse
+    # schedule saved.
+    tiles_skipped: int = 0
 
 
 @dataclass
@@ -78,16 +84,19 @@ class Counters:
         st.seconds += float(seconds)
         st.calls += 1
 
-    def add_tiles(self, name: str, computed: int, total: int) -> None:
+    def add_tiles(self, name: str, computed: int, total: int, skipped: int = 0) -> None:
         """Record one compare schedule's pair-tile accounting: `computed`
         tiles actually dispatched vs `total` tiles of the full N^2 grid the
-        output covers. Separate from add()/stage() on purpose — pairs and
-        seconds are recorded once at the pipeline layer (controller), tiles
-        once at the compute layer (the engine that knows its schedule), so
-        neither is ever double-counted."""
+        output covers, plus `skipped` schedule tiles pruned away by the
+        LSH candidate bitmap (0 when pruning is off). Separate from
+        add()/stage() on purpose — pairs and seconds are recorded once at
+        the pipeline layer (controller), tiles once at the compute layer
+        (the engine that knows its schedule), so neither is ever
+        double-counted."""
         st = self.stages.setdefault(name, _Stage())
         st.tiles_computed += int(computed)
         st.tiles_total += int(total)
+        st.tiles_skipped += int(skipped)
 
     def add_fault(self, kind: str, n: int = 1) -> None:
         """Count one fault-tolerance event (retry, watchdog trip, device
@@ -119,6 +128,15 @@ class Counters:
                 out["stages"][name]["tiles_total"] = st.tiles_total
                 out["stages"][name]["tile_fraction"] = round(
                     st.tiles_computed / st.tiles_total, 4
+                )
+            if st.tiles_skipped > 0:
+                # pruning honesty: dense-equivalent totals above stay as
+                # they are; the skipped count and the fraction of the
+                # SCHEDULE the bitmap removed ride alongside
+                out["stages"][name]["tiles_skipped_pruned"] = st.tiles_skipped
+                sched = st.tiles_computed + st.tiles_skipped
+                out["stages"][name]["skip_fraction"] = round(
+                    st.tiles_skipped / max(sched, 1), 4
                 )
             total_pairs += st.pairs
             total_seconds += st.seconds

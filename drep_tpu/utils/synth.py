@@ -1,0 +1,47 @@
+"""Shared synthetic sketch planting for benches, chaos cells, and tests.
+
+One recipe for the "group-pool" packed sketches that the LSH pruning
+work measures itself against: members of a group draw their sketch ids
+from a common pool (small Mash distance inside the group, ~none across),
+and `contiguous=True` lays group members out adjacently in index order —
+the realistic post-sort layout where candidate pruning actually skips
+tiles (interleaved members occupy every tile, the worst case). Kept in
+ONE place so the bench proxy stage (bench.py), the chaos matrix
+(tools/chaos_matrix.py --prune), and the test suites cannot drift onto
+subtly different data while claiming to measure the same property.
+
+(The pre-existing per-suite planters — tests/_chaos_worker.py's
+kill-oracle data, tests/test_chaos.py, chaos_matrix._packed — are
+deliberately NOT rebased onto this: their byte-exact rng streams anchor
+recorded oracles.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from drep_tpu.ops.minhash import PAD_ID, PackedSketches
+
+
+def planted_group_sketches(
+    n: int = 256,
+    s: int = 64,
+    groups: int = 16,
+    seed: int = 0,
+    contiguous: bool = True,
+    id_space: int = 2**20,
+) -> PackedSketches:
+    """Group-pool packed sketches: `n` genomes over `groups` pools of
+    `2*s` ids drawn from `id_space`, each row an `s`-subset of its
+    group's pool. Deterministic per seed."""
+    rng = np.random.default_rng(seed)
+    ids = np.full((n, s), PAD_ID, np.int32)
+    counts = np.full(n, s, np.int32)
+    pools = [
+        np.sort(rng.choice(id_space, size=s * 2, replace=False).astype(np.int32))
+        for _ in range(groups)
+    ]
+    for i in range(n):
+        g = (i * groups // n) if contiguous else (i % groups)
+        ids[i] = np.sort(rng.choice(pools[g], size=s, replace=False))
+    return PackedSketches(ids=ids, counts=counts, names=[f"g{i}" for i in range(n)])

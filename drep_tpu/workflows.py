@@ -141,7 +141,10 @@ def index_update_wrapper(
 
     _init_index(index_loc)
     return index_update(
-        index_loc, genomes, processes=kwargs.get("processes", 1) or 1
+        index_loc, genomes, processes=kwargs.get("processes", 1) or 1,
+        primary_prune=kwargs.get("primary_prune", "off") or "off",
+        prune_bands=kwargs.get("prune_bands", 0) or 0,
+        prune_min_shared=kwargs.get("prune_min_shared", 0) or 0,
     )
 
 

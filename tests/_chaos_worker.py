@@ -16,9 +16,12 @@ import numpy as np
 N, S, BLOCK, K, CUTOFF = 48, 64, 8, 21, 0.2
 
 
-def planted_packed():
+def planted_packed(contiguous: bool = False):
     """Deterministic group-structured sketches — identical in every
-    process (seeded), so oracle and kill/resume runs see the same data."""
+    process (seeded), so oracle and kill/resume runs see the same data.
+    `contiguous` lays group members out adjacently (the layout where the
+    LSH candidate bitmap actually skips tiles); the default interleaves
+    them (the original recipe the dense kill test was written against)."""
     from drep_tpu.ops.minhash import PAD_ID, PackedSketches
 
     rng = np.random.default_rng(11)
@@ -29,18 +32,29 @@ def planted_packed():
         for _ in range(4)
     ]
     for i in range(N):
-        ids[i] = np.sort(rng.choice(pools[i % 4], size=S, replace=False))
+        g = (i * 4 // N) if contiguous else (i % 4)
+        ids[i] = np.sort(rng.choice(pools[g], size=S, replace=False))
         counts[i] = S
     return PackedSketches(ids=ids, counts=counts, names=[f"g{i}" for i in range(N)])
 
 
-def run(ckpt_dir: str):
-    """(ii, jj, dd, pairs_computed, labels) for the planted set."""
+def run(ckpt_dir: str, prune: bool = False, contiguous: bool | None = None):
+    """(ii, jj, dd, pairs_computed, labels) for the planted set.
+    `prune=True` routes the walk through the LSH candidate bitmap
+    (ops/lsh.py) over the contiguous layout (where tiles actually skip);
+    pass `contiguous=True` with `prune=False` to compute the pruned
+    test's DENSE oracle on the same data."""
     from drep_tpu.parallel.streaming import connected_components, streaming_mash_edges
 
-    packed = planted_packed()
+    packed = planted_packed(contiguous=prune if contiguous is None else contiguous)
+    prune_set = None
+    if prune:
+        from drep_tpu.ops.lsh import build_candidates
+
+        prune_set = build_candidates(packed, keep=CUTOFF, k=K)
     ii, jj, dd, pairs = streaming_mash_edges(
-        packed, k=K, cutoff=CUTOFF, block=BLOCK, checkpoint_dir=ckpt_dir
+        packed, k=K, cutoff=CUTOFF, block=BLOCK, checkpoint_dir=ckpt_dir,
+        prune=prune_set,
     )
     labels = connected_components(N, ii, jj)
     return ii, jj, dd, pairs, labels
@@ -48,11 +62,12 @@ def run(ckpt_dir: str):
 
 def main() -> None:
     ckpt_dir, out_path = sys.argv[1], sys.argv[2]
+    prune = len(sys.argv) > 3 and sys.argv[3] == "prune"
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    ii, jj, dd, pairs, labels = run(ckpt_dir)
+    ii, jj, dd, pairs, labels = run(ckpt_dir, prune=prune)
     np.savez(out_path, ii=ii, jj=jj, dd=dd, pairs=pairs, labels=labels)
 
 

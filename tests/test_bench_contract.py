@@ -110,6 +110,69 @@ def test_killed_bench_leaves_readable_records_per_completed_stage(tmp_path, monk
     assert merged["stages"]["primary"]["pairs_per_sec_per_chip"] == 123.0
 
 
+def test_bench_tpuless_default_runs_proxy_and_exits_zero(tmp_path):
+    """ISSUE 7 acceptance: `python bench.py` on a TPU-less machine exits
+    0 with durable per-stage records for the CPU-runnable stages — the
+    default hardware plan degrades to the proxy suite (clearly marked,
+    value stays null) instead of wedging or erroring, and the merged
+    round file lands."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path), timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, r.stdout  # the one-line driver contract holds
+    doc = json.loads(lines[0])
+    assert doc["value"] is None  # proxies are NOT a throughput claim
+    rec = doc["stages"]["proxy_metrics"]
+    proxies = rec["proxy_metrics"]
+    assert proxies["pruned_edges_equal_dense"] is True
+    assert proxies["skip_fraction"] > 0
+    assert 0 < proxies["tile_fraction"] < 0.6
+    assert "checksum_overhead_frac" in proxies
+    assert "pairs_per_sec_per_chip" not in str(rec)
+    # durable records + auto-merged round file
+    assert (tmp_path / ".bench_stages" / "proxy_metrics.json").exists()
+    merged = json.loads((tmp_path / "BENCH_merged.json").read_text())
+    assert "proxy_metrics" in merged["stages"]
+    # ... and the merge tooling refuses proxies as measured hardware perf
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "missing_stages", str(REPO / "tools" / "missing_stages.py")
+    )
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+    assert set(ms.missing(merged)) == set(ms.PLAN_TO_RECORD)
+    # a proxy-carrying record can never satisfy a hardware stage either
+    fake = {
+        "stages": {"primary": {"proxy_metrics": proxies}},
+        "stage_provenance": {"primary": {"attempt": 1, "link": {
+            "dispatch_ms_median": 1.0, "h2d_gbps": 1.0, "d2h_gbps": 1.0}}},
+    }
+    assert "primary" in ms.missing(fake)
+
+
+def test_bench_probe_failure_contained_to_subprocess(tmp_path):
+    """A backend that cannot even initialize (stand-in for the wedged
+    tunnel) costs only the probe child: the parent falls back to a
+    CPU-pinned probe, records the failure as backend_probe evidence, and
+    the CPU-runnable plan still completes with rc 0."""
+    env = dict(os.environ, JAX_PLATFORMS="no_such_platform", PYTHONPATH=str(REPO))
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--stages", "proxy"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path), timeout=900,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    doc = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "error" in doc["stages"]["backend_probe"]
+    assert doc["stages"]["proxy_metrics"]["proxy_metrics"]["skip_fraction"] > 0
+
+
 def test_stage_record_preference_and_version_gate(tmp_path, monkeypatch):
     """Within a version the shared prefer_new rule keeps the better
     record (best-of, error never shadows success); records from an older

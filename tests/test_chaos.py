@@ -173,6 +173,61 @@ def test_sigkill_mid_streaming_run_resumes_bit_identical(tmp_path):
     assert 0 < pairs < oracle[3], (pairs, oracle[3])
 
 
+def test_sigkill_mid_pruned_streaming_resumes_bit_identical(tmp_path):
+    """The pruned schedule's crash story (ISSUE 7, chaos_matrix --prune
+    cell): SIGKILL a --primary_prune lsh run mid-flight; the pruned
+    resume completes the missing stripes and the result is bit-identical
+    to an uninterrupted DENSE run on the same data — kill/resume and
+    pruning compose, with recall 1.0 intact across the crash."""
+    ckpt = str(tmp_path / "ckpt")
+
+    # the oracle is the DENSE schedule on the same contiguous-group data:
+    # equality proves the pruned resume dropped nothing
+    oracle = cw.run(str(tmp_path / "oracle_ckpt"), prune=False, contiguous=True)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DREP_TPU_FAULTS"] = "streaming_tile:sleep:1.0:secs=0.25"
+    out_npz = str(tmp_path / "killed.npz")
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, ckpt, out_npz, "prune"],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            shards = [f for f in os.listdir(ckpt)] if os.path.isdir(ckpt) else []
+            if sum(f.startswith("row_") and f.endswith(".npz") for f in shards) >= 2:
+                break
+            if proc.poll() is not None:
+                out = proc.communicate()[0].decode(errors="replace")
+                pytest.fail(f"worker finished before the kill (pacing broken?):\n{out}")
+            time.sleep(0.02)
+        else:
+            proc.kill()
+            out = proc.communicate()[0].decode(errors="replace")
+            pytest.fail(f"no shards appeared within the deadline:\n{out}")
+        proc.send_signal(signal.SIGKILL)
+        proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+    assert proc.returncode == -signal.SIGKILL
+    assert not os.path.exists(out_npz), "worker published results despite the kill"
+
+    counters.reset()
+    ii, jj, dd, pairs, labels = cw.run(ckpt, prune=True)
+    _assert_edges_equal((ii, jj, dd), oracle[:3])
+    assert np.array_equal(labels, oracle[4])
+    assert pairs < oracle[3], (pairs, oracle[3])  # resumed stripes: 0 pairs
+    # the pruned resume kept skipping: the schedule stayed sparse
+    assert counters.gauges.get("skip_fraction", 0.0) > 0.0
+
+
 # --- injected per-tile failures: retries, quarantine, watchdog ----------
 
 

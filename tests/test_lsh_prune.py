@@ -1,0 +1,338 @@
+"""LSH-banded candidate pruning (ops/lsh.py): the recall-1.0 contract.
+
+The pruned streaming primary must be BIT-EQUAL in retained edges to the
+dense schedule — over seeded genome sets, several band configs, and
+adversarially-constructed near-threshold pairs — because the candidate
+threshold is DERIVED from the retention bound (the module docstring's
+pigeonhole argument), not tuned. These tests are the equivalence suite
+the `--primary_prune` default stays "off" behind.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from drep_tpu.errors import UserInputError
+from drep_tpu.ops.lsh import (
+    CandidateSet,
+    build_candidates,
+    derive_min_shared,
+    jaccard_floor,
+)
+from drep_tpu.ops.minhash import (
+    PAD_ID,
+    PackedSketches,
+    all_vs_all_mash,
+    mash_distance_from_jaccard,
+)
+from drep_tpu.parallel.streaming import (
+    retention_bound,
+    streaming_mash_edges,
+    streaming_primary_clusters,
+)
+from drep_tpu.utils.profiling import counters
+
+
+def _clusterable_packed(n=64, s=64, groups=8, seed=0, contiguous=True):
+    """The shared group-pool planting recipe (utils/synth.py): contiguous
+    = the realistic post-sort order where pruning actually skips tiles,
+    interleaved = every tile occupied (the worst case)."""
+    from drep_tpu.utils.synth import planted_group_sketches
+
+    return planted_group_sketches(
+        n=n, s=s, groups=groups, seed=seed, contiguous=contiguous
+    )
+
+
+def _edges_equal(got, want):
+    for g, w in zip(got[:3], want[:3]):
+        assert np.asarray(g).tobytes() == np.asarray(w).tobytes()
+
+
+BAND_CONFIGS = [(0, 0), (0, 1), (16, 0), (64, 0), (0, 2)]
+
+
+@pytest.mark.parametrize("seed", [0, 3, 11])
+@pytest.mark.parametrize("bands,min_shared", BAND_CONFIGS)
+def test_pruned_edges_bit_equal_dense(seed, bands, min_shared):
+    """THE equivalence property: pruned streaming edges == dense streaming
+    edges, bit for bit, over seeded genome sets and band configs."""
+    packed = _clusterable_packed(seed=seed)
+    keep = 0.2
+    want = streaming_mash_edges(packed, k=21, cutoff=keep, block=8)
+    cand = build_candidates(
+        packed, keep=keep, k=21, bands=bands, min_shared=min_shared
+    )
+    got = streaming_mash_edges(packed, k=21, cutoff=keep, block=8, prune=cand)
+    _edges_equal(got, want)
+
+
+@pytest.mark.parametrize("keep", [0.05, 0.115, 0.25])
+def test_candidates_cover_all_retained_pairs(keep):
+    """Recall 1.0 against the dense oracle: every pair with d <= keep is
+    a candidate (interleaved layout so nothing hides behind tile
+    granularity — this checks the PAIR set, not the tile walk)."""
+    packed = _clusterable_packed(contiguous=False, seed=5)
+    dist, _ = all_vs_all_mash(packed, k=21)
+    retained = {
+        (i, j)
+        for i in range(packed.n)
+        for j in range(i + 1, packed.n)
+        if dist[i, j] <= keep
+    }
+    for bands, min_shared in BAND_CONFIGS:
+        cand = build_candidates(
+            packed, keep=keep, k=21, bands=bands, min_shared=min_shared
+        )
+        got = set(zip(cand.ii.tolist(), cand.jj.tolist()))
+        missing = retained - got
+        assert not missing, (
+            f"bands={bands} min_shared={min_shared}: {len(missing)} retained "
+            f"pairs pruned — recall < 1.0: {sorted(missing)[:5]}"
+        )
+
+
+def test_adversarial_near_threshold_pairs():
+    """Pairs engineered to straddle the derived shared-count threshold:
+    genome pairs (2p, 2p+1) share exactly m in 0..6 of their s=64 hashes
+    (disjoint value ranges per pair so nothing else collides). At
+    keep=0.115 / k=21 the derivation gives T=3 — every pair at or inside
+    the gate must survive pruning, and the pruned edge walk must still
+    be bit-equal to dense."""
+    s, k, keep = 64, 21, 0.115
+    t = int(derive_min_shared(keep, k, s)[()])
+    assert t == 3  # the derivation this test was built against
+    n_pairs = 7
+    ids = np.full((2 * n_pairs, s), PAD_ID, np.int32)
+    for p in range(n_pairs):
+        base = 100_000 * p  # disjoint value range per pair
+        shared = np.arange(base, base + p, dtype=np.int32)
+        own_a = np.arange(base + 1_000, base + 1_000 + s - p, dtype=np.int32)
+        own_b = np.arange(base + 2_000, base + 2_000 + s - p, dtype=np.int32)
+        ids[2 * p] = np.sort(np.concatenate([shared, own_a]))
+        ids[2 * p + 1] = np.sort(np.concatenate([shared, own_b]))
+    packed = PackedSketches(
+        ids=ids, counts=np.full(2 * n_pairs, s, np.int32),
+        names=[f"g{i}" for i in range(2 * n_pairs)],
+    )
+    dist, _ = all_vs_all_mash(packed, k=k)
+    cand = build_candidates(packed, keep=keep, k=k)
+    got = set(zip(cand.ii.tolist(), cand.jj.tolist()))
+    for p in range(n_pairs):
+        pair = (2 * p, 2 * p + 1)
+        if dist[pair] <= keep:
+            assert pair in got, f"retained boundary pair {pair} (m={p}) pruned"
+    # sanity on the construction: the gate actually separates the pairs
+    assert dist[0, 1] > keep and dist[12, 13] <= keep
+    want = streaming_mash_edges(packed, k=k, cutoff=keep, block=4)
+    pruned = streaming_mash_edges(packed, k=k, cutoff=keep, block=4, prune=cand)
+    _edges_equal(pruned, want)
+
+
+def test_derivation_is_sound_brute_force(rng):
+    """For random sketch pairs: d <= keep implies the two PACKED rows
+    share >= derive_min_shared(keep, k, s_use) ids — the inequality the
+    whole recall proof stands on, checked directly against the
+    estimator's own distances."""
+    s, k = 48, 21
+    packed = _clusterable_packed(n=40, s=s, groups=4, seed=7)
+    dist, _ = all_vs_all_mash(packed, k=k)
+    for keep in (0.03, 0.1, 0.2, 0.4):
+        t = derive_min_shared(keep, k, np.minimum(packed.counts, s))
+        for i in range(packed.n):
+            for j in range(i + 1, packed.n):
+                if dist[i, j] <= keep:
+                    a = packed.ids[i][packed.ids[i] != PAD_ID]
+                    b = packed.ids[j][packed.ids[j] != PAD_ID]
+                    shared = len(np.intersect1d(a, b))
+                    tij = min(int(t[i]), int(t[j]))
+                    assert shared >= tij, (keep, i, j, shared, tij)
+
+
+def test_jaccard_floor_inverts_mash_distance():
+    """jaccard_floor is the (safety-margined) inverse of the Mash
+    distance at the bound: d(j_min) <= keep for every keep in (0, 1),
+    and keep >= 1 prunes nothing (floor 0)."""
+    for keep in (0.01, 0.1, 0.25, 0.5, 0.99):
+        jm = jaccard_floor(keep, 21)
+        assert 0.0 < jm < 1.0
+        d = float(mash_distance_from_jaccard(np.float64(jm), 21, xp=np))
+        # the safety margin pushes j_min DOWN, so d(j_min) sits at-or-
+        # just-above keep (conservative: nothing at d == keep is pruned)
+        assert keep - 1e-12 <= d <= keep + 1e-4
+    assert jaccard_floor(1.0, 21) == 0.0
+    assert derive_min_shared(1.0, 21, 1000)[()] == 1  # floor never below 1
+
+
+def test_occupancy_bitmap_covers_every_candidate():
+    packed = _clusterable_packed()
+    cand = build_candidates(packed, keep=0.2, k=21)
+    block, n_blocks = 8, 8
+    occ = cand.occupancy(block, n_blocks)
+    for i, j in zip(cand.ii, cand.jj):
+        assert occ[i // block, j // block]
+    # only the scheduled (upper-triangle) half is ever set
+    assert not np.tril(occ, -1).any()
+
+
+def test_skip_fraction_and_dense_equivalent_totals():
+    """Accounting honesty: tiles_total stays the dense-equivalent grid,
+    skipped tiles land in tiles_skipped_pruned, the skip_fraction gauge
+    is > 0 on clusterable (group-contiguous) data, and pairs_computed
+    counts only dispatched tiles."""
+    packed = _clusterable_packed()
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    counters.reset()
+    cand = build_candidates(packed, keep=0.2, k=21)
+    got = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, prune=cand)
+    _edges_equal(got, want)
+    st = counters.report()["stages"]["primary_compare"]
+    assert st["tiles_total"] == 64  # dense-equivalent full grid (8x8)
+    assert st["tiles_computed"] + st["tiles_skipped_pruned"] == 36  # triangle
+    assert st["tiles_skipped_pruned"] > 0
+    assert 0.0 < st["skip_fraction"] < 1.0
+    assert counters.gauges["skip_fraction"] == st["skip_fraction"]
+    assert 0 < got[3] < want[3]  # pairs: only dispatched tiles counted
+
+
+def test_no_pruning_accounting_when_off():
+    """prune=None must leave the pruning counters untouched: no
+    skip_fraction gauge, no tiles_skipped_pruned in the report — the
+    zero-overhead-when-off contract's accounting half."""
+    packed = _clusterable_packed()
+    counters.reset()
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    assert "skip_fraction" not in counters.gauges
+    assert "tiles_skipped_pruned" not in counters.report()["stages"]["primary_compare"]
+
+
+def test_prune_param_mismatch_refuses_resume(tmp_path):
+    """A checkpoint store written under one banding config must refuse —
+    actionably, without clearing shards — a resume under another
+    (including pruned -> off and off -> pruned)."""
+    packed = _clusterable_packed()
+    keep = 0.2
+    ck = str(tmp_path / "ck")
+    cand = build_candidates(packed, keep=keep, k=21)
+    streaming_mash_edges(packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck, prune=cand)
+    shards_before = sorted(f for f in os.listdir(ck) if f.endswith(".npz"))
+    cand16 = build_candidates(packed, keep=keep, k=21, bands=16)
+    with pytest.raises(UserInputError, match="pruning parameters"):
+        streaming_mash_edges(
+            packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck, prune=cand16
+        )
+    with pytest.raises(UserInputError, match="pruning parameters"):
+        streaming_mash_edges(packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck)
+    # refusal never destroys the store
+    assert sorted(f for f in os.listdir(ck) if f.endswith(".npz")) == shards_before
+    # ... and the matching config still resumes without recomputing
+    got = streaming_mash_edges(
+        packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck, prune=cand
+    )
+    assert got[3] == 0
+    # off -> pruned over an UNPRUNED store refuses too
+    ck2 = str(tmp_path / "ck2")
+    streaming_mash_edges(packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck2)
+    with pytest.raises(UserInputError, match="pruning parameters"):
+        streaming_mash_edges(
+            packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck2, prune=cand
+        )
+
+
+def test_pruned_resume_after_partial_run_is_bit_identical(tmp_path):
+    """Shards from a pruned run resume into the identical edge set (the
+    non-chaos half of the SIGKILL cell): delete two mid-run shards, rerun
+    pruned, compare against the dense oracle."""
+    import glob
+
+    packed = _clusterable_packed()
+    keep = 0.2
+    want = streaming_mash_edges(packed, k=21, cutoff=keep, block=8)
+    ck = str(tmp_path / "ck")
+    cand = build_candidates(packed, keep=keep, k=21)
+    streaming_mash_edges(packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck, prune=cand)
+    shards = sorted(glob.glob(os.path.join(ck, "row_*.npz")))
+    os.remove(shards[1])
+    os.remove(shards[3])
+    got = streaming_mash_edges(
+        packed, k=21, cutoff=keep, block=8, checkpoint_dir=ck, prune=cand
+    )
+    _edges_equal(got, want)
+
+
+def test_streaming_primary_clusters_prune_partition_identical():
+    """The clustering entry point: identical partition (and identical
+    retained-edge payload) with pruning on vs off, both linkage families."""
+    packed = _clusterable_packed()
+    for alg in ("average", "single"):
+        l0, e0, _ = streaming_primary_clusters(
+            packed, k=21, p_ani=0.9, block=8, keep_dist=0.25, cluster_alg=alg
+        )
+        l1, e1, _ = streaming_primary_clusters(
+            packed, k=21, p_ani=0.9, block=8, keep_dist=0.25, cluster_alg=alg,
+            primary_prune="lsh",
+        )
+        assert np.array_equal(l0, l1)
+        _edges_equal(e1, e0)
+
+
+def test_prune_via_controller_identical_cdb(tmp_path, genome_paths):
+    """--primary_prune lsh end to end through the cluster controller:
+    identical Cdb to the unpruned streaming run on the fixture genomes."""
+    from drep_tpu.workflows import compare_wrapper
+
+    off = compare_wrapper(
+        str(tmp_path / "wd_off"), genome_paths,
+        streaming_primary=True, skip_plots=True,
+    )
+    on = compare_wrapper(
+        str(tmp_path / "wd_on"), genome_paths,
+        streaming_primary=True, primary_prune="lsh", skip_plots=True,
+    )
+    key = ["genome", "primary_cluster", "secondary_cluster"]
+    assert (
+        on.sort_values("genome")[key].reset_index(drop=True)
+        .equals(off.sort_values("genome")[key].reset_index(drop=True))
+    )
+
+
+def test_index_update_prune_matches_unpruned(tmp_path):
+    """ROADMAP service-mode follow-on (a): `index update` consumes the
+    same candidate set — the pruned rect compare admits an identical
+    generation (labels, winners, edge payload) to the unpruned one."""
+    import _index_testlib as tl
+    from drep_tpu.index import index_update
+    from drep_tpu.index.store import load_index
+    from drep_tpu.workflows import index_build_wrapper
+
+    paths = tl.write_genome_set(str(tmp_path / "fa"), [3, 2, 3, 2], seed=4)
+    for tag, prune in (("off", "off"), ("lsh", "lsh")):
+        loc = str(tmp_path / f"idx_{tag}")
+        index_build_wrapper(loc, genomes=paths[:5], length=0)  # 6 kb toys
+        index_update(loc, paths[5:], primary_prune=prune)
+    a = load_index(str(tmp_path / "idx_off"))
+    b = load_index(str(tmp_path / "idx_lsh"))
+    assert tl.primary_partition(a) == tl.primary_partition(b)
+    assert tl.winners_by_members(a) == tl.winners_by_members(b)
+    for arr_a, arr_b in zip(a.edges, b.edges):
+        assert np.array_equal(arr_a, arr_b)
+
+
+def test_restrict_min_col_and_empty_candidates():
+    packed = _clusterable_packed()
+    cand = build_candidates(packed, keep=0.2, k=21, min_col=48)
+    assert (cand.jj >= 48).all()
+    # a fully-pruned walk (no candidates at all) returns zero edges and
+    # skips every tile — the degenerate-but-correct extreme
+    empty = CandidateSet(
+        ii=np.empty(0, np.int64), jj=np.empty(0, np.int64), n=packed.n,
+        params={"prune_scheme": "lsh", "prune_bands": 0,
+                "prune_min_shared": 0, "prune_keep": 0.0},
+    )
+    counters.reset()
+    ii, jj, dd, pairs = streaming_mash_edges(
+        packed, k=21, cutoff=1e-9, block=8, prune=empty
+    )
+    assert len(ii) == 0 and pairs == 0
+    assert counters.gauges["skip_fraction"] == 1.0

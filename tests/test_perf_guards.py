@@ -125,6 +125,51 @@ def test_streaming_fault_layer_zero_overhead_when_unset(rng, tmp_path):
     assert dt_auto < 20.0, f"auto-watchdog warm pass took {dt_auto:.1f}s — thread-spawn overhead?"
 
 
+def test_prune_skip_fraction_and_zero_overhead_when_off(rng):
+    """The LSH pruning guard (ISSUE 7): on clusterable group-contiguous
+    data the pruned schedule must actually skip tiles (skip_fraction > 0,
+    strictly fewer pairs dispatched) while staying bit-equal to the dense
+    pass; with --primary_prune off (prune=None, the default) the walk
+    must carry ZERO pruning artifacts — no skip gauge, no skipped-tile
+    counter, no fault events — and stay inside the same warm wall bound
+    as the zero-overhead fault-layer guard (the off path adds one
+    `occ is None` check per tile and nothing else)."""
+    from drep_tpu.ops.lsh import build_candidates
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.profiling import counters
+    from drep_tpu.utils.synth import planted_group_sketches
+
+    packed = planted_group_sketches(n=256, s=64, groups=16, seed=0)
+
+    faults.configure(None)
+    streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)  # warm the jits
+    counters.reset()
+    before = dict(counters.faults)
+
+    t0 = time.perf_counter()
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    dt_off = time.perf_counter() - t0
+    assert counters.faults == before, "fault events on the pruning-off path"
+    assert "skip_fraction" not in counters.gauges
+    rep = counters.report()["stages"]["primary_compare"]
+    assert "tiles_skipped_pruned" not in rep
+    assert dt_off < 20.0, f"528-tile warm off-pass took {dt_off:.1f}s"
+
+    cand = build_candidates(packed, keep=0.2, k=21)
+    counters.reset()
+    got = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8, prune=cand)
+    for g, w in zip(got[:3], want[:3]):
+        assert np.asarray(g).tobytes() == np.asarray(w).tobytes()
+    assert got[3] < want[3], "pruning dispatched as many pairs as dense"
+    st = counters.report()["stages"]["primary_compare"]
+    assert st["tiles_skipped_pruned"] > 0
+    assert counters.gauges["skip_fraction"] > 0.4, (
+        f"clusterable data skipped only {counters.gauges['skip_fraction']:.0%} "
+        f"of the schedule — pruning is not engaging"
+    )
+
+
 def test_checksummed_store_overhead_within_5pct(rng, tmp_path, monkeypatch):
     """The durable-I/O layer's checksum+atomic-write cost on the 528-tile
     warm checkpointed pass must stay <= 5% of the same pass with checksums

@@ -22,6 +22,12 @@ Two tiers:
   on resume, ENOSPC degrading into the actionable StoreFullError, and
   the scrub-then-resume loop (tools/scrub_store.py detects, ``--delete``
   quarantines, the next run recomputes) — seconds each, in-process.
+- pruned-schedule cells (``--prune``): the LSH-banded candidate pruning
+  (ISSUE 7, ops/lsh.py) — SIGKILL mid-pruned-run resuming bit-identical
+  to the DENSE oracle (pytest-delegated), a banding-param mismatch on
+  resume refusing with an actionable error (shards untouched), and
+  ``io:corrupt`` bit rot on a pruned shard healing through the existing
+  recompute path. CPU-only, seconds each.
 - index cells (``--index``): the incremental service mode (ISSUE 6,
   drep_tpu/index/) — SIGKILL mid-``index update`` (pre-publish and
   mid-rect-compare) followed by a rerun converging on the uninterrupted
@@ -301,6 +307,95 @@ def _io_cells():
     ]
 
 
+# --- pruned-schedule cells (--prune): ISSUE 7 --------------------------
+
+
+def _prune_packed(n=48, s=64, seed=0):
+    """Group-CONTIGUOUS clusterable sketches — the layout where the LSH
+    candidate bitmap actually skips tiles (the shared planting recipe,
+    utils/synth.py)."""
+    from drep_tpu.utils.synth import planted_group_sketches
+
+    return planted_group_sketches(n=n, s=s, groups=5, seed=seed)
+
+
+def _prune_mismatch_refuses():
+    """Changed banding params on resume must refuse with the actionable
+    error — never silently clear or mix shards."""
+    import tempfile
+
+    from drep_tpu.errors import UserInputError
+    from drep_tpu.ops.lsh import build_candidates
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+
+    packed = _prune_packed()
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "ckpt")
+        cand = build_candidates(packed, keep=0.2, k=21)
+        streaming_mash_edges(
+            packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt, prune=cand
+        )
+        shards = sorted(f for f in os.listdir(ckpt) if f.endswith(".npz"))
+        cand16 = build_candidates(packed, keep=0.2, k=21, bands=16)
+        _expect_raise(
+            UserInputError,
+            lambda: streaming_mash_edges(
+                packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt, prune=cand16
+            ),
+        )
+        assert sorted(
+            f for f in os.listdir(ckpt) if f.endswith(".npz")
+        ) == shards, "refusal cleared shards"
+
+
+def _prune_corrupt_heals(spec):
+    """io:corrupt bit rot on a PRUNED run's shard: the resume must heal
+    it through the existing recompute path, with edges bit-equal to the
+    dense oracle."""
+    import tempfile
+
+    from drep_tpu.ops.lsh import build_candidates
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+    from drep_tpu.utils import faults
+    from drep_tpu.utils.profiling import counters as _c
+
+    packed = _prune_packed()
+    want = streaming_mash_edges(packed, k=21, cutoff=0.2, block=8)
+    cand = build_candidates(packed, keep=0.2, k=21)
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "ckpt")
+        faults.configure(spec)
+        try:
+            streaming_mash_edges(
+                packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt, prune=cand
+            )
+        finally:
+            faults.configure(None)
+        got = streaming_mash_edges(
+            packed, k=21, cutoff=0.2, block=8, checkpoint_dir=ckpt, prune=cand
+        )
+        assert all(
+            a.tobytes() == b.tobytes() for a, b in zip(got[:3], want[:3])
+        ), "healed pruned edges differ from the dense oracle"
+        assert _c.faults.get("corrupt_shards_healed", 0) >= 1, _c.faults
+
+
+def _prune_cells():
+    return [
+        ("prune_meta", "mismatch", "banding params changed on resume -> refuse",
+         "abort", _prune_mismatch_refuses),
+        ("io", "corrupt", "bit-rot on a pruned shard -> heal, dense-equal",
+         "survive", lambda: _prune_corrupt_heals("io:corrupt:1.0:max=1:path=row_")),
+    ]
+
+
+# the SIGKILL cell needs a subprocess victim — delegate to its pytest test
+PRUNE_PYTEST_CELLS = [
+    ("process_death", "kill", "SIGKILL mid-pruned-run -> resume bit-identical to dense",
+     "survive", "tests/test_chaos.py::test_sigkill_mid_pruned_streaming_resumes_bit_identical"),
+]
+
+
 # index cells (--index): the incremental service mode's crash/rot story
 # (ISSUE 6). Both delegate to their pytest chaos tests — the SIGKILL cell
 # needs a subprocess victim, and the corrupt cell shares its oracle
@@ -338,12 +433,15 @@ def main() -> int:
     pod = "--pod" in sys.argv
     io_cells = "--io" in sys.argv
     index_cells = "--index" in sys.argv
+    prune_cells = "--prune" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
 
     cells = _cells()
     if io_cells:
         cells += _io_cells()
+    if prune_cells:
+        cells += _prune_cells()
     rows = []
     failures = 0
     for site, mode, label, expected, run in cells:
@@ -375,6 +473,7 @@ def main() -> int:
             failures += rc != 0
             rows.append((site, mode, label, expected, verdict))
 
+    _pytest_cells(PRUNE_PYTEST_CELLS, "--prune", prune_cells)
     _pytest_cells(INDEX_CELLS, "--index", index_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)
 

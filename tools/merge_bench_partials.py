@@ -47,7 +47,12 @@ def _rate(rec) -> float | None:
     return max(nested) if nested else None
 
 
-def load_attempts(pattern: str) -> list[tuple[int, dict]]:
+def load_attempts(pattern: str, with_paths: bool = False):
+    """(attempt_number, record) pairs for every readable partial matching
+    `pattern` — or (attempt_number, record, path) triples with
+    `with_paths=True`, so the CLI can REPORT exactly which files it
+    consumed (the r04 strays sat in the repo root for two rounds because
+    nothing ever said what had already been folded in)."""
     out = []
     for path in glob.glob(pattern):
         m = re.search(r"attempt(\d+)", os.path.basename(path))
@@ -59,12 +64,14 @@ def load_attempts(pattern: str) -> list[tuple[int, dict]]:
         except Exception:
             continue  # unreadable partial: nothing to merge from it
         if rec.get("stages"):
-            out.append((int(m.group(1)), rec))
+            out.append((int(m.group(1)), rec, path))
     # key on the attempt number ONLY: an attempt can leave two files (its
     # emitted partial plus a preserved killed-partial), and bare tuple
     # sorting would fall through to comparing the dicts — a TypeError
     out.sort(key=lambda t: t[0])
-    return out  # ascending attempt order; later overwrites earlier
+    if with_paths:
+        return out  # ascending attempt order; later overwrites earlier
+    return [(n, rec) for n, rec, _ in out]
 
 
 def prefer_new(old, new) -> bool:
@@ -175,19 +182,27 @@ def main() -> None:
     if args.out is None:
         m = re.search(r"BENCH_r(\d+)", args.pattern)
         args.out = f"BENCH_r{int(m.group(1)):02d}_merged.json" if m else "BENCH_merged.json"
-    attempts = load_attempts(args.pattern)
-    if not attempts:
+    triples = load_attempts(args.pattern, with_paths=True)
+    if not triples:
         raise SystemExit(f"no partials match {args.pattern}")
-    merged = merge(attempts)
+    merged = merge([(n, rec) for n, rec, _ in triples])
+    # provenance: WHICH files fed this artifact — once folded in, the
+    # source partials are safe to delete (this note replaces them)
+    merged["merged_from_files"] = [os.path.basename(p) for _, _, p in triples]
     with open(args.out, "w") as f:
         json.dump(merged, f, indent=1)
         f.write("\n")
     covered = [k for k in merged["stages"] if not k.endswith("_error")]
     failed = [k for k in merged["stages"] if k.endswith("_error")]
     print(
-        f"merged {len(attempts)} attempts -> {args.out}: "
+        f"merged {len(triples)} attempts -> {args.out}: "
         f"{len(covered)} stage records ({', '.join(sorted(covered))})"
         + (f"; unresolved failures: {', '.join(sorted(failed))}" if failed else "")
+    )
+    print(
+        "consumed: "
+        + ", ".join(os.path.basename(p) for _, _, p in triples)
+        + " (recorded in merged_from_files; the source partials may now be deleted)"
     )
 
 

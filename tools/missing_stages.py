@@ -108,6 +108,15 @@ def missing(merged: dict) -> list[str]:
             # then leaves a number-free record that must not count as
             # done (ADVICE r4 medium)
             and not rec.get("measurement_pending")
+            # CPU-proxy records (bench_proxy, emitted when no accelerator
+            # is reachable) characterize the scheduling/storage layers —
+            # they are NOT hardware throughput and must never satisfy a
+            # hardware stage or read as a speedup claim
+            and not rec.get("proxy_metrics")
+            # a hardware stage that RAN on a non-TPU backend (wedged-
+            # tunnel cpu fallback, forced JAX_PLATFORMS=cpu) carries a
+            # `backend` stamp — its rate is not a chip measurement
+            and rec.get("backend", "tpu") == "tpu"
         )
         if not ok or not _link_ok(prov.get(key, {}).get("link")):
             out.append(plan)
