@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                                "value lowers it (1 = most conservative). Values "
                                "above the derivation are clamped down — they "
                                "would break the recall-1.0 contract")
+        clus.add_argument("--prune_join_chunk", type=int, default=0,
+                          help="memory bound (in candidate codes) for the LSH "
+                               "bucket join's host expansion: 0 (default) joins "
+                               "everything in one pass; >0 chunks the expansion "
+                               "and folds counts incrementally — identical "
+                               "candidate set, bounded host RSS (for >1M-genome "
+                               "runs on thin hosts)")
 
         warn = p.add_argument_group("WARNINGS")
         warn.add_argument("--warn_dist", type=float, default=0.25)
@@ -142,6 +149,21 @@ def build_parser() -> argparse.ArgumentParser:
                               "as derived_ring_step_timeout_s). Results are "
                               "bit-identical either way; env "
                               "DREP_TPU_RING_MONOLITHIC=1 also forces it")
+        tpu.add_argument("--ring_comm", default="auto",
+                         choices=["auto", "ppermute", "pallas_dma"],
+                         help="dense-ring rotation backend: 'pallas_dma' fuses "
+                              "the ICI rotation into the compare kernel "
+                              "(ops/pallas_ring.py — the neighbor transfer "
+                              "rides a Pallas async remote DMA hidden behind "
+                              "the tile compute); 'ppermute' is the shard_map "
+                              "reference. 'auto' (default) picks pallas_dma "
+                              "only on a real TPU after a one-time on-device "
+                              "self-check proves bit-equality — block tiles, "
+                              "checkpoints, and elastic fallback are identical "
+                              "either way. Env DREP_TPU_RING_COMM also "
+                              "accepted (plus 'pallas_interpret', the CPU "
+                              "equality oracle for tests/bench — never a "
+                              "performance mode)")
         tpu.add_argument("--io_retries", type=int, default=None,
                          help="transient shared-filesystem I/O errors "
                               "(EIO/ESTALE/ETIMEDOUT) retried per durable "
@@ -255,6 +277,9 @@ def build_parser() -> argparse.ArgumentParser:
     u.add_argument("--prune_min_shared", type=int, default=0,
                    help="conservative candidate-threshold floor (0 = "
                         "auto-derive; same semantics as the pipeline flag)")
+    u.add_argument("--prune_join_chunk", type=int, default=0,
+                   help="memory bound for the bucket join's host expansion "
+                        "(0 = one-pass; same semantics as the pipeline flag)")
 
     c = isub.add_parser(
         "classify",
@@ -263,6 +288,23 @@ def build_parser() -> argparse.ArgumentParser:
              "of indexed genomes)",
     )
     add_index_io(c)
+    c.add_argument("--primary_prune", default="off", choices=["off", "lsh"],
+                   help="LSH-banded candidate pruning for the query-vs-index "
+                        "rect compare: a query-vs-index bucket join restricts "
+                        "the K x N compare to candidate-occupied columns "
+                        "(recall 1.0 at the index's retention bound — "
+                        "verdicts identical to the dense classify). "
+                        "Execution knob only; the index is untouched either "
+                        "way (classify stays read-only)")
+    c.add_argument("--prune_bands", type=int, default=0,
+                   help="LSH band count (0 = per-id buckets; same semantics "
+                        "as the pipeline flag)")
+    c.add_argument("--prune_min_shared", type=int, default=0,
+                   help="conservative candidate-threshold floor (0 = "
+                        "auto-derive; same semantics as the pipeline flag)")
+    c.add_argument("--prune_join_chunk", type=int, default=0,
+                   help="memory bound for the bucket join's host expansion "
+                        "(0 = one-pass; same semantics as the pipeline flag)")
 
     cmp_p = sub.add_parser("compare", help="cluster genomes without dereplicating")
     add_common(cmp_p, with_filter=False, with_scoring=False)

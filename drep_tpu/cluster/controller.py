@@ -75,6 +75,12 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "primary_prune": "off",
     "prune_bands": 0,
     "prune_min_shared": 0,
+    # memory bound (in codes) for the LSH bucket join's host expansion:
+    # 0 = one np.unique over the whole expansion (fine to ~1M genomes on
+    # a fat host); > 0 = chunked incremental fold, identical candidate
+    # set (property-tested), for thin hosts beyond that. Pure execution
+    # knob — never pinned in checkpoint meta, never a _RESUME_KEY.
+    "prune_join_chunk": 0,
     "overlap_ingest": True,
     # fault tolerance (parallel/faulttol.py): retries per failed device
     # dispatch, the per-dispatch watchdog (seconds; 0 = auto-derived from
@@ -97,6 +103,12 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     # collective program kept as the bit-equality reference. Results are
     # bit-identical either way, so it never invalidates a workdir.
     "ring_monolithic": False,
+    # ring rotation backend (parallel/allpairs.py RING_COMM_CHOICES):
+    # "auto" selects the fused pallas DMA step (ops/pallas_ring.py —
+    # ICI rotation overlapped with the tile compute) iff the on-device
+    # self-check validates on a real TPU, else lax.ppermute. Block tiles
+    # are bit-identical across backends, so never a _RESUME_KEY.
+    "ring_comm": "auto",
 }
 
 _RESUME_KEYS = [
@@ -285,6 +297,7 @@ def _primary_clusters(
             primary_prune=kw["primary_prune"],
             prune_bands=kw["prune_bands"],
             prune_min_shared=kw["prune_min_shared"],
+            prune_join_chunk=kw["prune_join_chunk"],
         )
         return labels, None, np.empty((0, 4)), _streaming_mdb(edges, gs.names), pairs_computed
     if kw["primary_prune"] != "off":
@@ -371,9 +384,12 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     # primary/secondary rings kill-resumable and pod-death elastic.
     # --ring_monolithic False maps to None so DREP_TPU_RING_MONOLITHIC
     # can still force the reference program for an A/B check.
+    # --ring_comm "auto" maps to None so DREP_TPU_RING_COMM still governs
+    # (the same deference --ring_monolithic gives its env override)
     configure_ring(
         monolithic=True if kw["ring_monolithic"] else None,
         checkpoint_base=os.path.join(wd.location, "data", "dense_ring"),
+        comm=None if kw["ring_comm"] == "auto" else kw["ring_comm"],
     )
     snapshot = {k: kw.get(k) for k in _RESUME_KEYS if k != "genomes"}
     # normalize: CLI passes 0.25 explicitly, library callers omit it — the

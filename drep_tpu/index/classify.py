@@ -29,13 +29,25 @@ from drep_tpu.utils.logger import get_logger
 
 
 def index_classify(
-    index_loc: str, genome_paths: list[str], processes: int = 1
+    index_loc: str, genome_paths: list[str], processes: int = 1,
+    primary_prune: str = "off", prune_bands: int = 0, prune_min_shared: int = 0,
+    prune_join_chunk: int = 0,
 ) -> list[dict]:
     """One verdict dict per query: the primary/secondary cluster it would
     join, that cluster's winner (would the query itself win?), its nearest
     indexed genome by Mash distance, and whether it is novel (a cluster of
     its own). Queries are classified jointly when several are given — the
-    single-query call is the pure membership lookup."""
+    single-query call is the pure membership lookup.
+
+    ``primary_prune="lsh"`` routes the in-memory K x N rect compare
+    through the SAME LSH candidate set `index update` consumes
+    (update._rect_edges prune_cfg): a query-vs-index bucket join at the
+    index's own retention bound restricts the compare to
+    candidate-occupied column blocks — recall 1.0 by construction, so
+    the retained edges and therefore the VERDICTS are identical to the
+    dense classify (property-tested). A pure execution knob on a
+    read-only operation: nothing about the index (or the answer)
+    changes."""
     from drep_tpu.ingest import sketch_paths
 
     idx = load_index(index_loc, heal=False)
@@ -63,7 +75,13 @@ def index_classify(
     if len(admitted):
         _admit_batch(idx, admitted, results, idx.generation + 1)
         # in-memory rectangular compare: checkpoint_dir None => no writes
-        ii, jj, dd, _pairs = _rect_edges(idx, n_old, None)
+        prune_cfg = {
+            "primary_prune": primary_prune,
+            "prune_bands": prune_bands,
+            "prune_min_shared": prune_min_shared,
+            "prune_join_chunk": prune_join_chunk,
+        }
+        ii, jj, dd, _pairs = _rect_edges(idx, n_old, None, prune_cfg=prune_cfg)
         idx.edges = (
             np.concatenate([idx.edges[0], ii]),
             np.concatenate([idx.edges[1], jj]),

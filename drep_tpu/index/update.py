@@ -98,6 +98,7 @@ def _rect_edges(
             bands=int(prune_cfg.get("prune_bands", 0)),
             min_shared=int(prune_cfg.get("prune_min_shared", 0)),
             min_col=n_old,
+            join_chunk=int(prune_cfg.get("prune_join_chunk", 0)),
         )
     ii, jj, dd, pairs = streaming_mash_edges(
         packed, int(p["kmer_size"]), keep,
@@ -384,6 +385,7 @@ def publish_generation(
 def index_update(
     index_loc: str, genome_paths: list[str] | None, processes: int = 1,
     primary_prune: str = "off", prune_bands: int = 0, prune_min_shared: int = 0,
+    prune_join_chunk: int = 0,
 ) -> dict:
     """`index update`: admit K new genomes (sketch K, compare K x N,
     re-cluster dirty components, re-score touched clusters) and publish
@@ -424,6 +426,7 @@ def index_update(
         "primary_prune": primary_prune,
         "prune_bands": prune_bands,
         "prune_min_shared": prune_min_shared,
+        "prune_join_chunk": prune_join_chunk,
     }
     with counters.stage("index_rect_compare"):
         ii, jj, dd, pairs = _rect_edges(

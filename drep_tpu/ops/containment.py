@@ -146,6 +146,14 @@ def _pair_intersection(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(hit.astype(jnp.int32))
 
 
+def containment_inter_tile_raw(a_ids, b_ids):
+    """The UNJITTED symmetric intersection tile body — shared by
+    :func:`containment_inter_tile` and the fused Pallas ring step
+    (ops/pallas_ring.py traces it inside its own kernel)."""
+    row = jax.vmap(_pair_intersection, in_axes=(None, 0))
+    return jax.vmap(row, in_axes=(0, None))(a_ids, b_ids)
+
+
 @jax.jit
 def containment_inter_tile(a_ids, b_ids):
     """SYMMETRIC intersection-size tile between sketch blocks:
@@ -154,9 +162,7 @@ def containment_inter_tile(a_ids, b_ids):
     (set intersection is symmetric), so mirrored blocks are transposed
     copies, never recomputed. cov/ani derive from counts on host
     (:func:`ani_cov_from_intersections`)."""
-    row = jax.vmap(_pair_intersection, in_axes=(None, 0))
-    tile = jax.vmap(row, in_axes=(0, None))
-    return tile(a_ids, b_ids)
+    return containment_inter_tile_raw(a_ids, b_ids)
 
 
 def containment_to_ani(c, k: int, xp=np):

@@ -39,10 +39,12 @@ the dense walk would have discarded anyway.
 Knobs: ``bands`` (0 = one band per id, the tightest and the only mode
 where the derived count threshold applies; B > 0 = the id space split
 into B equal ranges — coarser keys, smaller join, threshold pinned to
-1) and ``min_shared`` (conservative floor: an explicit value CLAMPS the
+1), ``min_shared`` (conservative floor: an explicit value CLAMPS the
 derived threshold from below-or-equal — 1 is the most conservative;
 values above the derivation would break the recall proof and are
-clamped down with a warning, never honored).
+clamped down with a warning, never honored), and ``join_chunk``
+(memory bound on the bucket join's host expansion — the candidate set
+is identical for every value; see :func:`build_candidates`).
 
 Why this is exact where classic banded MinHash-LSH is probabilistic:
 the textbook scheme bands r-row signature GROUPS and only collides when
@@ -168,6 +170,88 @@ class CandidateSet:
         return occ
 
 
+def _codes(pa, pb, n: int) -> np.ndarray:
+    """int64 pair code ``min*n + max`` — the explicit widening matters:
+    member indices are intp, and on a 32-bit-intp platform ``lo * n``
+    would silently overflow past ~46k genomes (colliding codes = a wrong
+    candidate set, breaking recall without a sound)."""
+    lo = np.minimum(pa, pb).astype(np.int64)
+    hi = np.maximum(pa, pb).astype(np.int64)
+    return lo * np.int64(n) + hi
+
+
+def _iter_pair_codes(starts, sizes, g_sorted, n: int, chunk: int):
+    """Yield int64 pair-code batches (``lo * n + hi`` per within-bucket
+    pair, lo < hi) for the bucket join. ``chunk <= 0`` yields one batch
+    per distinct bucket size (the original expansion); ``chunk > 0``
+    bounds every batch to ~``chunk`` codes: size groups are sliced over
+    buckets, and a HEAVY-HITTER bucket whose own c*(c-1)/2 expansion
+    exceeds the bound is walked row-by-row (anchor x tail, no
+    triu_indices — the index arrays would be as large as the expansion
+    itself), so even one hot band key shared by 100k genomes never
+    materializes more than ~chunk + c codes at once. Batch boundaries
+    never change the multiset of codes, only how much is resident."""
+    for c in np.unique(sizes):
+        if c < 2:
+            continue
+        c = int(c)
+        bucket_starts = starts[sizes == c]
+        pairs_per_bucket = c * (c - 1) // 2
+        if chunk > 0 and pairs_per_bucket > int(chunk):
+            # heavy-hitter buckets: row-wise expansion, flushed at the bound
+            for bs in bucket_starts:
+                members = g_sorted[bs + np.arange(c)]
+                buf: list[np.ndarray] = []
+                held = 0
+                for a_i in range(c - 1):
+                    buf.append(_codes(members[a_i], members[a_i + 1 :], n))
+                    held += c - 1 - a_i
+                    if held >= int(chunk):
+                        yield np.concatenate(buf)
+                        buf, held = [], 0
+                if buf:
+                    yield np.concatenate(buf)
+            continue
+        ai, bi = np.triu_indices(c, 1)
+        step = (
+            len(bucket_starts)
+            if chunk <= 0
+            else max(1, int(chunk) // pairs_per_bucket)
+        )
+        for o in range(0, len(bucket_starts), step):
+            bs = bucket_starts[o : o + step]
+            members = g_sorted[bs[:, None] + np.arange(c)[None, :]]
+            yield _codes(members[:, ai].ravel(), members[:, bi].ravel(), n)
+
+
+def _join_codes(code_batches) -> tuple[np.ndarray, np.ndarray]:
+    """Fold pair-code batches into (unique codes, per-code counts)
+    WITHOUT concatenating the duplicate-heavy expansion: each batch is
+    uniqued locally and two-way SORTED-MERGED into the running
+    accumulator (searchsorted hit/miss + one np.insert — O(output +
+    batch log output) per batch, never a re-sort of the accumulator), so
+    peak memory is O(output + one batch) instead of O(total expanded
+    pairs). Identical output to ``np.unique(concat,
+    return_counts=True)`` (counts are additive over any partition of the
+    multiset) — the property tests pin it."""
+    codes = np.empty(0, np.int64)
+    counts = np.empty(0, np.int64)
+    for batch in code_batches:
+        u, ct = np.unique(batch, return_counts=True)
+        if not len(codes):
+            codes, counts = u, ct.astype(np.int64)
+            continue
+        idx = np.searchsorted(codes, u)
+        hit = (idx < len(codes)) & (codes[np.minimum(idx, len(codes) - 1)] == u)
+        np.add.at(counts, idx[hit], ct[hit])
+        if not hit.all():
+            new_u = u[~hit]
+            pos = np.searchsorted(codes, new_u)
+            codes = np.insert(codes, pos, new_u)
+            counts = np.insert(counts, pos, ct[~hit])
+    return codes, counts
+
+
 def build_candidates(
     packed: PackedSketches,
     keep: float,
@@ -175,6 +259,7 @@ def build_candidates(
     bands: int = 0,
     min_shared: int = 0,
     min_col: int = 0,
+    join_chunk: int = 0,
 ) -> CandidateSet:
     """Banding + bucket join: every pair that can survive the retention
     bound ``keep`` (and, with ``min_col``, reach the rectangular
@@ -186,6 +271,15 @@ def build_candidates(
     retention bound; an explicit value is a conservative floor, clamped
     UP-never (values above the derivation are reduced to it with a
     warning — honoring them would break the recall-1.0 contract).
+    ``join_chunk``: 0 (default) materializes the whole candidate-code
+    expansion and runs ONE ``np.unique`` over it — fine to ~1M genomes
+    on a fat host; > 0 bounds the join's working set to ~that many codes
+    at a time (chunked expansion + incremental sorted-merge fold,
+    :func:`_join_codes`) so thin hosts survive beyond-1M runs. A pure
+    execution knob: the candidate set is IDENTICAL for every value
+    (property-tested), so it is deliberately NOT pinned into the
+    checkpoint meta params — resuming under a different chunk size is
+    always safe.
     """
     logger = get_logger()
     n, s = packed.n, packed.sketch_size
@@ -217,29 +311,24 @@ def build_candidates(
     starts = np.flatnonzero(np.r_[True, k_sorted[1:] != k_sorted[:-1]])
     sizes = np.diff(np.r_[starts, len(k_sorted)])
 
-    pair_lo: list[np.ndarray] = []
-    pair_hi: list[np.ndarray] = []
-    for c in np.unique(sizes):
-        if c < 2:
-            continue
-        bucket_starts = starts[sizes == c]
-        members = g_sorted[bucket_starts[:, None] + np.arange(c)[None, :]]
-        ai, bi = np.triu_indices(int(c), 1)
-        pa = members[:, ai].ravel()
-        pb = members[:, bi].ravel()
-        pair_lo.append(np.minimum(pa, pb))
-        pair_hi.append(np.maximum(pa, pb))
-    if not pair_lo:
+    # shared-band count per pair: one np.unique over the full expansion
+    # (default), or the memory-bounded chunked fold (join_chunk > 0) —
+    # identical (codes, counts) either way
+    if join_chunk > 0:
+        uniq, shared = _join_codes(
+            _iter_pair_codes(starts, sizes, g_sorted, n, join_chunk)
+        )
+    else:
+        batches = list(_iter_pair_codes(starts, sizes, g_sorted, n, 0))
+        if batches:
+            uniq, shared = np.unique(np.concatenate(batches), return_counts=True)
+        else:
+            uniq = shared = np.empty(0, np.int64)
+    if not len(uniq):
         return CandidateSet(
             ii=np.empty(0, np.int64), jj=np.empty(0, np.int64), n=n,
             params=_params(keep, bands, min_shared),
         )
-    lo = np.concatenate(pair_lo).astype(np.int64)
-    hi = np.concatenate(pair_hi).astype(np.int64)
-
-    # shared-band count per pair, then the recall-preserving threshold
-    code = lo * n + hi
-    uniq, shared = np.unique(code, return_counts=True)
     lo, hi = uniq // n, uniq % n
     if bands > 0:
         # distinct shared ids can merge into one wide band — only >= 1

@@ -1,0 +1,341 @@
+"""Fused rotate+compare ring step — a Pallas TPU kernel (ISSUE 8).
+
+MULTICHIP_r05 measured the host-stepped dense ring at efficiency 0.806
+with D=8 fixed per-device work: ~1/5 of pod throughput lost to dispatch
+gaps between `shard_map` programs and to `lax.ppermute` rotations that
+serialize against the compare kernel (XLA schedules the collective after
+the tile compute that consumes the SAME b operand — the transfer and the
+MXU never overlap). This module fuses the two into ONE `pallas_call` per
+ring step (SNIPPETS.md [1]/[2], the JAX Pallas TPU distributed-guide
+pattern): the kernel STARTS an async remote copy of the local B operand
+to the ring neighbor's receive buffer (`pltpu.make_async_remote_copy`,
+DMA semaphores in scratch, `device_id_type=MESH`), computes the current
+tile from the still-resident B block while the ICI transfer is in
+flight, then WAITS the semaphores — rotation hidden entirely behind
+compute.
+
+Double buffering: each step's B receive buffer is a fresh `pallas_call`
+output, and the host-stepped driver (parallel/allpairs.py) threads step
+i's output in as step i+1's input — input buffer and output buffer
+alternate roles every step, which IS the double-buffer swap; the DMA
+always writes the buffer the receiver is NOT currently reading.
+
+Rotation semantics are pinned to the existing ring's
+``lax.ppermute(b, axis, [(j, (j+1) % D)])``: after the step, device m
+holds what device m-1 held, so at step i device m computes block
+``(m - i) mod D`` — the half-ring schedule, the host mirror, and the
+per-block recovery indexing are all untouched. The tile bodies are the
+SAME functions the ppermute ring jit-wraps (ops/minhash.mash_tile_raw,
+ops/containment.containment_inter_tile_raw — imported, not copied), so the
+produced block tiles are bit-identical; tests pin this at D=3/8 in
+interpret mode, and the on-hardware self-check re-proves it per process
+before the fast path is ever selected.
+
+Why no neighbor barrier before the DMA: each `pallas_call` here performs
+exactly ONE remote write into a buffer that XLA allocated before any
+kernel in the step started, and the receive semaphore is hardware state
+that tolerates signal-before-wait — the buffer-reuse races the
+distributed guide's barriers guard against need a multi-round kernel,
+which the host-stepped design deliberately avoids (the step boundary is
+the checkpoint/redo unit from PR 4 and must stay host-visible).
+
+Gating mirrors ops/pallas_indicator.py exactly: the fused path is only
+auto-selected on a REAL TPU backend after a one-time per-process
+self-check (compile a tiny fused step on the local devices, compare
+bit-equality against an inline ppermute reference); any Mosaic
+rejection, runtime fault, or numerics mismatch permanently falls back to
+the ppermute ring for the process. The TPU tunnel in this image wedges
+for hours (PARITY.md), so new Mosaic patterns cannot be validated at
+author time — the self-check makes the fast path self-deploying when
+hardware answers. ``DREP_TPU_PALLAS_RING=0`` pins the fallback.
+
+Interpret mode (``interpret=True``) runs the SAME kernel — remote DMAs
+discharged onto the shard axis as collectives — on any backend; it is
+the CPU tier-1 equality oracle and the bench's step-parity proxy, never
+a performance claim (tools/missing_stages.py refuses such records).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from drep_tpu.parallel.mesh import AXIS
+
+# VMEM budget for one fused step's working set (bytes): both sketch
+# operands + the tile output must fit comfortably under the ~16 MB/core
+# VMEM. Blocks past this run the ppermute ring (resolve_comm's caller
+# checks fused_block_fits) — gridding the kernel over row tiles is the
+# documented follow-on once hardware answers.
+_FUSED_VMEM_BYTES = 12 << 20
+
+
+def fused_block_fits(n_local: int, sketch_width: int, n_outputs: int = 1) -> bool:
+    """Whether a [n_local, sketch_width] int32 block pair (+ the f32 tile
+    outputs) fits the fused kernel's VMEM budget."""
+    operand = n_local * sketch_width * 4
+    tile = n_local * n_local * 4 * n_outputs
+    return 2 * operand + tile + n_local * 8 <= _FUSED_VMEM_BYTES
+
+
+def _raw_mash_tile(k: int):
+    """The mash distance tile body WITHOUT the jit wrapper (pallas
+    kernels trace their own program) — THE SAME tile body the ppermute
+    ring's `mash_distance_tile` jit-wraps (ops/minhash.mash_tile_raw),
+    so the two cannot drift; the unused jaccard output is dead-code-
+    eliminated by the compiler."""
+    from drep_tpu.ops.minhash import mash_tile_raw
+
+    raw = mash_tile_raw(k)
+
+    def tile(a_ids, a_counts, b_ids, b_counts):
+        d, _j = raw(a_ids, a_counts, b_ids, b_counts)
+        return d
+
+    return tile
+
+
+def _raw_containment_tile(k: int):
+    """Symmetric |A∩B| tile body — THE SAME body `containment_inter_tile`
+    jit-wraps (ops/containment.containment_inter_tile_raw), unjitted."""
+    del k  # |A∩B| is count-free; k rides only in the cache key
+    from drep_tpu.ops.containment import containment_inter_tile_raw
+
+    def tile(a_ids, a_counts, b_ids, b_counts):
+        del a_counts, b_counts
+        return containment_inter_tile_raw(a_ids, b_ids)
+
+    return tile
+
+
+# kind -> (raw tile factory, n_outputs); mirrors allpairs._TILE_KINDS —
+# every kind must keep tile(A,B) == tile(B,A).T bit-exact (the half-ring
+# host mirror depends on it, same contract as the ppermute ring)
+_RAW_TILE_KINDS = {
+    "mash": (_raw_mash_tile, 1),
+    "containment": (_raw_containment_tile, 1),
+}
+
+
+def _fused_step_kernel(
+    a_ids_ref, a_counts_ref, b_ids_ref, b_counts_ref,
+    *refs, tile_fn, n_outputs: int, n_devices: int,
+):
+    """One fused rotate+compare step. `refs` unpacks to (tile_refs...,
+    b_ids_out_ref, b_counts_out_ref, ids_send_sem, ids_recv_sem,
+    cts_send_sem, cts_recv_sem). Counts ride as [n_local, 1] (2-D keeps
+    the DMA shape lane-friendly; the driver reshapes)."""
+    tile_refs = refs[:n_outputs]
+    b_ids_out_ref, b_counts_out_ref = refs[n_outputs : n_outputs + 2]
+    ids_send, ids_recv, cts_send, cts_recv = refs[n_outputs + 2 :]
+
+    my_id = lax.axis_index(AXIS)
+    dst = lax.rem(my_id + 1, n_devices)  # == ppermute perm [(j, j+1) % D]
+    copy_ids = pltpu.make_async_remote_copy(
+        src_ref=b_ids_ref, dst_ref=b_ids_out_ref,
+        send_sem=ids_send, recv_sem=ids_recv,
+        device_id=dst, device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    copy_cts = pltpu.make_async_remote_copy(
+        src_ref=b_counts_ref, dst_ref=b_counts_out_ref,
+        send_sem=cts_send, recv_sem=cts_recv,
+        device_id=dst, device_id_type=pltpu.DeviceIdType.MESH,
+    )
+    # start the ICI transfer FIRST, then compute the tile from the
+    # still-resident operand — the DMA engine and the compute units run
+    # concurrently, which is the whole point of the fusion
+    copy_ids.start()
+    copy_cts.start()
+    tiles = tile_fn(
+        a_ids_ref[...], a_counts_ref[...][:, 0],
+        b_ids_ref[...], b_counts_ref[...][:, 0],
+    )
+    if not isinstance(tiles, tuple):
+        tiles = (tiles,)
+    for ref, t in zip(tile_refs, tiles):
+        # same f32 cast as the step program / standalone block recompute
+        ref[...] = t.astype(jnp.float32)
+    copy_ids.wait()
+    copy_cts.wait()
+
+
+@functools.lru_cache(maxsize=None)
+def fused_ring_step_fn(kind: str, k: int, mesh, interpret: bool = False):
+    """One jitted shard_map program per (kind, k, mesh, interpret): the
+    fused rotate+compare ring step. Call signature and output layout are
+    IDENTICAL to allpairs._ring_step_fn(..., rotate=True) — the step-wise
+    driver swaps one for the other per the resolved comm backend; the
+    last (rotation-free) step always runs the plain program (nothing to
+    overlap). Returns (fn, n_outputs)."""
+    from jax.sharding import PartitionSpec as P
+
+    from drep_tpu.utils.jaxcompat import shard_map
+
+    make_tile, n_outputs = _RAW_TILE_KINDS[kind]
+    tile_fn = make_tile(k)
+    D = mesh.devices.size
+
+    def shard_body(a_ids, a_counts, b_ids, b_counts):
+        n_local, s = a_ids.shape
+        cts2 = a_counts.reshape(n_local, 1)
+        b_cts2 = b_counts.reshape(n_local, 1)
+        out = pl.pallas_call(
+            functools.partial(
+                _fused_step_kernel,
+                tile_fn=tile_fn, n_outputs=n_outputs, n_devices=D,
+            ),
+            out_shape=(
+                *[
+                    jax.ShapeDtypeStruct((n_local, n_local), jnp.float32)
+                    for _ in range(n_outputs)
+                ],
+                jax.ShapeDtypeStruct((n_local, s), b_ids.dtype),
+                jax.ShapeDtypeStruct((n_local, 1), b_counts.dtype),
+            ),
+            # tile compute reads the operands from VMEM; the receive
+            # buffers stay in compiler-chosen (HBM) space — they are the
+            # remote DMA's destination, not compute operands this step
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=(
+                *[pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n_outputs)],
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ),
+            scratch_shapes=[pltpu.SemaphoreType.DMA] * 4,
+            interpret=interpret,
+            compiler_params=pltpu.TPUCompilerParams(collective_id=7),
+        )(a_ids, cts2, b_ids, b_cts2)
+        *tiles, b_ids_next, b_cts_next = out
+        return (*tiles, b_ids_next, b_cts_next.reshape(n_local))
+
+    fn = jax.jit(
+        shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS, None), P(AXIS)),
+            out_specs=(
+                *[P(AXIS, None) for _ in range(n_outputs)],
+                P(AXIS, None),
+                P(AXIS),
+            ),
+        )
+    )
+    return fn, n_outputs
+
+
+# -- the auto-gate: one-time per-process on-device self-check -------------
+
+_SELFTEST: dict[str, object] = {"ok": None, "reason": None}
+
+
+def pallas_ring_unavailable_reason() -> str | None:
+    """Why the fused path is off (None when it is on) — surfaced by the
+    resolve logging so a forced --ring_comm pallas_dma fallback is
+    explainable."""
+    pallas_ring_ok()
+    return _SELFTEST["reason"]
+
+
+def pallas_ring_ok() -> bool:
+    """One-time per-process gate for the fused ring: False off-TPU, with
+    fewer than 2 local TPU devices (no rotation to fuse — and no way to
+    self-check one), or when the env pin says no; otherwise compile the
+    fused step on a 2-device LOCAL mesh and require bit-equality of both
+    the tile and the rotated operands against an inline lax.ppermute
+    reference. Any failure — Mosaic rejection, remote-compile outage,
+    wrong numerics — permanently falls back to the ppermute ring for the
+    process: a gate miss costs ~19% pod throughput, never correctness.
+
+    The self-check runs on LOCAL devices only (no pod collective): every
+    pod process runs the same software stack against the same hardware
+    generation, so the verdicts agree — and even a pathological
+    disagreement is survivable, because a fused program that fails at
+    dispatch falls into the existing aborted -> per-block recovery path.
+    """
+    if _SELFTEST["ok"] is not None:
+        return bool(_SELFTEST["ok"])
+    if os.environ.get("DREP_TPU_PALLAS_RING", "") == "0":
+        _SELFTEST.update(ok=False, reason="DREP_TPU_PALLAS_RING=0 pin")
+        return False
+    try:
+        if jax.devices()[0].platform != "tpu":
+            _SELFTEST.update(
+                ok=False,
+                reason=f"backend is {jax.devices()[0].platform!r}, not tpu",
+            )
+            return False
+        if len(jax.local_devices()) < 2:
+            _SELFTEST.update(ok=False, reason="fewer than 2 local TPU devices")
+            return False
+        _SELFTEST["ok"] = bool(_selftest_fused_step())
+        if not _SELFTEST["ok"]:
+            _SELFTEST["reason"] = "self-check numerics mismatch"
+    except Exception as e:  # any compile/runtime failure -> permanent fallback
+        _SELFTEST.update(ok=False, reason=f"self-check failed: {e!r}")
+    return bool(_SELFTEST["ok"])
+
+
+def _selftest_fused_step() -> bool:
+    """Compile-and-verify on the real device: one fused mash step on a
+    tiny 2-device local mesh vs an inline ppermute reference — tile AND
+    rotated operands must match bit-for-bit."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from drep_tpu.ops.minhash import mash_distance_tile
+    from drep_tpu.utils.jaxcompat import shard_map
+
+    devices = jax.local_devices()[:2]
+    mesh = jax.make_mesh((2,), (AXIS,), devices=devices)
+    rng = np.random.default_rng(0)
+    n_local, s = 8, 128
+    ids = np.sort(
+        rng.integers(0, 2**20, size=(2 * n_local, s), dtype=np.int32), axis=1
+    )
+    counts = np.full(2 * n_local, s, np.int32)
+    ids_d = jax.device_put(ids, NamedSharding(mesh, P(AXIS, None)))
+    cts_d = jax.device_put(counts, NamedSharding(mesh, P(AXIS)))
+
+    fused, _ = fused_ring_step_fn("mash", 21, mesh, interpret=False)
+    tile_f, b_ids_f, b_cts_f = jax.block_until_ready(
+        fused(ids_d, cts_d, ids_d, cts_d)
+    )
+
+    def ref_body(a_ids, a_counts, b_ids, b_counts):
+        d, _j = mash_distance_tile(a_ids, a_counts, b_ids, b_counts, k=21)
+        perm = [(j, (j + 1) % 2) for j in range(2)]
+        return (
+            d.astype(jnp.float32),
+            lax.ppermute(b_ids, AXIS, perm),
+            lax.ppermute(b_counts, AXIS, perm),
+        )
+
+    ref = jax.jit(
+        shard_map(
+            ref_body, mesh=mesh,
+            in_specs=(P(AXIS, None), P(AXIS), P(AXIS, None), P(AXIS)),
+            out_specs=(P(AXIS, None), P(AXIS, None), P(AXIS)),
+        )
+    )
+    tile_r, b_ids_r, b_cts_r = jax.block_until_ready(ref(ids_d, cts_d, ids_d, cts_d))
+    return (
+        np.asarray(tile_f).tobytes() == np.asarray(tile_r).tobytes()
+        and np.asarray(b_ids_f).tobytes() == np.asarray(b_ids_r).tobytes()
+        and np.asarray(b_cts_f).tobytes() == np.asarray(b_cts_r).tobytes()
+    )
+
+
+def reset_selftest_for_tests() -> None:
+    """Clear the cached gate verdict (tests exercise both outcomes)."""
+    _SELFTEST.update(ok=None, reason=None)

@@ -47,6 +47,18 @@ across the live set, and assemble a distance matrix bit-identical to a
 healthy run from the shared shard store. The monolithic single-program
 ring is kept behind ``monolithic=True`` / ``--ring_monolithic`` /
 ``DREP_TPU_RING_MONOLITHIC=1`` as the bit-equality reference.
+
+Fused DMA rotation (ISSUE 8): each rotating step's shard_map program can
+be swapped for the fused Pallas kernel (ops/pallas_ring.py) that starts
+the ICI transfer of the B operand to the ring neighbor and computes the
+tile WHILE it flies — recovering the ~19% multi-chip loss MULTICHIP_r05
+measured against non-overlapped ppermute rotation. Backend selection
+(``--ring_comm`` / ``DREP_TPU_RING_COMM`` / :func:`resolve_ring_comm`)
+is auto-gated on a one-time on-device self-check; block tiles are
+bit-identical across backends (pinned in tests), so checkpoint shards,
+resume, per-block recovery, and the elastic death protocol are all
+backend-agnostic — a degraded or failed fused step falls into the SAME
+per-block (collective-free) recovery path as a failed ppermute step.
 """
 
 from __future__ import annotations
@@ -71,34 +83,115 @@ from drep_tpu.utils.logger import get_logger
 # monolithic-reference opt-in: explicit argument > configure_ring() >
 # env var > step-wise default
 RING_MONOLITHIC_ENV = "DREP_TPU_RING_MONOLITHIC"
+# ring comm backend request: explicit argument > configure_ring() > env >
+# "auto" (auto-select the fused pallas ring iff the on-device self-check
+# passes — ops/pallas_ring.py; otherwise lax.ppermute)
+RING_COMM_ENV = "DREP_TPU_RING_COMM"
+RING_COMM_CHOICES = ("auto", "ppermute", "pallas_dma", "pallas_interpret")
+
+# per-ring-step AutoTimeout warmup: exclude exactly the FIRST step's wait
+# from the rolling median — it absorbs the step program's compile (the
+# fused pallas step's Mosaic compile is the heaviest case), and the
+# default TileExecutor warmup (8) would discard the entire half-ring
+# schedule at production D (gauges.derived_ring_step_timeout_s never
+# derived). The warm/cold split is one step for every comm backend.
+RING_STEP_WARMUP = 1
 
 # process-wide ring execution config, set once per run by the cluster
 # controller from the CLI flags (same pattern as faulttol's
 # configure_defaults): engines call ring_allpairs deep inside replicated
 # control flow and cannot thread a workdir down to it.
-_RING_CONFIG: dict = {"monolithic": None, "checkpoint_base": None}
+_RING_CONFIG: dict = {"monolithic": None, "checkpoint_base": None, "comm": None}
 
 
 def configure_ring(
-    monolithic: bool | None = None, checkpoint_base: str | None = None
+    monolithic: bool | None = None,
+    checkpoint_base: str | None = None,
+    comm: str | None = None,
 ) -> None:
     """Install run-wide ring defaults: `monolithic` forces the single
     collective reference program; `checkpoint_base` roots the step-wise
     ring's per-call block shard stores (one subdirectory per distinct
-    input fingerprint, created lazily when a ring actually runs).
+    input fingerprint, created lazily when a ring actually runs); `comm`
+    picks the rotation backend (RING_COMM_CHOICES — None defers to
+    DREP_TPU_RING_COMM, then "auto").
 
     This REPLACES the whole config — an omitted argument resets that knob
     to its default (None), it does not preserve the previous value; a
     bare ``configure_ring()`` is the full reset (tests rely on it). To
-    flip one knob mid-run, pass both."""
+    flip one knob mid-run, pass all."""
     _RING_CONFIG["monolithic"] = monolithic
     _RING_CONFIG["checkpoint_base"] = checkpoint_base
+    _RING_CONFIG["comm"] = comm
 
 
 def ring_monolithic_default() -> bool:
     if _RING_CONFIG["monolithic"] is not None:
         return bool(_RING_CONFIG["monolithic"])
     return os.environ.get(RING_MONOLITHIC_ENV, "") not in ("", "0", "false")
+
+
+def ring_comm_requested() -> str:
+    """The comm backend the run ASKS for (config > env > auto) — validated
+    here so a typo'd DREP_TPU_RING_COMM fails loudly, not as a silent
+    auto."""
+    req = _RING_CONFIG["comm"] or os.environ.get(RING_COMM_ENV, "") or "auto"
+    if req not in RING_COMM_CHOICES:
+        raise ValueError(
+            f"ring comm backend {req!r}: expected one of {RING_COMM_CHOICES}"
+        )
+    return req
+
+
+def resolve_ring_comm(
+    mesh, requested: str | None = None,
+    n_local: int = 0, sketch_width: int = 0, n_outputs: int = 1,
+) -> str:
+    """The comm backend a step-wise ring over `mesh` actually RUNS:
+    'pallas_dma' (the fused rotate+compare kernel, ops/pallas_ring.py),
+    'pallas_interpret' (the same kernel discharged on the host backend —
+    the CPU equality oracle, never a perf claim), or 'ppermute' (the
+    shard_map reference).
+
+    'auto' selects pallas_dma only when the one-time on-device self-check
+    passed (real TPU backend, bit-equal numerics — the
+    pallas_indicator_ok gating pattern) AND the block shape fits the
+    fused kernel's VMEM budget; an explicit 'pallas_dma' that cannot be
+    honored falls back to ppermute with a warning naming the reason — a
+    comm knob must never turn into a wedge or a wrong answer."""
+    req = requested if requested is not None else ring_comm_requested()
+    if req not in RING_COMM_CHOICES:
+        raise ValueError(
+            f"ring comm backend {req!r}: expected one of {RING_COMM_CHOICES}"
+        )
+    if req == "ppermute" or mesh.devices.size < 2:
+        return "ppermute"
+    from drep_tpu.ops.pallas_ring import (
+        fused_block_fits,
+        pallas_ring_ok,
+        pallas_ring_unavailable_reason,
+    )
+
+    fits = (
+        fused_block_fits(n_local, sketch_width, n_outputs)
+        if n_local and sketch_width
+        else True
+    )
+    if req == "pallas_interpret":
+        # the interpret oracle has no VMEM to overflow — always honored
+        return "pallas_interpret"
+    if pallas_ring_ok() and fits:
+        return "pallas_dma"
+    if req == "pallas_dma":
+        get_logger().warning(
+            "dense ring: --ring_comm pallas_dma requested but unavailable "
+            "(%s) — falling back to ppermute",
+            pallas_ring_unavailable_reason()
+            if not pallas_ring_ok()
+            else f"block [{n_local}, {sketch_width}] exceeds the fused "
+            f"kernel's VMEM budget",
+        )
+    return "ppermute"
 
 
 def half_ring_steps(n_devices: int) -> int:
@@ -426,6 +519,7 @@ def ring_allpairs(
     monolithic: bool | None = None,
     checkpoint_dir: str | None = None,
     ft_config=None,
+    ring_comm: str | None = None,
 ) -> tuple[np.ndarray, ...]:
     """Run the `kind` tile kernel over every pair of rows, sharded over the
     mesh. Returns full [N, N] float32 matrices (one per kernel output),
@@ -442,7 +536,10 @@ def ring_allpairs(
     run-wide flag / env) forces the original single collective program,
     kept as the bit-equality reference. `checkpoint_dir` overrides the
     configured per-call block store location (None + no configured base =
-    in-memory only).
+    in-memory only). `ring_comm` picks the step rotation backend
+    (RING_COMM_CHOICES; None defers to configure_ring/env/auto —
+    :func:`resolve_ring_comm`): the fused pallas kernel overlaps the ICI
+    rotation with the tile compute, with bit-identical block tiles.
     """
     if mesh is None:
         mesh = make_mesh()
@@ -460,7 +557,7 @@ def ring_allpairs(
         # full-grid total (the monolithic reference genuinely computes
         # its whole schedule every call and books it)
         outs, tiles_computed = _ring_allpairs_stepwise(
-            packed, kind, k, mesh, half, checkpoint_dir, ft_config
+            packed, kind, k, mesh, half, checkpoint_dir, ft_config, ring_comm
         )
     else:
         outs = _ring_allpairs_monolithic(packed, kind, k, mesh, half)
@@ -564,7 +661,7 @@ def _exchange_rows_no_store(
 
 
 def _ring_allpairs_stepwise(
-    packed, kind, k, mesh, half, checkpoint_dir, ft_config
+    packed, kind, k, mesh, half, checkpoint_dir, ft_config, ring_comm=None
 ) -> tuple[list[np.ndarray], int]:
     """The host-stepped elastic ring (module docstring): one dispatch per
     ring step, per-step block tiles checkpointed to a shard store, missing
@@ -741,10 +838,30 @@ def _ring_allpairs_stepwise(
         # per-block path, which needs no full-pod collective at all
         run_ring = len(missing0) == len(schedule) and (hb is None or not hb.dead)
         aborted = None
+        # honest backend gauge: 0.0 unless a fused pallas step actually
+        # runs this call — a resume/recovery-only call (run_ring False)
+        # executes no rotation at all and must not inherit a previous
+        # call's 1.0
+        counters.set_gauge("ring_comm_pallas", 0.0)
         if run_ring:
+            # rotation backend for THIS schedule: the fused pallas kernel
+            # (ICI rotation hidden behind the tile compute) when the
+            # resolve gate admits it, the shard_map ppermute otherwise.
+            # Block tiles are bit-identical either way (pinned in tests),
+            # so the choice never touches the checkpoint/recovery story.
+            comm = resolve_ring_comm(
+                mesh, ring_comm, n_local, ids.shape[1], n_outputs
+            ) if n_steps > 1 else "ppermute"
+            if comm != "ppermute":
+                counters.set_gauge("ring_comm_pallas", 1.0)
             ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
             counts_d = put_global(counts, NamedSharding(mesh, P(AXIS)))
-            auto = AutoTimeout(cfg)
+            # the fused step's cold profile differs from the warm steps
+            # (the Mosaic/XLA compile lands on the first step's wait):
+            # exclude exactly that first step from the rolling median —
+            # the TileExecutor-style warmup exclusion, sized for a ring
+            # whose whole schedule is only half_ring_steps(D) samples
+            auto = AutoTimeout(cfg, warmup=RING_STEP_WARMUP)
             # dispatch every step up front: JAX dispatch is async and each
             # step consumes the previous step's device-resident B operand,
             # so the queue keeps the devices as busy as the monolithic
@@ -754,7 +871,19 @@ def _ring_allpairs_stepwise(
                 out_pending: list[tuple[int, list]] = []
                 b_ids, b_counts = ids_d, counts_d
                 for i in range(n_steps):
-                    fn, _ = _ring_step_fn(kind, k, mesh, i < n_steps - 1)
+                    rotate = i < n_steps - 1
+                    if rotate and comm != "ppermute":
+                        from drep_tpu.ops.pallas_ring import fused_ring_step_fn
+
+                        fn, _ = fused_ring_step_fn(
+                            kind, k, mesh,
+                            interpret=comm == "pallas_interpret",
+                        )
+                    else:
+                        # the final step has no rotation to overlap — the
+                        # plain program (which skips the dead hop) is the
+                        # right one under EVERY comm backend
+                        fn, _ = _ring_step_fn(kind, k, mesh, rotate)
                     *outs, b_ids, b_counts = fn(ids_d, counts_d, b_ids, b_counts)
                     out_pending.append((i, outs))
                 return out_pending
@@ -970,13 +1099,15 @@ def sharded_mash_allpairs(
     monolithic: bool | None = None,
     checkpoint_dir: str | None = None,
     ft_config=None,
+    ring_comm: str | None = None,
 ) -> np.ndarray:
     """[N, N] Mash distance matrix, ring-sharded over the mesh (half-ring
     triangular schedule unless ``full_grid``; host-stepped elastic
-    execution unless ``monolithic``)."""
+    execution unless ``monolithic``; rotation backend per ``ring_comm``)."""
     (dist,) = ring_allpairs(
         packed, "mash", k, mesh=mesh, full_grid=full_grid,
         monolithic=monolithic, checkpoint_dir=checkpoint_dir, ft_config=ft_config,
+        ring_comm=ring_comm,
     )
     np.fill_diagonal(dist, 0.0)
     return dist
@@ -990,6 +1121,7 @@ def sharded_containment_allpairs(
     monolithic: bool | None = None,
     checkpoint_dir: str | None = None,
     ft_config=None,
+    ring_comm: str | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """([N,N] symmetric max-containment ani, [N,N] directional cov),
     ring-sharded over the mesh. The ring ships symmetric raw intersection
@@ -999,5 +1131,6 @@ def sharded_containment_allpairs(
     (inter,) = ring_allpairs(
         packed, "containment", k, mesh=mesh, full_grid=full_grid,
         monolithic=monolithic, checkpoint_dir=checkpoint_dir, ft_config=ft_config,
+        ring_comm=ring_comm,
     )
     return ani_cov_from_intersections(inter, packed.counts, k)

@@ -158,16 +158,25 @@ class AutoTimeout:
     ``AUTO_TIMEOUT_FLOOR_S`` — and under the generous warmup cap until
     enough samples exist, so even an early wedge cannot hang forever.
     An explicit positive ``dispatch_timeout_s`` in the config is always
-    authoritative; auto off means disabled (0.0)."""
+    authoritative; auto off means disabled (0.0).
 
-    def __init__(self, config: "FaultTolConfig") -> None:
+    `warmup` is the number of leading waits excluded as compile warmup —
+    the TileExecutor keeps the default (its schedules run hundreds of
+    tiles); the step-wise dense ring passes its own
+    (allpairs.RING_STEP_WARMUP = 1: only the first step is cold — it
+    absorbs the step program's compile, the fused pallas step's Mosaic
+    compile being the heaviest case — and a half-ring schedule has too
+    few steps to discard eight)."""
+
+    def __init__(self, config: "FaultTolConfig", warmup: int = AUTO_TIMEOUT_WARMUP) -> None:
         self.config = config
+        self.warmup = warmup
         self._waits: deque[float] = deque(maxlen=64)
         self._n_waits = 0
 
     def note(self, dt: float) -> None:
         self._n_waits += 1
-        if self._n_waits > AUTO_TIMEOUT_WARMUP:
+        if self._n_waits > self.warmup:
             self._waits.append(dt)
 
     def effective(self) -> float:

@@ -1138,6 +1138,7 @@ def streaming_primary_clusters(
     primary_prune: str = "off",
     prune_bands: int = 0,
     prune_min_shared: int = 0,
+    prune_join_chunk: int = 0,
 ) -> tuple[np.ndarray, tuple[np.ndarray, np.ndarray, np.ndarray], int]:
     """Streaming primary clustering: (labels 1..C, retained edges, pairs
     actually computed this call).
@@ -1192,7 +1193,8 @@ def streaming_primary_clusters(
         from drep_tpu.ops.lsh import build_candidates
 
         prune = build_candidates(
-            packed, keep=keep, k=k, bands=prune_bands, min_shared=prune_min_shared
+            packed, keep=keep, k=k, bands=prune_bands,
+            min_shared=prune_min_shared, join_chunk=prune_join_chunk,
         )
     ii, jj, dd, pairs_computed = streaming_mash_edges(
         packed, k, keep, block=block, checkpoint_dir=checkpoint_dir,

@@ -145,20 +145,26 @@ def index_update_wrapper(
         primary_prune=kwargs.get("primary_prune", "off") or "off",
         prune_bands=kwargs.get("prune_bands", 0) or 0,
         prune_min_shared=kwargs.get("prune_min_shared", 0) or 0,
+        prune_join_chunk=kwargs.get("prune_join_chunk", 0) or 0,
     )
 
 
 def index_classify_wrapper(
     index_loc: str, genomes: list[str] | None = None, **kwargs
 ) -> list[dict]:
-    """`index classify`: read-only membership verdicts."""
+    """`index classify`: read-only membership verdicts (optionally via
+    the LSH candidate set — verdicts identical, see index/classify.py)."""
     from drep_tpu.index import index_classify
 
     if not genomes:
         raise UserInputError("index classify needs -g <genome FASTAs>")
     _init_index(index_loc, write_logs=False)
     return index_classify(
-        index_loc, genomes, processes=kwargs.get("processes", 1) or 1
+        index_loc, genomes, processes=kwargs.get("processes", 1) or 1,
+        primary_prune=kwargs.get("primary_prune", "off") or "off",
+        prune_bands=kwargs.get("prune_bands", 0) or 0,
+        prune_min_shared=kwargs.get("prune_min_shared", 0) or 0,
+        prune_join_chunk=kwargs.get("prune_join_chunk", 0) or 0,
     )
 
 

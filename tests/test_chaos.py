@@ -1299,3 +1299,46 @@ def test_missing_stages_refuses_degraded_records():
         assert plan in ms.missing(
             merged({**clean, "fault_tolerance": {"ring_step_failures": 1}}, key)
         )
+
+
+def test_missing_stages_refuses_interpret_pallas_records():
+    """ISSUE 8 satellite: a ring_scaling record whose rows ran the fused
+    pallas ring in INTERPRET mode (the CPU equality oracle) is
+    correctness evidence, never a hardware speedup claim — refused
+    exactly like proxy metrics, wherever the marker nests."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "missing_stages", os.path.join(REPO, "tools", "missing_stages.py")
+    )
+    ms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ms)
+
+    link = {"h2d_gbps": 1.0, "d2h_gbps": 1.0}
+
+    def merged(rec):
+        return {
+            "stages": {"ring_scaling": rec},
+            "stage_provenance": {"ring_scaling": {"link": link}},
+        }
+
+    hw = {
+        "backend": "tpu",
+        "rows": [
+            {"D": 8, "ring_comm": "ppermute", "efficiency": 0.81},
+            {"D": 8, "ring_comm": "pallas_dma", "efficiency": 0.96},
+        ],
+    }
+    assert "ring" not in ms.missing(merged(hw))
+    # one interpret row poisons the record (its wall says nothing about
+    # ICI overlap); nested-dict markers are caught too
+    tainted = {**hw, "rows": hw["rows"] + [{"D": 8, "ring_comm": "pallas_interpret"}]}
+    assert "ring" in ms.missing(merged(tainted))
+    assert "ring" in ms.missing(
+        merged({"backend": "cpu", "proxy_metrics": {
+            "rows": [{"D": 8, "ring_comm": "pallas_interpret"}]}})
+    )
+    # and the CPU proxy record refuses even without interpret rows
+    assert "ring" in ms.missing(
+        merged({"backend": "cpu", "proxy_metrics": {"dispatch_gap_ms_per_step": 1.0}})
+    )

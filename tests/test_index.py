@@ -181,6 +181,42 @@ def test_classify_reads_only_and_answers_membership(tmp_path, monkeypatch):
     assert lib.tree_digest(loc, exclude_dirs=()) == digest_before
 
 
+def test_classify_with_lsh_prune_verdicts_identical(tmp_path):
+    """ISSUE 8 satellite: `index classify --primary_prune lsh` routes the
+    query-vs-index rect compare through the LSH candidate set (the same
+    bucket join `index update` consumes) — the compare touches only
+    candidate-occupied columns, yet every verdict field is IDENTICAL to
+    the dense classify (recall 1.0 at the index's retention bound), the
+    skip actually engages, and the index stays byte-for-byte untouched."""
+    # streaming_block=4 splits the union over several column tiles, so a
+    # query sharing content with ONE group leaves the other groups' tiles
+    # candidate-free — the skip has something to actually skip
+    paths = lib.write_genome_set(str(tmp_path / "g"), [4, 4, 4], seed=5)
+    loc = str(tmp_path / "idx")
+    build_from_paths(loc, paths, length=0, streaming_block=4)
+    queries = [paths[1], paths[5]] + lib.write_genome_set(
+        str(tmp_path / "q"), [1], seed=77, prefix="q"
+    )
+
+    from drep_tpu.utils.profiling import counters
+
+    want = index_classify(loc, queries)
+    digest_before = lib.tree_digest(loc, exclude_dirs=())
+    for join_chunk in (0, 16):  # the chunked join composes with classify
+        counters.reset()
+        got = index_classify(
+            loc, queries, primary_prune="lsh", prune_join_chunk=join_chunk
+        )
+        assert got == want, "pruned classify verdicts differ from dense"
+        # the candidate restriction ENGAGED: tiles were actually pruned
+        # (a regression that drops prune_cfg would pass the verdict
+        # equality — identical answers are the whole point — but it
+        # cannot book skipped tiles)
+        st = counters.stages.get("primary_compare")
+        assert st is not None and st.tiles_skipped > 0, vars(st) if st else None
+    assert lib.tree_digest(loc, exclude_dirs=()) == digest_before  # read-only
+
+
 def test_classify_via_cli_emits_json_verdicts(tmp_path):
     """The service front door: `drep-tpu index classify` prints one JSON
     verdict line per query on stdout."""

@@ -319,6 +319,43 @@ def test_index_update_prune_matches_unpruned(tmp_path):
         assert np.array_equal(arr_a, arr_b)
 
 
+@pytest.mark.parametrize("seed", [0, 3, 11])
+@pytest.mark.parametrize("bands", [0, 16])
+def test_chunked_join_candidate_sets_identical(seed, bands):
+    """ISSUE 8 satellite: the memory-bounded chunked bucket join
+    (--prune_join_chunk) must emit the EXACT candidate set of the
+    one-pass np.unique join — every (ii, jj) pair, same order, same
+    pinned params — across seeds, band configs, and chunk sizes from
+    degenerate (1 code at a time) to larger-than-everything."""
+    packed = _clusterable_packed(seed=seed)
+    want = build_candidates(packed, keep=0.2, k=21, bands=bands)
+    for chunk in (1, 7, 64, 1_000, 1 << 40):
+        got = build_candidates(
+            packed, keep=0.2, k=21, bands=bands, join_chunk=chunk
+        )
+        assert np.array_equal(got.ii, want.ii), (seed, bands, chunk)
+        assert np.array_equal(got.jj, want.jj), (seed, bands, chunk)
+        # a pure execution knob: the pinned checkpoint params must NOT
+        # move (a resume under a different chunk size is always legal)
+        assert got.params == want.params
+
+
+def test_chunked_join_edges_and_thresholds_identical():
+    """The chunked join composes with the downstream threshold math
+    (derive_min_shared consumes per-pair s_use AFTER the join) and with
+    the streaming walk: pruned edges stay bit-equal to dense."""
+    packed = _clusterable_packed(seed=2)
+    keep = 0.2
+    want = streaming_mash_edges(packed, k=21, cutoff=keep, block=8)
+    cand = build_candidates(packed, keep=keep, k=21, join_chunk=13)
+    got = streaming_mash_edges(packed, k=21, cutoff=keep, block=8, prune=cand)
+    _edges_equal(got, want)
+    # min_shared floor composes with the chunked fold too
+    c1 = build_candidates(packed, keep=keep, k=21, min_shared=1)
+    c2 = build_candidates(packed, keep=keep, k=21, min_shared=1, join_chunk=5)
+    assert np.array_equal(c1.ii, c2.ii) and np.array_equal(c1.jj, c2.jj)
+
+
 def test_restrict_min_col_and_empty_candidates():
     packed = _clusterable_packed()
     cand = build_candidates(packed, keep=0.2, k=21, min_col=48)

@@ -1,0 +1,247 @@
+"""Fused Pallas DMA ring (ISSUE 8) vs the ppermute reference.
+
+The fused rotate+compare kernel (ops/pallas_ring.py) must be a drop-in
+for the step-wise ring's rotating steps: block tiles BIT-IDENTICAL to
+the lax.ppermute schedule at odd and even D (the even-D half ring has
+the split middle step and the rotate-last-skip), double-buffer rotation
+correct across chained steps, checkpoint shards byte-compatible across
+comm backends, and the auto-gate refusing the compiled path on CPU
+(interpret mode is the only off-TPU mode, and never auto-selected).
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from drep_tpu.ops.containment import pack_scaled_sketches
+from drep_tpu.ops.minhash import pack_sketches, pad_packed_rows
+from drep_tpu.parallel.allpairs import (
+    RING_COMM_CHOICES,
+    configure_ring,
+    resolve_ring_comm,
+    ring_comm_requested,
+    sharded_containment_allpairs,
+    sharded_mash_allpairs,
+)
+from drep_tpu.parallel.mesh import make_mesh
+from drep_tpu.utils.profiling import counters
+
+
+def _sketch_set(rng, n, s):
+    base = np.unique(rng.integers(0, 2**62, size=6 * s * n, dtype=np.uint64))
+    rng.shuffle(base)
+    shared = base[:s]
+    out = []
+    for i in range(n):
+        own = base[s * (i + 1) : s * (i + 2)]
+        mix = int(s * rng.random() * 0.8)
+        out.append(np.sort(np.unique(np.concatenate([shared[:mix], own[: s - mix]]))[:s]))
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_ring_config():
+    configure_ring()
+    yield
+    configure_ring()
+
+
+# odd and even device counts: even D exercises the split middle step and
+# a different rotate-last-skip position — both schedules must produce
+# bit-identical matrices under the fused kernel
+@pytest.mark.parametrize("n_dev", [3, 8])
+def test_fused_mash_ring_bit_equals_ppermute(rng, n_dev):
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual CPU devices"
+    mesh = make_mesh(n_dev)
+    n, s = 21, 64
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    want = sharded_mash_allpairs(packed, k=21, mesh=mesh, ring_comm="ppermute")
+    got = sharded_mash_allpairs(packed, k=21, mesh=mesh, ring_comm="pallas_interpret")
+    assert got.tobytes() == want.tobytes(), "fused pallas ring != ppermute ring"
+    # honest accounting is backend-agnostic: the comm choice must not
+    # change what the schedule books
+    assert counters.gauges.get("ring_comm_pallas") == 1.0
+
+
+@pytest.mark.parametrize("n_dev", [3, 8])
+def test_fused_containment_ring_bit_equals_ppermute(rng, n_dev):
+    mesh = make_mesh(n_dev)
+    n = 19
+    packed = pack_scaled_sketches(
+        _sketch_set(rng, n, 96), [f"g{i}" for i in range(n)], pad_multiple=32
+    )
+    a_w, c_w = sharded_containment_allpairs(packed, k=21, mesh=mesh, ring_comm="ppermute")
+    a_g, c_g = sharded_containment_allpairs(
+        packed, k=21, mesh=mesh, ring_comm="pallas_interpret"
+    )
+    assert a_g.tobytes() == a_w.tobytes()
+    assert c_g.tobytes() == c_w.tobytes()
+
+
+def test_double_buffer_rotation_across_chained_steps(rng):
+    """Step i's B output feeds step i+1's B input (the host-threaded
+    double-buffer swap): after j chained fused steps every device must
+    hold the block j hops upstream — exactly j applications of the
+    ppermute perm [(m, (m+1) % D)] — while each step's tile matches the
+    one the resident operands predict."""
+    from drep_tpu.ops.minhash import mash_distance_tile
+    from drep_tpu.ops.pallas_ring import fused_ring_step_fn
+    from drep_tpu.parallel.allpairs import put_global
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from drep_tpu.parallel.mesh import AXIS
+
+    D, n = 4, 16
+    s = 32
+    mesh = make_mesh(D)
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    ids, cts = pad_packed_rows(packed.ids, packed.counts, D)
+    n_local = ids.shape[0] // D
+    ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
+    cts_d = put_global(cts, NamedSharding(mesh, P(AXIS)))
+    fn, _ = fused_ring_step_fn("mash", 21, mesh, interpret=True)
+
+    b_ids, b_cts = ids_d, cts_d
+    for step in range(1, D):
+        tile, b_ids, b_cts = fn(ids_d, cts_d, b_ids, b_cts)
+        # rotation: device m now holds block (m - step) mod D
+        want_ids = np.roll(
+            ids.reshape(D, n_local, s), step, axis=0
+        ).reshape(D * n_local, s)
+        assert np.asarray(b_ids).tobytes() == want_ids.tobytes(), step
+        want_cts = np.roll(cts.reshape(D, n_local), step, axis=0).ravel()
+        assert np.asarray(b_cts).tobytes() == want_cts.tobytes(), step
+        # the tile was computed from the PRE-rotation operand (the overlap
+        # contract: compute rides the buffer the DMA is draining)
+        pre = np.roll(ids.reshape(D, n_local, s), step - 1, axis=0).reshape(-1, s)
+        pre_c = np.roll(cts.reshape(D, n_local), step - 1, axis=0).ravel()
+        for m in range(D):
+            sl = slice(m * n_local, (m + 1) * n_local)
+            d_want, _ = mash_distance_tile(
+                ids[sl], cts[sl], pre[sl], pre_c[sl], k=21
+            )
+            assert (
+                np.asarray(tile)[sl].tobytes()
+                == np.asarray(d_want).astype(np.float32).tobytes()
+            ), (step, m)
+
+
+def test_checkpoint_shards_are_comm_backend_agnostic(rng, tmp_path):
+    """A store written by the FUSED ring must resume under the ppermute
+    ring (and vice versa) with zero recompute and bit-identical output —
+    per-step blk shards are the redoable unit from PR 4 and the comm
+    backend must not leak into them."""
+    mesh = make_mesh(3)
+    n, s = 21, 64
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    ckpt = str(tmp_path / "ring")
+    want = sharded_mash_allpairs(
+        packed, k=21, mesh=mesh, checkpoint_dir=ckpt, ring_comm="pallas_interpret"
+    )
+    shards = sorted(f for f in os.listdir(ckpt) if f.startswith("blk_"))
+    assert len(shards) == 3 * 4 // 2, shards
+    assert counters.gauges.get("ring_comm_pallas") == 1.0
+    tc0 = counters.stages["primary_compare"].tiles_computed
+    got = sharded_mash_allpairs(
+        packed, k=21, mesh=mesh, checkpoint_dir=ckpt, ring_comm="ppermute"
+    )
+    # full resume: the ppermute run computed NOTHING, every block loaded
+    assert counters.stages["primary_compare"].tiles_computed == tc0
+    assert got.tobytes() == want.tobytes()
+    # the backend gauge is honest on resume too: no fused step ran in the
+    # second call, whatever the first call's backend was
+    assert counters.gauges.get("ring_comm_pallas") == 0.0
+
+
+def test_auto_gate_refuses_pallas_on_cpu():
+    """The compiled fused path must never engage off-TPU: 'auto' resolves
+    to ppermute, a forced 'pallas_dma' falls back (warning, not a wedge),
+    and the gate's reason names the backend."""
+    from drep_tpu.ops.pallas_ring import (
+        pallas_ring_ok,
+        pallas_ring_unavailable_reason,
+        reset_selftest_for_tests,
+    )
+
+    reset_selftest_for_tests()
+    try:
+        mesh = make_mesh(3)
+        assert pallas_ring_ok() is False
+        assert "tpu" in (pallas_ring_unavailable_reason() or "")
+        assert resolve_ring_comm(mesh, "auto") == "ppermute"
+        assert resolve_ring_comm(mesh, "pallas_dma") == "ppermute"
+        # the interpret oracle is the ONLY off-TPU pallas mode, and only
+        # ever by explicit request
+        assert resolve_ring_comm(mesh, "pallas_interpret") == "pallas_interpret"
+    finally:
+        reset_selftest_for_tests()
+
+
+def test_env_pin_and_bad_comm_validation(monkeypatch):
+    from drep_tpu.ops.pallas_ring import pallas_ring_ok, reset_selftest_for_tests
+
+    monkeypatch.setenv("DREP_TPU_PALLAS_RING", "0")
+    reset_selftest_for_tests()
+    try:
+        assert pallas_ring_ok() is False
+    finally:
+        reset_selftest_for_tests()
+
+    monkeypatch.setenv("DREP_TPU_RING_COMM", "warp_drive")
+    with pytest.raises(ValueError, match="warp_drive"):
+        ring_comm_requested()
+    monkeypatch.setenv("DREP_TPU_RING_COMM", "pallas_interpret")
+    assert ring_comm_requested() == "pallas_interpret"
+    assert set(RING_COMM_CHOICES) == {
+        "auto", "ppermute", "pallas_dma", "pallas_interpret"
+    }
+
+
+def test_fused_block_fits_budget():
+    """The VMEM guard: bench-scale blocks fit, production-scale sketch
+    blocks (which would overflow a single un-gridded kernel) do not —
+    resolve falls back to ppermute for those rather than compiling a
+    kernel Mosaic would reject."""
+    from drep_tpu.ops.pallas_ring import fused_block_fits
+
+    assert fused_block_fits(128, 256)
+    assert fused_block_fits(256, 1024)
+    assert not fused_block_fits(6250, 1024)  # 100k-genome/D=16 primary block
+
+
+def test_ring_comm_gauge_reports_ppermute(rng):
+    mesh = make_mesh(3)
+    n, s = 12, 32
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    sharded_mash_allpairs(packed, k=21, mesh=mesh, ring_comm="ppermute")
+    assert counters.gauges.get("ring_comm_pallas") == 0.0
+
+
+def test_ring_step_autotimeout_excludes_first_step_only():
+    """ISSUE 8 satellite: the ring's per-step AutoTimeout excludes
+    exactly the FIRST (compile-bearing) step from the rolling median —
+    the TileExecutor-style warmup exclusion resized for half-ring
+    schedules (the old warmup of 8 discarded every sample at production
+    D and the gauge never derived)."""
+    from drep_tpu.parallel.allpairs import RING_STEP_WARMUP
+    from drep_tpu.parallel.faulttol import (
+        AUTO_TIMEOUT_FLOOR_S,
+        AutoTimeout,
+        FaultTolConfig,
+    )
+
+    assert RING_STEP_WARMUP == 1
+    auto = AutoTimeout(FaultTolConfig(auto_timeout=True), warmup=RING_STEP_WARMUP)
+    auto.note(500.0)  # the cold step: compile-inflated, must not poison
+    for _ in range(4):
+        auto.note(0.01)  # the D=8 half-ring's warm steps
+    derived = auto.derived()
+    assert derived is not None, "gauge must derive from a half-ring schedule"
+    assert derived == AUTO_TIMEOUT_FLOOR_S  # 20x median(0.01) floors at 30s
+    # default warmup (the TileExecutor) still excludes its 8
+    auto_default = AutoTimeout(FaultTolConfig(auto_timeout=True))
+    for _ in range(5):
+        auto_default.note(0.01)
+    assert auto_default.derived() is None

@@ -96,7 +96,7 @@ def _streaming(spec, ft_config=None, checkpoint_dir=None):
     ), "edges differ under injection"
 
 
-def _ring(spec, ft_config=None):
+def _ring(spec, ft_config=None, ring_comm=None):
     from drep_tpu.parallel.allpairs import sharded_mash_allpairs
     from drep_tpu.parallel.mesh import make_mesh
     from drep_tpu.utils import faults
@@ -106,7 +106,9 @@ def _ring(spec, ft_config=None):
     want = sharded_mash_allpairs(packed, k=21, mesh=mesh)
     faults.configure(spec)
     try:
-        got = sharded_mash_allpairs(packed, k=21, mesh=mesh, ft_config=ft_config)
+        got = sharded_mash_allpairs(
+            packed, k=21, mesh=mesh, ft_config=ft_config, ring_comm=ring_comm
+        )
     finally:
         faults.configure(None)
     assert got.tobytes() == want.tobytes(), "ring matrix differs under injection"
@@ -176,6 +178,12 @@ def _cells():
         ("ring_dispatch", "hang", "wedged ring step -> watchdog + recovery",
          "survive", lambda: _ring(
              "ring_dispatch:hang:1.0:max=1:secs=30", _ft(dispatch_timeout_s=0.5))),
+        # the fused pallas ring (ISSUE 8, interpret mode on CPU) shares
+        # the per-block recovery path: a failed fused step must fall back
+        # to standalone-block recompute with a bit-identical matrix
+        ("ring_dispatch", "raise", "failed FUSED pallas step -> per-block recovery",
+         "survive", lambda: _ring(
+             "ring_dispatch:raise:1.0:max=1", ring_comm="pallas_interpret")),
         ("secondary_batch", "raise", "one failed batch -> local retry",
          "survive", lambda: _secondary_retry("secondary_batch:raise:1.0:max=1")),
         ("secondary_batch", "raise", "beyond retry budget -> abort",
@@ -418,6 +426,8 @@ POD_CELLS = [
      "survive", "tests/test_multihost.py::test_elastic_pod_survives_sigkilled_member"),
     ("ring_step", "kill", "SIGKILL between ring steps -> block re-deal",
      "survive", "tests/test_multihost.py::test_elastic_ring_survives_sigkilled_member"),
+    ("ring_step", "kill", "SIGKILL mid-PALLAS-ring -> survivors fall back, bit-identical",
+     "survive", "tests/test_multihost.py::test_elastic_pallas_ring_survives_sigkilled_member"),
     ("barrier", "death", "death BEFORE the stage-open barrier -> admission",
      "survive", "tests/test_multihost.py::test_streaming_prebarrier_death_continues_degraded"),
     ("secondary_batch", "raise", "mid-batch failure on a pod -> local retry",
