@@ -905,6 +905,13 @@ def bench_e2e(n: int, s_scaled: int = 1200, publish=None, workdir: str | None = 
         if ft_events.get("pod_epoch_bumps") or ft_events.get("dead_processes"):
             out["pod_epochs"] = 1 + int(ft_events.get("pod_epoch_bumps", 0))
             out["dead_processes"] = int(ft_events.get("dead_processes", 0))
+        # membership-churn honesty (ISSUE 9): a run that admitted mid-run
+        # joiners or drained members gracefully ran parts of the stage on
+        # a DIFFERENT chip count than the record claims — correct results,
+        # never measured perf (tools/missing_stages.py refuses the stamp)
+        if ft_events.get("pod_joins") or ft_events.get("planned_departures"):
+            out["pod_joins"] = int(ft_events.get("pod_joins", 0))
+            out["planned_departures"] = int(ft_events.get("planned_departures", 0))
         if publish is not None:
             publish(out)
 
@@ -1378,6 +1385,24 @@ def _emit(stages: dict) -> None:
                 if isinstance(st, dict) and "pod_epochs" not in st:
                     st["pod_epochs"] = pod_epoch() + 1
                     st["dead_processes"] = len(pod_dead())
+    except Exception:  # provenance must never block the record
+        pass
+    # membership-churn provenance (ISSUE 9), stamped into EVERY stage
+    # record with the same conservatism: a mid-run JOIN admitted capacity
+    # partway (wall-clock spans two chip counts), a planned DRAIN shed it
+    # — both are counters because a pure-join run deliberately leaves the
+    # downstream pod state healthy. tools/missing_stages.py refuses any
+    # membership-churned record as measured perf.
+    try:
+        from drep_tpu.utils.profiling import counters as _pod_counters
+
+        joins = int(_pod_counters.faults.get("pod_joins", 0))
+        departs = int(_pod_counters.faults.get("planned_departures", 0))
+        if joins or departs:
+            for st in stages.values():
+                if isinstance(st, dict) and "pod_joins" not in st:
+                    st["pod_joins"] = joins
+                    st["planned_departures"] = departs
     except Exception:  # provenance must never block the record
         pass
     # storage-side I/O provenance (ISSUE 5), stamped into EVERY stage

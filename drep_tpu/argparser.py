@@ -138,6 +138,26 @@ def build_parser() -> argparse.ArgumentParser:
                               "stripes AND dense-ring blocks) before aborting; "
                               "heartbeat cadence via DREP_TPU_HEARTBEAT_S "
                               "(0 disables)")
+        tpu.add_argument("--max_joins", type=int, default=0,
+                         help="mid-run JOIN admissions the elastic pod accepts "
+                              "per stage (scale-UP elasticity): a new process "
+                              "started against the same checkpoint dir with "
+                              "DREP_TPU_POD_JOIN=auto (or an explicit id) "
+                              "publishes a join-request note, the lowest-live "
+                              "leader admits it at a stripe/ring-step boundary "
+                              "via an epoch bump, and unfinished work re-deals "
+                              "over the GROWN live set — final edges/matrices "
+                              "stay bit-identical to a fixed-membership run. "
+                              "0 (default) refuses joins")
+        tpu.add_argument("--drain_grace_s", type=float, default=30.0,
+                         help="graceful-preemption window: SIGTERM flags the "
+                              "process for a planned departure, honored at the "
+                              "next stripe/ring-step boundary (departure note "
+                              "published, exit 0, peers re-deal immediately — "
+                              "no heartbeat-staleness wait); if nothing "
+                              "consumes the flag within this many seconds the "
+                              "process publishes the note best-effort and "
+                              "exits 0 anyway (preemption grants no extension)")
         tpu.add_argument("--ring_monolithic", action="store_true",
                          help="run the dense all-pairs ring as ONE collective "
                               "program (the pre-elastic reference) instead of "

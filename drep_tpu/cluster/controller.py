@@ -91,6 +91,14 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     "fault_retries": 2,
     "dispatch_timeout": 0.0,
     "max_dead_processes": 1,
+    # scale-UP elasticity (ISSUE 9): mid-run join admissions the elastic
+    # pod accepts (0 = refused), and the graceful-preemption grace window
+    # (SIGTERM -> planned departure at the next safe boundary; the grace
+    # timer force-exits 0 if nothing consumes the flag). Membership churn
+    # never changes results (bit-identical by the canonical epoch-0
+    # assembly), so neither is a _RESUME_KEY.
+    "max_joins": 0,
+    "drain_grace_s": 30.0,
     # durable-I/O knobs (utils/durableio.py): transient shared-FS retry
     # budget (None = DREP_TPU_IO_RETRIES / default 3) and fsync-on-publish
     # (False = DREP_TPU_FSYNC). Pure durability policy — never results —
@@ -147,7 +155,11 @@ def _ft_config(kw: dict[str, Any]):
     auto-derived watchdog (k x rolling median tile latency, floored —
     parallel/faulttol.py); an explicit positive value is authoritative,
     a negative value disables the watchdog entirely."""
-    from drep_tpu.parallel.faulttol import FaultTolConfig, configure_defaults
+    from drep_tpu.parallel.faulttol import (
+        FaultTolConfig,
+        configure_defaults,
+        install_drain_handler,
+    )
 
     timeout = float(kw["dispatch_timeout"])
     cfg = FaultTolConfig(
@@ -155,8 +167,14 @@ def _ft_config(kw: dict[str, Any]):
         dispatch_timeout_s=max(0.0, timeout),
         auto_timeout=timeout == 0.0,
         max_dead_processes=int(kw["max_dead_processes"]),
+        max_joins=int(kw.get("max_joins", 0)),
     )
     configure_defaults(cfg)
+    # graceful-preemption wiring (ISSUE 9): SIGTERM -> planned departure
+    # at the next stripe/ring-step boundary, force-exit 0 past the grace.
+    # Best-effort: library embeddings off the main thread keep their own
+    # signal policy (install returns False there).
+    install_drain_handler(float(kw.get("drain_grace_s", 30.0)))
     # the storage-side twin: install the run's durable-I/O policy
     # (--io_retries / --fsync; None falls through to the env knobs) so
     # every shard/meta/note publish in the run honors the same budget

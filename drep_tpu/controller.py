@@ -120,9 +120,20 @@ def _honor_jax_platforms_env() -> None:
 def main(argv: list[str] | None = None) -> None:
     _honor_jax_platforms_env()
     from drep_tpu.errors import UserInputError
+    from drep_tpu.parallel.faulttol import PodDrained
 
     try:
         Controller().parseArguments(parse_args(argv))
+    except PodDrained as e:
+        # graceful preemption (ISSUE 9): this member published its
+        # planned-departure note at a safe boundary and the pod re-deals
+        # its unfinished work immediately — exit 0 is the drain contract
+        # (the orchestrator must see a clean exit, not a failure to
+        # restart-loop on; shard-level checkpoints keep the finished work)
+        import sys
+
+        get_logger().warning("drained cleanly: %s", e)
+        sys.exit(0)
     except UserInputError as e:
         # user-input errors (bad paths, non-FASTA input, conflicting
         # flags) end as one `!!!` line, not a traceback — the reference's

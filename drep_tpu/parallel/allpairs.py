@@ -555,17 +555,21 @@ def ring_allpairs(
         # THIS process actually computed this call — a full store resume
         # reports 0, a pod member reports only its share — against the
         # full-grid total (the monolithic reference genuinely computes
-        # its whole schedule every call and books it)
-        outs, tiles_computed = _ring_allpairs_stepwise(
+        # its whole schedule every call and books it). The grid total
+        # comes back from the stepwise path too: a mid-run JOINER runs
+        # the pod's block geometry (from the store meta), not its own
+        # local mesh's.
+        outs, tiles_computed, grid_d = _ring_allpairs_stepwise(
             packed, kind, k, mesh, half, checkpoint_dir, ft_config, ring_comm
         )
     else:
         outs = _ring_allpairs_monolithic(packed, kind, k, mesh, half)
         tiles_computed = ring_tiles_computed(n_devices, half)
+        grid_d = n_devices
     counters.add_tiles(
         "primary_compare" if kind == "mash" else "secondary_compare",
         computed=tiles_computed,
-        total=n_devices * n_devices,
+        total=grid_d * grid_d,
     )
     return tuple(g[:n, :n] for g in outs)
 
@@ -660,16 +664,31 @@ def _exchange_rows_no_store(
         mem[blk] = tuple(tiles)
 
 
+def _read_ring_meta(store: str) -> dict | None:
+    """The block store's meta.json, or None while it is missing/corrupt
+    (a joiner polls this: the pod writes it at its store open). Same
+    corruption contract as every membership note."""
+    from drep_tpu.parallel.faulttol import read_pod_note
+
+    return read_pod_note(os.path.join(store, "meta.json"), what="ring store meta")
+
+
 def _ring_allpairs_stepwise(
     packed, kind, k, mesh, half, checkpoint_dir, ft_config, ring_comm=None
-) -> tuple[list[np.ndarray], int]:
+) -> tuple[list[np.ndarray], int, int]:
     """The host-stepped elastic ring (module docstring): one dispatch per
     ring step, per-step block tiles checkpointed to a shard store, missing
     blocks individually redoable via the per-block tile executor, and —
     on a multi-process pod — a HeartbeatManager death verdict between
     steps re-dealing the dead member's blocks across the survivors with a
-    bit-identical final matrix. Returns (full padded matrices, block
-    tiles this process actually computed — the honest tiles_computed)."""
+    bit-identical final matrix. Membership also GROWS and DRAINS
+    (ISSUE 9): an admitted joiner (``DREP_TPU_POD_JOIN`` against the same
+    block store) enters the per-block completion under the pod's block
+    geometry (D from the store meta, never its own local mesh), and a
+    drain request is honored at step/block boundaries via a planned-
+    departure note + :class:`PodDrained`. Returns (full padded matrices,
+    block tiles this process actually computed — the honest
+    tiles_computed — and the schedule's device-grid D)."""
     from drep_tpu.parallel.faulttol import (
         DEFAULT_ALLGATHER_TIMEOUT_S,
         DEFAULT_CONFIG,
@@ -677,11 +696,15 @@ def _ring_allpairs_stepwise(
         CollectiveTimeout,
         FaultTolError,
         HeartbeatManager,
+        PodDrained,
         TileExecutor,
         WatchdogTimeout,
         _wait_ready,
         collective_timeout_s,
+        drain_requested,
         heartbeat_cadence_s,
+        join_elastic_pod,
+        join_requested,
         wait_elastic,
     )
     from drep_tpu.utils import faults
@@ -692,12 +715,6 @@ def _ring_allpairs_stepwise(
     cfg = ft_config if ft_config is not None else DEFAULT_CONFIG
     D = mesh.devices.size
     _make_tile, n_outputs = _TILE_KINDS[kind]
-    ids, counts = pad_packed_rows(packed.ids, packed.counts, D)
-    n_pad = ids.shape[0]
-    n_local = n_pad // D
-    n_steps = half_ring_steps(D) if half else D
-    schedule = ring_schedule(D, half)
-    sched_idx = {blk: i for i, blk in enumerate(schedule)}
     pid, pc = jax.process_index(), jax.process_count()
     local_mesh = all(d.process_index == pid for d in mesh.devices.flat)
 
@@ -719,7 +736,66 @@ def _ring_allpairs_stepwise(
 
     hb = None
     resume = False
-    if store is not None:
+    # join is honored only for an EXPLICIT checkpoint_dir (the pod's
+    # shared block store): a joiner process also runs replicated local
+    # work — per-cluster secondary rings with config-derived stores —
+    # and those must compute normally, not chase admission into every
+    # store the run creates
+    joining = checkpoint_dir is not None and join_requested() is not None
+    if joining and heartbeat_cadence_s() <= 0:
+        # refuse LOUDLY: falling through would run this process as an
+        # independent participant against the pod's live store (the
+        # streaming path has the same guard and the full rationale)
+        from drep_tpu.errors import UserInputError
+
+        raise UserInputError(
+            "DREP_TPU_POD_JOIN is set but heartbeats are disabled "
+            "(DREP_TPU_HEARTBEAT_S=0) — ring admission rides the "
+            "heartbeat protocol. Unset DREP_TPU_POD_JOIN to run "
+            "standalone, or re-enable heartbeats."
+        )
+    if joining:
+        # mid-run JOIN: this process is NOT part of the pod mesh — it
+        # contributes through the per-block completion only, under the
+        # POD's block geometry. The join request goes out first (a pod
+        # gated on arriving capacity may open its store after seeing
+        # it); the store meta — which carries D — is validated alongside
+        # the admission wait, and a geometry/input mismatch refuses.
+        cadence = heartbeat_cadence_s()
+        want = {
+            "kind": kind, "k": k, "n": packed.n, "half": half,
+            "schedule": "stepwise1", "fingerprint": fp,
+        }
+
+        def _meta_ok() -> bool:
+            stored = _read_ring_meta(store)
+            return stored is not None and all(
+                stored.get(kk) == vv for kk, vv in want.items()
+            )
+
+        hb = join_elastic_pod(
+            store, cadence, config=cfg,
+            what="dense ring (mid-run join)", validate=_meta_ok,
+        )
+        stored_meta = _read_ring_meta(store)
+        if stored_meta is None:  # vanished between validate and here
+            hb.close()
+            raise FaultTolError(
+                f"dense ring join: block store meta at {store} disappeared "
+                f"after admission — the pod's store was cleared mid-join"
+            )
+        D = int(stored_meta["n_devices"])
+        pid, pc = hb.pid, hb.pc
+        resume = True
+
+    ids, counts = pad_packed_rows(packed.ids, packed.counts, D)
+    n_pad = ids.shape[0]
+    n_local = n_pad // D
+    n_steps = half_ring_steps(D) if half else D
+    schedule = ring_schedule(D, half)
+    sched_idx = {blk: i for i, blk in enumerate(schedule)}
+
+    if store is not None and not joining:
         cadence = heartbeat_cadence_s()
         if cadence > 0:
             # started BEFORE the store-open barrier (the stale-note
@@ -727,7 +803,10 @@ def _ring_allpairs_stepwise(
             # also makes the barrier itself heartbeat-aware: a peer that
             # dies before ever reaching it is admitted as a pod death
             # (utils/ckptmeta.py), not a CollectiveTimeout abort
-            hb = HeartbeatManager(store, cadence, max_dead=cfg.max_dead_processes)
+            hb = HeartbeatManager(
+                store, cadence,
+                max_dead=cfg.max_dead_processes, max_joins=cfg.max_joins,
+            )
             hb.start()
         meta = {
             "kind": kind,
@@ -747,7 +826,21 @@ def _ring_allpairs_stepwise(
                 hb.close()
             raise
 
-    elastic = hb is not None and pc > 1 and not local_mesh
+    elastic = joining or (hb is not None and pc > 1 and not local_mesh)
+
+    def _maybe_drain() -> None:
+        if hb is None or not drain_requested():
+            return
+        # the departure note's count is this process's computed BLOCKS —
+        # the same unit the ring's done-note reports (hb.mark_done(len(
+        # mem))), so the member-set accounting stays consistent across
+        # finished and drained members
+        hb.announce_drain(pairs=n_computed)
+        raise PodDrained(
+            f"dense ring: process {pid} drained at a step/block boundary "
+            f"(planned-departure note published with {n_computed} computed "
+            f"block(s); peers re-deal its unfinished blocks immediately)"
+        )
 
     # blocks this call computed stay in memory; the rest resolve from the
     # shard store (found blocks cached so they are never re-statted).
@@ -834,9 +927,14 @@ def _ring_allpairs_stepwise(
         missing0 = _missing_blocks() if resume else list(schedule)
         # the collective step loop is entered only when EVERY process will
         # (fresh store scan is replicated state) and the pod is whole — a
-        # partial resume or an inherited degradation goes straight to the
-        # per-block path, which needs no full-pod collective at all
-        run_ring = len(missing0) == len(schedule) and (hb is None or not hb.dead)
+        # partial resume, an inherited degradation, or a JOINER (whose
+        # devices are outside the pod mesh by definition) goes straight
+        # to the per-block path, which needs no full-pod collective at all
+        run_ring = (
+            len(missing0) == len(schedule)
+            and (hb is None or not hb.dead)
+            and not joining
+        )
         aborted = None
         # honest backend gauge: 0.0 unless a fused pallas step actually
         # runs this call — a resume/recovery-only call (run_ring False)
@@ -906,7 +1004,7 @@ def _ring_allpairs_stepwise(
                 if ok:
                     pending = res
                 else:
-                    aborted = "pod degraded during step dispatch"
+                    aborted = "pod membership changed during step dispatch"
             else:
                 try:
                     pending = _dispatch_all()
@@ -933,7 +1031,7 @@ def _ring_allpairs_stepwise(
                             site="ring_dispatch",
                         )
                         if not ok:
-                            aborted = "pod degraded"
+                            aborted = "pod membership changed"
                             break
                     else:
                         _wait_ready(outs, auto.effective(), "ring_dispatch", None)
@@ -958,6 +1056,10 @@ def _ring_allpairs_stepwise(
                     break
                 auto.note(time.perf_counter() - t0)
                 _store_step(i, outs)
+                # a drain request is honored at the step boundary: this
+                # step's blocks are durable, the departure note goes out,
+                # and the peers re-deal the rest with no staleness wait
+                _maybe_drain()
             derived = auto.derived()
             if derived is not None:
                 # the per-step watchdog deadline the run derived from its
@@ -991,22 +1093,28 @@ def _ring_allpairs_stepwise(
             for blk in _missing_blocks():
                 mem[blk] = _compute_block(blk)
                 _save_block(blk, mem[blk], hb.epoch if hb is not None else 0)
+                _maybe_drain()  # the finished block is durable — safe exit
         else:
             stall_budget = collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S)
             done_written = False
             last_progress = time.time()
             progress_sig = None
             while True:
+                _maybe_drain()
                 live = list(hb.live)
                 missing = _missing_blocks()
                 computed = False
                 for blk in list(missing):
+                    # schedule-index dealing over the CURRENT live set —
+                    # deaths and drains shrink it, admitted joiners grow
+                    # it, and only still-missing blocks are ever dealt
                     if live[sched_idx[blk] % len(live)] != pid:
                         continue
                     computed = True
                     mem[blk] = _compute_block(blk)
                     missing.remove(blk)
                     _save_block(blk, mem[blk], hb.epoch)
+                    _maybe_drain()
                     if hb.maybe_check():
                         break  # epoch bumped mid-pass: re-deal promptly
                 if not missing and not done_written:
@@ -1076,16 +1184,19 @@ def _ring_allpairs_stepwise(
                 # read-modify-atomic-write race is benign.
                 from drep_tpu.utils.ckptmeta import stamp_checkpoint_meta
 
-                stamp_checkpoint_meta(
-                    store, {"pod_epochs": hb.epoch + 1, "dead_processes": hb.dead}
-                )
+                stamp = {"pod_epochs": hb.epoch + 1, "dead_processes": hb.dead}
+                if hb.drained:
+                    stamp["planned_departures"] = hb.drained
+                if hb.joined:
+                    stamp["pod_joins"] = len(hb.joined)
+                stamp_checkpoint_meta(store, stamp)
             logger.warning(
-                "dense ring: completed DEGRADED — pod member(s) %s died "
-                "mid-ring; survivors %s recomputed the missing blocks "
-                "per-tile across %d ownership epoch(s)",
-                hb.dead, hb.live, hb.epoch + 1,
+                "dense ring: completed with MEMBERSHIP CHURN — dead %s, "
+                "drained %s, joined %s; final members %s covered the "
+                "missing blocks per-tile across %d ownership epoch(s)",
+                hb.dead, hb.drained, hb.joined, hb.live, hb.epoch + 1,
             )
-        return mats, n_computed
+        return mats, n_computed, D
     finally:
         if hb is not None:
             hb.close()

@@ -49,6 +49,34 @@ crash story (atomic shards + Cdb resume):
   pre-barrier deaths via :func:`current_heartbeat`. A dead pod member no
   longer aborts the run at the collective timeout — the survivors finish
   the stage bit-identically.
+- the GROW-AND-DRAIN half of the protocol (ISSUE 9) — membership can
+  change in BOTH directions mid-stage, always at a stripe/ring-step
+  boundary, always via an epoch bump, never touching the canonical
+  epoch-0 assembly order (so final edges/matrices stay bit-identical to
+  a fixed-membership run):
+
+  - mid-run JOIN — a NEW process (spot capacity arriving, a restarted
+    member, an operator adding hosts) starts against the same
+    checkpoint dir with ``DREP_TPU_POD_JOIN`` set, publishes a
+    join-request note plus its first heartbeat
+    (:func:`join_elastic_pod`), and is ADMITTED by the lowest-live
+    leader at its next liveness check (bounded by ``--max_joins``): the
+    leader bumps the epoch, publishes an admit note carrying the grown
+    live set + the pod geometry, every member adopts it, and unfinished
+    work re-deals over the GROWN set. Joiners take ids >= the original
+    process count, so the epoch-0 canonical order (and with it
+    bit-identity) is untouched; a joiner is STAGE-SCOPED capacity — the
+    downstream pod state never includes it, so later barriers wait only
+    on the original members.
+  - graceful DRAIN — SIGTERM/preemption (:func:`install_drain_handler`,
+    or :func:`request_drain` directly) makes a member finish its
+    in-flight stripe/ring step, publish a planned-departure note (a
+    verdict class DISTINCT from death: adopted immediately, no
+    staleness wait, never counted against ``--max_dead_processes``, and
+    immunizing the member against a later staleness verdict exactly
+    like a done-note), and exit 0 via :class:`PodDrained` — degradation
+    latency drops from the ~5x-cadence staleness window to one
+    dispatch.
 
 Fault-injection points (utils/faults.py) fire INSIDE the watched
 regions, so injected hangs trip the same watchdogs real wedges do.
@@ -102,8 +130,30 @@ def heartbeat_cadence_s() -> float:
     return float(os.environ.get(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_S))
 
 
+# mid-run join request (the scale-UP half of the elastic protocol): set
+# on a NEW process started against a running pod's checkpoint dir.
+# "auto" derives the join id from the notes already in the store; an
+# integer pins it explicitly (must be >= the pod's original process
+# count — ids below it would collide with the canonical epoch-0 owners).
+POD_JOIN_ENV = "DREP_TPU_POD_JOIN"
+
+
+def join_requested() -> str | None:
+    """The requested join mode: None (not a joiner), "auto", or an
+    explicit id string."""
+    v = os.environ.get(POD_JOIN_ENV, "").strip()
+    return v or None
+
+
 class FaultTolError(RuntimeError):
     """A dispatch failed beyond the retry/quarantine/fallback budget."""
+
+
+class PodDrained(Exception):
+    """This process received a drain request (SIGTERM/preemption) and has
+    published its planned-departure note — the caller should exit 0.
+    Deliberately NOT a FaultTolError: a drain is a clean, expected exit,
+    and nothing may swallow it as a retryable dispatch failure."""
 
 
 class WatchdogTimeout(FaultTolError):
@@ -133,6 +183,11 @@ class FaultTolConfig:
     # pod-member deaths tolerated per run before the elastic protocol
     # gives up and aborts (CLI: --max_dead_processes)
     max_dead_processes: int = 1
+    # mid-run JOIN admissions the pod's leader accepts per stage (CLI:
+    # --max_joins; 0 = joins refused — the conservative default until an
+    # operator opts the run into elastic scale-up). Drains need no knob:
+    # a departure can never corrupt anything, so they are always honored.
+    max_joins: int = 0
 
 
 # auto-derived watchdog: k x the rolling median finalize-wait latency
@@ -212,6 +267,84 @@ def configure_defaults(config: FaultTolConfig) -> None:
     DEFAULT_CONFIG = config
 
 
+# -- graceful drain (planned departure) -----------------------------------
+#
+# A drain REQUEST is process-global (one flag, set by the SIGTERM handler
+# or the chaos fault mode) and CONSUMED at the elastic loops' safe
+# boundaries: the member finishes its in-flight stripe/ring step,
+# publishes a planned-departure note, and raises PodDrained so the caller
+# exits 0. The flag deliberately outlives any one stage — a preemption
+# notice that lands between stages must still drain the next one.
+
+_DRAIN_EVENT = threading.Event()
+
+
+def request_drain() -> None:
+    """Flag this process for graceful departure at the next safe
+    boundary (idempotent)."""
+    if not _DRAIN_EVENT.is_set():
+        get_logger().warning(
+            "elastic pod: drain requested — this process will finish its "
+            "in-flight work unit, publish a planned-departure note, and "
+            "exit 0"
+        )
+    _DRAIN_EVENT.set()
+
+
+def drain_requested() -> bool:
+    return _DRAIN_EVENT.is_set()
+
+
+def clear_drain() -> None:
+    """Reset the drain flag (tests; a long-lived service re-arming)."""
+    _DRAIN_EVENT.clear()
+
+
+def _drain_force_exit(grace_s: float) -> None:
+    """Grace-expiry fallback: the drain request was never consumed (no
+    elastic stage running, or the in-flight dispatch is wedged) — publish
+    the departure note best-effort and exit 0 anyway. Preemption gives no
+    extension; an exit-0 with the note beats a SIGKILL with nothing."""
+    time.sleep(max(0.0, grace_s))
+    if not _DRAIN_EVENT.is_set():
+        return  # cleared before expiry (a test, or a service re-arming)
+    hb = current_heartbeat()
+    if hb is not None:
+        import contextlib
+
+        with contextlib.suppress(Exception):
+            hb.announce_drain()
+    get_logger().warning(
+        "elastic pod: drain grace (%.1fs) expired with the request "
+        "unconsumed — exiting 0 now (shard-level checkpoints keep the "
+        "finished work)", grace_s,
+    )
+    os._exit(0)
+
+
+def install_drain_handler(grace_s: float) -> bool:
+    """Wire SIGTERM to the graceful-drain protocol: the handler sets the
+    drain flag (consumed at the next stripe/ring-step boundary) and arms
+    a grace timer that force-exits 0 if nothing consumes it within
+    `grace_s` (CLI: --drain_grace_s). Returns False when the handler
+    cannot be installed (non-main thread — library embeddings keep their
+    own signal policy)."""
+    import signal
+
+    def _on_term(signum, frame):  # noqa: ARG001 — signal signature
+        request_drain()
+        threading.Thread(
+            target=_drain_force_exit, args=(float(grace_s),),
+            daemon=True, name="drep-drain-grace",
+        ).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # not the main thread
+        return False
+    return True
+
+
 # -- elastic pod state ----------------------------------------------------
 #
 # Process-global because it outlives the streaming stage that discovers a
@@ -222,7 +355,10 @@ def configure_defaults(config: FaultTolConfig) -> None:
 # Reset at the start of every heartbeat-managed stage (HeartbeatManager
 # .start), so one process can run several pods' worth of work sequentially.
 
-_POD = {"epoch": 0, "live": None, "dead": [], "t0": 0.0}
+_POD = {
+    "epoch": 0, "live": None, "dead": [], "drained": [], "joined": [],
+    "t0": 0.0,
+}
 
 
 def pod_epoch() -> int:
@@ -231,12 +367,28 @@ def pod_epoch() -> int:
 
 
 def pod_live() -> list[int] | None:
-    """The live-process list once degraded, else None (healthy: everyone)."""
+    """The live-process list once degraded, else None (healthy: everyone).
+    ORIGINAL members only: joiners are stage-scoped capacity and never
+    appear here — a later stage's barrier must not wait on a process that
+    only ever participated in one stripe loop."""
     return _POD["live"]
 
 
 def pod_dead() -> list[int]:
     return list(_POD["dead"])
+
+
+def pod_drained() -> list[int]:
+    """Members that left via a planned departure (drain note) — gone like
+    the dead for downstream routing, but never counted against
+    --max_dead_processes."""
+    return list(_POD["drained"])
+
+
+def pod_joined() -> list[int]:
+    """Join ids admitted during this run (accounting/provenance only —
+    joiners never enter the downstream live view)."""
+    return list(_POD["joined"])
 
 
 def pod_t0() -> float:
@@ -247,11 +399,32 @@ def pod_t0() -> float:
 
 
 def reset_pod(t0: float | None = None) -> None:
-    _POD.update(epoch=0, live=None, dead=[], t0=(t0 if t0 is not None else 0.0))
+    _POD.update(
+        epoch=0, live=None, dead=[], drained=[], joined=[],
+        t0=(t0 if t0 is not None else 0.0),
+    )
 
 
-def mark_pod_degraded(epoch: int, live: list[int], dead: list[int]) -> None:
+def mark_pod_degraded(
+    epoch: int,
+    live: list[int],
+    dead: list[int],
+    drained: list[int] | None = None,
+    joined: list[int] | None = None,
+) -> None:
     _POD.update(epoch=int(epoch), live=list(live), dead=list(dead))
+    if drained is not None:
+        _POD["drained"] = list(drained)
+    if joined is not None:
+        _POD["joined"] = list(joined)
+
+
+def mark_pod_joined(joined: list[int]) -> None:
+    """Record admitted joiners WITHOUT degrading the downstream view: a
+    pure-join stage (no deaths, no drains) leaves the original pod whole,
+    so later barriers keep the healthy jax-collective path — only the
+    provenance/bench stamping needs to know capacity was grafted in."""
+    _POD["joined"] = list(joined)
 
 
 # the heartbeat manager of the CURRENTLY running heartbeat-managed stage
@@ -266,6 +439,21 @@ _CURRENT_HB: "HeartbeatManager | None" = None
 
 def current_heartbeat() -> "HeartbeatManager | None":
     return _CURRENT_HB
+
+
+def read_pod_note(path: str, what: str = "pod note") -> dict | None:
+    """THE checked JSON membership-note read (done/dead/drain/join/admit
+    notes, ring store meta): transient I/O errors retry, corrupt or
+    non-dict payloads read as ABSENT — a half-written note must never
+    crash a liveness scan (one implementation so the corruption contract
+    cannot drift across the protocol's consumers)."""
+    from drep_tpu.utils import durableio
+
+    try:
+        note = durableio.read_json_checked(path, what=what)
+        return note if isinstance(note, dict) else None
+    except (OSError, ValueError, durableio.CorruptPayloadError):
+        return None
 
 
 # per-(note_dir) count of heartbeat-managed stages THIS process has run —
@@ -323,6 +511,7 @@ class HeartbeatManager:
         max_dead: int = 1,
         pc: int | None = None,
         pid: int | None = None,
+        max_joins: int = 0,
     ) -> None:
         if pc is None or pid is None:
             import jax
@@ -332,10 +521,18 @@ class HeartbeatManager:
         self.note_dir = note_dir
         self.cadence = float(cadence)
         self.max_dead = int(max_dead)
+        self.max_joins = int(max_joins)
         self.pc, self.pid = int(pc), int(pid)
         self.miss_s = max(HEARTBEAT_MISS_FACTOR * self.cadence, 1.0)
         self.live = list(range(self.pc))
         self.dead: list[int] = []
+        # planned departures (drain notes adopted) — out of `live`, never
+        # counted against max_dead; and join admissions (ids >= pc) —
+        # IN `live` for this stage's dealing, invisible downstream
+        self.drained: list[int] = []
+        self.joined: list[int] = []
+        self._adopted_admits: set[int] = set()
+        self._join_budget_logged = False
         self.epoch = 0
         self.seq = 0  # call sequence for this store, set by start()
         self._beat_seq = 0
@@ -377,6 +574,28 @@ class HeartbeatManager:
         the pod has already re-dealt around."""
         return self._note("dead", pid)
 
+    def drain_path(self, pid: int | None = None) -> str:
+        """Planned-departure note (the drain verdict class): written by
+        the DEPARTING member itself at a safe boundary, adopted by every
+        peer with no staleness wait — and immunizing the member against a
+        later death verdict exactly like a done-note (its beats going
+        stale after the drain is the EXPECTED ending, not a second
+        failure)."""
+        return self._note("drain", self.pid if pid is None else pid)
+
+    def join_path(self, pid: int) -> str:
+        """Join-request note published by a NEW process asking admission
+        (:func:`join_elastic_pod`)."""
+        return self._note("join", pid)
+
+    def admit_path(self, pid: int) -> str:
+        """Admission verdict NAMING joiner `pid`, written by the
+        lowest-live leader: carries the grown live set, the pod's
+        original process count (the canonical epoch-0 geometry the joiner
+        cannot otherwise know), and the stage sequence the joiner must
+        adopt."""
+        return self._note("admit", pid)
+
     def _beat(self) -> None:
         from drep_tpu.utils.ckptmeta import atomic_write_bytes
 
@@ -404,6 +623,16 @@ class HeartbeatManager:
         # not self-fence on the previous run's death
         with contextlib.suppress(OSError):
             os.remove(self.verdict_path(self.pid))
+        # same lifecycle for the membership-churn notes naming THIS id: a
+        # drained-then-restarted member must not be re-adopted as
+        # departing, and a stale join request must not re-admit an id
+        # that is now a first-class member. Admit notes are NOT cleaned
+        # here — a joiner starts its manager while peers may still be
+        # adopting the note that admitted it (later stages reject old
+        # admits by their seq stamp instead).
+        for stale_note in (self.drain_path(), self.join_path(self.pid)):
+            with contextlib.suppress(OSError):
+                os.remove(stale_note)
         # own stale degraded-barrier sentinels likewise predate this
         # stage: a restarted degraded pod must not satisfy a file barrier
         # with a previous incarnation's note. Safe against peers still
@@ -426,6 +655,10 @@ class HeartbeatManager:
             # barriers over the corpse) — only the freshness epoch resets
             self.live = [p for p in prev_live if p < self.pc]
             self.dead = [p for p in pod_dead() if p < self.pc]
+            # drained members are as gone as the dead for this stage's
+            # dealing — but restored into their OWN list so the new
+            # stage's death budget never re-counts a planned departure
+            self.drained = [p for p in pod_drained() if p < self.pc]
             self.epoch = pod_epoch()
             _POD["t0"] = self._started_at
         else:
@@ -480,13 +713,21 @@ class HeartbeatManager:
         return self.check()
 
     def check(self) -> bool:
-        """Scan peer liveness; returns True when the epoch bumped.
+        """Scan peer membership + liveness; returns True when the epoch
+        bumped (any membership change: drain, join, or death — the
+        caller's cue to re-deal under the CURRENT live set).
 
-        Published death verdicts are adopted BEFORE any local staleness
-        judgment, so the survivor view converges pod-wide even when one
-        process's view of the beat mtimes is skewed (NFS attribute
-        caching): whoever detects first publishes, everyone else follows,
-        and the subject — if actually alive — fences itself."""
+        Verdict ordering matters: planned departures (drain notes) are
+        adopted FIRST — a drained member's beats going stale is its
+        expected ending, and judging staleness before the drain scan
+        could double-count the departure as a death against
+        ``max_dead``. Join admissions come second (the leader admits, the
+        rest adopt the published admit note). Published death verdicts
+        are adopted BEFORE any local staleness judgment, so the survivor
+        view converges pod-wide even when one process's view of the beat
+        mtimes is skewed (NFS attribute caching): whoever detects first
+        publishes, everyone else follows, and the subject — if actually
+        alive — fences itself."""
         from drep_tpu.utils.profiling import counters
 
         now = time.time()
@@ -498,6 +739,17 @@ class HeartbeatManager:
                 f"has re-dealt its stripes — fencing this process rather "
                 f"than continuing as a zombie. Restart the pod member."
             )
+        # ONE directory scan feeds both membership passes — the drain
+        # exists-checks and the join/admit globs would otherwise add
+        # per-peer stat + readdir traffic to every cadence tick on the
+        # very shared FS this protocol defends (None = transient listdir
+        # failure: the passes fall back to direct reads)
+        try:
+            names: set[str] | None = set(os.listdir(self.note_dir))
+        except OSError:
+            names = None
+        bumped = self._check_drains(now, names)
+        bumped = self._check_joins(now, names) or bumped
         newly: list[int] = []
         adopted: list[int] = []
         # staleness is judged SERVER-clock-to-server-clock: our own beat
@@ -541,7 +793,7 @@ class HeartbeatManager:
             if now - first >= max(self.cadence, 0.2):
                 newly.append(p)
         if not newly:
-            return False
+            return bumped
         if len(self.dead) + len(newly) > self.max_dead:
             raise FaultTolError(
                 f"elastic pod: process(es) {newly} stopped heartbeating, but "
@@ -568,7 +820,8 @@ class HeartbeatManager:
         self.epoch += 1
         counters.add_fault("dead_processes", len(newly))
         counters.add_fault("pod_epoch_bumps")
-        mark_pod_degraded(self.epoch, self.live, self.dead)
+        counters.note_epoch(self.epoch, "death")
+        self._publish_pod_state()
         get_logger().warning(
             "elastic pod: process(es) %s stopped heartbeating (> %.1fs stale) "
             "— bumping ownership epoch to %d and re-dealing their unfinished "
@@ -576,6 +829,282 @@ class HeartbeatManager:
             newly, self.miss_s, self.epoch, self.live,
         )
         return True
+
+    def _note_json(self, path: str) -> dict | None:
+        return read_pod_note(path)
+
+    def drain_payload(self, pid: int) -> dict | None:
+        """The peer's planned-departure note IF it covers the current
+        call (seq-gated exactly like done-notes — a previous stage's
+        drain must never depart a restarted member)."""
+        note = self._note_json(self.drain_path(pid))
+        if note is not None and int(note.get("seq", 0)) >= self.seq:
+            return note
+        return None
+
+    def all_members(self) -> list[int]:
+        """Every id that ever held membership this stage: the original
+        pod plus admitted joiners — the set whose done/drain notes the
+        honest pairs accounting must sum over."""
+        return sorted(set(range(self.pc)) | set(self.joined))
+
+    def announce_drain(self, pairs: int = 0) -> None:
+        """Publish this process's planned-departure note (called at a
+        safe boundary, after the in-flight work unit's shard is durable).
+        `pairs` rides along so the survivor-set totals stay honest about
+        what the departing member actually computed."""
+        from drep_tpu.utils.durableio import atomic_write_json
+        from drep_tpu.utils.profiling import counters
+
+        atomic_write_json(
+            self.drain_path(),
+            {
+                "seq": self.seq, "epoch": self.epoch,
+                "pairs": int(pairs), "at": time.time(),
+            },
+        )
+        counters.add_fault("drain_announced")
+        get_logger().warning(
+            "elastic pod: process %d published its planned-departure note "
+            "(epoch %d) and is exiting 0 — peers re-deal its unfinished "
+            "work with no staleness wait", self.pid, self.epoch,
+        )
+
+    def _check_drains(self, now: float, names: "set[str] | None" = None) -> bool:
+        """Adopt peers' planned-departure notes: immediate membership
+        verdict — one epoch bump, no staleness wait, no death verdict,
+        never counted against ``max_dead``. `names` is check()'s single
+        directory listing — peers without a drain entry there cost no
+        further I/O."""
+        from drep_tpu.utils.profiling import counters
+
+        departed: list[int] = []
+        latency = 0.0
+        for p in self.live:
+            if p == self.pid:
+                continue
+            if names is not None and f".pod-drain.p{p}" not in names:
+                continue
+            note = self.drain_payload(p)
+            if note is None:
+                continue
+            departed.append(p)
+            try:
+                latency = max(
+                    latency, now - os.stat(self.drain_path(p)).st_mtime
+                )
+            except OSError:
+                pass
+        if not departed:
+            return False
+        self.live = [p for p in self.live if p not in departed]
+        self.drained.extend(departed)
+        self.epoch += 1
+        counters.add_fault("planned_departures", len(departed))
+        counters.add_fault("pod_epoch_bumps")
+        counters.note_epoch(self.epoch, "drain")
+        # the degradation-latency proof: wall time from the departure
+        # note's publish to THIS adoption (the re-deal happens in the
+        # caller's very next dealing pass) — the drain contract is that
+        # this sits at ~one check cadence, never the 5x-cadence staleness
+        # window a death costs
+        counters.set_gauge("drain_adopt_latency_s", round(latency, 3))
+        self._publish_pod_state()
+        get_logger().warning(
+            "elastic pod: process(es) %s departed PLANNED (drain notes) — "
+            "bumping ownership epoch to %d and re-dealing their unfinished "
+            "work across %s immediately (no staleness wait; not counted "
+            "against --max_dead_processes)",
+            departed, self.epoch, self.live,
+        )
+        return True
+
+    def _check_joins(self, now: float, names: "set[str] | None" = None) -> bool:
+        """Admit (leader) / adopt (everyone else) mid-run joiners.
+
+        The lowest-live member is the admitting leader: it scans for
+        join-request notes from ids it has never seen, requires a FRESH
+        heartbeat from the candidate (a joiner that died between request
+        and admission must be garbage, not a member), honors at most
+        ``max_joins`` admissions, bumps the epoch, and publishes an admit
+        note carrying the grown live set + the pod geometry. Every other
+        member adopts published admit notes the same way it adopts death
+        verdicts — the membership view converges without any collective.
+        `names` is check()'s single directory listing; without join/admit
+        entries there the pass costs nothing."""
+        from drep_tpu.utils.profiling import counters
+
+        changed = False
+        # ADMITTING (turning requests into admit notes) is the leader's
+        # call, bounded by its --max_joins budget; ADOPTING a published
+        # admit note follows the leader's decision — but BOTH require the
+        # candidate to be beating NOW, judged server-clock-to-server-clock
+        # against our own beat's mtime (the same skew defense as the
+        # staleness verdicts): a fresh-beat requirement is also what makes
+        # stale admit notes from a PREVIOUS run harmless — the seq gate
+        # cannot reject them across restarts (every process's sequence
+        # restarts at 1), but a ghost joiner has no live beat, so it is
+        # never adopted and never consumes stripes or the death budget
+        lead = bool(self.live) and self.pid == min(self.live)
+        try:
+            ref = os.stat(self.beat_path()).st_mtime
+        except OSError:
+            ref = now
+
+        def _beating(j: int) -> bool:
+            try:
+                return ref - os.stat(self.beat_path(j)).st_mtime <= self.miss_s
+            except OSError:
+                return False
+
+        if names is not None:
+            candidates = [
+                os.path.join(self.note_dir, nm)
+                for nm in names
+                if nm.startswith(".pod-admit.p")
+                or (
+                    nm.startswith(".pod-join.p") and lead and self.max_joins > 0
+                )
+            ]
+        else:
+            import glob
+
+            candidates = glob.glob(
+                os.path.join(self.note_dir, ".pod-admit.p*")
+            ) + (
+                glob.glob(os.path.join(self.note_dir, ".pod-join.p*"))
+                if lead and self.max_joins > 0
+                else []
+            )
+        # sorted: admit notes (alphabetically first) are adopted before
+        # new requests are judged, and the scan order is deterministic
+        for path in sorted(candidates):
+            try:
+                j = int(path.rsplit(".p", 1)[1])
+            except ValueError:
+                continue
+            admitting = ".pod-join." in os.path.basename(path)
+            if admitting and lead and j in set(range(self.pc)) | set(self.live):
+                # an auto-derived join id can collide with a canonical
+                # member that simply has not beaten yet (pod startup):
+                # silence would starve the joiner until its timeout, so
+                # the leader REJECTS with a floor the joiner can re-
+                # request above
+                reject = self.admit_path(j)
+                if not os.path.exists(reject):
+                    note = self._note_json(path)
+                    try:
+                        from drep_tpu.utils.durableio import atomic_write_json
+
+                        atomic_write_json(
+                            reject,
+                            {
+                                "pid": j, "reject": "id collides with a pod member",
+                                "min_id": max(max(self.live), self.pc - 1) + 1,
+                                "seq": self.seq,
+                                "token": (note or {}).get("token"),
+                                "at": now,
+                            },
+                        )
+                    except OSError:
+                        pass
+                continue
+            if (
+                j == self.pid
+                or j in self.live
+                or j in self.dead
+                or j in self.drained
+                or j in self._adopted_admits
+            ):
+                continue
+            note = self._note_json(path)
+            if note is None:
+                continue
+            if admitting:
+                if not lead:
+                    continue  # only the leader turns requests into admits
+                # the candidate must already be heartbeating — admission
+                # of a corpse would hand it stripes nobody computes until
+                # its staleness verdict claws them back
+                if not _beating(j):
+                    continue
+                if len(self.joined) >= self.max_joins:
+                    if not self._join_budget_logged:
+                        self._join_budget_logged = True
+                        get_logger().warning(
+                            "elastic pod: join request from process %d "
+                            "refused — --max_joins %d admission(s) already "
+                            "granted this stage", j, self.max_joins,
+                        )
+                    continue
+            else:
+                # adopting a published admit note: seq-gated like every
+                # other membership note (a previous stage's admit must
+                # not resurrect a long-gone joiner), AND fresh-beat-gated
+                # (the seq gate is blind across pod RESTARTS — sequences
+                # start over — so liveness is what keeps a previous run's
+                # admit from resurrecting a ghost); rejects are a
+                # leader-to-joiner message, never a membership verdict
+                if (
+                    "reject" in note
+                    or int(note.get("seq", -1)) < self.seq
+                    or not _beating(j)
+                ):
+                    continue
+            if admitting:
+                # publish the admit note BEFORE committing the local
+                # view: the note is how the joiner (and every peer)
+                # learns of the admission — a member only this process
+                # knows about would be stranded, so a failed write means
+                # no admission happened at all
+                try:
+                    from drep_tpu.utils.durableio import atomic_write_json
+
+                    atomic_write_json(
+                        self.admit_path(j),
+                        {
+                            "pid": j, "epoch": self.epoch + 1,
+                            "live": sorted(self.live + [j]), "pc": self.pc,
+                            "seq": self.seq, "token": note.get("token"),
+                            "at": now,
+                        },
+                    )
+                except OSError:
+                    continue
+            self.live = sorted(self.live + [j])
+            self.joined.append(j)
+            self._adopted_admits.add(j)
+            self.epoch += 1
+            changed = True
+            counters.add_fault("pod_joins")
+            counters.add_fault("pod_epoch_bumps")
+            counters.note_epoch(self.epoch, "join")
+            self._publish_pod_state()
+            get_logger().warning(
+                "elastic pod: process %d JOINED mid-run (%s) — bumping "
+                "ownership epoch to %d and re-dealing unfinished work over "
+                "the grown live set %s",
+                j, "admitted by this leader" if admitting else "adopted admit note",
+                self.epoch, self.live,
+            )
+        return changed
+
+    def _publish_pod_state(self) -> None:
+        """Module pod state for DOWNSTREAM consumers (later barriers,
+        bench provenance). Joiners are stage-scoped: the downstream live
+        view holds original members only, and a PURE-join stage (no
+        deaths, no drains) leaves the pod state healthy — later stages
+        keep the normal collective path over the whole original pod."""
+        if self.dead or self.drained:
+            mark_pod_degraded(
+                self.epoch,
+                [p for p in self.live if p < self.pc],
+                self.dead,
+                drained=self.drained,
+                joined=self.joined,
+            )
+        elif self.joined:
+            mark_pod_joined(self.joined)
 
     def mark_done(self, pairs_computed: int) -> None:
         from drep_tpu.utils.durableio import atomic_write_json
@@ -597,6 +1126,244 @@ class HeartbeatManager:
             self._thread = None
         with contextlib.suppress(OSError):
             os.remove(self.beat_path())
+
+
+def _next_join_id(note_dir: str) -> int:
+    """Auto-derived join id: one past the highest process id any pod note
+    in the store names — guaranteed >= the original process count once
+    the pod is beating (every member's beat note is visible), so the
+    canonical epoch-0 owners are never shadowed. Explicit ids
+    (``DREP_TPU_POD_JOIN=<int>``) exist for orchestration that knows the
+    pod geometry up front (and for joins racing the pod's own startup,
+    where no notes exist yet to derive from)."""
+    import glob
+    import re
+
+    top = -1
+    for path in glob.glob(os.path.join(note_dir, ".pod-*.p*")):
+        m = re.search(r"\.p(\d+)$", path)
+        if m:
+            top = max(top, int(m.group(1)))
+    return top + 1
+
+
+def join_elastic_pod(
+    note_dir: str,
+    cadence: float,
+    config: "FaultTolConfig | None" = None,
+    what: str = "elastic stage",
+    timeout_s: float | None = None,
+    validate: Callable[[], bool] | None = None,
+) -> "HeartbeatManager":
+    """Join a RUNNING elastic pod as new capacity (the scale-UP half of
+    the protocol, ISSUE 9): publish a join-request note plus a first
+    heartbeat under a fresh id, wait for the leader's admit note, and
+    return a started :class:`HeartbeatManager` wired into the pod's
+    membership (live set, epoch, stage sequence, original process count —
+    all from the admit note, so the joiner's canonical-order view is
+    identical to every original member's).
+
+    The note goes out BEFORE any store validation so a pod gated on
+    "capacity has arrived" can open its store after seeing the request
+    (no circular wait); `validate` (e.g. a checkpoint-meta match) is
+    polled alongside the admission wait and must hold before this
+    returns — a joiner must never compute against a store whose inputs
+    differ from its own.
+
+    Raises :class:`CollectiveTimeout` when no admission (or no valid
+    store) materializes within the collective timeout — the pod may be
+    gone, finished, or running with ``--max_joins`` exhausted."""
+    import contextlib
+    import uuid
+
+    from drep_tpu.utils.durableio import atomic_write_json
+    from drep_tpu.utils.profiling import counters
+
+    cfg = config if config is not None else DEFAULT_CONFIG
+    t = collective_timeout_s() if timeout_s is None else timeout_s
+    deadline = time.time() + t if t > 0 else None
+    os.makedirs(note_dir, exist_ok=True)
+    token = uuid.uuid4().hex
+    req = join_requested()
+    explicit = None
+    if req is not None and req != "auto":
+        try:
+            explicit = int(req)
+        except ValueError:
+            from drep_tpu.errors import UserInputError
+
+            raise UserInputError(
+                f"{POD_JOIN_ENV}={req!r}: expected 'auto' or an integer "
+                f"join id (>= the pod's original process count)"
+            ) from None
+    logger = get_logger()
+
+    beat_stamp = b"join-candidate:" + token.encode()
+
+    def _owns_beat(jid: int) -> bool:
+        """Is `.pod-hb.p{jid}` still OUR candidate beat? A different
+        payload means the id's rightful owner (a late-starting canonical
+        member whose id an early auto-derivation shadowed, or a racing
+        joiner) is beating under it — our writes there would mask that
+        process's real death from the staleness detector. Transient read
+        trouble reads as ours (collision detection is best-effort; the
+        leader's reject path and admit-token check are the guarantees)."""
+        try:
+            with open(os.path.join(note_dir, f".pod-hb.p{jid}"), "rb") as f:
+                return f.read() == beat_stamp
+        except OSError:
+            return True
+
+    def _beat(jid: int) -> None:
+        from drep_tpu.utils.ckptmeta import atomic_write_bytes
+
+        atomic_write_bytes(os.path.join(note_dir, f".pod-hb.p{jid}"), beat_stamp)
+
+    floor = 0
+    while True:
+        jid = (
+            explicit
+            if explicit is not None
+            else max(_next_join_id(note_dir), floor)
+        )
+        _beat(jid)  # beat first: admission requires a live candidate
+        atomic_write_json(
+            os.path.join(note_dir, f".pod-join.p{jid}"),
+            {"token": token, "at": time.time()},
+        )
+        logger.info(
+            "elastic pod: requesting mid-run JOIN as process %d (note dir %s)",
+            jid, note_dir,
+        )
+        admit_path = os.path.join(note_dir, f".pod-admit.p{jid}")
+        last_beat = time.time()
+        note = None
+        while True:
+            if os.path.exists(admit_path):
+                note = read_pod_note(admit_path, what="admit note")
+                if note is not None and "reject" in note:
+                    # the leader refused this id (it collides with a
+                    # canonical member that had not beaten yet when the
+                    # id was derived) and published the floor to retry
+                    # above — explicit ids surface the operator error
+                    if explicit is not None:
+                        raise FaultTolError(
+                            f"{what}: join id {jid} rejected by the pod "
+                            f"leader ({note['reject']}); pass an id >= "
+                            f"{note.get('min_id', jid + 1)} (or "
+                            f"{POD_JOIN_ENV}=auto)"
+                        )
+                    floor = max(floor, int(note.get("min_id", jid + 1)))
+                    note = None
+                    # withdraw request AND beat: a stray fresh beat under
+                    # a canonical member's id could mask that member's
+                    # real death from the staleness detector — but never
+                    # remove a beat its rightful owner already reclaimed
+                    with contextlib.suppress(OSError):
+                        os.remove(os.path.join(note_dir, f".pod-join.p{jid}"))
+                    if _owns_beat(jid):
+                        with contextlib.suppress(OSError):
+                            os.remove(os.path.join(note_dir, f".pod-hb.p{jid}"))
+                    break
+                if note is not None and note.get("token") != token:
+                    # another joiner owns this id (two auto-joins raced):
+                    # withdraw and re-request under a fresh one (the id's
+                    # rightful owner keeps beating — only the join note
+                    # was ours to retract, and even that is shared)
+                    note = None
+                    if explicit is None:
+                        break
+            if note is not None and (validate is None or validate()):
+                break
+            if deadline is not None and time.time() > deadline:
+                if note is not None:
+                    # ALREADY ADMITTED but the store never validated (an
+                    # operator pointed a joiner at the wrong inputs): the
+                    # pod now counts this process as a member — leave as
+                    # a PLANNED DEPARTURE, not a future death verdict
+                    # that would burn --max_dead_processes on a healthy
+                    # pod a full staleness window from now
+                    with contextlib.suppress(OSError):
+                        atomic_write_json(
+                            os.path.join(note_dir, f".pod-drain.p{jid}"),
+                            {
+                                "seq": int(note.get("seq", 0)),
+                                "epoch": int(note.get("epoch", 0)),
+                                "pairs": 0, "at": time.time(),
+                            },
+                        )
+                else:
+                    # never admitted: withdraw the request AND the beat
+                    # (if still ours) so a later leader check cannot
+                    # admit a corpse
+                    with contextlib.suppress(OSError):
+                        os.remove(os.path.join(note_dir, f".pod-join.p{jid}"))
+                    if _owns_beat(jid):
+                        with contextlib.suppress(OSError):
+                            os.remove(os.path.join(note_dir, f".pod-hb.p{jid}"))
+                raise CollectiveTimeout(
+                    f"{what}: join request (process {jid}) was not admitted "
+                    f"within {t:.0f}s"
+                    + (
+                        ""
+                        if note is not None
+                        else " — the pod may be gone, already finished, or "
+                        "running with --max_joins exhausted"
+                    )
+                    + (
+                        ""
+                        if validate is None or note is None
+                        else " — admitted, but the store's checkpoint meta "
+                        "never matched this process's inputs (different "
+                        "genome set / parameters?); a planned-departure "
+                        "note was published so the pod re-deals with no "
+                        "staleness wait and no death-budget charge"
+                    )
+                    + f". (Timeout via {COLLECTIVE_TIMEOUT_ENV}.)"
+                )
+            if note is None and explicit is None and not _owns_beat(jid):
+                # the id's rightful owner is beating under it (an auto id
+                # derived before the pod was fully up shadowed a
+                # late-starting canonical member, or another joiner raced
+                # us): withdraw the REQUEST — the beat now belongs to the
+                # owner and must stay — and re-derive above everyone
+                # currently visible
+                floor = max(floor, _next_join_id(note_dir))
+                note = None
+                with contextlib.suppress(OSError):
+                    os.remove(os.path.join(note_dir, f".pod-join.p{jid}"))
+                break
+            if cadence > 0 and time.time() - last_beat >= cadence:
+                with contextlib.suppress(OSError):
+                    _beat(jid)
+                last_beat = time.time()
+            time.sleep(min(0.5, max(0.05, cadence / 2 if cadence > 0 else 0.1)))
+        if note is not None:
+            break
+
+    # adopt the pod's stage sequence BEFORE start() bumps it, so this
+    # process's done-note seq pairs with every original member's
+    key = os.path.abspath(note_dir)
+    _HB_SEQ[key] = int(note["seq"]) - 1
+    hb = HeartbeatManager(
+        note_dir, cadence,
+        max_dead=cfg.max_dead_processes,
+        pc=int(note["pc"]), pid=jid,
+        max_joins=cfg.max_joins,
+    )
+    hb.start()
+    hb.live = sorted(int(p) for p in note["live"])
+    hb.epoch = int(note["epoch"])
+    hb.joined = [p for p in hb.live if p >= hb.pc]
+    hb._adopted_admits.update(hb.joined)
+    with contextlib.suppress(OSError):
+        os.remove(os.path.join(note_dir, f".pod-join.p{jid}"))
+    counters.add_fault("pod_join_accepted")
+    logger.info(
+        "elastic pod: JOINED as process %d (epoch %d, live %s, original "
+        "pod size %d)", jid, hb.epoch, hb.live, hb.pc,
+    )
+    return hb
 
 
 def _watchdog_run(fn: Callable[[], Any], timeout_s: float, what: str, site: str):
@@ -663,11 +1430,12 @@ def wait_elastic(
 
     - `fn` completes -> ``(True, value)`` (a raise from `fn` with the pod
       still healthy at the deadline is re-raised).
-    - the pod DEGRADES (``hb.check()`` bumps the ownership epoch, or this
-      process adopts a peer's published death verdict) -> ``(False, None)``
-      immediately — the caller abandons the collective (the worker thread
-      stays parked inside the runtime; XLA collectives are not
-      cancellable) and re-deals the dead member's work. A collective-layer
+    - the pod's MEMBERSHIP CHANGES (``hb.check()`` bumps the ownership
+      epoch: a death verdict, a planned departure, or a mid-run join
+      admission) -> ``(False, None)`` immediately — the caller abandons
+      the collective (the worker thread stays parked inside the runtime;
+      XLA collectives are not cancellable) and re-deals the remaining
+      work over the CURRENT live set. A collective-layer
       ERROR from `fn` (a dead peer resets the transport) does NOT abort by
       itself: the death verdict needs a full staleness window to mature,
       so the error is held until the heartbeat evidence confirms it (or
@@ -940,6 +1708,8 @@ def retrying_call(
                     attempt_fn, cfg.dispatch_timeout_s, what=site, site=site
                 )
             return attempt_fn()
+        except PodDrained:
+            raise  # a planned departure is a clean exit, never a retry
         except Exception as e:  # noqa: BLE001
             last = e
             get_logger().warning(

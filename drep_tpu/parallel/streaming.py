@@ -166,10 +166,60 @@ def stripe_owner_live(bi: int, n_blocks: int, live: list[int]) -> int:
     """Epoch-scoped stripe ownership: the same mirror-paired dealing, over
     an explicit live-process list instead of ``range(pc)``. With the full
     pod alive this IS :func:`stripe_owner`; after an ownership-epoch bump
-    the dead members drop out of `live` and every stripe — including the
-    dead process's unfinished ones — re-deals across the survivors with
-    the same balance bound."""
+    the dead members drop out of `live` — or new members JOIN it (ids >=
+    the original process count) — and every stripe still missing a shard
+    re-deals across the CURRENT set with the same balance bound. Pure
+    scheduling: shard names/content and the canonical epoch-0 assembly
+    order never depend on who computed a stripe."""
     return live[min(bi, n_blocks - 1 - bi) % len(live)]
+
+
+def stripe_weights(occ: np.ndarray, first_col_block: int) -> np.ndarray:
+    """Per-stripe OCCUPIED-tile counts under a pruned schedule: the tiles
+    stripe `bi` will actually dispatch (candidate-occupied, within the
+    triangular/rect walk). The dealing weight for
+    :func:`deal_stripes` — under ``--primary_prune lsh`` the mirror-paired
+    stripe pairing no longer balances (skip-heavy stripes carry almost no
+    work), so the deal balances what is actually computed instead."""
+    n_blocks = occ.shape[0]
+    return np.array(
+        [
+            int(occ[bi, max(bi, first_col_block):n_blocks].sum())
+            for bi in range(n_blocks)
+        ],
+        dtype=np.int64,
+    )
+
+
+def deal_stripes(
+    n_blocks: int, live: list[int], weights: np.ndarray | None = None
+) -> list[int]:
+    """Owner per stripe over the CURRENT live set.
+
+    ``weights=None`` is exactly the mirror-paired
+    :func:`stripe_owner_live` deal (pinned by property tests — the dense
+    schedule's balance story is unchanged). With per-stripe weights
+    (occupied-tile counts from a pruned schedule, :func:`stripe_weights`)
+    the deal switches to deterministic greedy LPT: stripes in descending
+    weight order (ties by index), each to the currently-lightest member
+    (ties by id) — so every member's computed-tile load is within one
+    stripe's weight of the mean regardless of how skewed the skip pattern
+    is. Deterministic for identical inputs, which every member has
+    (candidates derive from the replicated pack), so the pod agrees on
+    ownership without any exchange. Dealing never reassigns work that is
+    already durable — callers deal only the stripes still MISSING a
+    shard, whoever computed the rest."""
+    if weights is None:
+        return [stripe_owner_live(bi, n_blocks, live) for bi in range(n_blocks)]
+    members = sorted(live)
+    load = {p: 0 for p in members}
+    owners = [members[0]] * n_blocks
+    order = sorted(range(n_blocks), key=lambda b: (-int(weights[b]), b))
+    for b in order:
+        p = min(members, key=lambda m: (load[m], m))
+        owners[b] = p
+        load[p] += int(weights[b])
+    return owners
 
 
 def _shard_name(bi: int, epoch: int) -> str:
@@ -511,15 +561,48 @@ def streaming_mash_edges(
     # runs even single-process (negligible: one tiny file per cadence) so
     # the zero-overhead guard exercises it; monitoring/epochs need peers.
     hb = None
-    if checkpoint_dir is not None:
-        cadence = heartbeat_cadence_s()
-        if cadence > 0:
-            from drep_tpu.parallel.faulttol import HeartbeatManager
+    cadence = heartbeat_cadence_s() if checkpoint_dir is not None else 0.0
+    # mid-run JOIN (ISSUE 9): this process is NOT a pod member — it was
+    # started against a running pod's checkpoint dir (DREP_TPU_POD_JOIN)
+    # to add capacity. It never opens the store (the pod did), never runs
+    # the stage barrier; it requests admission, adopts the pod's
+    # membership, and enters the elastic stripe loop as a grown-set
+    # member — unfinished stripes re-deal to it, finished shards are
+    # reused, and the canonical epoch-0 assembly keeps the final edges
+    # bit-identical to a fixed-membership run.
+    from drep_tpu.parallel.faulttol import join_requested
 
-            hb = HeartbeatManager(
-                checkpoint_dir, cadence, max_dead=ft.config.max_dead_processes
+    joining = join_requested() is not None
+    if joining and (checkpoint_dir is None or cadence <= 0):
+        # a join request that cannot run the protocol must refuse LOUDLY:
+        # falling through would make this process an independent pc=1 run
+        # against the pod's LIVE store — open_checkpoint_dir could clear
+        # the running pod's shards on any meta skew, and even an exact
+        # match silently duplicates every stripe instead of joining
+        from drep_tpu.errors import UserInputError
+
+        raise UserInputError(
+            "DREP_TPU_POD_JOIN is set but the elastic join protocol cannot "
+            "run: "
+            + (
+                "this streaming call has no shared checkpoint dir to join "
+                "through"
+                if checkpoint_dir is None
+                else "heartbeats are disabled (DREP_TPU_HEARTBEAT_S=0) and "
+                "admission rides the heartbeat protocol"
             )
-            hb.start()
+            + ". Unset DREP_TPU_POD_JOIN to run standalone, or point this "
+            "process at the pod's checkpoint dir with heartbeats enabled."
+        )
+    if checkpoint_dir is not None and cadence > 0 and not joining:
+        from drep_tpu.parallel.faulttol import HeartbeatManager
+
+        hb = HeartbeatManager(
+            checkpoint_dir, cadence,
+            max_dead=ft.config.max_dead_processes,
+            max_joins=ft.config.max_joins,
+        )
+        hb.start()
     elastic = hb is not None and pc > 1
 
     resume = False
@@ -548,42 +631,61 @@ def streaming_mash_edges(
             # pre-prune stores stay resumable); a store differing ONLY in
             # these refuses below instead of silently clearing/mixing
             meta.update(prune.params)
-        conflict = _prune_meta_conflict(checkpoint_dir, meta)
-        if conflict is not None:
-            stored_p, wanted_p = conflict
-            from drep_tpu.errors import UserInputError
+        if joining:
+            from drep_tpu.parallel.faulttol import join_elastic_pod
+            from drep_tpu.utils.ckptmeta import checkpoint_meta_matches
 
-            if hb is not None:
-                hb.close()  # never leak the beat writer on a refusing open
-            raise UserInputError(
-                f"streaming checkpoint store {checkpoint_dir} was written "
-                f"under different candidate-pruning parameters "
-                f"({ {k: v for k, v in stored_p.items() if v is not None} or 'pruning off'}) "
-                f"than this run requests "
-                f"({ {k: v for k, v in wanted_p.items() if v is not None} or 'pruning off'}). "
-                f"Refusing to resume: shards must never mix banding configs. "
-                f"Either rerun with the original --primary_prune/--prune_bands/"
-                f"--prune_min_shared knobs, or delete the store directory to "
-                f"recompute under the new ones."
+            # the join note goes out first (a pod gated on arriving
+            # capacity may open its store only after seeing it); the meta
+            # match is polled alongside admission — a joiner must never
+            # compute against a store built from different inputs
+            hb = join_elastic_pod(
+                checkpoint_dir, cadence, config=ft.config,
+                what="streaming primary (mid-run join)",
+                validate=lambda: checkpoint_meta_matches(checkpoint_dir, meta),
             )
-        # leader-only clear + barrier on >1 process lives inside
-        # open_checkpoint_dir (shared with the secondary shard store).
-        # Because the heartbeat manager above started BEFORE this open,
-        # the barrier is heartbeat-aware (utils/ckptmeta.py): a peer that
-        # dies before ever reaching it — even the leader — is admitted as
-        # a pod death within --max_dead_processes, the open completes
-        # over the survivor set, and the elastic loop below starts
-        # DEGRADED instead of this call aborting (ISSUE 4; previously any
-        # pre-barrier death raised at the collective timeout). A raising
-        # open (death budget exceeded, heartbeats disabled, wedged peer)
-        # must not leak the beat writer: a zombie beat would keep this
-        # process looking alive in the store forever.
-        try:
-            resume = open_checkpoint_dir(checkpoint_dir, meta, clear_suffixes=(".npz",))
-        except BaseException:
-            if hb is not None:
-                hb.close()
-            raise
+            pc, pid = hb.pc, hb.pid
+            elastic = True
+            resume = True
+        else:
+            conflict = _prune_meta_conflict(checkpoint_dir, meta)
+            if conflict is not None:
+                stored_p, wanted_p = conflict
+                from drep_tpu.errors import UserInputError
+
+                if hb is not None:
+                    hb.close()  # never leak the beat writer on a refusing open
+                raise UserInputError(
+                    f"streaming checkpoint store {checkpoint_dir} was written "
+                    f"under different candidate-pruning parameters "
+                    f"({ {k: v for k, v in stored_p.items() if v is not None} or 'pruning off'}) "
+                    f"than this run requests "
+                    f"({ {k: v for k, v in wanted_p.items() if v is not None} or 'pruning off'}). "
+                    f"Refusing to resume: shards must never mix banding configs. "
+                    f"Either rerun with the original --primary_prune/--prune_bands/"
+                    f"--prune_min_shared knobs, or delete the store directory to "
+                    f"recompute under the new ones."
+                )
+            # leader-only clear + barrier on >1 process lives inside
+            # open_checkpoint_dir (shared with the secondary shard store).
+            # Because the heartbeat manager above started BEFORE this open,
+            # the barrier is heartbeat-aware (utils/ckptmeta.py): a peer that
+            # dies before ever reaching it — even the leader — is admitted as
+            # a pod death within --max_dead_processes, the open completes
+            # over the survivor set, and the elastic loop below starts
+            # DEGRADED instead of this call aborting (ISSUE 4; previously any
+            # pre-barrier death raised at the collective timeout). A raising
+            # open (death budget exceeded, heartbeats disabled, wedged peer)
+            # must not leak the beat writer: a zombie beat would keep this
+            # process looking alive in the store forever.
+            try:
+                resume = open_checkpoint_dir(
+                    checkpoint_dir, meta, clear_suffixes=(".npz",)
+                )
+            except BaseException:
+                if hb is not None:
+                    hb.close()
+                raise
 
     all_ii: list[np.ndarray] = []
     all_jj: list[np.ndarray] = []
@@ -772,6 +874,16 @@ def streaming_mash_edges(
             all_ii, all_jj, all_dd, pairs_computed = _elastic_stripe_loop(
                 hb, checkpoint_dir, n_blocks, pc, pid, n_owned,
                 _compute_stripe, lambda: pairs_computed, resume, logger,
+                # candidate-aware dealing (ROADMAP LSH follow-on (c)):
+                # under a pruned schedule the mirror-paired balance is
+                # skewed by skip-heavy stripes — deal by occupied-tile
+                # count instead (deal_stripes; ownership is pure
+                # scheduling, so shards/assembly are untouched)
+                weights=(
+                    stripe_weights(occ, first_col_block)
+                    if occ is not None
+                    else None
+                ),
             )
 
         if ft.quarantined():
@@ -821,29 +933,54 @@ def _elastic_stripe_loop(
     own_pairs,
     resume: bool,
     logger,
+    weights=None,
 ) -> tuple[list, list, list, int]:
     """The epoch-aware stripe loop + survivor-set gather (the elastic-pod
     tentpole). Returns (ii_parts, jj_parts, dd_parts, pairs_total) — the
     per-stripe edge arrays in the canonical healthy-run ordering, and the
-    survivor-set pair total (this process's dispatched pairs plus every
-    current done-note's; `own_pairs` reads the caller's running count,
-    which `compute_stripe` advances).
+    member-set pair total (this process's dispatched pairs plus every
+    current done-note's — and, for members that left via a planned
+    departure, their drain note's honest partial count; `own_pairs` reads
+    the caller's running count, which `compute_stripe` advances).
 
     Every stripe's edges are durable in the shared shard store the moment
     it finishes, so completion needs no full-pod collective: each process
     (1) computes the missing stripes it owns under the CURRENT epoch's
-    live list, re-dealing on every bump, (2) publishes a done-note, (3)
-    waits until every stripe has a shard and every live peer is done, and
-    (4) reads the shards back in process-major epoch-0 order — the exact
-    order the healthy jax allgather concatenates, so the final edge list
-    is bit-identical to an undegraded run by construction."""
+    live list (:func:`deal_stripes` — mirror-paired, or occupied-tile-
+    weighted under a pruned schedule; `weights`), re-dealing on every
+    membership bump — deaths and DRAINS shrink the set, JOINS grow it —
+    (2) publishes a done-note, (3) waits until every stripe has a shard
+    and every live peer is done, and (4) reads the shards back in
+    process-major epoch-0 order — the exact order the healthy jax
+    allgather concatenates, so the final edge list is bit-identical to a
+    fixed-membership run by construction (joiners take ids past the
+    original process count precisely so this order never shifts).
+
+    A drain request on THIS process (SIGTERM via install_drain_handler,
+    or the chaos fault mode) is honored at stripe boundaries: the
+    in-flight stripe's shard is already durable, the planned-departure
+    note goes out with the honest pair count, and :class:`PodDrained`
+    unwinds to an exit-0 — peers re-deal the rest with no staleness
+    wait."""
     import time
 
     from drep_tpu.parallel.faulttol import (
         DEFAULT_ALLGATHER_TIMEOUT_S,
         CollectiveTimeout,
+        PodDrained,
         collective_timeout_s,
+        drain_requested,
     )
+
+    def _maybe_drain() -> None:
+        if not drain_requested():
+            return
+        hb.announce_drain(pairs=own_pairs())
+        raise PodDrained(
+            f"streaming primary: process {pid} drained at a stripe "
+            f"boundary (planned-departure note published; peers re-deal "
+            f"its unfinished stripes immediately)"
+        )
 
     stall_budget = collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S)
     done_written = False
@@ -881,16 +1018,22 @@ def _elastic_stripe_loop(
             )
 
     while True:
+        _maybe_drain()
         live = list(hb.live)
+        # ownership under the CURRENT membership: only stripes still
+        # missing a shard are ever acted on, so a membership change can
+        # never reassign (or recompute) work that is already durable
+        owners = deal_stripes(n_blocks, live, weights)
         missing = _missing_stripes()  # ONE shared-FS scan per tick
         computed = False
         for bi in list(missing):
-            if stripe_owner_live(bi, n_blocks, live) != pid:
+            if owners[bi] != pid:
                 continue
             computed = True
             mem[bi] = compute_stripe(bi, epoch=hb.epoch)
             shard_of[bi] = os.path.join(checkpoint_dir, _shard_name(bi, hb.epoch))
             missing.remove(bi)
+            _maybe_drain()  # the in-flight stripe is durable — safe exit
             if hb.maybe_check():
                 break  # epoch bumped mid-pass: re-deal promptly
         if not missing and not done_written:
@@ -986,29 +1129,39 @@ def _elastic_stripe_loop(
         hb.mark_done(own_pairs())
 
     if hb.epoch > 0 and pid == min(hb.live):
-        # the lowest live process stamps degradation provenance into the
-        # store's meta: a later resume sees HOW these shards were produced
-        # (extra keys never invalidate the subset meta match)
+        # the lowest live process stamps membership-churn provenance into
+        # the store's meta: a later resume sees HOW these shards were
+        # produced — deaths, planned departures, admitted joiners (extra
+        # keys never invalidate the subset meta match)
         from drep_tpu.utils.ckptmeta import stamp_checkpoint_meta
 
-        stamp_checkpoint_meta(
-            checkpoint_dir,
-            {"pod_epochs": hb.epoch + 1, "dead_processes": hb.dead},
-        )
+        stamp = {"pod_epochs": hb.epoch + 1, "dead_processes": hb.dead}
+        if hb.drained:
+            stamp["planned_departures"] = hb.drained
+        if hb.joined:
+            stamp["pod_joins"] = len(hb.joined)
+        stamp_checkpoint_meta(checkpoint_dir, stamp)
     if hb.epoch > 0:
         logger.warning(
-            "streaming primary: completed DEGRADED — pod member(s) %s died "
-            "mid-stage; survivors %s finished their stripes across %d "
-            "ownership epoch(s)",
-            hb.dead, hb.live, hb.epoch + 1,
+            "streaming primary: completed with MEMBERSHIP CHURN — dead %s, "
+            "drained %s, joined %s; final members %s finished the stripes "
+            "across %d ownership epoch(s)",
+            hb.dead, hb.drained, hb.joined, hb.live, hb.epoch + 1,
         )
-    # survivor-set total: own dispatched pairs + every CURRENT done-note's
-    # (a member that died mid-stage takes its uncheckpointed pair count
-    # with it — the counter stays honest about who computed; previous-call
-    # notes never count)
+    # member-set total: own dispatched pairs + every CURRENT done-note's,
+    # plus the honest partial counts drained members left in their
+    # departure notes (a member that DIED mid-stage takes its
+    # uncheckpointed pair count with it — the counter stays honest about
+    # who computed; previous-call notes never count). Joiners' done-notes
+    # ride in all_members().
+    def _peer_pairs(p: int) -> int:
+        note = hb.done_payload(p)
+        if note is None:
+            note = hb.drain_payload(p)
+        return int((note or {}).get("pairs", 0))
+
     pairs_total = own_pairs() + sum(
-        int((hb.done_payload(p) or {}).get("pairs", 0))
-        for p in range(pc) if p != pid
+        _peer_pairs(p) for p in hb.all_members() if p != pid
     )
     return all_ii, all_jj, all_dd, pairs_total
 

@@ -262,7 +262,13 @@ def _file_barrier(
     deadline = time.time() + timeout if timeout > 0 else None
     seen: set[int] = set()
     while True:
-        waiting_on = list(hb.live) if hb is not None else live
+        # joiners (ids >= the original process count) are STAGE-SCOPED
+        # capacity admitted by the heartbeat protocol — they never run
+        # replicated control flow, so no barrier may ever await their
+        # sentinel (a leader can admit one DURING this very wait)
+        waiting_on = (
+            [p for p in hb.live if p < hb.pc] if hb is not None else live
+        )
         missing = []
         for p in waiting_on:
             if p == pid or p in seen:
@@ -305,11 +311,15 @@ def _file_barrier(
 
 # the ONLY stored-meta keys a resume is allowed to ignore: pure
 # provenance stamped after the fact (stamp_checkpoint_meta), describing
-# HOW shards were produced, never WHAT they were computed from. Any other
-# unexpected stored key means the store was written by code pinning
-# something this version does not — resuming would silently accept shards
-# computed under a different contract, so it must invalidate.
-META_PROVENANCE_KEYS = ("pod_epochs", "dead_processes")
+# HOW shards were produced, never WHAT they were computed from — deaths,
+# planned departures (drains), and mid-run join admissions are all
+# membership-churn history, not inputs. Any other unexpected stored key
+# means the store was written by code pinning something this version does
+# not — resuming would silently accept shards computed under a different
+# contract, so it must invalidate.
+META_PROVENANCE_KEYS = (
+    "pod_epochs", "dead_processes", "planned_departures", "pod_joins",
+)
 
 
 def checkpoint_meta_matches(ckpt_dir: str, meta: dict[str, Any]) -> bool:

@@ -40,7 +40,11 @@ The ``kill`` mode (``process_death`` site, fired per streaming stripe;
 ``ring_step`` site, fired per dense-ring step boundary) SIGKILLs the
 calling process — the pod-member death the elastic protocols survive,
 made deterministic for chaos tests (indistinguishable from an external
-SIGKILL: no cleanup, no atexit, heartbeats simply stop).
+SIGKILL: no cleanup, no atexit, heartbeats simply stop). The ``drain``
+mode at the same two sites is the GRACEFUL counterpart: it flags the
+process for a planned departure (faulttol.request_drain — the SIGTERM
+path minus the signal), consumed at that very boundary: departure note
+published, PodDrained raised, exit 0.
 
 Zero overhead when unset: the spec parses once (lazily, from the env);
 every :func:`fire` call thereafter is a no-op behind one falsy check.
@@ -80,7 +84,7 @@ SITES = (
 # StoreFullError); corrupt = flip one bit of the published npz AFTER the
 # atomic rename — the post-write rot the in-band checksum self-heals.
 IO_MODES = ("io_error", "stale_read", "enospc", "corrupt")
-MODES = ("raise", "hang", "sleep", "torn", "kill") + IO_MODES
+MODES = ("raise", "hang", "sleep", "torn", "kill", "drain") + IO_MODES
 
 
 class InjectedFault(RuntimeError):
@@ -160,6 +164,15 @@ def _parse(spec: str) -> dict[str, list[_Rule]]:
                 f"mode {mode!r} has no 'io' site semantics — use "
                 f"shard_write:torn for torn publishes, or "
                 f"process_death/ring_step:kill for deaths"
+            )
+        if mode == "drain" and site not in ("process_death", "ring_step"):
+            # the drain request is consumed at the elastic loops' safe
+            # boundaries, which are exactly the death sites' fire points —
+            # anywhere else the flag would be set but never honored and
+            # the chaos run would claim coverage while testing nothing
+            raise FaultSpecError(
+                f"mode 'drain' fires only at the safe-boundary sites "
+                f"process_death/ring_step (got site {site!r})"
             )
         if mode == "torn" and site != "shard_write":
             # tearing is an action the WRITER polls (torn_write), and only
@@ -276,6 +289,14 @@ def fire(site: str, device: int | None = None) -> None:
             import signal
 
             os.kill(os.getpid(), signal.SIGKILL)
+        if rule.mode == "drain":
+            # graceful-preemption stand-in: flag the process for a planned
+            # departure, consumed at this very boundary (the elastic loops
+            # check right after their fire point) — the SIGTERM path minus
+            # the signal, deterministic for chaos tests
+            from drep_tpu.parallel.faulttol import request_drain
+
+            request_drain()
         # 'torn' rules are polled via torn_write(), never fired here
 
 

@@ -66,6 +66,12 @@ class Counters:
     # --dispatch_timeout was left at 0 (parallel/faulttol.py) — reported so
     # an operator can pin an explicit value from evidence.
     gauges: dict[str, float] = field(default_factory=dict)
+    # elastic-pod membership history (ISSUE 9): one entry per ownership-
+    # epoch bump, with WHY it bumped (death / drain / join). The faults
+    # counters say how many of each happened; this says in what ORDER —
+    # a drain-then-join churn and a join-then-drain churn are different
+    # operational stories that the same counter totals would conflate.
+    epoch_history: list = field(default_factory=list)
 
     @contextlib.contextmanager
     def stage(self, name: str, pairs: int = 0) -> Iterator[None]:
@@ -107,6 +113,16 @@ class Counters:
     def set_gauge(self, name: str, value: float) -> None:
         """Record a derived operational value (last write wins)."""
         self.gauges[name] = float(value)
+
+    def note_epoch(self, epoch: int, reason: str) -> None:
+        """Record one ownership-epoch bump (reason: death/drain/join) in
+        the membership history, and mirror the current epoch into the
+        ``pod_epoch`` gauge so a dashboard scraping only gauges still
+        sees the membership generation."""
+        self.epoch_history.append(
+            {"epoch": int(epoch), "reason": str(reason), "at": round(time.time(), 3)}
+        )
+        self.set_gauge("pod_epoch", float(epoch))
 
     def report(self) -> dict[str, Any]:
         import jax
@@ -150,6 +166,8 @@ class Counters:
             out["fault_tolerance"] = dict(sorted(self.faults.items()))
         if self.gauges:
             out["gauges"] = dict(sorted(self.gauges.items()))
+        if self.epoch_history:
+            out["epoch_history"] = list(self.epoch_history)
         return out
 
     def write(self, log_dir: str) -> str:
@@ -169,6 +187,7 @@ class Counters:
         self.stages.clear()
         self.faults.clear()
         self.gauges.clear()
+        self.epoch_history.clear()
 
 
 counters = Counters()  # the process-global instance used by the pipeline

@@ -33,6 +33,17 @@ def main() -> None:
         jax.config.update("jax_num_cpu_devices", 2)
     except AttributeError:
         pass
+    if mode in ("join_streaming", "join_ring"):
+        # mid-run JOINER (ISSUE 9): NOT a member of the jax.distributed
+        # pod at all — a separate single-process jax runtime that joins
+        # the pod's elastic stage through the checkpoint-dir protocol
+        # alone (DREP_TPU_POD_JOIN set by the parent test). Dispatched
+        # BEFORE the gloo collectives config below: gloo backend init
+        # needs the distributed client this process deliberately never
+        # creates.
+        _joiner_case(outdir, mode, sys.argv[6])
+        return
+
     try:
         # pre-0.5 jaxlib implements cross-process CPU collectives only
         # through gloo, and the default ("none") makes every multiprocess
@@ -299,6 +310,92 @@ def _elastic_packed():
     )
 
 
+def _dump_counters(outdir: str, who) -> None:
+    """Fault counters + gauges for the parent's assertions (gauges carry
+    the drain-adoption latency the ISSUE-9 tests pin)."""
+    import json
+
+    from drep_tpu.utils.profiling import counters
+
+    with open(os.path.join(outdir, f"counters_{who}.json"), "w") as f:
+        json.dump({**counters.faults, "gauges": dict(counters.gauges)}, f)
+
+
+def _maybe_install_test_knobs(ckpt_dir: str | None) -> None:
+    """Test-only env knobs for the elastic up/down cases:
+
+    - DREP_TPU_TEST_MAX_JOINS / DREP_TPU_TEST_MAX_DEAD: install a process
+      FaultTolConfig with that join budget / death budget (the CLI's
+      --max_joins / --max_dead_processes path, minus the CLI). MAX_DEAD=0
+      is the drain tests' tripwire: any mis-classification of a planned
+      departure as a death aborts the run loudly.
+    - DREP_TPU_TEST_WAIT_JOIN: block until a join-request note exists in
+      the checkpoint dir before starting the stage — deterministic
+      ordering for the join tests (admission lands at the very first
+      liveness check instead of racing the joiner's interpreter startup).
+    """
+    mj = int(os.environ.get("DREP_TPU_TEST_MAX_JOINS", "0"))
+    md = os.environ.get("DREP_TPU_TEST_MAX_DEAD")
+    if mj or md is not None:
+        from drep_tpu.parallel.faulttol import FaultTolConfig, configure_defaults
+
+        configure_defaults(
+            FaultTolConfig(
+                max_joins=mj,
+                max_dead_processes=int(md) if md is not None else 1,
+            )
+        )
+    if os.environ.get("DREP_TPU_TEST_WAIT_JOIN") and ckpt_dir is not None:
+        import glob
+        import time
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if glob.glob(os.path.join(ckpt_dir, ".pod-join.p*")):
+                return
+            time.sleep(0.05)
+        raise AssertionError("no join-request note appeared within 120s")
+
+
+def _joiner_case(outdir: str, mode: str, ckpt_dir: str) -> None:
+    """Run ONE elastic stage as a mid-run joiner: request admission via
+    the checkpoint-dir protocol, compute the work re-dealt to this
+    process, and publish the assembled result + counters for the parent's
+    bit-identity assertions. DREP_TPU_TEST_JOIN_AFTER_DRAIN delays the
+    join request until a departure note exists (the drain-then-join churn
+    cell's deterministic ordering)."""
+    import glob
+    import time
+
+    if os.environ.get("DREP_TPU_TEST_JOIN_AFTER_DRAIN"):
+        deadline = time.time() + 120
+        while time.time() < deadline and not glob.glob(
+            os.path.join(ckpt_dir, ".pod-drain.p*")
+        ):
+            time.sleep(0.05)
+    packed = _elastic_packed()
+    if mode == "join_streaming":
+        from drep_tpu.parallel.streaming import streaming_mash_edges
+
+        ii, jj, dd, pairs = streaming_mash_edges(
+            packed, k=21, cutoff=0.2, block=ELASTIC_BLOCK, checkpoint_dir=ckpt_dir
+        )
+        np.savez(
+            os.path.join(outdir, "edges_joiner.npz"), ii=ii, jj=jj, dd=dd, pairs=pairs
+        )
+    else:
+        from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+        from drep_tpu.parallel.mesh import make_mesh
+
+        dist = sharded_mash_allpairs(
+            packed, k=21, mesh=make_mesh(), checkpoint_dir=ckpt_dir
+        )
+        np.save(os.path.join(outdir, "ring_joiner.npy"), dist)
+    _dump_counters(outdir, "joiner")
+    with open(os.path.join(outdir, "ok_joiner"), "w") as f:
+        f.write("ok")
+
+
 def _finish_pod_case(pid: int, nproc: int, outdir: str) -> None:
     """Shared pod-case epilogue: write the ok-file, keep process 0 (the
     jax coordination service host) alive until every still-live peer has
@@ -321,9 +418,13 @@ def _finish_pod_case(pid: int, nproc: int, outdir: str) -> None:
         # horizon the service aborts THIS process and fails the test.
         import time
 
-        from drep_tpu.parallel.faulttol import pod_dead
+        from drep_tpu.parallel.faulttol import pod_dead, pod_drained
 
-        want = [p for p in range(nproc) if p != 0 and p not in set(pod_dead())]
+        # drained members exit 0 WITHOUT an ok-file (their verdict is the
+        # drained_N marker) — waiting for one would burn the whole linger
+        # deadline on every drain test
+        gone = set(pod_dead()) | set(pod_drained())
+        want = [p for p in range(nproc) if p != 0 and p not in gone]
         deadline = time.time() + 45
         while time.time() < deadline and not all(
             os.path.exists(os.path.join(outdir, f"ok_{p}")) for p in want
@@ -347,11 +448,9 @@ def _elastic_case(
     The survivors must diagnose it from the missing heartbeat note during
     the barrier wait (pre-barrier death admission, utils/ckptmeta.py),
     continue degraded, and compute the FULL edge set between them."""
-    import json
-
+    from drep_tpu.parallel.faulttol import PodDrained
     from drep_tpu.parallel.streaming import streaming_mash_edges
     from drep_tpu.utils.ckptmeta import open_checkpoint_dir
-    from drep_tpu.utils.profiling import counters
 
     if die_prebarrier and pid == 1:
         # "dead before the stage-open barrier" FROM THE PROTOCOL'S VIEW:
@@ -373,10 +472,20 @@ def _elastic_case(
         ):
             time.sleep(0.05)
         os._exit(0)
+    _maybe_install_test_knobs(ckpt_dir)
     packed = _elastic_packed()
-    ii, jj, dd, pairs = streaming_mash_edges(
-        packed, k=21, cutoff=0.2, block=ELASTIC_BLOCK, checkpoint_dir=ckpt_dir
-    )
+    try:
+        ii, jj, dd, pairs = streaming_mash_edges(
+            packed, k=21, cutoff=0.2, block=ELASTIC_BLOCK, checkpoint_dir=ckpt_dir
+        )
+    except PodDrained:
+        # the graceful-preemption exit (ISSUE 9): departure note is out,
+        # peers re-deal immediately — this process's verdict artifact is
+        # the drained marker + its honest counters, then exit 0
+        with open(os.path.join(outdir, f"drained_{pid}"), "w") as f:
+            f.write("drained")
+        _dump_counters(outdir, pid)
+        os._exit(0)
     # degraded-pod plumbing downstream of the streaming stage: the next
     # checkpoint-store open (the secondary loop's shape) must coordinate
     # over the survivor set — file barrier, lowest-live leader — instead
@@ -387,8 +496,7 @@ def _elastic_case(
     np.savez(
         os.path.join(outdir, f"edges_{pid}.npz"), ii=ii, jj=jj, dd=dd, pairs=pairs
     )
-    with open(os.path.join(outdir, f"counters_{pid}.json"), "w") as f:
-        json.dump(counters.faults, f)
+    _dump_counters(outdir, pid)
     _finish_pod_case(pid, nproc, outdir)
 
 
@@ -399,19 +507,23 @@ def _ring_case(pid: int, nproc: int, outdir: str, ckpt_dir: str) -> None:
     step boundary with its first step's blocks durable; the survivors
     must detect the death between steps, re-deal the missing blocks, and
     assemble a distance matrix bit-identical to the healthy pod's."""
-    import json
-
     from drep_tpu.parallel.allpairs import sharded_mash_allpairs
+    from drep_tpu.parallel.faulttol import PodDrained
     from drep_tpu.parallel.mesh import make_mesh
-    from drep_tpu.utils.profiling import counters
 
+    _maybe_install_test_knobs(ckpt_dir)
     packed = _elastic_packed()
-    dist = sharded_mash_allpairs(
-        packed, k=21, mesh=make_mesh(), checkpoint_dir=ckpt_dir
-    )
+    try:
+        dist = sharded_mash_allpairs(
+            packed, k=21, mesh=make_mesh(), checkpoint_dir=ckpt_dir
+        )
+    except PodDrained:
+        with open(os.path.join(outdir, f"drained_{pid}"), "w") as f:
+            f.write("drained")
+        _dump_counters(outdir, pid)
+        os._exit(0)
     np.save(os.path.join(outdir, f"ring_{pid}.npy"), dist)
-    with open(os.path.join(outdir, f"counters_{pid}.json"), "w") as f:
-        json.dump(counters.faults, f)
+    _dump_counters(outdir, pid)
     _finish_pod_case(pid, nproc, outdir)
 
 

@@ -28,6 +28,13 @@ Two tiers:
   resume refusing with an actionable error (shards untouched), and
   ``io:corrupt`` bit rot on a pruned shard healing through the existing
   recompute path. CPU-only, seconds each.
+- elastic membership cells (``--elastic``): the grow-and-drain half of
+  the pod protocol (ISSUE 9) — a mid-run JOIN admitted into a streaming
+  pod and into a stepwise ring (unfinished work re-dealt over the GROWN
+  live set, final edges/matrix bit-identical), a graceful DRAIN
+  mid-streaming (planned-departure note, immediate epoch bump — no
+  staleness wait, exit 0), and a drain-then-join churn. Delegate to
+  their pytest chaos tests (tests/test_elastic_updown.py), CPU-only.
 - index cells (``--index``): the incremental service mode (ISSUE 6,
   drep_tpu/index/) — SIGKILL mid-``index update`` (pre-publish and
   mid-rect-compare) followed by a rerun converging on the uninterrupted
@@ -37,10 +44,11 @@ Two tiers:
 
 Usage::
 
-    JAX_PLATFORMS=cpu python tools/chaos_matrix.py          # in-process grid
-    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --io     # + storage cells
-    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --index  # + index cells
-    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod    # + pod cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py           # in-process grid
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --io      # + storage cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --index   # + index cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --elastic # + join/drain cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
 
 from __future__ import annotations
@@ -420,6 +428,23 @@ INDEX_CELLS = [
 ]
 
 
+# elastic membership-churn cells (--elastic, ISSUE 9): the grow-and-drain
+# half of the pod protocol. All four delegate to their multi-process
+# pytest chaos tests (tests/test_elastic_updown.py — each needs a real
+# jax.distributed CPU pod plus, for the join cells, a separate
+# single-process joiner), CPU-only, tens of seconds each.
+ELASTIC_CELLS = [
+    ("pod_join", "join", "mid-streaming JOIN -> grown-set re-deal, bit-identical",
+     "survive", "tests/test_elastic_updown.py::test_join_mid_streaming_bit_identical"),
+    ("pod_join", "join", "mid-ring JOIN -> per-block re-deal over grown set",
+     "survive", "tests/test_elastic_updown.py::test_join_mid_ring_bit_identical"),
+    ("pod_drain", "drain", "DRAIN mid-streaming -> immediate re-deal, exit 0",
+     "survive", "tests/test_elastic_updown.py::test_drain_mid_streaming_bit_identical"),
+    ("pod_churn", "drain+join", "drain THEN join churn -> bit-identical",
+     "survive", "tests/test_elastic_updown.py::test_drain_then_join_churn_bit_identical"),
+]
+
+
 # pod cells delegate to the pytest chaos tests (site x mode -> test id)
 POD_CELLS = [
     ("process_death", "kill", "SIGKILL mid-streaming -> epoch re-deal",
@@ -444,6 +469,7 @@ def main() -> int:
     io_cells = "--io" in sys.argv
     index_cells = "--index" in sys.argv
     prune_cells = "--prune" in sys.argv
+    elastic_cells = "--elastic" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
 
@@ -485,6 +511,7 @@ def main() -> int:
 
     _pytest_cells(PRUNE_PYTEST_CELLS, "--prune", prune_cells)
     _pytest_cells(INDEX_CELLS, "--index", index_cells)
+    _pytest_cells(ELASTIC_CELLS, "--elastic", elastic_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)
 
     w_site = max(len(r[0]) for r in rows)

@@ -57,14 +57,17 @@ def _has_error(rec) -> bool:
 def _degraded(rec: dict) -> bool:
     """A record from a run that lost pod member(s) and completed via the
     elastic ownership-epoch protocol — streaming stripes OR dense-ring
-    blocks (ISSUE 4) — or whose ring abandoned its collective schedule
-    into per-block recovery, or that HEALED corrupt shards (ISSUE 5 —
-    healing implies recompute the record does not time-attribute, exactly
-    like degradation): results are correct, but the wall-clock was
-    produced on fewer chips (or a serialized recovery path) than the
-    record claims — not measured perf (same contract as fault-stamped
-    records). bench stamps the top-level keys into EVERY stage record;
-    the fault_tolerance sub-dict catches any record that carried the raw
+    blocks (ISSUE 4) — or whose MEMBERSHIP CHURNED at all (ISSUE 9: a
+    planned drain ran part of the stage on fewer chips, a mid-run join
+    ran part of it on MORE chips — either way the wall-clock describes a
+    chip count the record does not carry), or whose ring abandoned its
+    collective schedule into per-block recovery, or that HEALED corrupt
+    shards (ISSUE 5 — healing implies recompute the record does not
+    time-attribute, exactly like degradation): results are correct, but
+    the wall-clock was not produced on the claimed steady chip count —
+    not measured perf (same contract as fault-stamped records). bench
+    stamps the top-level keys into EVERY stage record; the
+    fault_tolerance sub-dict catches any record that carried the raw
     counters without the stamp. Transient io_retries alone do NOT refuse
     a record — a retried write costs milliseconds, not recompute — but
     io_unrecoverable does: an op that failed past the budget forced a
@@ -74,10 +77,15 @@ def _degraded(rec: dict) -> bool:
     return bool(
         rec.get("dead_processes")
         or rec.get("pod_epochs", 1) > 1
+        or rec.get("pod_joins")
+        or rec.get("planned_departures")
         or rec.get("corrupt_shards_healed")
         or rec.get("io_unrecoverable")
         or ft.get("dead_processes")
         or ft.get("pod_epoch_bumps")
+        or ft.get("pod_joins")
+        or ft.get("planned_departures")
+        or ft.get("drain_announced")
         or ft.get("ring_step_failures")
         or ft.get("corrupt_shards_healed")
         or ft.get("io_unrecoverable")

@@ -23,8 +23,10 @@ Verified payload families (everything else is left alone):
   edge graph, labels/winner table; drep_tpu/index/store.py). Zero-byte,
   truncated, unparseable, or checksum-mismatched shards are DAMAGE.
 - ``meta.json``, the genome-index ``manifest.json``, and the pod
-  protocol's JSON notes (``.pod-done.*``, ``.pod-dead.*``) —
-  unparseable or checksum-mismatched is DAMAGE.
+  protocol's JSON notes (``.pod-done.*``, ``.pod-dead.*``, and the
+  elastic membership family ``.pod-drain.*`` / ``.pod-join.*`` /
+  ``.pod-admit.*``) — unparseable or checksum-mismatched is DAMAGE,
+  never an orphan.
 
 For a genome index, a damaged shard removed by ``--delete`` is healed by
 the next ``drep-tpu index update`` (sketch shards re-sketch from the
@@ -57,12 +59,18 @@ from drep_tpu.utils import durableio  # noqa: E402
 
 def _is_json_note(name: str) -> bool:
     # every checked-JSON family the pipeline publishes: store meta, the
-    # pod protocol's done/death notes, workdir argument snapshots, ingest
-    # poison markers, and the genome-index manifest
-    # (drep_tpu/index/store.py) — all carry the in-band "crc"
+    # pod protocol's membership notes (done/death verdicts, plus the
+    # ISSUE-9 drain departures and join request/admit pairs), workdir
+    # argument snapshots, ingest poison markers, and the genome-index
+    # manifest (drep_tpu/index/store.py) — all carry the in-band "crc"
     return (
         name in ("meta.json", "manifest.json")
-        or name.startswith((".pod-done.", ".pod-dead.", "ingest_error_"))
+        or name.startswith(
+            (
+                ".pod-done.", ".pod-dead.", ".pod-drain.", ".pod-join.",
+                ".pod-admit.", "ingest_error_",
+            )
+        )
         or name.endswith("_arguments.json")
     )
 
