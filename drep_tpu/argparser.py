@@ -199,6 +199,18 @@ def build_parser() -> argparse.ArgumentParser:
                               "loss cannot revert a checkpoint the run "
                               "already trusted — some IOPS cost on shared "
                               "filesystems; DREP_TPU_FSYNC=1 is equivalent")
+        tpu.add_argument("--events", default=None, choices=["off", "on"],
+                         help="structured event tracing (utils/telemetry.py): "
+                              "'on' writes durable append-only per-process "
+                              "event logs <wd>/log/events.p<N>.jsonl — spans "
+                              "for stages/stripes/ring-steps, instants for "
+                              "faults and elastic membership verdicts — read "
+                              "by tools/trace_report.py (merged Chrome trace "
+                              "+ text forensics) and scrub-safe (a torn "
+                              "final line is crash evidence, not damage). "
+                              "Default off: zero overhead, zero files. "
+                              "DREP_TPU_EVENTS=on is equivalent; an explicit "
+                              "flag wins over the env")
         tpu.add_argument("--profile", nargs="?", const="auto", default=None,
                          help="record a jax.profiler trace of the compare stage "
                               "(optionally to the given directory; default "

@@ -501,10 +501,16 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
 
     from drep_tpu.utils.profiling import counters
 
+    from drep_tpu.utils import telemetry
+
     t0 = _time.perf_counter()
-    primary, pdist, plink, sparse_mdb, pairs_done = _primary_clusters(
-        gs, bdb, kw, wd=wd, ft_cfg=ft_cfg
-    )
+    # primary stage span (ISSUE 10): counters.add below keeps the totals;
+    # the span keeps WHEN the stage ran (counters.stage cannot wrap this
+    # site — pairs_done is only known after the call)
+    with telemetry.span("stage:primary_compare"):
+        primary, pdist, plink, sparse_mdb, pairs_done = _primary_clusters(
+            gs, bdb, kw, wd=wd, ft_cfg=ft_cfg
+        )
     counters.add("primary_compare", pairs=pairs_done, seconds=_time.perf_counter() - t0)
     from drep_tpu.parallel.faulttol import pod_dead, pod_epoch, pod_live
 
@@ -552,6 +558,10 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
     else:
         from drep_tpu.cluster.secondary_ckpt import SecondaryCheckpoint
 
+        # controller stage open/close instants (the whole secondary loop
+        # is too branchy for one `with` block; an open with no close IS
+        # the crash evidence — a run that died inside the ANI stage)
+        telemetry.event("stage_open", stage="secondary")
         greedy = kw["greedy_secondary_clustering"]
         # the batched route stays available under greedy: small clusters
         # get their (ani, cov) from ONE device call covering many
@@ -701,6 +711,7 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
             for idx, lab in zip(indices, labels):
                 secondary_names[gs.names[idx]] = f"{pc}_{lab}"
         ckpt.finish(n_primary)
+        telemetry.event("stage_close", stage="secondary")
 
     ndb = (
         pd.concat(ndb_parts, ignore_index=True)

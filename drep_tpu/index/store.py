@@ -201,10 +201,18 @@ class IndexStore:
 
     def publish_manifest(self, manifest: dict) -> None:
         """THE generation commit point: everything before this is
-        invisible to readers, everything after is durable."""
+        invisible to readers, everything after is durable — and, with
+        event tracing on, stamped as a timeline instant (ISSUE 10: the
+        service mode's generation commits join the forensic record)."""
+        from drep_tpu.utils import telemetry
         from drep_tpu.utils.durableio import atomic_write_json
 
         atomic_write_json(self.manifest_path, manifest)
+        telemetry.event(
+            "index_generation",
+            generation=int(manifest.get("generation", -1)),
+            n_genomes=int(manifest.get("n_genomes", 0)),
+        )
 
     # ---- shard serialization --------------------------------------------
     def write_sketch_shard(self, rel: str, names, locations, gdb_rows: pd.DataFrame,

@@ -77,6 +77,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from drep_tpu.ops.containment import ani_cov_from_intersections, containment_inter_tile
 from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
 from drep_tpu.parallel.mesh import AXIS, make_mesh
+from drep_tpu.utils import telemetry
 from drep_tpu.utils.jaxcompat import pcast, shard_map
 from drep_tpu.utils.logger import get_logger
 
@@ -870,6 +871,9 @@ def _ring_allpairs_stepwise(
         path = os.path.join(store, _block_name(blk[0], blk[1], epoch))
         atomic_savez(path, **{f"o{oi}": t for oi, t in enumerate(tiles)})
         shard_of[blk] = path
+        telemetry.event(
+            "blk_publish", shard=_block_name(blk[0], blk[1], epoch)
+        )
 
     def _store_step(i: int, outs) -> None:
         """Host copies of this process's addressable shards of step `i`,
@@ -902,6 +906,10 @@ def _ring_allpairs_stepwise(
         if ex is None:
             ex = TileExecutor(devices, cfg, fault_site="ring_dispatch")
         a, b = blk
+        with telemetry.span("ring_block_recover", a=a, b=b):
+            return _compute_block_tiles(a, b)
+
+    def _compute_block_tiles(a: int, b: int) -> tuple:
         asl = slice(a * n_local, (a + 1) * n_local)
         bsl = slice(b * n_local, (b + 1) * n_local)
 
@@ -1013,53 +1021,57 @@ def _ring_allpairs_stepwise(
             for i, outs in pending:
                 if aborted is not None:
                     break
-                # the elastic chaos tests SIGKILL a pod member here — at a
-                # step boundary, with finished steps' blocks already durable
-                faults.fire("ring_step")
-                t0 = time.perf_counter()
-                try:
-                    if elastic:
-                        def wait(outs=outs):
-                            faults.fire("ring_dispatch")
-                            jax.block_until_ready(outs)
+                # the step span opens BEFORE the chaos fire so a member
+                # killed at the boundary leaves its unclosed "B" as crash
+                # evidence; the elastic chaos tests SIGKILL a pod member
+                # here — with finished steps' blocks already durable
+                with telemetry.span("ring_step", step=i, steps=n_steps):
+                    faults.fire("ring_step")
+                    t0 = time.perf_counter()
+                    try:
+                        if elastic:
+                            def wait(outs=outs):
+                                faults.fire("ring_dispatch")
+                                jax.block_until_ready(outs)
 
-                        ok, _ = wait_elastic(
-                            wait,
-                            hb,
-                            collective_timeout_s(),
-                            what=f"dense ring step {i + 1}/{n_steps} ({kind})",
-                            site="ring_dispatch",
+                            ok, _ = wait_elastic(
+                                wait,
+                                hb,
+                                collective_timeout_s(),
+                                what=f"dense ring step {i + 1}/{n_steps} ({kind})",
+                                site="ring_dispatch",
+                            )
+                            if not ok:
+                                aborted = "pod membership changed"
+                                break
+                        else:
+                            _wait_ready(outs, auto.effective(), "ring_dispatch", None)
+                    except WatchdogTimeout as e:
+                        counters.add_fault("ring_step_failures")
+                        logger.warning(
+                            "dense ring: step %d/%d tripped the %ss watchdog — "
+                            "recomputing its blocks per-tile",
+                            i + 1, n_steps, round(auto.effective(), 1),
                         )
-                        if not ok:
-                            aborted = "pod membership changed"
-                            break
-                    else:
-                        _wait_ready(outs, auto.effective(), "ring_dispatch", None)
-                except WatchdogTimeout as e:
-                    counters.add_fault("ring_step_failures")
-                    logger.warning(
-                        "dense ring: step %d/%d tripped the %ss watchdog — "
-                        "recomputing its blocks per-tile",
-                        i + 1, n_steps, round(auto.effective(), 1),
-                    )
-                    aborted = e
-                    break
-                except (CollectiveTimeout, FaultTolError):
-                    raise  # wedged peer / max_dead exceeded: abort loudly
-                except Exception as e:  # noqa: BLE001 — per-block recovery
-                    counters.add_fault("ring_step_failures")
-                    logger.warning(
-                        "dense ring: step %d/%d failed (%s) — recomputing "
-                        "its blocks per-tile", i + 1, n_steps, e,
-                    )
-                    aborted = e
-                    break
-                auto.note(time.perf_counter() - t0)
-                _store_step(i, outs)
-                # a drain request is honored at the step boundary: this
-                # step's blocks are durable, the departure note goes out,
-                # and the peers re-deal the rest with no staleness wait
-                _maybe_drain()
+                        aborted = e
+                        break
+                    except (CollectiveTimeout, FaultTolError):
+                        raise  # wedged peer / max_dead exceeded: abort loudly
+                    except Exception as e:  # noqa: BLE001 — per-block recovery
+                        counters.add_fault("ring_step_failures")
+                        logger.warning(
+                            "dense ring: step %d/%d failed (%s) — recomputing "
+                            "its blocks per-tile", i + 1, n_steps, e,
+                        )
+                        aborted = e
+                        break
+                    auto.note(time.perf_counter() - t0)
+                    _store_step(i, outs)
+                    # a drain request is honored at the step boundary: this
+                    # step's blocks are durable, the departure note goes
+                    # out, and the peers re-deal the rest with no
+                    # staleness wait
+                    _maybe_drain()
             derived = auto.derived()
             if derived is not None:
                 # the per-step watchdog deadline the run derived from its
@@ -1099,10 +1111,18 @@ def _ring_allpairs_stepwise(
             done_written = False
             last_progress = time.time()
             progress_sig = None
+            last_deal_epoch = -1
             while True:
                 _maybe_drain()
                 live = list(hb.live)
                 missing = _missing_blocks()
+                if hb.epoch != last_deal_epoch:
+                    if hb.epoch > 0:
+                        telemetry.event(
+                            "re_deal", unit="ring_block", live=live,
+                            missing=len(missing),
+                        )
+                    last_deal_epoch = hb.epoch
                 computed = False
                 for blk in list(missing):
                     # schedule-index dealing over the CURRENT live set —

@@ -92,7 +92,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
-from drep_tpu.utils import faults
+from drep_tpu.utils import faults, telemetry
 from drep_tpu.utils.logger import get_logger
 
 # multi-host collective watchdog (seconds); 0 disables; the env var
@@ -733,6 +733,7 @@ class HeartbeatManager:
         now = time.time()
         self._last_check = now
         if os.path.exists(self.verdict_path(self.pid)):
+            telemetry.event("fenced", pid=self.pid)
             raise FaultTolError(
                 f"elastic pod: a peer declared process {self.pid} dead (its "
                 f"view of this process's heartbeat went stale) and the pod "
@@ -815,6 +816,15 @@ class HeartbeatManager:
                 )
             except OSError:  # best-effort: peers can still detect on
                 pass  # their own staleness clock
+        # the heartbeat verdict instant: WHO was declared dead and whether
+        # this process published the verdict or adopted a peer's (the
+        # epoch instant that follows carries the bump itself)
+        telemetry.event(
+            "death_verdict",
+            peers=newly,
+            adopted=sorted(adopted),
+            by=self.pid,
+        )
         self.dead.extend(newly)
         self.live = [p for p in self.live if p not in newly]
         self.epoch += 1
@@ -864,6 +874,7 @@ class HeartbeatManager:
             },
         )
         counters.add_fault("drain_announced")
+        telemetry.event("drain_announce", pid=self.pid, pairs=int(pairs))
         get_logger().warning(
             "elastic pod: process %d published its planned-departure note "
             "(epoch %d) and is exiting 0 — peers re-deal its unfinished "
@@ -897,6 +908,9 @@ class HeartbeatManager:
                 pass
         if not departed:
             return False
+        telemetry.event(
+            "drain_adopted", peers=departed, latency_s=round(latency, 3)
+        )
         self.live = [p for p in self.live if p not in departed]
         self.drained.extend(departed)
         self.epoch += 1
@@ -1071,6 +1085,10 @@ class HeartbeatManager:
                     )
                 except OSError:
                     continue
+            telemetry.event(
+                "join_admitted" if admitting else "join_adopted",
+                peer=j, by=self.pid,
+            )
             self.live = sorted(self.live + [j])
             self.joined.append(j)
             self._adopted_admits.add(j)
@@ -1113,6 +1131,7 @@ class HeartbeatManager:
             self.done_path(),
             {"pairs": int(pairs_computed), "epoch": self.epoch, "seq": self.seq},
         )
+        telemetry.event("done", pid=self.pid, pairs=int(pairs_computed))
 
     def close(self) -> None:
         import contextlib
@@ -1359,6 +1378,14 @@ def join_elastic_pod(
     with contextlib.suppress(OSError):
         os.remove(os.path.join(note_dir, f".pod-join.p{jid}"))
     counters.add_fault("pod_join_accepted")
+    # the joiner's stream must re-home to its ADMITTED id (a production
+    # joiner configured telemetry as a pid-0 single-process run — without
+    # this its events would interleave into member 0's log) and stamp the
+    # pod's CURRENT epoch (it never ran note_epoch for the bumps it
+    # missed)
+    telemetry.set_pid(jid)
+    telemetry.set_epoch(hb.epoch)
+    telemetry.event("joined", pid=jid, epoch=hb.epoch, live=hb.live)
     logger.info(
         "elastic pod: JOINED as process %d (epoch %d, live %s, original "
         "pod size %d)", jid, hb.epoch, hb.live, hb.pc,

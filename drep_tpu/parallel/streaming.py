@@ -51,6 +51,7 @@ import os
 import numpy as np
 
 from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
+from drep_tpu.utils import telemetry
 from drep_tpu.utils.logger import get_logger
 
 DEFAULT_BLOCK = 1024
@@ -714,13 +715,19 @@ def streaming_mash_edges(
             counts1d_on = counts_on
 
     def _compute_stripe(bi: int, epoch: int = 0) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Dispatch + finalize one row-block stripe; publishes its shard
-        (epoch-stamped name) when checkpointing. Returns the stripe's
-        surviving edges."""
+        """Dispatch + finalize one row-block stripe inside a traced span
+        (ISSUE 10: an unclosed stripe "B" record is the crash evidence —
+        the stripe in flight when a member died); publishes its shard
+        under the epoch-stamped name when checkpointing. Returns the
+        stripe's surviving edges."""
+        with telemetry.span("stripe", bi=bi, epoch=epoch):
+            # the elastic chaos tests SIGKILL a pod member here — at a
+            # stripe boundary, with its finished shards already durable
+            _faults.fire("process_death")
+            return _compute_stripe_tiles(bi, epoch)
+
+    def _compute_stripe_tiles(bi: int, epoch: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         nonlocal pairs_computed, tiles_done, tiles_full, tiles_skipped
-        # the elastic chaos tests SIGKILL a pod member here — at a stripe
-        # boundary, with its finished shards already durable
-        _faults.fire("process_death")
         if occ is not None and not occ[bi, max(bi, first_col_block):n_blocks].any():
             # fully-pruned stripe: no tile holds a candidate, so the dense
             # walk would retain nothing here — publish the (empty) shard
@@ -735,6 +742,10 @@ def streaming_mash_edges(
                 atomic_savez(
                     os.path.join(checkpoint_dir, _shard_name(bi, epoch)),
                     ii=empty[0], jj=empty[1], dist=empty[2],
+                )
+                telemetry.event(
+                    "shard_publish", shard=_shard_name(bi, epoch), edges=0,
+                    pruned=True,
                 )
             return empty
         _ensure_pack_on_devices()
@@ -842,6 +853,9 @@ def streaming_mash_edges(
                 os.path.join(checkpoint_dir, _shard_name(bi, epoch)),
                 ii=s_ii, jj=s_jj, dist=s_dd,
             )
+            telemetry.event(
+                "shard_publish", shard=_shard_name(bi, epoch), edges=len(s_ii)
+            )
         return s_ii, s_jj, s_dd
 
     try:
@@ -866,6 +880,7 @@ def streaming_mash_edges(
                 # report against the stripes THIS process owns: on multi-
                 # process runs the global n_blocks would understate resume
                 # progress ~pc-fold
+                telemetry.event("resume", stripes=n_resumed, owned=n_owned)
                 logger.info(
                     "streaming primary: resumed %d/%d owned row-block shards (process %d/%d)",
                     n_resumed, n_owned, pid, pc,
@@ -1012,11 +1027,13 @@ def _elastic_stripe_loop(
             1 for b in shard_of if stripe_owner(b, n_blocks, pc) == pid
         )
         if n_resumed:
+            telemetry.event("resume", stripes=n_resumed, owned=n_owned)
             logger.info(
                 "streaming primary: resumed %d/%d owned row-block shards (process %d/%d)",
                 n_resumed, n_owned, pid, pc,
             )
 
+    last_deal_epoch = -1
     while True:
         _maybe_drain()
         live = list(hb.live)
@@ -1025,6 +1042,15 @@ def _elastic_stripe_loop(
         # never reassign (or recompute) work that is already durable
         owners = deal_stripes(n_blocks, live, weights)
         missing = _missing_stripes()  # ONE shared-FS scan per tick
+        if hb.epoch != last_deal_epoch:
+            if hb.epoch > 0:
+                # the re-deal instant: this tick deals the still-missing
+                # stripes under the CHANGED membership (causally after
+                # the drain/death/join verdict and its epoch instant)
+                telemetry.event(
+                    "re_deal", unit="stripe", live=live, missing=len(missing)
+                )
+            last_deal_epoch = hb.epoch
         computed = False
         for bi in list(missing):
             if owners[bi] != pid:

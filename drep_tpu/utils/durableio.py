@@ -184,6 +184,11 @@ def retry_io(
                 attempt + 1, retries + 1,
             )
     _count("io_unrecoverable")
+    # timeline detail the bare counter cannot carry: WHICH payload ran
+    # out of retry budget (the generic fault instant rides add_fault)
+    from drep_tpu.utils import telemetry
+
+    telemetry.event("io_unrecoverable", what=what, path=path)
     raise last  # type: ignore[misc]  # loop ran >= once with a transient error
 
 
@@ -472,6 +477,9 @@ def quarantine_corrupt(path: str) -> None:
     EACCES/flaky NFS; the recompute's atomic rewrite replaces it either
     way (the idempotent self-heal invariant)."""
     _count("corrupt_shards_healed")
+    from drep_tpu.utils import telemetry
+
+    telemetry.event("io_heal", path=path)
     with contextlib.suppress(OSError):
         os.remove(path)
 

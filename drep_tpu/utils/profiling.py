@@ -16,9 +16,12 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Iterator
+
+from drep_tpu.utils import telemetry
 
 
 @dataclass
@@ -76,13 +79,16 @@ class Counters:
     @contextlib.contextmanager
     def stage(self, name: str, pairs: int = 0) -> Iterator[None]:
         t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            st = self.stages.setdefault(name, _Stage())
-            st.pairs += int(pairs)
-            st.seconds += time.perf_counter() - t0
-            st.calls += 1
+        # the one hook that traces every counted stage block (controller
+        # stage open/close, ISSUE 10) — a no-op object when events are off
+        with telemetry.span("stage:" + name):
+            try:
+                yield
+            finally:
+                st = self.stages.setdefault(name, _Stage())
+                st.pairs += int(pairs)
+                st.seconds += time.perf_counter() - t0
+                st.calls += 1
 
     def add(self, name: str, pairs: int, seconds: float) -> None:
         st = self.stages.setdefault(name, _Stage())
@@ -107,8 +113,11 @@ class Counters:
     def add_fault(self, kind: str, n: int = 1) -> None:
         """Count one fault-tolerance event (retry, watchdog trip, device
         quarantine, CPU-fallback tile, pod-member death, or an injected
-        fault firing)."""
+        fault firing) — and, with event tracing on, stamp WHEN it
+        happened into the structured timeline (the counters keep the
+        totals; the events keep the order)."""
         self.faults[kind] = self.faults.get(kind, 0) + int(n)
+        telemetry.event("fault", kind=kind, n=int(n))
 
     def set_gauge(self, name: str, value: float) -> None:
         """Record a derived operational value (last write wins)."""
@@ -123,12 +132,28 @@ class Counters:
             {"epoch": int(epoch), "reason": str(reason), "at": round(time.time(), 3)}
         )
         self.set_gauge("pod_epoch", float(epoch))
+        # keep the event stream's stamped epoch current, and mark the
+        # bump itself as a timeline instant (the membership-timeline
+        # anchor tools/trace_report.py reconstructs from)
+        telemetry.set_epoch(int(epoch))
+        telemetry.event("epoch", epoch=int(epoch), reason=str(reason))
 
     def report(self) -> dict[str, Any]:
-        import jax
+        # host-side tooling (tools/trace_report.py, the scrubber's
+        # neighbors) must be able to render a counter report WITHOUT a
+        # JAX runtime: fall back to n_chips=1 with a provenance note when
+        # jax is absent or its backend refuses to initialize
+        n_chips_source = None
+        try:
+            import jax
 
-        n_chips = max(1, len(jax.devices()))
+            n_chips = max(1, len(jax.devices()))
+        except Exception as e:  # noqa: BLE001 — ImportError OR backend-init failure
+            n_chips = 1
+            n_chips_source = f"default (jax unavailable: {type(e).__name__})"
         out: dict[str, Any] = {"n_chips": n_chips, "stages": {}}
+        if n_chips_source is not None:
+            out["n_chips_source"] = n_chips_source
         total_pairs, total_seconds = 0, 0.0
         for name, st in self.stages.items():
             rate = st.pairs / st.seconds if st.seconds > 0 else 0.0
@@ -191,6 +216,127 @@ class Counters:
 
 
 counters = Counters()  # the process-global instance used by the pipeline
+
+
+# -- periodic Prometheus-textfile flush (ISSUE 10 satellite) ----------------
+#
+# Long runs were scrapeable only at exit (Counters.write). With
+# DREP_TPU_METRICS_FLUSH_S > 0 (default off — zero threads, zero files),
+# a daemon thread publishes the counters/gauges every cadence to
+# <wd>/log/metrics.prom in the Prometheus textfile-collector format,
+# atomically (utils/durableio.py) so a scrape can never read a torn file.
+
+METRICS_FLUSH_ENV = "DREP_TPU_METRICS_FLUSH_S"
+METRICS_NAME = "metrics.prom"
+
+_METRICS: dict[str, Any] = {"stop": None, "thread": None, "log_dir": None}
+
+
+def metrics_flush_cadence_s() -> float:
+    try:
+        return float(os.environ.get(METRICS_FLUSH_ENV, "0") or 0)
+    except ValueError:
+        return 0.0
+
+
+def _prom_escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def prom_text(c: Counters | None = None) -> str:
+    """The counters/gauges as Prometheus textfile-collector lines. Stage
+    pair/second/call totals, fault-event totals by kind, every gauge, the
+    pod epoch-bump count, and the flush timestamp (staleness detection on
+    the scraper side)."""
+    c = counters if c is None else c
+    lines = [
+        "# HELP drep_tpu_stage_pairs_total pair comparisons recorded per stage",
+        "# TYPE drep_tpu_stage_pairs_total counter",
+    ]
+    for name, st in sorted(c.stages.items()):
+        tag = f'{{stage="{_prom_escape(name)}"}}'
+        lines.append(f"drep_tpu_stage_pairs_total{tag} {st.pairs}")
+    lines += [
+        "# TYPE drep_tpu_stage_seconds_total counter",
+        *(
+            f'drep_tpu_stage_seconds_total{{stage="{_prom_escape(n)}"}} '
+            f"{round(st.seconds, 6)}"
+            for n, st in sorted(c.stages.items())
+        ),
+        "# TYPE drep_tpu_stage_calls_total counter",
+        *(
+            f'drep_tpu_stage_calls_total{{stage="{_prom_escape(n)}"}} {st.calls}'
+            for n, st in sorted(c.stages.items())
+        ),
+        "# HELP drep_tpu_fault_events_total fault-tolerance events by kind",
+        "# TYPE drep_tpu_fault_events_total counter",
+        *(
+            f'drep_tpu_fault_events_total{{kind="{_prom_escape(k)}"}} {v}'
+            for k, v in sorted(c.faults.items())
+        ),
+        "# HELP drep_tpu_gauge derived operational values (last write wins)",
+        "# TYPE drep_tpu_gauge gauge",
+        *(
+            f'drep_tpu_gauge{{name="{_prom_escape(g)}"}} {v}'
+            for g, v in sorted(c.gauges.items())
+        ),
+        "# TYPE drep_tpu_epoch_bumps_total counter",
+        f"drep_tpu_epoch_bumps_total {len(c.epoch_history)}",
+        "# TYPE drep_tpu_metrics_flush_timestamp_seconds gauge",
+        f"drep_tpu_metrics_flush_timestamp_seconds {round(time.time(), 3)}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def flush_metrics(log_dir: str, c: Counters | None = None) -> str:
+    """One atomic publish of the current counters to
+    ``<log_dir>/metrics.prom`` (the durable-I/O rename path — a scrape
+    mid-publish reads the previous whole file, never a torn one)."""
+    from drep_tpu.utils.durableio import atomic_write_bytes
+
+    path = os.path.join(log_dir, METRICS_NAME)
+    atomic_write_bytes(path, prom_text(c).encode())
+    return path
+
+
+def start_metrics_flush(log_dir: str) -> bool:
+    """Launch the periodic flusher when ``DREP_TPU_METRICS_FLUSH_S`` > 0
+    (default off: no thread, no file). Idempotent per run — a second
+    start replaces the first (library users run several workflows per
+    process)."""
+    stop_metrics_flush()
+    cadence = metrics_flush_cadence_s()
+    _METRICS["log_dir"] = log_dir
+    if cadence <= 0:
+        return False
+    stop = threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(cadence):
+            try:
+                flush_metrics(log_dir)
+            except Exception:  # noqa: BLE001 — a flaky flush must never kill the run
+                pass
+
+    t = threading.Thread(target=loop, daemon=True, name="drep-metrics-flush")
+    _METRICS["stop"] = stop
+    _METRICS["thread"] = t
+    t.start()
+    return True
+
+
+def stop_metrics_flush(final: bool = False) -> None:
+    """Stop the flusher; with `final`, publish one last snapshot so the
+    scrape file agrees with the exit-time perf_counters.json."""
+    stop, t = _METRICS["stop"], _METRICS["thread"]
+    _METRICS["stop"] = _METRICS["thread"] = None
+    if stop is not None:
+        stop.set()
+    if t is not None:
+        t.join(timeout=2.0)
+    if final and stop is not None and _METRICS["log_dir"]:
+        with contextlib.suppress(Exception):
+            flush_metrics(_METRICS["log_dir"])
 
 
 @contextlib.contextmanager

@@ -19,7 +19,9 @@ from drep_tpu.workdir import WorkDirectory
 from drep_tpu.errors import UserInputError
 
 
-def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]:
+def _init(
+    wd_loc: str, genomes: list[str], events: str | bool | None = None
+) -> tuple[WorkDirectory, pd.DataFrame]:
     # multi-host bring-up must precede any backend use (no-op single-host)
     from drep_tpu.parallel.mesh import initialize_distributed
     from drep_tpu.utils.xla_cache import enable_persistent_cache
@@ -28,6 +30,19 @@ def _init(wd_loc: str, genomes: list[str]) -> tuple[WorkDirectory, pd.DataFrame]
     initialize_distributed()
     wd = WorkDirectory(wd_loc)
     setup_logger(wd.get_dir("log"))
+    # structured event tracing (ISSUE 10): per-process append-only JSONL
+    # under <wd>/log, gated by --events / DREP_TPU_EVENTS (default off —
+    # zero files, zero overhead); plus the optional periodic Prometheus
+    # textfile flush (DREP_TPU_METRICS_FLUSH_S, default off)
+    import jax
+
+    from drep_tpu.utils import telemetry
+    from drep_tpu.utils.profiling import start_metrics_flush
+
+    telemetry.configure(
+        log_dir=wd.get_dir("log"), enabled=events, pid=jax.process_index()
+    )
+    start_metrics_flush(wd.get_dir("log"))
     # fresh per-run state (library users may call several workflows per process)
     from drep_tpu.cluster.anim import reset_run_state
     from drep_tpu.utils.profiling import counters
@@ -53,10 +68,14 @@ def _trace_dir(wd: WorkDirectory, profile) -> str | None:
 
 
 def _finish_counters(wd: WorkDirectory) -> None:
-    from drep_tpu.utils.profiling import counters
+    from drep_tpu.utils import telemetry
+    from drep_tpu.utils.profiling import counters, stop_metrics_flush
 
+    stop_metrics_flush(final=True)
     rep = counters.report()
     path = counters.write(wd.get_dir("log"))
+    telemetry.event("run_finished", pairs=rep["total"]["pairs"])
+    telemetry.close()
     total = rep["total"]
     get_logger().info(
         "perf: %d pairs in %.2fs = %s pairs/sec/chip (%d chip(s)) -> %s",
@@ -67,15 +86,18 @@ def _finish_counters(wd: WorkDirectory) -> None:
 
 def compare_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> pd.DataFrame:
     """`compare`: cluster + evaluate + analyze. Returns Cdb."""
+    from drep_tpu.utils import telemetry
     from drep_tpu.utils.profiling import trace
 
-    wd, bdb = _init(wd_loc, genomes or [])
+    wd, bdb = _init(wd_loc, genomes or [], events=kwargs.pop("events", None))
     with trace(_trace_dir(wd, kwargs.pop("profile", None))):
-        cdb = d_cluster_wrapper(wd, bdb, **kwargs)
+        with telemetry.span("stage:cluster"):
+            cdb = d_cluster_wrapper(wd, bdb, **kwargs)
     # per-genome stats for downstream stages come from the ingest pass's Gdb
     # (one FASTA read per genome, not a second parse)
     wd.store_db(wd.get_db("Gdb")[["genome", "length", "N50", "contigs"]], "genomeInformation")
-    d_evaluate_wrapper(wd, **kwargs)
+    with telemetry.span("stage:evaluate"):
+        d_evaluate_wrapper(wd, **kwargs)
     if not kwargs.get("skip_plots", False):
         from drep_tpu.analyze import plot_all
 
@@ -104,6 +126,17 @@ def _init_index(index_loc: str, write_logs: bool = True) -> None:
         log_dir = os.path.join(os.path.abspath(index_loc), "log")
         os.makedirs(log_dir, exist_ok=True)
     setup_logger(log_dir)
+    # event tracing + metrics flush ride the index log dir; classify
+    # (write_logs=False) keeps BOTH off — its read-only byte-for-byte
+    # contract forbids even an event line under the index tree
+    from drep_tpu.utils import telemetry
+    from drep_tpu.utils.profiling import start_metrics_flush, stop_metrics_flush
+
+    telemetry.configure(log_dir=log_dir)
+    if log_dir is not None:
+        start_metrics_flush(log_dir)
+    else:
+        stop_metrics_flush()
     counters.reset()
 
 
@@ -173,15 +206,22 @@ def dereplicate_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs)
     Returns Wdb (the winners)."""
     from drep_tpu.utils.profiling import trace
 
-    wd, bdb = _init(wd_loc, genomes or [])
+    from drep_tpu.utils import telemetry
+
+    wd, bdb = _init(wd_loc, genomes or [], events=kwargs.pop("events", None))
     if kwargs.get("run_tax"):
         from drep_tpu.bonus import validate_bonus_args
 
         validate_bonus_args(kwargs)  # fail fast, before hours of clustering
-    filtered = d_filter_wrapper(wd, bdb, genomeInfo=kwargs.pop("genomeInfo", None), **kwargs)
+    with telemetry.span("stage:filter"):
+        filtered = d_filter_wrapper(
+            wd, bdb, genomeInfo=kwargs.pop("genomeInfo", None), **kwargs
+        )
     with trace(_trace_dir(wd, kwargs.pop("profile", None))):
-        d_cluster_wrapper(wd, filtered, **kwargs)
-    wdb = d_choose_wrapper(wd, filtered, **kwargs)
+        with telemetry.span("stage:cluster"):
+            d_cluster_wrapper(wd, filtered, **kwargs)
+    with telemetry.span("stage:choose"):
+        wdb = d_choose_wrapper(wd, filtered, **kwargs)
     if kwargs.get("run_tax"):
         from drep_tpu.bonus import d_bonus_wrapper
 
@@ -190,7 +230,8 @@ def dereplicate_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs)
             cent_index=kwargs.get("cent_index"),
             processes=kwargs.get("processes", 1),
         )
-    d_evaluate_wrapper(wd, **kwargs)
+    with telemetry.span("stage:evaluate"):
+        d_evaluate_wrapper(wd, **kwargs)
     if not kwargs.get("skip_plots", False):
         from drep_tpu.analyze import plot_all
 

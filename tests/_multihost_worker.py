@@ -311,14 +311,33 @@ def _elastic_packed():
 
 
 def _dump_counters(outdir: str, who) -> None:
-    """Fault counters + gauges for the parent's assertions (gauges carry
-    the drain-adoption latency the ISSUE-9 tests pin)."""
+    """Fault counters + gauges + the ordered epoch history for the
+    parent's assertions (gauges carry the drain-adoption latency the
+    ISSUE-9 tests pin; epoch_history anchors the ISSUE-10
+    trace-report-vs-counters membership-timeline check)."""
     import json
 
     from drep_tpu.utils.profiling import counters
 
     with open(os.path.join(outdir, f"counters_{who}.json"), "w") as f:
-        json.dump({**counters.faults, "gauges": dict(counters.gauges)}, f)
+        json.dump(
+            {
+                **counters.faults,
+                "gauges": dict(counters.gauges),
+                "epoch_history": list(counters.epoch_history),
+            },
+            f,
+        )
+
+
+def _maybe_events(outdir: str, pid: int) -> None:
+    """Structured event tracing for the pod chaos cells (ISSUE 10): when
+    the parent test exports DREP_TPU_EVENTS=on, each member appends to
+    <outdir>/log/events.p<pid>.jsonl for the tools/trace_report.py
+    timeline assertions. A no-op (zero files) otherwise."""
+    from drep_tpu.utils import telemetry
+
+    telemetry.configure(log_dir=os.path.join(outdir, "log"), pid=pid)
 
 
 def _maybe_install_test_knobs(ckpt_dir: str | None) -> None:
@@ -373,6 +392,8 @@ def _joiner_case(outdir: str, mode: str, ckpt_dir: str) -> None:
             os.path.join(ckpt_dir, ".pod-drain.p*")
         ):
             time.sleep(0.05)
+    join_req = os.environ.get("DREP_TPU_POD_JOIN", "").strip()
+    _maybe_events(outdir, int(join_req) if join_req.isdigit() else 99)
     packed = _elastic_packed()
     if mode == "join_streaming":
         from drep_tpu.parallel.streaming import streaming_mash_edges
@@ -473,6 +494,7 @@ def _elastic_case(
             time.sleep(0.05)
         os._exit(0)
     _maybe_install_test_knobs(ckpt_dir)
+    _maybe_events(outdir, pid)
     packed = _elastic_packed()
     try:
         ii, jj, dd, pairs = streaming_mash_edges(
@@ -512,6 +534,7 @@ def _ring_case(pid: int, nproc: int, outdir: str, ckpt_dir: str) -> None:
     from drep_tpu.parallel.mesh import make_mesh
 
     _maybe_install_test_knobs(ckpt_dir)
+    _maybe_events(outdir, pid)
     packed = _elastic_packed()
     try:
         dist = sharded_mash_allpairs(

@@ -41,6 +41,14 @@ Two tiers:
   result, and ``io:corrupt`` bit rot on index shards self-healing
   through recompute/re-sketch on the next update. Delegate to their
   pytest chaos tests (tests/test_index_chaos.py), CPU-only.
+- event-tracing cells (``--events``): the observability layer (ISSUE 10,
+  utils/telemetry.py + tools/trace_report.py) — the drain-mid-streaming
+  and kill-mid-streaming pods re-run with ``DREP_TPU_EVENTS=on``,
+  asserting the MERGED timeline holds the drain/death verdict, the
+  epoch bump, and the re-deal spans in causal order, the Chrome trace
+  loads, and the membership timeline equals every survivor's
+  ``epoch_history`` exactly. Delegate to tests/test_trace_report.py,
+  CPU-only.
 
 Usage::
 
@@ -48,6 +56,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --io      # + storage cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --index   # + index cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --elastic # + join/drain cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
 
@@ -445,6 +454,19 @@ ELASTIC_CELLS = [
 ]
 
 
+# event-tracing cells (--events, ISSUE 10): the elastic drain/death pods
+# re-run with DREP_TPU_EVENTS=on; the tests merge every member's event
+# log (tools/trace_report.py), pin the causal order (drain note -> epoch
+# bump -> re-deal spans; death verdict -> epoch bump), require a loadable
+# Chrome trace, and check the membership timeline against epoch_history.
+EVENTS_CELLS = [
+    ("events", "drain", "drain mid-streaming, events on -> causal merged timeline",
+     "survive", "tests/test_trace_report.py::test_drain_pod_events_timeline_causal"),
+    ("events", "kill", "SIGKILL mid-streaming, events on -> verdict timeline + crash evidence",
+     "survive", "tests/test_trace_report.py::test_death_pod_events_timeline"),
+]
+
+
 # pod cells delegate to the pytest chaos tests (site x mode -> test id)
 POD_CELLS = [
     ("process_death", "kill", "SIGKILL mid-streaming -> epoch re-deal",
@@ -470,6 +492,7 @@ def main() -> int:
     index_cells = "--index" in sys.argv
     prune_cells = "--prune" in sys.argv
     elastic_cells = "--elastic" in sys.argv
+    events_cells = "--events" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
 
@@ -512,6 +535,7 @@ def main() -> int:
     _pytest_cells(PRUNE_PYTEST_CELLS, "--prune", prune_cells)
     _pytest_cells(INDEX_CELLS, "--index", index_cells)
     _pytest_cells(ELASTIC_CELLS, "--elastic", elastic_cells)
+    _pytest_cells(EVENTS_CELLS, "--events", events_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)
 
     w_site = max(len(r[0]) for r in rows)

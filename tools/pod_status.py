@@ -1,0 +1,284 @@
+#!/usr/bin/env python
+"""Live status of a RUNNING pod, read from the shared checkpoint dir alone.
+
+The elastic-pod protocol's ground truth is the note/shard state in the
+checkpoint directory (heartbeat notes, done/drain/death/join/admit
+verdicts, ``row_*``/``blk_*`` shards, ``meta.json``) — so a read-only
+observer can reconstruct the whole operational picture without touching
+the pod: who is live / stale / finished / draining / dead / joining, the
+current ownership epoch, published-shard progress, and an ETA from the
+shard publish rate.
+
+Usage::
+
+    python tools/pod_status.py <wd>/data/streaming_primary        # human text
+    python tools/pod_status.py <ckpt_dir> --json                  # machine
+
+**Read-only by contract, byte-for-byte** — like ``index classify``: this
+tool only ever lists and reads; it creates, modifies, deletes, and
+touches nothing (asserted in tests/test_trace_report.py against a
+content hash of the whole store). Safe to run from cron against a live
+pod. CPU-only, no JAX backend required.
+
+Liveness is judged exactly like the protocol judges it: staleness
+relative to the NEWEST beat's mtime (server-clock-to-server-clock — a
+constant observer-vs-fileserver skew can never fake a death), at the
+``DREP_TPU_HEARTBEAT_S`` x 5 miss window. The epoch is the best
+reconstruction the notes allow: the max epoch any note carries vs the
+count of membership verdicts — exact whenever any member has published a
+done/drain/admit note since the last bump.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from drep_tpu.utils import durableio  # noqa: E402
+
+# THE protocol's own liveness rule — imported, not re-implemented, so a
+# future cadence/miss-factor tune can never make this observer judge
+# members by different rules than the pod does (faulttol has no
+# module-level jax import; this tool stays backend-free)
+from drep_tpu.parallel.faulttol import (  # noqa: E402
+    HEARTBEAT_MISS_FACTOR,
+    heartbeat_cadence_s as _cadence_s,
+)
+
+_NOTE_RE = re.compile(r"^\.pod-(hb|done|dead|drain|join|admit)\.p(\d+)$")
+_ROW_RE = re.compile(r"^row_(\d+)(?:\.e\d+)?\.npz$")
+_BLK_RE = re.compile(r"^blk_(\d+)_(\d+)(?:\.e\d+)?\.npz$")
+
+
+def _read_note(path: str):
+    """Checked read, corruption-tolerant: a half-written note reads as
+    absent (the protocol's own contract), never a crash."""
+    try:
+        note = durableio.read_json_checked(path, what="pod note")
+        return note if isinstance(note, dict) else None
+    except (OSError, ValueError, durableio.CorruptPayloadError):
+        return None
+
+
+def _ring_total_blocks(meta: dict) -> int | None:
+    """Block count of the stepwise ring schedule (mirrors
+    parallel/allpairs.py ring_schedule without importing jax): half
+    schedules run ceil((D+1)/2) steps of D blocks, and the even-D middle
+    step keeps only the canonical device half."""
+    try:
+        d = int(meta["n_devices"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if not meta.get("half", True):
+        return d * d
+    n_steps = (d + 2) // 2  # ceil((D+1)/2)
+    total = n_steps * d
+    if d > 1 and d % 2 == 0:
+        total -= d // 2  # mirrored twin owns the middle step's other half
+    return total
+
+
+def collect(ckpt_dir: str, now: float | None = None) -> dict:
+    """One read-only snapshot of the store. Never writes, deletes, or
+    touches anything under `ckpt_dir`."""
+    now = time.time() if now is None else now
+    try:
+        names = sorted(os.listdir(ckpt_dir))
+    except OSError as e:
+        return {"error": f"cannot list {ckpt_dir}: {e}"}
+
+    notes: dict[str, dict[int, str]] = {
+        k: {} for k in ("hb", "done", "dead", "drain", "join", "admit")
+    }
+    row_shards: dict[int, float] = {}
+    blk_shards: dict[tuple[int, int], float] = {}
+    for name in names:
+        m = _NOTE_RE.match(name)
+        if m:
+            notes[m.group(1)][int(m.group(2))] = os.path.join(ckpt_dir, name)
+            continue
+        m = _ROW_RE.match(name)
+        if m:
+            bi = int(m.group(1))
+            path = os.path.join(ckpt_dir, name)
+            try:
+                mt = os.stat(path).st_mtime
+            except OSError:
+                continue
+            # several epochs of one stripe count once; keep the earliest
+            # publish for the rate estimate
+            if bi not in row_shards or mt < row_shards[bi]:
+                row_shards[bi] = mt
+            continue
+        m = _BLK_RE.match(name)
+        if m:
+            blk = (int(m.group(1)), int(m.group(2)))
+            path = os.path.join(ckpt_dir, name)
+            try:
+                mt = os.stat(path).st_mtime
+            except OSError:
+                continue
+            if blk not in blk_shards or mt < blk_shards[blk]:
+                blk_shards[blk] = mt
+
+    meta = _read_note(os.path.join(ckpt_dir, "meta.json")) or {}
+
+    # -- membership -------------------------------------------------------
+    beat_mtime: dict[int, float] = {}
+    for pid, path in notes["hb"].items():
+        try:
+            beat_mtime[pid] = os.stat(path).st_mtime
+        except OSError:
+            pass
+    # server-clock reference: the newest beat (the protocol's own rule);
+    # fall back to the observer clock when nothing beats
+    ref = max(beat_mtime.values(), default=now)
+    # same floor as HeartbeatManager.__init__
+    miss_s = max(HEARTBEAT_MISS_FACTOR * _cadence_s(), 1.0)
+
+    done_notes = {p: _read_note(path) or {} for p, path in notes["done"].items()}
+    drain_notes = {p: _read_note(path) or {} for p, path in notes["drain"].items()}
+    admit_notes = {p: _read_note(path) or {} for p, path in notes["admit"].items()}
+    admitted = {
+        p for p, n in admit_notes.items() if n and "reject" not in n
+    }
+
+    members: dict[int, dict] = {}
+    all_pids = (
+        set(beat_mtime) | set(done_notes) | set(drain_notes)
+        | set(notes["dead"]) | set(notes["join"]) | admitted
+    )
+    for pid in sorted(all_pids):
+        if pid in notes["dead"]:
+            state = "dead"
+        elif pid in drain_notes:
+            state = "draining"
+        elif pid in done_notes:
+            state = "finished"
+        elif pid in notes["join"] and pid not in admitted:
+            state = "joining"
+        elif pid in beat_mtime:
+            state = "live" if ref - beat_mtime[pid] <= miss_s else "stale"
+        else:
+            state = "gone"
+        entry: dict = {"state": state}
+        if pid in beat_mtime:
+            entry["beat_age_s"] = round(ref - beat_mtime[pid], 2)
+        if pid in done_notes and "pairs" in done_notes[pid]:
+            entry["pairs"] = int(done_notes[pid]["pairs"])
+        if pid in drain_notes and "pairs" in drain_notes[pid]:
+            entry["pairs"] = int(drain_notes[pid]["pairs"])
+        if pid in admitted:
+            entry["joined"] = True
+        members[pid] = entry
+
+    # -- epoch reconstruction ---------------------------------------------
+    note_epochs = [
+        int(n["epoch"])
+        for n in (*done_notes.values(), *drain_notes.values(), *admit_notes.values())
+        if n and "epoch" in n
+    ]
+    verdict_count = len(notes["dead"]) + len(drain_notes) + len(admitted)
+    epoch = max([*note_epochs, verdict_count, 0])
+
+    # -- progress + ETA ----------------------------------------------------
+    shards = row_shards if row_shards else blk_shards
+    total = None
+    if row_shards or "n_blocks" in meta:
+        try:
+            total = int(meta["n_blocks"])
+        except (KeyError, TypeError, ValueError):
+            total = None
+    elif blk_shards or "n_devices" in meta:
+        total = _ring_total_blocks(meta)
+    done = len(shards)
+    progress = (done / total) if total else None
+    eta_s = None
+    if shards and total and done < total:
+        mts = sorted(shards.values())
+        span = mts[-1] - mts[0]
+        if done > 1 and span > 0:
+            rate = (done - 1) / span
+            eta_s = round((total - done) / rate, 1)
+
+    pending_joins = sorted(set(notes["join"]) - admitted)
+    out = {
+        "checkpoint_dir": os.path.abspath(ckpt_dir),
+        "observed_at": round(now, 3),
+        "heartbeat_cadence_s": _cadence_s(),
+        "miss_window_s": round(miss_s, 2),
+        "epoch": epoch,
+        "members": {str(p): members[p] for p in sorted(members)},
+        "live": sorted(p for p, e in members.items() if e["state"] == "live"),
+        "finished": sorted(p for p, e in members.items() if e["state"] == "finished"),
+        "draining": sorted(p for p, e in members.items() if e["state"] == "draining"),
+        "dead": sorted(notes["dead"]),
+        "stale": sorted(p for p, e in members.items() if e["state"] == "stale"),
+        "pending_joins": pending_joins,
+        "shards_published": done,
+        "shards_total": total,
+        "progress": round(progress, 4) if progress is not None else None,
+        "eta_s": eta_s,
+    }
+    if meta:
+        keep = ("n", "n_blocks", "block", "n_devices", "kind", "pod_epochs",
+                "dead_processes", "planned_departures", "pod_joins")
+        out["meta"] = {k: meta[k] for k in keep if k in meta}
+    return out
+
+
+def render(status: dict) -> str:
+    if "error" in status:
+        return status["error"] + "\n"
+    lines = [
+        f"pod status @ {status['checkpoint_dir']}",
+        f"  epoch {status['epoch']}  "
+        f"(miss window {status['miss_window_s']}s at cadence "
+        f"{status['heartbeat_cadence_s']}s)",
+    ]
+    for pid, e in status["members"].items():
+        detail = "  ".join(
+            f"{k}={v}" for k, v in e.items() if k != "state"
+        )
+        lines.append(f"  p{pid:<3} {e['state']:<9} {detail}")
+    if not status["members"]:
+        lines.append("  no pod notes — single-process run, or not started")
+    done, total = status["shards_published"], status["shards_total"]
+    if total:
+        pct = 100.0 * (status["progress"] or 0.0)
+        eta = (
+            f", eta ~{status['eta_s']:.0f}s"
+            if status.get("eta_s") is not None
+            else ""
+        )
+        lines.append(f"  progress: {done}/{total} shards ({pct:.1f}%){eta}")
+    elif done:
+        lines.append(f"  progress: {done} shards published (total unknown)")
+    if status["pending_joins"]:
+        lines.append(f"  pending join request(s): {status['pending_joins']}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("checkpoint_dir", help="the pod's shared checkpoint dir "
+                    "(e.g. <wd>/data/streaming_primary)")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    status = collect(args.checkpoint_dir)
+    if args.json:
+        print(json.dumps(status, indent=1, sort_keys=True))
+    else:
+        sys.stdout.write(render(status))
+    return 1 if "error" in status else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

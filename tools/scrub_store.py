@@ -27,6 +27,12 @@ Verified payload families (everything else is left alone):
   elastic membership family ``.pod-drain.*`` / ``.pod-join.*`` /
   ``.pod-admit.*``) — unparseable or checksum-mismatched is DAMAGE,
   never an orphan.
+- ``events.p*.jsonl`` telemetry logs (utils/telemetry.py) — every
+  complete line must parse as JSON (mid-file rot is DAMAGE); a torn
+  FINAL line is a killed writer's expected crash evidence, reported as
+  its own non-damage class (like orphaned ``.tmp-``). ``metrics.prom``
+  (the Prometheus textfile flush) and ``events.runid`` are known
+  plain-text families, deliberately skipped.
 
 For a genome index, a damaged shard removed by ``--delete`` is healed by
 the next ``drep-tpu index update`` (sketch shards re-sketch from the
@@ -48,6 +54,7 @@ required.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -55,6 +62,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from drep_tpu.utils import durableio  # noqa: E402
+
+import re  # noqa: E402
+
+# the telemetry log family (ISSUE 10, utils/telemetry.py): line-wise JSON,
+# crash-safe by construction — a torn FINAL line is expected SIGKILL
+# evidence (its own non-damage class, like orphaned .tmp-), a torn
+# MID-FILE line is damage
+_EVENTS_RE = re.compile(r"^events\.p\d+\.jsonl$")
 
 
 def _is_json_note(name: str) -> bool:
@@ -98,6 +113,29 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
     verified = legacy = 0
     damaged: list[tuple[str, str]] = []
     artifacts: list[str] = []
+    torn_tails: list[str] = []
+
+    def check_events(path: str) -> None:
+        """Line-wise validation of a telemetry event log: every COMPLETE
+        line must parse as JSON (mid-file rot is damage); a torn final
+        line — no trailing newline — is the expected crash evidence a
+        SIGKILLed writer leaves, counted in its own class."""
+        nonlocal verified
+        with open(path, "rb") as f:
+            raw = f.read()
+        body, _, tail = raw.rpartition(b"\n")
+        for i, line in enumerate(body.split(b"\n") if body else []):
+            if not line.strip():
+                continue
+            try:
+                json.loads(line.decode())
+            except (ValueError, UnicodeDecodeError):
+                raise durableio.CorruptPayloadError(
+                    f"unparseable event line {i + 1}"
+                ) from None
+        if tail.strip():
+            torn_tails.append(path)
+        verified += 1
 
     def check(path: str, name: str) -> None:
         nonlocal verified, legacy
@@ -109,7 +147,15 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
             # forever would train operators to ignore the scrubber.
             artifacts.append(path)
             return
+        if name == "metrics.prom" or name == "events.runid":
+            # Prometheus textfile (atomic publish, plain text — no
+            # checksum contract) and the run-id marker: known families,
+            # deliberately skipped
+            return
         try:
+            if _EVENTS_RE.match(name):
+                check_events(path)
+                return
             if name.endswith(".npz"):
                 if os.path.getsize(path) == 0:
                     raise durableio.CorruptPayloadError("zero-byte shard")
@@ -162,15 +208,19 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
                 action = f" [delete failed: {e}]"
         print(f"ARTIFACT {path}: orphaned atomic-write tmp (crash leftover, "
               f"never read by resume){action}", file=out)
+    for path in torn_tails:
+        print(f"TORN-TAIL {path}: event log ends mid-line (expected crash "
+              f"evidence from a killed writer, not damage)", file=out)
     print(
         f"scrub: {verified} payload(s) checksum-verified, {legacy} legacy "
         f"(readable, no in-band checksum), {len(damaged)} damaged"
         + (" (deleted)" if delete and damaged else "")
-        + (f", {len(artifacts)} crash artifact(s)" if artifacts else ""),
+        + (f", {len(artifacts)} crash artifact(s)" if artifacts else "")
+        + (f", {len(torn_tails)} torn event-log tail(s)" if torn_tails else ""),
         file=out,
     )
     return {"verified": verified, "legacy": legacy, "damaged": damaged,
-            "artifacts": artifacts}
+            "artifacts": artifacts, "torn_tails": torn_tails}
 
 
 def main(argv: list[str] | None = None) -> int:
