@@ -1515,6 +1515,35 @@ def _record_stage_error(stages: dict, label: str, msg: str) -> None:
         stages[label] = {"error": msg}
 
 
+def _stall_site() -> dict | None:
+    """Wedge diagnosis (ISSUE 11 satellite): when the wedged stage was
+    TRACED (`--events on` / DREP_TPU_EVENTS=on routed its telemetry into
+    a workdir log dir), read its own event logs through
+    tools/trace_report.py's stall_diagnosis and name the in-flight span
+    — the durable stage record then says WHERE the run stalled (which
+    stripe/ring-step/stage was open when the stream went quiet), not
+    just that the watchdog fired. Best-effort: diagnosis must never
+    block the bail that makes the record durable."""
+    try:
+        import importlib.util
+
+        from drep_tpu.utils import telemetry
+
+        log_dir = telemetry.configured_log_dir()
+        if not log_dir or not os.path.isdir(log_dir):
+            return None
+        spec = importlib.util.spec_from_file_location(
+            "_bench_trace_report",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools", "trace_report.py"),
+        )
+        tr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tr)
+        return tr.stall_diagnosis(log_dir)
+    except Exception:  # noqa: BLE001 — forensics, not a dependency
+        return None
+
+
 def _clear_partial() -> None:
     import os
 
@@ -1888,12 +1917,25 @@ def _child_main(want: list, args) -> None:
             # json.dumps over a resizing dict raises — which would skip
             # the very output line this path exists to guarantee
             snap = dict(stages)
+            key = stage_keys.get(label, label)
             _record_stage_error(
                 snap,
-                stage_keys.get(label, label),
+                key,
                 f"stage exceeded its {budget:.0f}s watchdog budget "
                 "(wedged TPU tunnel mid-run?) — remaining stages skipped",
             )
+            # a TRACED wedge names its own stall site in the durable
+            # record (trace_report.stall_diagnosis over the stage's own
+            # event logs): which span was open, where the stream stopped
+            stall = _stall_site()
+            if stall is not None and isinstance(snap.get(key), dict):
+                entry = dict(snap[key])
+                entry["stall"] = stall
+                snap[key] = entry
+                site = stall.get("stall_site") or stall.get("last_event") or {}
+                print(
+                    f"bench: {label} stall site: {site}", file=sys.stderr, flush=True
+                )
             print(f"bench: {label} WEDGED after {budget:.0f}s, bailing", file=sys.stderr, flush=True)
             _stamp_backend(snap)
             _emit(snap)

@@ -338,6 +338,77 @@ def build_parser() -> argparse.ArgumentParser:
                    help="memory bound for the bucket join's host expansion "
                         "(0 = one-pass; same semantics as the pipeline flag)")
 
+    s = isub.add_parser(
+        "serve",
+        help="resident serving tier: a long-lived daemon that loads the "
+             "index once, dynamically batches concurrent classify "
+             "queries over a local socket into one K x N rect compare, "
+             "hot-swaps to newly published generations, and drains "
+             "gracefully on SIGTERM (verdicts identical to one-shot "
+             "classify; the index stays byte-for-byte untouched)",
+    )
+    s.add_argument("index_directory", help="the long-lived genome index")
+    s.add_argument("-p", "--processes", type=int, default=1,
+                   help="sketching processes per batch (queries are small; "
+                        "1 keeps the daemon single-sketcher)")
+    s.add_argument("-d", "--debug", action="store_true")
+    s.add_argument("--io_retries", type=int, default=None,
+                   help="transient shared-filesystem I/O retry budget "
+                        "(utils/durableio.py; same knob as the pipeline)")
+    s.add_argument("--fsync", action="store_true",
+                   help="fsync every durable publish (DREP_TPU_FSYNC=1 "
+                        "equivalent; the daemon itself never writes the "
+                        "index — this covers its log/metrics dir)")
+    s.add_argument("--socket", default=None, metavar="PATH",
+                   help="serve on a unix-domain socket at PATH instead of TCP")
+    s.add_argument("--host", default="127.0.0.1",
+                   help="TCP bind host (default 127.0.0.1 — the daemon is "
+                        "a LOCAL front door; put a real ingress in front "
+                        "for anything wider)")
+    s.add_argument("--port", type=int, default=0,
+                   help="TCP bind port (default 0 = OS-assigned; the bound "
+                        "address is printed as the JSON ready line)")
+    s.add_argument("--max_queue", type=int, default=256,
+                   help="admission-queue bound: a request arriving at a "
+                        "full queue is refused IMMEDIATELY with a "
+                        "retry_after_s hint (backpressure beats unbounded "
+                        "buffering). Default 256")
+    s.add_argument("--max_batch", type=int, default=64,
+                   help="most queries coalesced into one rectangular "
+                        "compare (1 = unbatched FIFO, the loadgen's "
+                        "reference mode). Default 64")
+    s.add_argument("--batch_window_ms", type=float, default=5.0,
+                   help="how long the first waiting query holds the batch "
+                        "open for late arrivals (the latency cost of "
+                        "coalescing when idle). Default 5ms")
+    s.add_argument("--poll_generation_s", type=float, default=2.0,
+                   help="manifest re-read cadence for generation hot-swap: "
+                        "a published generation G+1 is adopted between "
+                        "batches within this many seconds. Default 2s")
+    s.add_argument("--log_dir", default=None,
+                   help="home for the daemon's logs, Prometheus textfile "
+                        "flush (DREP_TPU_METRICS_FLUSH_S), and event "
+                        "traces. NEVER the index directory — default is "
+                        "console-only logging, no files anywhere")
+    s.add_argument("--events", default=None, choices=["off", "on"],
+                   help="structured event tracing of the serve timeline "
+                        "(serve_batch spans, generation_swap instants) "
+                        "into --log_dir; tools/trace_report.py renders "
+                        "the server timeline. Needs --log_dir")
+    s.add_argument("--primary_prune", default="off", choices=["off", "lsh"],
+                   help="LSH-banded candidate pruning applied PER BATCH to "
+                        "the query-vs-index rect compare (same candidate "
+                        "set `index update` consumes; verdicts identical)")
+    s.add_argument("--prune_bands", type=int, default=0,
+                   help="LSH band count (0 = per-id buckets; same semantics "
+                        "as the pipeline flag)")
+    s.add_argument("--prune_min_shared", type=int, default=0,
+                   help="conservative candidate-threshold floor (0 = "
+                        "auto-derive; same semantics as the pipeline flag)")
+    s.add_argument("--prune_join_chunk", type=int, default=0,
+                   help="memory bound for the bucket join's host expansion "
+                        "(0 = one-pass; same semantics as the pipeline flag)")
+
     cmp_p = sub.add_parser("compare", help="cluster genomes without dereplicating")
     add_common(cmp_p, with_filter=False, with_scoring=False)
 

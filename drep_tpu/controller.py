@@ -61,6 +61,7 @@ class Controller:
         from drep_tpu.workflows import (
             index_build_wrapper,
             index_classify_wrapper,
+            index_serve_wrapper,
             index_update_wrapper,
         )
 
@@ -71,6 +72,10 @@ class Controller:
             return index_build_wrapper(index_loc, genomes, **kwargs)
         if sub == "update":
             return index_update_wrapper(index_loc, genomes, **kwargs)
+        if sub == "serve":
+            # blocks until drained (SIGTERM/SIGINT); exit 0 is the drain
+            # contract, same as the elastic pod's graceful preemption
+            return index_serve_wrapper(index_loc, genomes, **kwargs)
         if sub == "classify":
             import json
             import sys

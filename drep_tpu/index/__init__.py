@@ -8,9 +8,20 @@ membership queries from the store without mutating it. Pinned invariant:
 incremental result == from-scratch rerun on the union set (same Cdb
 labels up to renumbering, same winners), property-tested over randomized
 update schedules in tests/test_index.py.
+
+The resident-core split (ISSUE 11): `load_resident_index` /
+`sketch_queries` / `classify_batch` are the separable halves of
+classify that the long-lived `index serve` daemon (drep_tpu/serve/)
+amortizes — load once, classify many, never mutate the resident index.
 """
 
 from drep_tpu.index.build import build_from_paths, build_from_workdir  # noqa: F401
-from drep_tpu.index.classify import index_classify  # noqa: F401
+from drep_tpu.index.classify import (  # noqa: F401
+    SketchedQueries,
+    classify_batch,
+    index_classify,
+    load_resident_index,
+    sketch_queries,
+)
 from drep_tpu.index.store import IndexStore, LoadedIndex, load_index  # noqa: F401
 from drep_tpu.index.update import index_update  # noqa: F401

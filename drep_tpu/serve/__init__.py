@@ -1,0 +1,16 @@
+"""Resident serving tier (ISSUE 11): the `index serve` daemon.
+
+A long-lived classify front door over the genome index — load once,
+dynamically batch concurrent queries into one K x N rect compare,
+hot-swap index generations mid-flight, answer with byte-identical
+one-shot verdicts, and drain gracefully on SIGTERM. See serve/daemon.py
+for the architecture and README "Serving" for the operator story.
+"""
+
+from drep_tpu.serve.batcher import AdmissionQueue, PendingRequest  # noqa: F401
+from drep_tpu.serve.client import ServeClient, ServeError  # noqa: F401
+from drep_tpu.serve.daemon import (  # noqa: F401
+    IndexServer,
+    ServeConfig,
+    install_signal_handlers,
+)

@@ -1,0 +1,127 @@
+"""Dynamic batching + bounded admission for the serve daemon.
+
+The queue is the daemon's ONLY buffer, and it is bounded on purpose: a
+classify request costs sketching + a share of a rect compare, so an
+unbounded queue under overload converts client timeouts into server
+OOM. Admission control answers `full` IMMEDIATELY with a retry hint
+(protocol.error_response reason="backpressure") — shedding load at the
+door is the production behavior, queueing forever is not.
+
+Batch formation is the tentpole's economics: the first waiting request
+opens a batch window (``batch_window_ms``); everything that arrives
+inside the window joins, up to ``max_batch`` — so 16 concurrent
+single-genome queries coalesce into ONE K x N rectangular compare
+instead of 16. An idle daemon serves a lone request with at most one
+window of added latency (and ``max_batch=1`` degenerates to pure FIFO —
+the unbatched reference the serve bench compares against).
+
+One correctness wrinkle rides here: queries are namespaced by basename
+(``query:<basename>`` — index/classify.py), so two DIFFERENT paths with
+the SAME basename cannot share a batch. ``next_batch`` defers the
+collider to the next batch instead of failing either request.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class PendingRequest:
+    """One admitted classify request waiting for its batch."""
+
+    genome: str  # absolute FASTA path
+    reply: Callable[[dict], None]  # writes one response to the client
+    req_id: Any = None
+    enqueued_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.genome)
+
+
+class AdmissionQueue:
+    """Bounded FIFO with condition-variable batch formation and a drain
+    latch. Thread-safe: connection handlers submit, the single batch
+    loop consumes."""
+
+    def __init__(self, max_queue: int = 256):
+        self.max_queue = int(max_queue)
+        self._items: deque[PendingRequest] = deque()
+        self._cond = threading.Condition()
+        self._draining = False
+
+    # ---- admission (handler threads) ------------------------------------
+    def submit(self, req: PendingRequest) -> str | None:
+        """Admit one request. Returns None on success, or the refusal
+        reason ("backpressure" / "draining") — the caller answers the
+        client immediately either way."""
+        with self._cond:
+            if self._draining:
+                return "draining"
+            if len(self._items) >= self.max_queue:
+                return "backpressure"
+            self._items.append(req)
+            self._cond.notify()
+            return None
+
+    def depth(self) -> int:
+        return len(self._items)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # ---- drain (signal handler / tests) ----------------------------------
+    def drain(self) -> None:
+        """Refuse all future admissions; wake the batch loop so it can
+        finish what is queued and exit (the PR 9 drain idiom: in-flight
+        work completes, new work is refused, the process exits 0)."""
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    # ---- batch formation (the batch loop) --------------------------------
+    def next_batch(
+        self, max_batch: int, window_s: float
+    ) -> list[PendingRequest] | None:
+        """Block until at least one request is queued, then hold the
+        batch window open for late arrivals up to `max_batch`. Returns
+        None exactly once the queue is BOTH draining and empty — the
+        batch loop's termination signal."""
+        max_batch = max(1, int(max_batch))
+        with self._cond:
+            while not self._items:
+                if self._draining:
+                    return None
+                self._cond.wait()
+            if max_batch > 1 and window_s > 0:
+                deadline = time.monotonic() + window_s
+                while len(self._items) < max_batch:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cond.wait(timeout=left):
+                        break
+            batch: list[PendingRequest] = []
+            seen: dict[str, str] = {}  # basename -> path already in batch
+            deferred: list[PendingRequest] = []
+            while self._items and len(batch) < max_batch:
+                req = self._items.popleft()
+                if seen.get(req.basename, req.genome) != req.genome:
+                    # same basename, DIFFERENT path: the query: namespace
+                    # can hold only one per batch — defer, never fail.
+                    # (The same path twice is fine: the daemon classifies
+                    # it once and fans the verdict out.)
+                    deferred.append(req)
+                    continue
+                seen[req.basename] = req.genome
+                batch.append(req)
+            for req in reversed(deferred):
+                self._items.appendleft(req)
+            if deferred:
+                self._cond.notify()
+            return batch

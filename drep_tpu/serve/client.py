@@ -1,0 +1,148 @@
+"""Client library for the `index serve` daemon.
+
+Speaks the NDJSON protocol (serve/protocol.py) over a unix-domain or
+TCP socket. One connection per client; requests can be PIPELINED
+(``classify_many`` sends the whole batch before reading replies — how a
+loadgen actually fills the daemon's batch window). Backpressure is a
+first-class outcome, not an exception storm: a refusal carries
+``retry_after_s`` and ``classify`` honors it up to ``retries`` times.
+
+Used by tools/serve_client.py (CLI + loadgen) and the serve tests; kept
+dependency-free (no JAX, no pandas) so a thin front-end can import it
+alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+import uuid
+from typing import Any
+
+
+class ServeError(RuntimeError):
+    """An error response from the daemon (or a dead connection).
+    ``reason`` mirrors the protocol field; ``retry_after_s`` is the
+    daemon's backoff hint (None when the error is not retryable)."""
+
+    def __init__(self, msg: str, reason: str | None = None,
+                 retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+def _parse_address(address: str) -> tuple[int, Any]:
+    """'host:port' -> TCP; anything with a path separator (or an
+    existing socket file) -> unix domain."""
+    if os.path.sep in address or os.path.exists(address):
+        return socket.AF_UNIX, address
+    host, _, port = address.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"bad serve address {address!r} (want host:port or a socket path)"
+        )
+    return socket.AF_INET, (host, int(port))
+
+
+class ServeClient:
+    """One connection to a serve daemon. Thread-compatible (a lock
+    serializes request/response turns); use one client per loadgen
+    thread for true concurrency."""
+
+    def __init__(self, address: str, timeout_s: float = 120.0):
+        self.address = address
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        family, target = _parse_address(address)
+        self._sock = socket.socket(family, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout_s)
+        self._sock.connect(target)
+        self._reader = self._sock.makefile("rb")
+
+    # ---- context manager -------------------------------------------------
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        for closer in (self._reader.close, self._sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
+
+    # ---- wire ------------------------------------------------------------
+    def _send(self, obj: dict) -> None:
+        data = json.dumps(obj, separators=(",", ":")).encode() + b"\n"
+        self._sock.sendall(data)
+
+    def _recv(self) -> dict:
+        line = self._reader.readline()
+        if not line:
+            raise ServeError(
+                f"connection to {self.address} closed by the daemon",
+                reason="disconnected",
+            )
+        return json.loads(line.decode("utf-8"))
+
+    def request(self, obj: dict) -> dict:
+        """One request/response turn."""
+        with self._lock:
+            self._send(obj)
+            return self._recv()
+
+    # ---- ops -------------------------------------------------------------
+    def ping(self) -> dict:
+        return self.request({"op": "ping"})
+
+    def status(self) -> dict:
+        resp = self.request({"op": "status"})
+        if not resp.get("ok"):
+            raise ServeError(resp.get("error", "status failed"),
+                             reason=resp.get("reason"))
+        return resp["status"]
+
+    def classify(self, genome: str, retries: int = 0) -> dict:
+        """Classify one genome; returns the full classify response
+        (``verdict``, ``generation``, ``batch_size``, latencies).
+        Honors backpressure up to `retries` times, sleeping the
+        daemon's own ``retry_after_s`` hint between attempts."""
+        attempt = 0
+        while True:
+            resp = self.request(
+                {"op": "classify", "genome": genome, "id": uuid.uuid4().hex[:8]}
+            )
+            if resp.get("ok"):
+                return resp
+            retry_after = resp.get("retry_after_s")
+            if retry_after is not None and attempt < retries:
+                attempt += 1
+                time.sleep(float(retry_after))
+                continue
+            raise ServeError(
+                resp.get("error", "classify failed"),
+                reason=resp.get("reason"), retry_after_s=retry_after,
+            )
+
+    def classify_many(self, genomes: list[str]) -> list[dict]:
+        """PIPELINED classify: all requests go out before any reply is
+        read, so the daemon's batch window sees them together (the
+        coalescing path). Replies are matched by request id; returns
+        responses in input order (errors inline, not raised)."""
+        with self._lock:
+            ids = []
+            for g in genomes:
+                rid = uuid.uuid4().hex[:8]
+                ids.append(rid)
+                self._send({"op": "classify", "genome": g, "id": rid})
+            by_id: dict[str, dict] = {}
+            for _ in genomes:
+                resp = self._recv()
+                by_id[resp.get("id", "?")] = resp
+        return [by_id.get(rid, {"ok": False, "error": "no reply"}) for rid in ids]

@@ -1,0 +1,620 @@
+"""The `index serve` daemon: a long-lived, dynamically-batching,
+hot-swapping classify front door (ISSUE 11 tentpole).
+
+One process loads the index ONCE (:func:`load_resident_index` — the
+manifest + shard reads + JAX init that a one-shot classify re-pays per
+query), then serves classify requests over a local socket forever:
+
+- **dynamic batching** (serve/batcher.py): concurrent requests coalesce
+  into one K x N rectangular compare through the existing streaming
+  ``min_col`` path — 16 concurrent single-genome queries cost one rect
+  dispatch, not 16. Verdict independence is preserved
+  (``classify_batch(joint=False)``): every answer is byte-identical to
+  a one-shot `index classify` of that genome alone.
+- **hot-swap generations**: a poller re-reads ``manifest.json`` every
+  ``poll_generation_s``; a published generation G+1 is loaded into a
+  NEW resident object and swapped in between batches — in-flight
+  batches finish on the generation they started on, new admissions
+  ride the new one, and every verdict carries the generation that
+  produced it. The daemon is a pure READER (the pod_status.py pattern):
+  byte-for-byte, it never writes under the index directory.
+- **backpressure**: the admission queue is bounded; a full queue (or a
+  draining daemon) answers immediately with ``retry_after_s`` instead
+  of queueing unboundedly.
+- **graceful drain** (the PR 9 idiom): SIGTERM refuses new admissions,
+  finishes every queued batch, answers every in-flight client, and
+  exits 0.
+- **observability**: per-request/per-batch latency histograms +
+  queue-depth/batch-size gauges through utils/profiling.py (Prometheus
+  textfile flush included), and `serve_batch`/`generation_swap`
+  telemetry span/instant sites so tools/trace_report.py renders server
+  timelines. Both ride ``--log_dir`` — NEVER the index directory (the
+  read-only contract would break on the first event line).
+
+The server is equally usable as a library (tests run it in-process):
+``IndexServer(cfg).start()`` binds and returns the address;
+``serve_batches()`` runs the batch loop in the calling thread;
+``request_drain()`` is the programmatic SIGTERM.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from drep_tpu.errors import UserInputError
+from drep_tpu.index.classify import (
+    classify_batch,
+    load_resident_index,
+    sketch_queries,
+)
+from drep_tpu.index.store import IndexStore
+from drep_tpu.serve import protocol
+from drep_tpu.serve.batcher import AdmissionQueue, PendingRequest
+from drep_tpu.utils import telemetry
+from drep_tpu.utils.logger import get_logger
+from drep_tpu.utils.profiling import counters
+
+# retry hint sent with a backpressure refusal: roughly one batch window
+# plus slack — long enough that an immediate retry storm cannot hold the
+# queue at the high-water mark, short enough to be invisible to a human
+_RETRY_AFTER_FLOOR_S = 0.05
+
+
+@dataclass
+class ServeConfig:
+    index_loc: str
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = OS-assigned, reported in the ready line
+    socket_path: str | None = None  # unix domain socket (wins over TCP)
+    max_queue: int = 256
+    max_batch: int = 64
+    batch_window_ms: float = 5.0
+    poll_generation_s: float = 2.0
+    processes: int = 1
+    prune_cfg: dict | None = None
+    log_dir: str | None = None  # metrics/telemetry home — never the index
+
+    def address(self) -> str:
+        return self.socket_path if self.socket_path else f"{self.host}:{self.port}"
+
+
+@dataclass
+class _ServeStats:
+    started_at: float = field(default_factory=time.monotonic)
+    requests_total: int = 0
+    rejected_total: int = 0
+    errors_total: int = 0
+    batches_total: int = 0
+    swaps_total: int = 0
+
+
+class IndexServer:
+    """One resident index + one listener + one batch loop.
+
+    `classify_fn(resident, paths) -> {display_name: verdict}` is
+    injectable for tests (backpressure/chaos cells stub it with a sleep);
+    the default runs the real resident-core path."""
+
+    def __init__(
+        self,
+        cfg: ServeConfig,
+        classify_fn: Callable[[Any, list[str]], dict] | None = None,
+    ):
+        self.cfg = cfg
+        self.queue = AdmissionQueue(cfg.max_queue)
+        self.stats = _ServeStats()
+        self._classify_fn = classify_fn or self._classify_paths
+        self._resident = None
+        self._listener: socket.socket | None = None
+        self._threads: list[threading.Thread] = []
+        self._stop_poll = threading.Event()
+        self._lock = threading.Lock()  # resident swap + stats
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> str:
+        """Load the index (once), bind the listener, start the acceptor
+        and generation-poller threads. Returns the bound address."""
+        t0 = time.monotonic()
+        with telemetry.span("serve_load", index=self.cfg.index_loc):
+            self._resident = load_resident_index(self.cfg.index_loc)
+        counters.set_gauge("serve_generation", float(self._resident.generation))
+        get_logger().info(
+            "index serve: generation %d (%d genomes) resident in %.2fs",
+            self._resident.generation, self._resident.n, time.monotonic() - t0,
+        )
+        if self.cfg.socket_path:
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            with contextlib.suppress(OSError):
+                os.unlink(self.cfg.socket_path)
+            sock.bind(self.cfg.socket_path)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind((self.cfg.host, self.cfg.port))
+            self.cfg.port = sock.getsockname()[1]
+        sock.listen(128)
+        self._listener = sock
+        acceptor = threading.Thread(
+            target=self._accept_loop, daemon=True, name="drep-serve-accept"
+        )
+        poller = threading.Thread(
+            target=self._poll_generations, daemon=True, name="drep-serve-poll"
+        )
+        self._threads = [acceptor, poller]
+        for t in self._threads:
+            t.start()
+        telemetry.event(
+            "serve_start", address=self.cfg.address(),
+            generation=int(self._resident.generation), n=self._resident.n,
+        )
+        return self.cfg.address()
+
+    def run(self) -> int:
+        """start() + the batch loop in the calling thread, with a ready
+        line on stdout (the machine-readable handshake loadgens and
+        orchestration parse). Returns 0 after a graceful drain."""
+        address = self.start()
+        print(
+            json.dumps(
+                {
+                    "serving": address,
+                    "generation": int(self._resident.generation),
+                    "n_genomes": self._resident.n,
+                    "pid": os.getpid(),
+                },
+                separators=(",", ":"),
+            ),
+            flush=True,
+        )
+        self.serve_batches()
+        self.close()
+        get_logger().info(
+            "index serve: drained cleanly after %d request(s) in %d batch(es)",
+            self.stats.requests_total, self.stats.batches_total,
+        )
+        return 0
+
+    def request_drain(self) -> None:
+        """The programmatic SIGTERM: refuse new admissions, let the
+        batch loop finish what is queued, stop the poller."""
+        telemetry.event("serve_drain", queued=self.queue.depth())
+        self._stop_poll.set()
+        self.queue.drain()
+        # stop accepting new connections (in-flight sockets finish)
+        if self._listener is not None:
+            with contextlib.suppress(OSError):
+                self._listener.close()
+
+    def close(self) -> None:
+        self._stop_poll.set()
+        if self._listener is not None:
+            with contextlib.suppress(OSError):
+                self._listener.close()
+        if self.cfg.socket_path:
+            with contextlib.suppress(OSError):
+                os.unlink(self.cfg.socket_path)
+        telemetry.event("serve_stop", requests=self.stats.requests_total)
+
+    # ---- the batch loop --------------------------------------------------
+    def serve_batches(self) -> None:
+        """Form and serve batches until drained-and-empty. THE serving
+        thread: every JAX dispatch and every resident read happens
+        here, so a generation swap (poller thread) can only ever land
+        BETWEEN batches for the classify path."""
+        window_s = max(0.0, float(self.cfg.batch_window_ms)) / 1000.0
+        while True:
+            batch = self.queue.next_batch(self.cfg.max_batch, window_s)
+            if batch is None:
+                return
+            self._serve_one_batch(batch)
+
+    def _classify_paths(self, resident, paths: list[str]) -> dict:
+        """The real classify core: sketch the batch once, ONE rect
+        compare, independent verdict assembly. Returns verdicts (and
+        filtered refusals) keyed by display name (basename)."""
+        queries = sketch_queries(resident, paths, processes=self.cfg.processes)
+        verdicts = classify_batch(
+            resident, queries, processes=self.cfg.processes,
+            prune_cfg=self.cfg.prune_cfg, joint=False,
+        )
+        return {v["genome"]: v for v in verdicts + queries.dropped}
+
+    def _serve_one_batch(self, batch: list[PendingRequest]) -> None:
+        t0 = time.monotonic()
+        # queue wait ends when the batch STARTS — measured here so a
+        # long batch is not double-counted into queue_ms (queue + batch
+        # must sum to the request's server-side wall)
+        queue_ms_of = {
+            id(req): (t0 - req.enqueued_at) * 1000.0 for req in batch
+        }
+        resident = self._resident  # pinned for the whole batch
+        gen = int(resident.generation)
+        paths = list(dict.fromkeys(req.genome for req in batch))
+        counters.set_gauge("serve_queue_depth", float(self.queue.depth()))
+        counters.set_gauge("serve_batch_size", float(len(batch)))
+        by_name: dict = {}
+        path_err: dict[str, str] = {}
+        try:
+            with counters.stage("serve_batch"):
+                with telemetry.span(
+                    "serve_batch", n=len(batch), unique=len(paths), generation=gen
+                ):
+                    by_name = self._classify_fn(resident, paths)
+        except Exception as e:  # noqa: BLE001 — a poisoned batch must not kill the daemon
+            # isolate the poison: one unreadable/malformed query must not
+            # fail its co-batched neighbors (K one-shot classifies would
+            # only have failed the bad one). Retry each path alone; only
+            # the genuinely bad ones answer with an error.
+            get_logger().warning(
+                "serve: batch of %d failed (%s: %s) — isolating per query",
+                len(batch), type(e).__name__, e,
+            )
+            counters.add_fault("serve_batch_poisoned")
+            for p in paths:
+                try:
+                    with counters.stage("serve_batch"):
+                        by_name.update(self._classify_fn(resident, [p]))
+                except UserInputError as pe:
+                    path_err[os.path.basename(p)] = str(pe)
+                except Exception as pe:  # noqa: BLE001
+                    path_err[os.path.basename(p)] = f"{type(pe).__name__}: {pe}"
+                    get_logger().exception("serve: query %s failed", p)
+        batch_ms = (time.monotonic() - t0) * 1000.0
+        counters.observe("serve_batch_ms", batch_ms)
+        counters.observe("serve_batch_requests", float(len(batch)))
+        # book the batch BEFORE replying: a client that queries status
+        # right after its verdict must see its own request counted
+        with self._lock:
+            self.stats.batches_total += 1
+            self.stats.requests_total += len(batch)
+        for req in batch:
+            queue_ms = queue_ms_of[id(req)]
+            base = os.path.basename(req.genome)
+            verdict = by_name.get(base)
+            if verdict is None:
+                self.stats.errors_total += 1
+                resp = protocol.error_response(
+                    path_err.get(base, f"no verdict produced for {req.genome}"),
+                    req_id=req.req_id, reason="classify_failed",
+                )
+            else:
+                resp = protocol.classify_response(
+                    verdict, req_id=req.req_id, batch_size=len(batch),
+                    queue_ms=queue_ms, batch_ms=batch_ms,
+                )
+            # the request's full server-side latency: queue wait + the
+            # batch that served it
+            counters.observe("serve_request_ms", queue_ms + batch_ms)
+            req.reply(resp)
+
+    # ---- generation hot-swap --------------------------------------------
+    def _poll_generations(self) -> None:
+        """Re-read the manifest on a cadence; a bumped generation loads
+        into a NEW resident object and swaps in atomically (one
+        reference assignment — in-flight batches keep the old object).
+        The pure-reader contract holds: polling is a read_manifest, the
+        reload is load_index(heal=False)."""
+        store = IndexStore(self.cfg.index_loc)
+        while not self._stop_poll.wait(max(0.05, float(self.cfg.poll_generation_s))):
+            try:
+                manifest = store.read_manifest()
+                gen = int(manifest.get("generation", -1))
+            except Exception:  # noqa: BLE001 — a torn/in-flight publish reads as "not yet"
+                continue
+            if self._resident is None or gen <= int(self._resident.generation):
+                continue
+            try:
+                t0 = time.monotonic()
+                with telemetry.span("generation_load", generation=gen):
+                    fresh = load_resident_index(self.cfg.index_loc)
+            except Exception as e:  # noqa: BLE001 — keep serving the old generation
+                get_logger().warning(
+                    "serve: failed to load generation %d (%s) — still serving %d",
+                    gen, e, self._resident.generation,
+                )
+                continue
+            old = int(self._resident.generation)
+            self._resident = fresh
+            with self._lock:
+                self.stats.swaps_total += 1
+            counters.set_gauge("serve_generation", float(fresh.generation))
+            telemetry.event(
+                "generation_swap", old=old, new=int(fresh.generation),
+                n=fresh.n, load_s=round(time.monotonic() - t0, 4),
+            )
+            get_logger().info(
+                "serve: hot-swapped generation %d -> %d (%d genomes)",
+                old, fresh.generation, fresh.n,
+            )
+
+    # ---- status ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The health/metrics snapshot the `status` op and the HTTP
+        ``/healthz`` shim both serve (one function — the endpoints
+        cannot drift). Includes a pod_status view of any in-flight
+        `index update` rect-compare pod under ``<index>/pending/`` (the
+        PR 10 follow-on reuse)."""
+        resident = self._resident
+        hists = {
+            name: h.summary()
+            # list(): the batch thread inserts new histogram keys
+            # concurrently with this handler-thread read
+            for name, h in list(counters.hists.items())
+            if name.startswith("serve_")
+        }
+        out = {
+            "ok": True,
+            "pid": os.getpid(),
+            "address": self.cfg.address(),
+            "generation": int(resident.generation) if resident is not None else None,
+            "n_genomes": resident.n if resident is not None else None,
+            "uptime_s": round(time.monotonic() - self.stats.started_at, 3),
+            "draining": self.queue.draining,
+            "queue_depth": self.queue.depth(),
+            "max_queue": self.cfg.max_queue,
+            "max_batch": self.cfg.max_batch,
+            "batch_window_ms": self.cfg.batch_window_ms,
+            "requests_total": self.stats.requests_total,
+            "rejected_total": self.stats.rejected_total,
+            "errors_total": self.stats.errors_total,
+            "batches_total": self.stats.batches_total,
+            "generation_swaps": self.stats.swaps_total,
+            "latency_ms": hists,
+        }
+        pod = self._pending_update_status()
+        if pod is not None:
+            out["update_pod"] = pod
+        return out
+
+    def _pending_update_status(self) -> dict | None:
+        """pod_status.collect() over the newest in-flight update pod (if
+        any) — the daemon's health view names the very update whose
+        publish it will hot-swap to. Best-effort: the tool lives in
+        tools/ (repo layout); when unreachable the field is omitted."""
+        pending = os.path.join(os.path.abspath(self.cfg.index_loc), "pending")
+        try:
+            gens = sorted(
+                d for d in os.listdir(pending)
+                if d.startswith("g") and os.path.isdir(os.path.join(pending, d))
+            )
+        except OSError:
+            return None
+        if not gens:
+            return None
+        ckpt = os.path.join(pending, gens[-1])
+        try:
+            collect = _pod_status_collect()
+            if collect is None:
+                return None
+            status = collect(ckpt)
+            # the serve snapshot only needs the operational core
+            keep = ("epoch", "live", "dead", "draining", "shards_published",
+                    "shards_total", "progress", "eta_s")
+            return {"checkpoint_dir": ckpt,
+                    **{k: status[k] for k in keep if k in status}}
+        except Exception:  # noqa: BLE001 — health must never crash on a racing update
+            return None
+
+    # ---- connections -----------------------------------------------------
+    def _accept_loop(self) -> None:
+        import struct
+
+        while True:
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed: drain/shutdown
+            # SEND-only timeout (SO_SNDTIMEO, not settimeout — a socket
+            # timeout would also drop idle READERS): a client that stops
+            # consuming replies makes sendall error out instead of
+            # wedging the single batch-loop thread, which would stall
+            # every other client and break the SIGTERM drain contract
+            with contextlib.suppress(OSError):
+                conn.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct.pack("ll", 15, 0),
+                )
+            t = threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True,
+                name="drep-serve-conn",
+            )
+            t.start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        wlock = threading.Lock()
+        # per-connection in-flight accounting: the reader may hit EOF (a
+        # pipelining client half-closing its write side) while the batch
+        # loop still owes replies on this socket — the LAST reply closes
+        # the fd, never the reader
+        state = {"inflight": 0, "eof": False}
+
+        def send(obj: dict) -> None:
+            data = protocol.encode(obj)
+            with wlock:
+                with contextlib.suppress(OSError):
+                    conn.sendall(data)
+
+        def reply_classify(resp: dict) -> None:
+            send(resp)
+            with wlock:
+                state["inflight"] -= 1
+                last = state["eof"] and state["inflight"] <= 0
+            if last:
+                with contextlib.suppress(OSError):
+                    conn.close()
+
+        reader = conn.makefile("rb")
+        try:
+            first = reader.readline(protocol.MAX_LINE_BYTES)
+            if not first:
+                return
+            if protocol.looks_like_http(first):
+                self._handle_http(conn, first, reader)
+                return
+            line = first
+            while line:
+                stripped = line.strip()
+                if stripped:
+                    try:
+                        self._handle_line(stripped, send, reply_classify, state, wlock)
+                    except Exception as e:  # noqa: BLE001 — one bad request
+                        # must not kill the connection thread silently
+                        send(protocol.error_response(
+                            f"internal error: {type(e).__name__}: {e}",
+                            reason="internal",
+                        ))
+                        get_logger().exception("serve: request handler failed")
+                line = reader.readline(protocol.MAX_LINE_BYTES)
+        except (OSError, ValueError):
+            pass  # client went away: its queued requests still classify;
+            # the reply write is suppressed above
+        finally:
+            with contextlib.suppress(OSError):
+                reader.close()
+            with wlock:
+                state["eof"] = True
+                idle = state["inflight"] <= 0
+            if idle:
+                with contextlib.suppress(OSError):
+                    conn.close()
+
+    def _handle_line(
+        self, line: bytes, send: Callable[[dict], None],
+        reply_classify: Callable[[dict], None], state: dict, wlock,
+    ) -> None:
+        try:
+            req = protocol.parse_request(line)
+        except protocol.ProtocolError as e:
+            send(protocol.error_response(str(e), reason="protocol"))
+            return
+        op = req["op"]
+        if op == "ping":
+            send({"ok": True, "op": "ping",
+                  "generation": int(self._resident.generation)})
+            return
+        if op == "status":
+            send({"ok": True, "op": "status", "status": self.snapshot()})
+            return
+        with wlock:
+            state["inflight"] += 1
+        self._admit_classify(req, reply_classify)
+
+    def _admit_classify(self, req: dict, send: Callable[[dict], None]) -> None:
+        genome = os.path.abspath(req["genome"])
+        req_id = req.get("id")
+        if not os.path.isfile(genome):
+            send(protocol.error_response(
+                f"no such genome file: {genome}", req_id=req_id, reason="bad_request",
+            ))
+            return
+        pending = PendingRequest(genome=genome, reply=send, req_id=req_id)
+        refused = self.queue.submit(pending)
+        if refused is not None:
+            with self._lock:
+                self.stats.rejected_total += 1
+            counters.add_fault("serve_rejected")
+            retry = max(
+                _RETRY_AFTER_FLOOR_S, float(self.cfg.batch_window_ms) / 1000.0
+            )
+            msg = (
+                "daemon is draining (SIGTERM received)"
+                if refused == "draining"
+                else f"admission queue full ({self.cfg.max_queue})"
+            )
+            send(protocol.error_response(
+                msg, req_id=req_id, reason=refused, retry_after_s=retry,
+            ))
+
+    # ---- HTTP shim -------------------------------------------------------
+    def _handle_http(self, conn: socket.socket, first: bytes, reader) -> None:
+        try:
+            method, path, body = protocol.http_request(first, reader)
+            req = protocol.http_to_request(method, path, body)
+        except protocol.ProtocolError as e:
+            with contextlib.suppress(OSError):
+                conn.sendall(protocol.http_response(
+                    404 if "no route" in str(e) else 400,
+                    protocol.error_response(str(e), reason="protocol"),
+                ))
+            with contextlib.suppress(OSError):
+                conn.close()
+            return
+        if req["op"] == "status":
+            with contextlib.suppress(OSError):
+                conn.sendall(protocol.http_response(200, self.snapshot()))
+            with contextlib.suppress(OSError):
+                conn.close()
+            return
+        # POST /classify: admit, block this shim thread for the verdict
+        done = threading.Event()
+        box: dict[str, dict] = {}
+
+        def reply(resp: dict) -> None:
+            box["resp"] = resp
+            done.set()
+
+        self._admit_classify(dict(req), reply)
+        done.wait()
+        resp = box.get("resp", protocol.error_response("no response"))
+        status = 200 if resp.get("ok") else (
+            503 if resp.get("reason") in ("backpressure", "draining") else 400
+        )
+        with contextlib.suppress(OSError):
+            conn.sendall(protocol.http_response(
+                status, resp, retry_after_s=resp.get("retry_after_s")
+            ))
+        with contextlib.suppress(OSError):
+            conn.close()
+
+
+_POD_STATUS: list = []  # [collect-or-None], resolved once per process
+
+
+def _pod_status_collect():
+    """Import tools/pod_status.py's collect() from the repo layout
+    (tools/ is not a package), once per process — /healthz probes fire
+    every few seconds and must not re-execute the module each time.
+    Returns None when the file is not reachable (installed-package
+    deployments)."""
+    if _POD_STATUS:
+        return _POD_STATUS[0]
+    import importlib.util
+
+    collect = None
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(repo, "tools", "pod_status.py")
+    if os.path.exists(path):
+        spec = importlib.util.spec_from_file_location("_drep_pod_status", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        collect = mod.collect
+    _POD_STATUS.append(collect)
+    return collect
+
+
+def install_signal_handlers(server: IndexServer) -> None:
+    """SIGTERM/SIGINT -> graceful drain (main thread only — the CLI
+    path). The handler only flips latches; the batch loop drains and
+    run() returns 0, the drain contract orchestrators restart-loop on."""
+    import signal
+
+    def _drain(signum, _frame):
+        get_logger().warning(
+            "serve: %s received — draining (%d queued)",
+            signal.Signals(signum).name, server.queue.depth(),
+        )
+        # defer off the signal frame: the handler interrupts the batch
+        # loop (the main thread), and touching its synchronization
+        # primitives from the interrupted frame is a whole class of
+        # reentrancy bugs a one-line thread hop removes outright
+        threading.Thread(target=server.request_drain, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)

@@ -1,0 +1,176 @@
+"""The `index serve` wire protocol: newline-delimited JSON, one object
+per line, request/response — plus a minimal HTTP/1.0 shim on the same
+listener (auto-detected per connection from the first bytes).
+
+NDJSON requests (the native protocol — what ServeClient speaks)::
+
+    {"op": "classify", "genome": "/abs/path.fasta", "id": "optional"}
+    {"op": "status"}        # the daemon's health/metrics snapshot
+    {"op": "ping"}          # liveness + current generation
+
+Responses always carry ``ok``. A classify success::
+
+    {"ok": true, "id": ..., "verdict": {...}, "generation": G,
+     "batch_size": K, "queue_ms": ..., "batch_ms": ...}
+
+``verdict`` is byte-for-byte the one-shot `index classify` verdict dict
+(generation-stamped). A refusal (backpressure or drain) is an error
+WITH a retry hint — the client's cue to back off, never a broken pipe::
+
+    {"ok": false, "id": ..., "error": "admission queue full (256)",
+     "reason": "backpressure", "retry_after_s": 0.05}
+
+HTTP shim (one request per connection, enough for curl/k8s probes)::
+
+    GET /healthz          -> 200, the status snapshot JSON
+    GET /status           -> same
+    POST /classify        -> body {"genome": "/abs/path.fasta"}; the
+                             classify response JSON (503 + Retry-After
+                             on backpressure/drain)
+
+The protocol layer is transport-free (pure bytes <-> dicts) so the
+daemon, the client library, and the tests share one encoder/decoder and
+none of them can drift.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+MAX_LINE_BYTES = 1 << 20  # a request line is a path + opcode, never MBs
+
+OPS = ("classify", "status", "ping")
+
+# HTTP methods the shim answers; anything else on a connection whose
+# first line is not JSON is a protocol error
+_HTTP_METHODS = ("GET ", "POST ", "HEAD ")
+
+
+class ProtocolError(ValueError):
+    """A malformed request line — answered with an error response (the
+    connection survives; a client bug must not look like a server
+    crash)."""
+
+
+def encode(obj: dict) -> bytes:
+    """One response/request line (newline-terminated, compact)."""
+    return json.dumps(obj, separators=(",", ":"), default=str).encode() + b"\n"
+
+
+def parse_request(line: bytes) -> dict:
+    """Validate one NDJSON request line into a request dict. Raises
+    ProtocolError with an actionable message on anything malformed."""
+    if len(line) > MAX_LINE_BYTES:
+        raise ProtocolError(f"request line exceeds {MAX_LINE_BYTES} bytes")
+    try:
+        req = json.loads(line.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ProtocolError(f"request is not valid JSON: {e}") from e
+    if not isinstance(req, dict):
+        raise ProtocolError(f"request must be a JSON object, got {type(req).__name__}")
+    op = req.get("op")
+    if op not in OPS:
+        raise ProtocolError(f"unknown op {op!r} (expected one of {list(OPS)})")
+    if op == "classify":
+        genome = req.get("genome")
+        if not isinstance(genome, str) or not genome:
+            raise ProtocolError('classify needs a "genome" FASTA path')
+    return req
+
+
+def error_response(
+    msg: str, *, req_id: Any = None, reason: str | None = None,
+    retry_after_s: float | None = None,
+) -> dict:
+    out: dict[str, Any] = {"ok": False, "error": str(msg)}
+    if req_id is not None:
+        out["id"] = req_id
+    if reason is not None:
+        out["reason"] = reason
+    if retry_after_s is not None:
+        out["retry_after_s"] = round(float(retry_after_s), 4)
+    return out
+
+
+def classify_response(
+    verdict: dict, *, req_id: Any = None, batch_size: int = 1,
+    queue_ms: float = 0.0, batch_ms: float = 0.0,
+) -> dict:
+    out: dict[str, Any] = {
+        "ok": True,
+        "verdict": verdict,
+        "generation": verdict.get("generation"),
+        "batch_size": int(batch_size),
+        "queue_ms": round(float(queue_ms), 3),
+        "batch_ms": round(float(batch_ms), 3),
+    }
+    if req_id is not None:
+        out["id"] = req_id
+    return out
+
+
+# ---- HTTP shim ------------------------------------------------------------
+
+
+def looks_like_http(first_line: bytes) -> bool:
+    try:
+        head = first_line.decode("latin-1")
+    except Exception:  # noqa: BLE001 — binary junk is not HTTP
+        return False
+    return head.startswith(_HTTP_METHODS)
+
+
+def http_request(first_line: bytes, reader) -> tuple[str, str, bytes]:
+    """Parse one HTTP/1.0-style request from `reader` (a file-like
+    yielding lines, the first already consumed as `first_line`).
+    Returns (method, path, body)."""
+    parts = first_line.decode("latin-1").strip().split()
+    if len(parts) < 2:
+        raise ProtocolError("malformed HTTP request line")
+    method, path = parts[0].upper(), parts[1]
+    length = 0
+    while True:
+        hline = reader.readline(MAX_LINE_BYTES)
+        if not hline or hline in (b"\r\n", b"\n"):
+            break
+        name, _, value = hline.decode("latin-1").partition(":")
+        if name.strip().lower() == "content-length":
+            try:
+                length = min(int(value.strip()), MAX_LINE_BYTES)
+            except ValueError as e:
+                raise ProtocolError("bad Content-Length") from e
+    body = reader.read(length) if length else b""
+    return method, path, body
+
+
+def http_response(status: int, payload: dict, retry_after_s: float | None = None) -> bytes:
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              503: "Service Unavailable"}.get(status, "OK")
+    body = json.dumps(payload, separators=(",", ":"), default=str).encode()
+    head = (
+        f"HTTP/1.0 {status} {reason}\r\n"
+        f"Content-Type: application/json\r\n"
+        f"Content-Length: {len(body)}\r\n"
+    )
+    if retry_after_s is not None:
+        head += f"Retry-After: {max(1, round(retry_after_s))}\r\n"
+    return head.encode("latin-1") + b"Connection: close\r\n\r\n" + body
+
+
+def http_to_request(method: str, path: str, body: bytes) -> dict:
+    """Map one shim endpoint onto the native request shape. Raises
+    ProtocolError (-> 400/404) on anything outside the documented
+    surface."""
+    route = path.split("?", 1)[0].rstrip("/") or "/"
+    if method in ("GET", "HEAD") and route in ("/healthz", "/status"):
+        return {"op": "status"}
+    if method == "POST" and route == "/classify":
+        try:
+            doc = json.loads(body.decode("utf-8")) if body else {}
+        except (ValueError, UnicodeDecodeError) as e:
+            raise ProtocolError(f"classify body is not valid JSON: {e}") from e
+        if not isinstance(doc, dict) or not doc.get("genome"):
+            raise ProtocolError('POST /classify body needs {"genome": "<path>"}')
+        return {"op": "classify", "genome": str(doc["genome"]), "id": doc.get("id")}
+    raise ProtocolError(f"no route {method} {route} (try GET /healthz or POST /classify)")

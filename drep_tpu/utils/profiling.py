@@ -43,6 +43,56 @@ class _Stage:
     tiles_skipped: int = 0
 
 
+class Histogram:
+    """Bounded-window latency histogram for long-lived processes (the
+    serve daemon, ISSUE 11): a ring buffer of the last `size`
+    observations feeds the percentiles (p50/p99 over the recent window —
+    what an operator actually wants from a daemon that has been up for
+    a week), while count/total/max run unbounded. O(1) observe, O(size)
+    summary — summaries are scrape-cadence, observations are per-request."""
+
+    __slots__ = ("size", "ring", "count", "total", "vmax")
+
+    def __init__(self, size: int = 8192):
+        self.size = int(size)
+        self.ring: list[float] = []
+        self.count = 0
+        self.total = 0.0
+        self.vmax = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if len(self.ring) < self.size:
+            self.ring.append(v)
+        else:
+            self.ring[self.count % self.size] = v
+        self.count += 1
+        self.total += v
+        if v > self.vmax:
+            self.vmax = v
+
+    @staticmethod
+    def _pick(sorted_vals: list[float], q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+        return sorted_vals[int(idx)]
+
+    def percentile(self, q: float) -> float:
+        return self._pick(sorted(self.ring), q)
+
+    def summary(self) -> dict[str, float]:
+        vals = sorted(self.ring)
+        return {
+            "count": self.count,
+            "mean": round(self.total / self.count, 4) if self.count else 0.0,
+            "p50": round(self._pick(vals, 0.5), 4),
+            "p90": round(self._pick(vals, 0.9), 4),
+            "p99": round(self._pick(vals, 0.99), 4),
+            "max": round(self.vmax, 4),
+        }
+
+
 @dataclass
 class Counters:
     """Per-stage pair/time accounting. One process-global instance (the
@@ -75,6 +125,11 @@ class Counters:
     # a drain-then-join churn and a join-then-drain churn are different
     # operational stories that the same counter totals would conflate.
     epoch_history: list = field(default_factory=list)
+    # per-request latency distributions (ISSUE 11, the serve daemon):
+    # gauges hold last-write-wins scalars, but a serving tier's honesty
+    # metric is the TAIL — p50/p99 over a bounded recent window, per
+    # named series (serve_request_ms, serve_batch_ms, ...).
+    hists: dict[str, Histogram] = field(default_factory=dict)
 
     @contextlib.contextmanager
     def stage(self, name: str, pairs: int = 0) -> Iterator[None]:
@@ -122,6 +177,15 @@ class Counters:
     def set_gauge(self, name: str, value: float) -> None:
         """Record a derived operational value (last write wins)."""
         self.gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into the named latency histogram
+        (created on first use). Hot-path cheap: one dict lookup + ring
+        write; percentile math happens only at report/flush time."""
+        h = self.hists.get(name)
+        if h is None:
+            h = self.hists[name] = Histogram()
+        h.observe(value)
 
     def note_epoch(self, epoch: int, reason: str) -> None:
         """Record one ownership-epoch bump (reason: death/drain/join) in
@@ -193,6 +257,10 @@ class Counters:
             out["gauges"] = dict(sorted(self.gauges.items()))
         if self.epoch_history:
             out["epoch_history"] = list(self.epoch_history)
+        if self.hists:
+            out["histograms"] = {
+                name: h.summary() for name, h in sorted(self.hists.items())
+            }
         return out
 
     def write(self, log_dir: str) -> str:
@@ -213,6 +281,7 @@ class Counters:
         self.faults.clear()
         self.gauges.clear()
         self.epoch_history.clear()
+        self.hists.clear()
 
 
 counters = Counters()  # the process-global instance used by the pipeline
@@ -279,6 +348,13 @@ def prom_text(c: Counters | None = None) -> str:
         *(
             f'drep_tpu_gauge{{name="{_prom_escape(g)}"}} {v}'
             for g, v in sorted(c.gauges.items())
+        ),
+        "# HELP drep_tpu_latency summary stats over the recent observation window",
+        "# TYPE drep_tpu_latency gauge",
+        *(
+            f'drep_tpu_latency{{name="{_prom_escape(n)}",stat="{stat}"}} {v}'
+            for n, h in sorted(c.hists.items())
+            for stat, v in h.summary().items()
         ),
         "# TYPE drep_tpu_epoch_bumps_total counter",
         f"drep_tpu_epoch_bumps_total {len(c.epoch_history)}",

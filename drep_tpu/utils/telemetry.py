@@ -120,6 +120,14 @@ def events_path() -> str | None:
     return _STATE["path"]
 
 
+def configured_log_dir() -> str | None:
+    """The log dir the sink was configured with (set whether or not
+    tracing is on). bench.py's wedge diagnosis reads this to find the
+    wedged stage's own event logs without plumbing the workdir out of
+    the stage thunk."""
+    return _STATE["log_dir"]
+
+
 def set_epoch(epoch: int) -> None:
     """Keep the stamped ownership epoch current (profiling.note_epoch and
     the elastic join path call this — every later line carries it)."""

@@ -41,6 +41,13 @@ Two tiers:
   result, and ``io:corrupt`` bit rot on index shards self-healing
   through recompute/re-sketch on the next update. Delegate to their
   pytest chaos tests (tests/test_index_chaos.py), CPU-only.
+- serve cells (``--serve``): the resident serving tier (ISSUE 11,
+  drep_tpu/serve/) — SIGKILL the `index serve` daemon mid-batch: every
+  connected client gets a clean disconnection error (never a hang or a
+  half-written line), a restarted daemon serves the SAME generation,
+  and the index directory stays byte-for-byte untouched through kill
+  and restart. Delegates to its pytest chaos test (tests/test_serve.py),
+  CPU-only.
 - event-tracing cells (``--events``): the observability layer (ISSUE 10,
   utils/telemetry.py + tools/trace_report.py) — the drain-mid-streaming
   and kill-mid-streaming pods re-run with ``DREP_TPU_EVENTS=on``,
@@ -56,6 +63,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --io      # + storage cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --index   # + index cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --elastic # + join/drain cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --serve   # + serving-tier cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
@@ -454,6 +462,17 @@ ELASTIC_CELLS = [
 ]
 
 
+# serve cells (--serve, ISSUE 11): the resident serving tier's crash
+# story. SIGKILL needs a subprocess daemon + live clients — delegate to
+# the pytest chaos cell. CPU-only, tens of seconds.
+SERVE_CELLS = [
+    ("serve", "kill", "SIGKILL daemon mid-batch -> clean client error; restart serves same generation, index untouched",
+     "survive", "tests/test_serve.py::test_sigkill_daemon_clean_error_restart_same_generation"),
+    ("serve", "drain", "SIGTERM mid-traffic -> in-flight answered, admissions refused, exit 0",
+     "survive", "tests/test_serve.py::test_daemon_sigterm_drains_cleanly"),
+]
+
+
 # event-tracing cells (--events, ISSUE 10): the elastic drain/death pods
 # re-run with DREP_TPU_EVENTS=on; the tests merge every member's event
 # log (tools/trace_report.py), pin the causal order (drain note -> epoch
@@ -492,6 +511,7 @@ def main() -> int:
     index_cells = "--index" in sys.argv
     prune_cells = "--prune" in sys.argv
     elastic_cells = "--elastic" in sys.argv
+    serve_cells = "--serve" in sys.argv
     events_cells = "--events" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
@@ -535,6 +555,7 @@ def main() -> int:
     _pytest_cells(PRUNE_PYTEST_CELLS, "--prune", prune_cells)
     _pytest_cells(INDEX_CELLS, "--index", index_cells)
     _pytest_cells(ELASTIC_CELLS, "--elastic", elastic_cells)
+    _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)
 

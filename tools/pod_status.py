@@ -13,6 +13,15 @@ Usage::
 
     python tools/pod_status.py <wd>/data/streaming_primary        # human text
     python tools/pod_status.py <ckpt_dir> --json                  # machine
+    python tools/pod_status.py <ckpt_dir> --follow [SECONDS]      # live view
+
+``--follow`` (ISSUE 11 satellite, the PR 10 follow-on) polls the
+checkpoint dir on an interval and re-renders the status/ETA in place
+(ANSI home+clear on a TTY, separator lines otherwise) until Ctrl-C —
+the watch loop an autoscaling controller would sit in. Each render is
+the same one-shot :func:`collect` snapshot; the `index serve` daemon's
+health endpoint reuses exactly that function for its ``update_pod``
+view, so the CLI watcher and the daemon can never disagree.
 
 **Read-only by contract, byte-for-byte** — like ``index classify``: this
 tool only ever lists and reads; it creates, modifies, deletes, and
@@ -266,12 +275,60 @@ def render(status: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def follow(
+    ckpt_dir: str,
+    interval_s: float = 5.0,
+    count: int = 0,
+    out=None,
+    as_json: bool = False,
+) -> int:
+    """Poll + re-render in place every `interval_s` until Ctrl-C (or
+    `count` renders, for tests/scripting). Read-only like the one-shot
+    path — each iteration IS one :func:`collect` snapshot. Returns the
+    last snapshot's exit status."""
+    out = sys.stdout if out is None else out
+    clear = "\x1b[H\x1b[2J" if getattr(out, "isatty", lambda: False)() else ""
+    n = 0
+    status: dict = {}
+    try:
+        while True:
+            status = collect(ckpt_dir)
+            body = (
+                json.dumps(status, indent=1, sort_keys=True) + "\n"
+                if as_json
+                else render(status)
+            )
+            if clear:
+                out.write(clear + body)
+            else:
+                out.write(f"--- poll {n + 1} @ {time.strftime('%H:%M:%S')} ---\n" + body)
+            out.flush()
+            n += 1
+            if count and n >= count:
+                break
+            time.sleep(max(0.05, interval_s))
+    except KeyboardInterrupt:
+        pass
+    return 1 if "error" in status else 0
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("checkpoint_dir", help="the pod's shared checkpoint dir "
                     "(e.g. <wd>/data/streaming_primary)")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--follow", nargs="?", const=5.0, type=float, default=None,
+                    metavar="SECONDS",
+                    help="re-render every SECONDS (default 5) in place "
+                         "until Ctrl-C — the live pod view")
+    ap.add_argument("--count", type=int, default=0,
+                    help="with --follow: stop after N renders (0 = forever)")
     args = ap.parse_args(argv)
+    if args.follow is not None:
+        return follow(
+            args.checkpoint_dir, interval_s=args.follow, count=args.count,
+            as_json=args.json,
+        )
     status = collect(args.checkpoint_dir)
     if args.json:
         print(json.dumps(status, indent=1, sort_keys=True))

@@ -236,6 +236,77 @@ def chrome_trace(events: list[dict]) -> dict:
     }
 
 
+def stall_diagnosis(log_dir: str) -> dict | None:
+    """Name a wedged run's stall site from its own event logs (ISSUE 11
+    satellite — bench.py's wedge bail calls this so a traced stage that
+    overruns its watchdog records WHERE it stalled, not just that it
+    did). Returns None when there are no events to read.
+
+    The diagnosis is the crash-forensics triple:
+
+    - ``stall_site``: the most recently OPENED still-open span — what
+      was in flight when the log went quiet (the "B" with no "E" that
+      telemetry.py documents as the crash evidence);
+    - ``open_spans``: every unclosed span, oldest first (nesting shows
+      the stage -> stripe containment);
+    - ``last_event`` + ``idle_gaps``: where the stream stopped, and any
+      silent stretches between work spans before it did.
+    """
+    loaded = load_events(log_dir)
+    events = loaded["events"]
+    if not events:
+        return None
+    spans, unclosed = pair_spans(events)
+    t_lo = min(r.get("wall", 0.0) for r in events)
+    t_hi = max(r.get("wall", 0.0) for r in events)
+    last = events[-1]
+    out: dict = {
+        "log_dir": os.path.abspath(log_dir),
+        "n_events": len(events),
+        "wall_span_s": round(t_hi - t_lo, 3),
+        "last_event": {
+            "ev": last.get("ev"), "ph": last.get("ph"),
+            "pid": last.get("pid", 0),
+            "at_s": round(last.get("wall", t_lo) - t_lo, 3),
+        },
+        "open_spans": [
+            {
+                "pid": b.get("pid", 0), "ev": b.get("ev"),
+                "args": b.get("args") or {},
+                "opened_at_s": round(b.get("wall", t_lo) - t_lo, 3),
+                "open_for_s": round(t_hi - b.get("wall", t_lo), 3),
+            }
+            for b in unclosed
+        ],
+        "torn_tails": [os.path.basename(p) for p in loaded["torn_tails"]],
+    }
+    if unclosed:
+        # the INNERMOST in-flight work: the latest-opened unclosed span
+        out["stall_site"] = out["open_spans"][-1]
+    work = [sp for sp in spans if sp["ev"] in WORK_SPANS]
+    if work:
+        med = _median([sp["dur"] for sp in work])
+        gap_floor = max(1.0, 3 * med)
+        gaps = []
+        by_pid: dict[int, list] = {}
+        for sp in work:
+            by_pid.setdefault(sp["pid"], []).append(sp)
+        for pid, mine in by_pid.items():
+            mine.sort(key=lambda s: s["begin"])
+            for a, b in zip(mine, mine[1:]):
+                gap = b["begin"] - a["end"]
+                if gap > gap_floor:
+                    gaps.append(
+                        {"pid": pid, "gap_s": round(gap, 3),
+                         "after_s": round(a["end"] - t_lo, 3)}
+                    )
+        if gaps:
+            out["idle_gaps"] = sorted(
+                gaps, key=lambda g: -g["gap_s"]
+            )[:8]
+    return out
+
+
 def _percentile(sorted_vals: list[float], q: float) -> float:
     if not sorted_vals:
         return 0.0
