@@ -115,6 +115,7 @@ def _centrifuge_one(args) -> tuple[str, str, int, float]:
                 "-p", str(max(threads, 1)),
             ]
         )
+        # drep-lint: allow[durable-funnel] — the EXTERNAL centrifuge binary wrote the tmp; this rename is the atomic publish half of the recipe
         os.replace(tmp, report)
     tax, taxid, frac = genome_taxonomy(parse_centrifuge_report(report))
     return genome, tax, taxid, frac

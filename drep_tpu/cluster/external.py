@@ -19,6 +19,7 @@ import pandas as pd
 
 from drep_tpu.cluster.dispatch import register_primary, register_secondary
 from drep_tpu.errors import UserInputError
+from drep_tpu.utils.durableio import atomic_write_bytes
 from drep_tpu.ingest import GenomeSketches
 from drep_tpu.utils.logger import get_logger
 
@@ -93,8 +94,7 @@ def secondary_fastani(
     cov = np.zeros((m, m), dtype=np.float32)
     with tempfile.TemporaryDirectory() as tmp:
         lst = os.path.join(tmp, "genomes.txt")
-        with open(lst, "w") as f:
-            f.write("\n".join(paths) + "\n")
+        atomic_write_bytes(lst, ("\n".join(paths) + "\n").encode())
         out_f = os.path.join(tmp, "fastani.out")
         _run(["fastANI", "--ql", lst, "--rl", lst, "-t", str(processes), "-o", out_f])
         index = {p: i for i, p in enumerate(paths)}

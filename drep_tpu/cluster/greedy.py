@@ -154,8 +154,6 @@ def greedy_secondary_cluster(
     Genomes are visited largest-first (most k-mers), the reference's
     heuristic that big complete genomes make good representatives.
     """
-    import os
-
     s_ani, cov_thresh = kw["S_ani"], kw["cov_thresh"]
     m = len(indices)
     order = sorted(range(m), key=lambda t: -int(gs.gdb["n_kmers"].iloc[indices[t]]))
@@ -167,9 +165,11 @@ def greedy_secondary_cluster(
     # DREP_TPU_GREEDY_MATMUL=1 forces the matmul path off-TPU so the CPU
     # test mesh can exercise the sharded route (gathers are otherwise the
     # better CPU kernel)
+    from drep_tpu.utils import envknobs
+
     use_matmul = (
         jax.devices()[0].platform == "tpu"
-        or os.environ.get("DREP_TPU_GREEDY_MATMUL") == "1"
+        or envknobs.env_bool("DREP_TPU_GREEDY_MATMUL")
     )
     mesh = None
     base_block = block

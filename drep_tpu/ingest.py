@@ -26,6 +26,7 @@ import numpy as np
 import pandas as pd
 
 from drep_tpu.errors import UserInputError
+from drep_tpu.utils import envknobs
 from drep_tpu.ops import kmers
 from drep_tpu.sketch_worker import sketch_one as _sketch_one
 from drep_tpu.utils.fasta import fasta_stats
@@ -260,7 +261,7 @@ def _barrier_deadline() -> float:
     """Monotonic deadline for the sharded-ingest coordination waits (one
     env knob, one default, shared by the assembly barrier and the
     marker wait so the two cannot drift)."""
-    return time.monotonic() + float(os.environ.get(_INGEST_BARRIER_ENV, "600"))
+    return time.monotonic() + envknobs.env_float(_INGEST_BARRIER_ENV)
 
 
 def sketch_genomes(
@@ -498,7 +499,7 @@ def sketch_genomes(
                 raise RuntimeError(
                     f"sharded ingest barrier timed out: {len(need - set(results))} "
                     f"genomes never appeared in {shard_dir} for "
-                    f"{os.environ.get(_INGEST_BARRIER_ENV, '600')}s with no new "
+                    f"{envknobs.env_float(_INGEST_BARRIER_ENV):.0f}s with no new "
                     f"shards (first missing: {missing}). A peer process likely "
                     "died; raise the window via DREP_TPU_INGEST_BARRIER_S if its "
                     "per-shard gaps are legitimately longer."

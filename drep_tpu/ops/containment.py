@@ -445,9 +445,9 @@ def _indicator_dtype(width: int):
     stay below 2^24, checked here (a real raise, not an assert — -O must
     not turn an exactness violation into silent wrong counts).
     """
-    import os
+    from drep_tpu.utils import envknobs
 
-    forced = os.environ.get("DREP_TPU_INDICATOR_DTYPE")
+    forced = envknobs.env_str("DREP_TPU_INDICATOR_DTYPE")
     if forced in (None, "", "int8"):
         return jnp.int8
     if forced == "float32":

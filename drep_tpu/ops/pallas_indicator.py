@@ -32,7 +32,6 @@ self-test makes the fast path self-deploying when hardware answers.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -124,7 +123,9 @@ def pallas_indicator_ok() -> bool:
     is always a correct (slower) substitute."""
     if _SELFTEST["ok"] is not None:
         return _SELFTEST["ok"]
-    if os.environ.get("DREP_TPU_PALLAS_INDICATOR", "") == "0":
+    from drep_tpu.utils import envknobs
+
+    if not envknobs.env_bool("DREP_TPU_PALLAS_INDICATOR"):
         _SELFTEST["ok"] = False
         return False
     try:

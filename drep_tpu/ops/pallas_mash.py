@@ -51,9 +51,9 @@ def rows_per_iter(s2: int) -> int:
     Clamped so R * 2*S2 never exceeds 2 * (2*PALLAS_MAX_WIDTH) merged
     lanes per sublane block — the request that compiles at R=1/max width
     must not fail Mosaic allocation when the knob multiplies it."""
-    import os
+    from drep_tpu.utils import envknobs
 
-    r = int(os.environ.get("DREP_TPU_MASH_ROWS_PER_ITER", "1"))
+    r = envknobs.env_int("DREP_TPU_MASH_ROWS_PER_ITER")
     if r not in (1, 2, 4):
         raise ValueError("DREP_TPU_MASH_ROWS_PER_ITER must be 1, 2, or 4")
     bound = max(1, (2 * PALLAS_MAX_WIDTH) // max(s2, 1))

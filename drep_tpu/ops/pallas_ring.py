@@ -58,7 +58,6 @@ a performance claim (tools/missing_stages.py refuses such records).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -266,7 +265,9 @@ def pallas_ring_ok() -> bool:
     """
     if _SELFTEST["ok"] is not None:
         return bool(_SELFTEST["ok"])
-    if os.environ.get("DREP_TPU_PALLAS_RING", "") == "0":
+    from drep_tpu.utils import envknobs
+
+    if not envknobs.env_bool("DREP_TPU_PALLAS_RING"):
         _SELFTEST.update(ok=False, reason="DREP_TPU_PALLAS_RING=0 pin")
         return False
     try:

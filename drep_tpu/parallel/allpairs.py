@@ -77,7 +77,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from drep_tpu.ops.containment import ani_cov_from_intersections, containment_inter_tile
 from drep_tpu.ops.minhash import PackedSketches, mash_distance_tile, pad_packed_rows
 from drep_tpu.parallel.mesh import AXIS, make_mesh
-from drep_tpu.utils import telemetry
+from drep_tpu.utils import envknobs, telemetry
 from drep_tpu.utils.jaxcompat import pcast, shard_map
 from drep_tpu.utils.logger import get_logger
 
@@ -129,14 +129,14 @@ def configure_ring(
 def ring_monolithic_default() -> bool:
     if _RING_CONFIG["monolithic"] is not None:
         return bool(_RING_CONFIG["monolithic"])
-    return os.environ.get(RING_MONOLITHIC_ENV, "") not in ("", "0", "false")
+    return envknobs.env_bool(RING_MONOLITHIC_ENV)
 
 
 def ring_comm_requested() -> str:
     """The comm backend the run ASKS for (config > env > auto) — validated
     here so a typo'd DREP_TPU_RING_COMM fails loudly, not as a silent
     auto."""
-    req = _RING_CONFIG["comm"] or os.environ.get(RING_COMM_ENV, "") or "auto"
+    req = _RING_CONFIG["comm"] or envknobs.env_str(RING_COMM_ENV) or "auto"
     if req not in RING_COMM_CHOICES:
         raise ValueError(
             f"ring comm backend {req!r}: expected one of {RING_COMM_CHOICES}"
@@ -1109,7 +1109,7 @@ def _ring_allpairs_stepwise(
         else:
             stall_budget = collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S)
             done_written = False
-            last_progress = time.time()
+            last_progress = time.monotonic()
             progress_sig = None
             last_deal_epoch = -1
             while True:
@@ -1145,12 +1145,12 @@ def _ring_allpairs_stepwise(
                 sig = (len(missing), tuple(hb.live))
                 if computed or sig != progress_sig:
                     progress_sig = sig
-                    last_progress = time.time()
+                    last_progress = time.monotonic()
                 if not missing:
                     break
                 if hb.maybe_check():
                     continue
-                if time.time() - last_progress > stall_budget:
+                if time.monotonic() - last_progress > stall_budget:
                     raise CollectiveTimeout(
                         f"dense ring completion stalled for {stall_budget:.0f}s:"
                         f" block(s) {missing[:8]}{'...' if len(missing) > 8 else ''}"

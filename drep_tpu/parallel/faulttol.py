@@ -92,7 +92,7 @@ from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
-from drep_tpu.utils import faults, telemetry
+from drep_tpu.utils import envknobs, faults, telemetry
 from drep_tpu.utils.logger import get_logger
 
 # multi-host collective watchdog (seconds); 0 disables; the env var
@@ -107,12 +107,14 @@ from drep_tpu.utils.logger import get_logger
 #   peers run slower still), so the default must sit above any plausible
 #   single-stage wall, catching only truly dead pods.
 COLLECTIVE_TIMEOUT_ENV = "DREP_TPU_COLLECTIVE_TIMEOUT_S"
-DEFAULT_COLLECTIVE_TIMEOUT_S = 900.0
+# single source: the envknobs registry owns the default; this name stays
+# for importers and the call sites that override it
+DEFAULT_COLLECTIVE_TIMEOUT_S = float(envknobs.knob(COLLECTIVE_TIMEOUT_ENV).default)
 DEFAULT_ALLGATHER_TIMEOUT_S = 6 * 3600.0
 
 
 def collective_timeout_s(default: float = DEFAULT_COLLECTIVE_TIMEOUT_S) -> float:
-    return float(os.environ.get(COLLECTIVE_TIMEOUT_ENV, default))
+    return envknobs.env_float(COLLECTIVE_TIMEOUT_ENV, default=default)
 
 
 # per-process heartbeat cadence for the elastic-pod protocol (seconds);
@@ -122,12 +124,12 @@ def collective_timeout_s(default: float = DEFAULT_COLLECTIVE_TIMEOUT_S) -> float
 # past any plausible beat-writer scheduling jitter, still minutes-not-hours
 # at the default.
 HEARTBEAT_ENV = "DREP_TPU_HEARTBEAT_S"
-DEFAULT_HEARTBEAT_S = 5.0
+DEFAULT_HEARTBEAT_S = float(envknobs.knob(HEARTBEAT_ENV).default)  # registry-owned
 HEARTBEAT_MISS_FACTOR = 5.0
 
 
 def heartbeat_cadence_s() -> float:
-    return float(os.environ.get(HEARTBEAT_ENV, DEFAULT_HEARTBEAT_S))
+    return envknobs.env_float(HEARTBEAT_ENV)
 
 
 # mid-run join request (the scale-UP half of the elastic protocol): set
@@ -141,7 +143,7 @@ POD_JOIN_ENV = "DREP_TPU_POD_JOIN"
 def join_requested() -> str | None:
     """The requested join mode: None (not a joiner), "auto", or an
     explicit id string."""
-    v = os.environ.get(POD_JOIN_ENV, "").strip()
+    v = envknobs.env_str(POD_JOIN_ENV).strip()
     return v or None
 
 
@@ -536,10 +538,14 @@ class HeartbeatManager:
         self.epoch = 0
         self.seq = 0  # call sequence for this store, set by start()
         self._beat_seq = 0
+        # wall-clock stage start: published as pod_t0() and compared
+        # against note MTIMES (server clock) by the file barrier — its
+        # monotonic twin below anchors purely-local elapsed windows
         self._started_at = 0.0
-        self._last_check = 0.0
-        # pid -> wall time the peer FIRST looked stale: a death verdict
-        # needs staleness confirmed across a full cadence, so one
+        self._started_mono = 0.0
+        self._last_check = 0.0  # monotonic: cadence gate for maybe_check
+        # pid -> monotonic time the peer FIRST looked stale: a death
+        # verdict needs staleness confirmed across a full cadence, so one
         # transient failed stat (NFS rename window, ESTALE) can never
         # fence a healthy member
         self._suspect: dict[int, float] = {}
@@ -646,7 +652,10 @@ class HeartbeatManager:
         ):
             with contextlib.suppress(OSError):
                 os.remove(note)
-        self._started_at = time.time()
+        # wall by design: pod_t0() gates barrier-note freshness against
+        # file mtimes (server clock), never elapsed-time math
+        self._started_at = time.time()  # drep-lint: allow[clock-mono] — pod_t0 is compared against note mtimes (server clock)
+        self._started_mono = time.monotonic()
         prev_live = pod_live()
         if prev_live is not None:
             # the pod already lost members in an earlier stage of this
@@ -708,7 +717,7 @@ class HeartbeatManager:
     def maybe_check(self) -> bool:
         """Time-gated :meth:`check` (at most once per cadence) — cheap
         enough to call per stripe."""
-        if time.time() - self._last_check < self.cadence:
+        if time.monotonic() - self._last_check < self.cadence:
             return False
         return self.check()
 
@@ -730,8 +739,15 @@ class HeartbeatManager:
         alive — fences itself."""
         from drep_tpu.utils.profiling import counters
 
-        now = time.time()
-        self._last_check = now
+        # two clocks, deliberately: `now` (wall) is compared against note
+        # MTIMES stamped by the shared filesystem's server clock (drain
+        # latency, join-admission freshness, the own-beat ref fallback);
+        # `mono` anchors purely-local elapsed windows (cadence gate,
+        # unreadable-beat and suspect confirmation, startup grace), which
+        # an NTP step must never stretch or collapse
+        now = time.time()  # drep-lint: allow[clock-mono] — compared against note mtimes (server clock)
+        mono = time.monotonic()
+        self._last_check = mono
         if os.path.exists(self.verdict_path(self.pid)):
             telemetry.event("fenced", pid=self.pid)
             raise FaultTolError(
@@ -780,18 +796,18 @@ class HeartbeatManager:
                 # has been unreadable for the full miss window AND the
                 # stage is past its startup grace (the stage-open barrier
                 # ordered every peer's first beat before monitoring began)
-                first_bad = self._unreadable.setdefault(p, now)
+                first_bad = self._unreadable.setdefault(p, mono)
                 stale = (
-                    now - first_bad > self.miss_s
-                    and now - self._started_at > self.miss_s
+                    mono - first_bad > self.miss_s
+                    and mono - self._started_mono > self.miss_s
                 )
             if not stale:
                 self._suspect.pop(p, None)
                 continue
             # confirm across a full cadence before the irreversible
             # verdict — a single bad observation must heal, not fence
-            first = self._suspect.setdefault(p, now)
-            if now - first >= max(self.cadence, 0.2):
+            first = self._suspect.setdefault(p, mono)
+            if mono - first >= max(self.cadence, 0.2):
                 newly.append(p)
         if not newly:
             return bumped
@@ -870,6 +886,7 @@ class HeartbeatManager:
             self.drain_path(),
             {
                 "seq": self.seq, "epoch": self.epoch,
+                # drep-lint: allow[clock-mono] — cross-host note timestamp (read by pod_status/forensics)
                 "pairs": int(pairs), "at": time.time(),
             },
         )
@@ -1200,7 +1217,7 @@ def join_elastic_pod(
 
     cfg = config if config is not None else DEFAULT_CONFIG
     t = collective_timeout_s() if timeout_s is None else timeout_s
-    deadline = time.time() + t if t > 0 else None
+    deadline = time.monotonic() + t if t > 0 else None
     os.makedirs(note_dir, exist_ok=True)
     token = uuid.uuid4().hex
     req = join_requested()
@@ -1248,6 +1265,7 @@ def join_elastic_pod(
         _beat(jid)  # beat first: admission requires a live candidate
         atomic_write_json(
             os.path.join(note_dir, f".pod-join.p{jid}"),
+            # drep-lint: allow[clock-mono] — cross-host note timestamp
             {"token": token, "at": time.time()},
         )
         logger.info(
@@ -1255,7 +1273,7 @@ def join_elastic_pod(
             jid, note_dir,
         )
         admit_path = os.path.join(note_dir, f".pod-admit.p{jid}")
-        last_beat = time.time()
+        last_beat = time.monotonic()
         note = None
         while True:
             if os.path.exists(admit_path):
@@ -1294,7 +1312,7 @@ def join_elastic_pod(
                         break
             if note is not None and (validate is None or validate()):
                 break
-            if deadline is not None and time.time() > deadline:
+            if deadline is not None and time.monotonic() > deadline:
                 if note is not None:
                     # ALREADY ADMITTED but the store never validated (an
                     # operator pointed a joiner at the wrong inputs): the
@@ -1308,6 +1326,7 @@ def join_elastic_pod(
                             {
                                 "seq": int(note.get("seq", 0)),
                                 "epoch": int(note.get("epoch", 0)),
+                                # drep-lint: allow[clock-mono] — cross-host note timestamp
                                 "pairs": 0, "at": time.time(),
                             },
                         )
@@ -1352,10 +1371,10 @@ def join_elastic_pod(
                 with contextlib.suppress(OSError):
                     os.remove(os.path.join(note_dir, f".pod-join.p{jid}"))
                 break
-            if cadence > 0 and time.time() - last_beat >= cadence:
+            if cadence > 0 and time.monotonic() - last_beat >= cadence:
                 with contextlib.suppress(OSError):
                     _beat(jid)
-                last_beat = time.time()
+                last_beat = time.monotonic()
             time.sleep(min(0.5, max(0.05, cadence / 2 if cadence > 0 else 0.1)))
         if note is not None:
             break
@@ -1487,7 +1506,7 @@ def wait_elastic(
 
     threading.Thread(target=work, daemon=True, name=f"drep-elastic-{site}").start()
     epoch0 = hb.epoch
-    deadline = time.time() + timeout_s if timeout_s > 0 else None
+    deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
     poll = min(1.0, max(0.05, hb.cadence if hb.cadence > 0 else 0.25))
     held: BaseException | None = None
     while True:
@@ -1505,7 +1524,7 @@ def wait_elastic(
         hb.check()
         if hb.epoch != epoch0:
             return False, None
-        if deadline is not None and time.time() > deadline:
+        if deadline is not None and time.monotonic() > deadline:
             counters.add_fault("watchdog_trips")
             if held is not None:
                 raise CollectiveTimeout(

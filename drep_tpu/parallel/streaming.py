@@ -999,7 +999,7 @@ def _elastic_stripe_loop(
 
     stall_budget = collective_timeout_s(DEFAULT_ALLGATHER_TIMEOUT_S)
     done_written = False
-    last_progress = time.time()
+    last_progress = time.monotonic()
     progress_sig = None
     # stripes this process computed THIS call stay in memory (assembly
     # reads only peers'/resumed shards from the shared store — bit-equal
@@ -1078,12 +1078,12 @@ def _elastic_stripe_loop(
         sig = (len(missing), tuple(hb.live), len(waiting))
         if computed or sig != progress_sig:
             progress_sig = sig
-            last_progress = time.time()
+            last_progress = time.monotonic()
         if not missing and not waiting:
             break
         if hb.maybe_check():  # cadence-gated: detection latency is the
             continue  # miss window anyway; deaths re-deal with no sleep
-        if time.time() - last_progress > stall_budget:
+        if time.monotonic() - last_progress > stall_budget:
             raise CollectiveTimeout(
                 f"streaming elastic completion stalled for {stall_budget:.0f}s: "
                 f"stripe(s) {missing[:8]}{'...' if len(missing) > 8 else ''} "

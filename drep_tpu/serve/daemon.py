@@ -132,6 +132,7 @@ class IndexServer:
         if self.cfg.socket_path:
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             with contextlib.suppress(OSError):
+                # drep-lint: allow[reader-purity] — the daemon's own unix-socket node (runtime scratch, --socket forbids paths inside the index)
                 os.unlink(self.cfg.socket_path)
             sock.bind(self.cfg.socket_path)
         else:
@@ -199,6 +200,7 @@ class IndexServer:
                 self._listener.close()
         if self.cfg.socket_path:
             with contextlib.suppress(OSError):
+                # drep-lint: allow[reader-purity] — removes the daemon's own unix-socket node on shutdown, never index state
                 os.unlink(self.cfg.socket_path)
         telemetry.event("serve_stop", requests=self.stats.requests_total)
 

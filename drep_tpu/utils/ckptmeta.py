@@ -259,7 +259,7 @@ def _file_barrier(
     atomic_write_bytes(_barrier_note(note_dir, tag, pid), str(seq).encode())
     fresh_after = pod_t0() - 60.0
     timeout = collective_timeout_s()
-    deadline = time.time() + timeout if timeout > 0 else None
+    deadline = time.monotonic() + timeout if timeout > 0 else None
     seen: set[int] = set()
     while True:
         # joiners (ids >= the original process count) are STAGE-SCOPED
@@ -292,7 +292,7 @@ def _file_barrier(
             # poll waits on the shrunken live set. Raises past the death
             # budget, or when a verdict fences THIS process.
             hb.maybe_check()
-        if deadline is not None and time.time() > deadline:
+        if deadline is not None and time.monotonic() > deadline:
             raise CollectiveTimeout(
                 f"checkpoint file barrier {tag!r}: process(es) {missing} of "
                 f"awaited set {waiting_on} never arrived within {timeout:.0f}s "

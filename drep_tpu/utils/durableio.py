@@ -68,10 +68,14 @@ from typing import Any, Callable
 
 import numpy as np
 
+from drep_tpu.utils import envknobs
+
 IO_RETRIES_ENV = "DREP_TPU_IO_RETRIES"
-DEFAULT_IO_RETRIES = 3
 IO_BACKOFF_ENV = "DREP_TPU_IO_BACKOFF_S"
-DEFAULT_IO_BACKOFF_S = 0.05
+# single source: the envknobs registry owns the defaults; the names stay
+# for importers (docs, tests) that quote them
+DEFAULT_IO_RETRIES = int(envknobs.knob(IO_RETRIES_ENV).default)
+DEFAULT_IO_BACKOFF_S = float(envknobs.knob(IO_BACKOFF_ENV).default)
 FSYNC_ENV = "DREP_TPU_FSYNC"
 CRC_ENV = "DREP_TPU_IO_CRC"
 
@@ -102,21 +106,21 @@ def configure(retries: int | None = None, fsync: bool | None = None) -> None:
 def io_retries() -> int:
     if _CONFIG["retries"] is not None:
         return max(0, int(_CONFIG["retries"]))
-    return max(0, int(os.environ.get(IO_RETRIES_ENV, DEFAULT_IO_RETRIES)))
+    return max(0, envknobs.env_int(IO_RETRIES_ENV))
 
 
 def io_backoff_s() -> float:
-    return float(os.environ.get(IO_BACKOFF_ENV, DEFAULT_IO_BACKOFF_S))
+    return envknobs.env_float(IO_BACKOFF_ENV)
 
 
 def fsync_enabled() -> bool:
     if _CONFIG["fsync"] is not None:
         return bool(_CONFIG["fsync"])
-    return os.environ.get(FSYNC_ENV, "") not in ("", "0", "false")
+    return envknobs.env_bool(FSYNC_ENV)
 
 
 def crc_enabled() -> bool:
-    return os.environ.get(CRC_ENV, "") not in ("0", "false")
+    return envknobs.env_bool(CRC_ENV)
 
 
 class StoreFullError(OSError):

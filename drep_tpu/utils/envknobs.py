@@ -1,0 +1,272 @@
+"""Central registry of every ``DREP_TPU_*`` environment knob.
+
+Nineteen-odd knobs grew organically across PRs 2-11, each read at its
+call site with bespoke parsing (``== "0"``, ``not in ("", "0",
+"false")``, bare truthiness) — a typo'd export (``DREP_TPU_HEARBEAT_S``)
+silently configured nothing, and nothing said which knobs even existed.
+This module is the single source of truth: every knob is declared ONCE
+(name, type, default, one-line doc) and read through a typed accessor
+(:func:`env_str` / :func:`env_int` / :func:`env_float` /
+:func:`env_bool`). The static-analysis suite (tools/lint, rule
+``env-knob``) enforces the funnel both ways: a ``DREP_TPU_*`` string
+literal anywhere in the tree that is not declared here is a violation
+(dead/typo'd knob), and a direct ``os.environ`` read of one outside this
+module is a violation (bespoke-parse drift).
+
+Accessor semantics, pinned by tests/test_lint.py:
+
+- unset        -> the declared default (which may be ``None`` for str).
+- empty/blank  -> the declared default (int/float/bool; ``env_str``
+  returns the raw value so spec-string knobs keep "" == unset).
+- bool strings -> ``1/true/on/yes`` are True, ``0/false/off/no`` are
+  False (case/whitespace-insensitive); anything else raises ``ValueError``
+  naming the knob — a typo must never silently flip a safety default
+  (the old inline parsers mapped garbage to true OR false depending on
+  the site).
+- int/float    -> parsed with ``int()``/``float()``; a malformed value
+  raises ``ValueError`` naming the knob (same failure the old inline
+  ``int(os.environ.get(...))`` reads produced, now with context).
+
+Per-call default overrides (``env_float(name, default=...)``) exist for
+knobs whose effective default is context-dependent (the collective
+timeout: 900 s at a stage-open barrier, 6 h at the allgather).
+
+This module must stay stdlib-only and importable with no JAX backend —
+durableio, the scrubber, and host-side tools all read knobs.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = [
+    "Knob", "KNOBS", "env_str", "env_int", "env_float", "env_bool",
+    "knob", "describe",
+]
+
+
+@dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str  # "str" | "int" | "float" | "bool"
+    default: object
+    doc: str
+    test_only: bool = False  # read only by the test harness, never by the pipeline
+
+
+KNOBS: dict[str, Knob] = {}
+
+
+def _declare(
+    name: str, kind: str, default, doc: str, test_only: bool = False
+) -> None:
+    if name in KNOBS:
+        raise ValueError(f"duplicate env-knob declaration: {name}")
+    KNOBS[name] = Knob(name, kind, default, doc, test_only)
+
+
+# -- fault injection / chaos -------------------------------------------------
+_declare(
+    "DREP_TPU_FAULTS", "str", "",
+    "Deterministic fault-injection spec, `site:mode[:prob][:k=v]` comma-list "
+    "(utils/faults.py). Empty = zero-overhead off.",
+)
+# -- elastic pod protocol ----------------------------------------------------
+_declare(
+    "DREP_TPU_HEARTBEAT_S", "float", 5.0,
+    "Per-process heartbeat cadence (s) for the elastic-pod protocol; 0 "
+    "disables heartbeats and epoch-coordinated re-dealing entirely.",
+)
+_declare(
+    "DREP_TPU_COLLECTIVE_TIMEOUT_S", "float", 900.0,
+    "Watchdog for multi-host collective waits (s); call sites override the "
+    "default where healthy skew differs (6 h at the allgather). <=0 disables.",
+)
+_declare(
+    "DREP_TPU_POD_JOIN", "str", "",
+    "Mid-run join request on a NEW process: 'auto' derives an id from the "
+    "pod's notes, an integer pins one. Empty = not a joiner.",
+)
+# -- dense ring --------------------------------------------------------------
+_declare(
+    "DREP_TPU_RING_COMM", "str", "",
+    "Ring comm backend: auto|ppermute|pallas_dma|pallas_interpret "
+    "(parallel/allpairs.resolve_ring_comm). Empty = auto.",
+)
+_declare(
+    "DREP_TPU_RING_MONOLITHIC", "bool", False,
+    "Run the dense ring as the single fori_loop program (the pre-PR-4 "
+    "reference) instead of host-stepped redoable units.",
+)
+_declare(
+    "DREP_TPU_PALLAS_RING", "bool", True,
+    "Set 0 to pin the fused Pallas DMA ring off (auto-gate reference "
+    "fallback is ppermute).",
+)
+# -- single-chip kernels -----------------------------------------------------
+_declare(
+    "DREP_TPU_PALLAS_INDICATOR", "bool", True,
+    "Set 0 to pin the Pallas indicator kernel off (ops/pallas_indicator.py).",
+)
+_declare(
+    "DREP_TPU_INDICATOR_DTYPE", "str", None,
+    "Force the indicator matmul accumulator dtype (ops/containment.py); "
+    "unset = heuristic choice.",
+)
+_declare(
+    "DREP_TPU_MASH_ROWS_PER_ITER", "int", 1,
+    "Rows per grid iteration for the Pallas mash kernel "
+    "(ops/pallas_mash.py); bench sweeps it.",
+)
+_declare(
+    "DREP_TPU_GREEDY_MATMUL", "bool", False,
+    "Set 1 to force the greedy secondary onto the MXU matmul path "
+    "(cluster/greedy.py).",
+)
+_declare(
+    "DREP_TPU_NO_NATIVE", "bool", False,
+    "Set 1 to disable the native (g++) ingest extension and use the pure-"
+    "python fallback (native/__init__.py).",
+)
+# -- durable I/O -------------------------------------------------------------
+_declare(
+    "DREP_TPU_IO_RETRIES", "int", 3,
+    "Transient-I/O retry budget (EIO/ESTALE/ETIMEDOUT) per durable op "
+    "(utils/durableio.py); the CLI --io_retries overrides.",
+)
+_declare(
+    "DREP_TPU_IO_BACKOFF_S", "float", 0.05,
+    "First retry backoff (s); doubles per attempt.",
+)
+_declare(
+    "DREP_TPU_FSYNC", "bool", False,
+    "Set 1 to fsync tmp file + directory around every atomic publish "
+    "(power-loss durability); the CLI --fsync overrides.",
+)
+_declare(
+    "DREP_TPU_IO_CRC", "bool", True,
+    "Set 0 to disable in-band checksum embed+verify on npz payloads and "
+    "JSON notes (perf-guard baseline / escape hatch).",
+)
+# -- observability -----------------------------------------------------------
+_declare(
+    "DREP_TPU_EVENTS", "bool", False,
+    "Set 1/on to enable structured event tracing (utils/telemetry.py); "
+    "zero overhead off.",
+)
+_declare(
+    "DREP_TPU_METRICS_FLUSH_S", "float", 0.0,
+    "Prometheus textfile flush cadence (s) for <wd>/log/metrics.prom; "
+    "0 = off.",
+)
+# -- ingest ------------------------------------------------------------------
+_declare(
+    "DREP_TPU_INGEST_BARRIER_S", "float", 600.0,
+    "Multi-host ingest assembly: max wait (s) with no new sketch shard "
+    "appearing before declaring a peer dead.",
+)
+# -- test harness only -------------------------------------------------------
+_declare(
+    "DREP_TPU_TEST_MAX_JOINS", "int", 0,
+    "Chaos-test worker: --max_joins for the in-worker controller.",
+    test_only=True,
+)
+_declare(
+    "DREP_TPU_TEST_MAX_DEAD", "int", 1,
+    "Chaos-test worker: --max_dead_processes for the in-worker controller.",
+    test_only=True,
+)
+_declare(
+    "DREP_TPU_TEST_WAIT_JOIN", "str", "",
+    "Chaos-test worker: block at a gate until a join-request note exists "
+    "(deterministic admission ordering).",
+    test_only=True,
+)
+_declare(
+    "DREP_TPU_TEST_JOIN_AFTER_DRAIN", "str", "",
+    "Chaos-test joiner: hold the join request until a departure note "
+    "exists (drain-then-join churn cell).",
+    test_only=True,
+)
+
+
+def knob(name: str) -> Knob:
+    try:
+        return KNOBS[name]
+    except KeyError:
+        raise KeyError(
+            f"undeclared env knob {name!r} — declare it in "
+            f"drep_tpu/utils/envknobs.py (the registry tools/lint enforces)"
+        ) from None
+
+
+def _raw(name: str) -> str | None:
+    knob(name)  # undeclared reads must fail loudly even at runtime
+    return os.environ.get(name)
+
+
+def env_str(name: str, default: str | None = None):
+    """String knob. Unset -> declared default (per-call `default` wins
+    when given). A SET-but-empty value is returned as-is: spec-string
+    knobs (DREP_TPU_FAULTS, DREP_TPU_POD_JOIN) treat "" as off."""
+    raw = _raw(name)
+    if raw is None:
+        return default if default is not None else KNOBS[name].default
+    return raw
+
+
+def env_int(name: str, default: int | None = None) -> int:
+    raw = _raw(name)
+    if raw is None or not raw.strip():
+        return int(default if default is not None else KNOBS[name].default)
+    try:
+        return int(raw.strip())
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected an integer") from None
+
+
+def env_float(name: str, default: float | None = None) -> float:
+    raw = _raw(name)
+    if raw is None or not raw.strip():
+        return float(default if default is not None else KNOBS[name].default)
+    try:
+        return float(raw.strip())
+    except ValueError:
+        raise ValueError(f"{name}={raw!r}: expected a number") from None
+
+
+_TRUE = frozenset({"1", "true", "on", "yes"})
+_FALSE = frozenset({"0", "false", "off", "no"})
+
+
+def env_bool(name: str, default: bool | None = None) -> bool:
+    raw = _raw(name)
+    fallback = bool(default if default is not None else KNOBS[name].default)
+    if raw is None or not raw.strip():
+        return fallback
+    v = raw.strip().lower()
+    if v in _TRUE:
+        return True
+    if v in _FALSE:
+        return False
+    # loud, like env_int/env_float: silently mapping `FSYNC=enable` or a
+    # typo'd `ture` to the default would downgrade a safety knob with no
+    # trace (the old inline parsers did exactly that, inconsistently)
+    raise ValueError(
+        f"{name}={raw!r}: expected one of "
+        f"{sorted(_TRUE)} / {sorted(_FALSE)}"
+    )
+
+
+def describe() -> str:
+    """Human-readable registry dump (`python -m tools.lint --knobs`)."""
+    width = max(len(k) for k in KNOBS)
+    lines = []
+    for k in sorted(KNOBS.values(), key=lambda k: (k.test_only, k.name)):
+        tag = " [test-only]" if k.test_only else ""
+        lines.append(
+            f"{k.name:<{width}}  {k.kind:<5} default={k.default!r}{tag}\n"
+            f"{'':<{width}}  {k.doc}"
+        )
+    return "\n".join(lines)

@@ -244,7 +244,9 @@ def reset() -> None:
 def _rules() -> dict[str, list[_Rule]]:
     global _RULES
     if _RULES is None:
-        _RULES = _parse(os.environ.get(ENV, ""))
+        from drep_tpu.utils import envknobs
+
+        _RULES = _parse(envknobs.env_str(ENV))
     return _RULES
 
 

@@ -193,6 +193,7 @@ class Counters:
         ``pod_epoch`` gauge so a dashboard scraping only gauges still
         sees the membership generation."""
         self.epoch_history.append(
+            # drep-lint: allow[clock-mono] — cross-host timeline timestamp (trace_report cross-checks it)
             {"epoch": int(epoch), "reason": str(reason), "at": round(time.time(), 3)}
         )
         self.set_gauge("pod_epoch", float(epoch))
@@ -302,8 +303,10 @@ _METRICS: dict[str, Any] = {"stop": None, "thread": None, "log_dir": None}
 
 
 def metrics_flush_cadence_s() -> float:
+    from drep_tpu.utils import envknobs
+
     try:
-        return float(os.environ.get(METRICS_FLUSH_ENV, "0") or 0)
+        return envknobs.env_float(METRICS_FLUSH_ENV)
     except ValueError:
         return 0.0
 
@@ -359,6 +362,7 @@ def prom_text(c: Counters | None = None) -> str:
         "# TYPE drep_tpu_epoch_bumps_total counter",
         f"drep_tpu_epoch_bumps_total {len(c.epoch_history)}",
         "# TYPE drep_tpu_metrics_flush_timestamp_seconds gauge",
+        # drep-lint: allow[clock-mono] — Prometheus convention: epoch-seconds gauge
         f"drep_tpu_metrics_flush_timestamp_seconds {round(time.time(), 3)}",
     ]
     return "\n".join(lines) + "\n"

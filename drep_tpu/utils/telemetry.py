@@ -56,12 +56,14 @@ import time
 import uuid
 from typing import Any
 
+from drep_tpu.utils import envknobs
+
 EVENTS_ENV = "DREP_TPU_EVENTS"
 RUN_ID_NAME = "events.runid"
 
 
 def env_enabled() -> bool:
-    return os.environ.get(EVENTS_ENV, "").strip().lower() in ("1", "on", "true")
+    return envknobs.env_bool(EVENTS_ENV)
 
 
 def resolve_enabled(flag: str | bool | None) -> bool:
@@ -219,6 +221,7 @@ def _emit(ev: str, ph: str, args: dict | None) -> None:
         "ev": ev,
         "ph": ph,
         "mono": round(time.monotonic(), 6),
+        # drep-lint: allow[clock-mono] — the event schema's wall key: trace_report aligns members by it
         "wall": round(time.time(), 6),
     }
     if args:

@@ -331,8 +331,10 @@ def test_torn_shard_write_is_recomputed_on_resume(tmp_path):
 
 def test_fault_spec_parsing_and_env_activation(monkeypatch):
     with pytest.raises(faults.FaultSpecError):
+        # drep-lint: allow[fault-site] — negative test: asserts the registry rejects unknown sites
         faults.configure("not_a_site:raise")
     with pytest.raises(faults.FaultSpecError):
+        # drep-lint: allow[fault-site] — negative test: asserts the registry rejects unknown modes
         faults.configure("streaming_tile:not_a_mode")
     with pytest.raises(faults.FaultSpecError):
         faults.configure("streaming_tile:raise:0.5:bogus=1")
@@ -1213,6 +1215,7 @@ def test_io_fault_spec_fields_and_path_targeting():
     faults.fire_io("write", path="/x")  # other process: no-op
     assert counters.faults.get("injected_io_io_error", 0) == 0
     with pytest.raises(faults.FaultSpecError):
+        # drep-lint: allow[fault-site] — negative test: asserts the io site rejects unknown modes
         faults.configure("io:not_a_mode")
     with pytest.raises(faults.FaultSpecError):
         faults.configure("io:corrupt:1.0:bogus=1")

@@ -314,6 +314,7 @@ def _scrub_then_resume():
         shard = sorted(f for f in os.listdir(ckpt) if f.startswith("row_"))[1]
         loc = os.path.join(ckpt, shard)
         data = open(loc, "rb").read()
+        # drep-lint: allow[durable-funnel] — deliberate chaos: plants the torn shard the scrubber cell must detect
         with open(loc, "wb") as f:
             f.write(data[: len(data) // 2])
         assert ss.scrub([ckpt])["damaged"], "scrub missed a truncated shard"

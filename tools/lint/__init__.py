@@ -1,0 +1,40 @@
+"""drep-lint: contract-enforcing static analysis for the pinned invariants.
+
+Run it:
+
+    python -m tools.lint                    # whole tree, text report
+    python -m tools.lint --format json      # machine-readable findings
+    python -m tools.lint --explain clock-mono
+    python -m tools.lint --rules durable-funnel,env-knob
+    python -m tools.lint --write-baseline   # ratchet reset (explicit)
+
+The six rules pin conventions PRs 2-11 built but nothing enforced:
+
+=================  ========================================================
+rule id            contract (see --explain <id> for the full rationale)
+=================  ========================================================
+durable-funnel     shared-FS payload writes go through utils/durableio.py
+reader-purity      classify/serve/pod_status/trace_report/scrub never
+                   reach a write (intra-repo call-graph walk)
+env-knob           every DREP_TPU_* knob declared in utils/envknobs.py and
+                   read through its typed accessors
+clock-mono         local elapsed/deadline math uses time.monotonic();
+                   wall clock is waived cross-host-only
+fault-site         fault sites/modes exist in the utils/faults.py registry
+                   and every site has chaos-test coverage
+telemetry-gate     event emission only via the gated telemetry API; no
+                   ad-hoc writes into the <wd>/log/ sink
+=================  ========================================================
+
+Violations are suppressed by an inline waiver WITH a written reason —
+
+    do_thing()  # drep-lint: allow[rule-id] — why this site is exempt
+
+(same line, or a comment-only line directly above) — or by the
+checked-in ``tools/lint/baseline.json`` ratchet (ships empty; exists so
+a future rule-tightening can land green and burn down). Everything else
+exits 1. tests/test_lint.py runs the full suite against the live tree
+as a tier-1 gate, and fires every rule against planted fixtures.
+"""
+
+from .engine import Finding, Result, Rule, all_rules, run  # noqa: F401

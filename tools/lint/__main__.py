@@ -1,0 +1,102 @@
+"""CLI for drep-lint (`python -m tools.lint`). Exit codes: 0 clean
+(modulo waivers/baseline), 1 violations or parse errors, 2 usage."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import engine
+
+
+def _default_root() -> str:
+    # tools/lint/__main__.py -> repo root two levels up
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="contract-enforcing static analysis for drep-tpu",
+    )
+    ap.add_argument("--root", default=_default_root(), help="repo root to scan")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--baseline", default=engine.BASELINE_DEFAULT,
+        help="baseline file (known findings to tolerate); '' disables",
+    )
+    ap.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from current findings (ratchet reset)",
+    )
+    ap.add_argument(
+        "--explain", metavar="RULE_ID", default=None,
+        help="print a rule's contract rationale and exit",
+    )
+    ap.add_argument(
+        "--knobs", action="store_true",
+        help="print the env-knob registry (drep_tpu/utils/envknobs.py) and exit",
+    )
+    ap.add_argument(
+        "-v", "--verbose", action="store_true",
+        help="also list waived findings with their reasons",
+    )
+    args = ap.parse_args(argv)
+
+    if args.explain is not None:
+        for rule in engine.all_rules():
+            if rule.id == args.explain:
+                print(f"[{rule.id}] {rule.title}\n")
+                print(rule.explain)
+                return 0
+        known = ", ".join(r.id for r in engine.all_rules())
+        print(f"unknown rule {args.explain!r}; known: {known}", file=sys.stderr)
+        return 2
+
+    if args.knobs:
+        sys.path.insert(0, args.root)
+        from drep_tpu.utils import envknobs
+
+        print(envknobs.describe())
+        return 0
+
+    rule_ids = (
+        [r.strip() for r in args.rules.split(",") if r.strip()]
+        if args.rules else None
+    )
+    if args.write_baseline and rule_ids:
+        print(
+            "--write-baseline rewrites the file WHOLE and needs every "
+            "rule's findings — drop --rules",
+            file=sys.stderr,
+        )
+        return 2
+    try:
+        result, model = engine.run(
+            args.root, rule_ids=rule_ids,
+            baseline_path=args.baseline or None,
+        )
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        engine.write_baseline(args.baseline or engine.BASELINE_DEFAULT, result, model)
+        n = len(result.findings) + len(result.baselined)
+        print(f"baseline rewritten with {n} entr{'y' if n == 1 else 'ies'}")
+        return 0
+
+    if args.format == "json":
+        print(engine.format_json(result))
+    else:
+        print(engine.format_text(result, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,260 @@
+"""drep-lint engine: run contract rules, apply waivers + baseline.
+
+Verdict pipeline for every raw finding a rule emits:
+
+1. **Waiver** — an inline ``# drep-lint: allow[rule-id] — reason`` on the
+   finding's line (or a comment-only line directly above) suppresses it.
+   A waiver with NO reason does not suppress (the written reason is the
+   contract: future readers must know WHY wall-clock/a write is okay
+   here) — the finding surfaces along with a note naming the reasonless
+   waiver.
+2. **Baseline** — a checked-in ``tools/lint/baseline.json`` of
+   fingerprints (rule + file + normalized source line) suppresses known
+   pre-existing findings so the gate lands green and ratchets DOWN:
+   new code cannot add violations, stale entries are reported for
+   removal. The shipped baseline is EMPTY — every live finding was fixed
+   or waived with a reason in this PR; the mechanism exists for the day
+   a rule tightens.
+3. Anything left is **active** -> exit 1.
+
+Fingerprints deliberately exclude line numbers (drift-proof against
+unrelated edits) and include the normalized source line plus an
+occurrence index (two identical lines in one file stay distinct).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from . import model as model_mod
+from .model import RepoModel
+
+BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+    hint: str = ""
+    waived: bool = False
+    waive_reason: str = ""
+    baselined: bool = False
+
+    def source_line(self, model: RepoModel) -> str:
+        sf = model.files.get(self.path)
+        if sf and 1 <= self.line <= len(sf.lines):
+            return sf.lines[self.line - 1].strip()
+        return ""
+
+    def to_dict(self) -> dict:
+        d = {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "message": self.message,
+        }
+        if self.hint:
+            d["hint"] = self.hint
+        if self.waived:
+            d["waived"] = True
+            d["waive_reason"] = self.waive_reason
+        if self.baselined:
+            d["baselined"] = True
+        return d
+
+
+@dataclass
+class Rule:
+    id: str
+    title: str
+    run: object  # Callable[[RepoModel], list[Finding]]
+    explain: str  # rationale + pointer to the PR that pinned the contract
+
+
+@dataclass
+class Result:
+    findings: list[Finding] = field(default_factory=list)  # active (gate fails)
+    waived: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    reasonless_waivers: list = field(default_factory=list)  # Waiver
+    stale_baseline: list[str] = field(default_factory=list)
+    unknown_waiver_rules: list = field(default_factory=list)  # (Waiver, bad id)
+    parse_errors: list[tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+
+def all_rules() -> list[Rule]:
+    from . import (
+        rules_clock, rules_durable, rules_env, rules_faults,
+        rules_readonly, rules_telemetry,
+    )
+
+    rules: list[Rule] = []
+    for mod in (
+        rules_durable, rules_readonly, rules_env, rules_clock,
+        rules_faults, rules_telemetry,
+    ):
+        rules.extend(mod.RULES)
+    ids = [r.id for r in rules]
+    assert len(ids) == len(set(ids)), f"duplicate rule ids: {ids}"
+    return rules
+
+
+def _fingerprint(f: Finding, model: RepoModel, occurrence: int) -> str:
+    return f"{f.rule}|{f.path}|{f.source_line(model)}|{occurrence}"
+
+
+def _load_baseline(path: str) -> set[str]:
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    return set(doc.get("entries", []))
+
+
+def write_baseline(path: str, result: Result, model: RepoModel) -> None:
+    """Regenerate the baseline from CURRENT active+baselined findings —
+    the explicit ratchet-reset escape hatch (``--write-baseline``).
+    Callers must have run ALL rules: the file is rewritten whole, so a
+    subset run would silently drop every other rule's entries (the CLI
+    refuses the --rules + --write-baseline combination)."""
+    entries: list[str] = []
+    seen: dict[tuple[str, str, str], int] = {}
+    for f in result.findings + result.baselined:
+        key = (f.rule, f.path, f.source_line(model))
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        entries.append(_fingerprint(f, model, occ))
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": sorted(entries)}, fh, indent=1)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+def run(
+    root: str,
+    rules: list[Rule] | None = None,
+    rule_ids: list[str] | None = None,
+    baseline_path: str | None = BASELINE_DEFAULT,
+    model: RepoModel | None = None,
+) -> tuple[Result, RepoModel]:
+    if model is None:
+        model = RepoModel(root)
+    if rules is None:
+        rules = all_rules()
+    if rule_ids:
+        known = {r.id for r in rules}
+        bad = [r for r in rule_ids if r not in known]
+        if bad:
+            raise ValueError(f"unknown rule id(s) {bad}; known: {sorted(known)}")
+        rules = [r for r in rules if r.id in rule_ids]
+    known_ids = {r.id for r in all_rules()}
+
+    result = Result(parse_errors=list(model.errors))
+    raw: list[Finding] = []
+    for rule in rules:
+        raw.extend(rule.run(model))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    baseline = _load_baseline(baseline_path) if baseline_path else set()
+    matched_baseline: set[str] = set()
+    occ_count: dict[tuple[str, str, str], int] = {}
+    for f in raw:
+        sf = model.files.get(f.path)
+        w = sf.waiver_for(f.rule, f.line) if sf is not None else None
+        if w is not None and w.reason:
+            w.used = True
+            f.waived, f.waive_reason = True, w.reason
+            result.waived.append(f)
+            continue
+        if w is not None and not w.reason:
+            w.used = True
+            result.reasonless_waivers.append(w)
+        key = (f.rule, f.path, f.source_line(model))
+        occ = occ_count.get(key, 0)
+        occ_count[key] = occ + 1
+        fp = _fingerprint(f, model, occ)
+        if fp in baseline:
+            matched_baseline.add(fp)
+            f.baselined = True
+            result.baselined.append(f)
+            continue
+        result.findings.append(f)
+    # stale = unmatched entries OF THE RULES THAT RAN: under --rules a
+    # skipped rule's entries are simply not judged (they are neither
+    # matched nor stale — only a full run can declare them fixed)
+    ran = {r.id for r in rules}
+    result.stale_baseline = sorted(
+        fp for fp in baseline - matched_baseline
+        if fp.split("|", 1)[0] in ran
+    )
+
+    # waiver hygiene: unknown rule ids in allow[...] are typos that would
+    # silently waive nothing forever
+    for sf in model.files.values():
+        for ws in sf.waivers.values():
+            for w in ws:
+                for rid in w.rules:
+                    if rid not in known_ids:
+                        result.unknown_waiver_rules.append((w, rid))
+    return result, model
+
+
+# -- output -----------------------------------------------------------------
+
+
+def format_text(result: Result, verbose: bool = False) -> str:
+    out: list[str] = []
+    for path, err in result.parse_errors:
+        out.append(f"PARSE ERROR {path}: {err}")
+    for f in result.findings:
+        out.append(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        if f.hint:
+            out.append(f"    hint: {f.hint}")
+    for w, rid in result.unknown_waiver_rules:
+        out.append(
+            f"{w.path}:{w.line}: WARNING waiver names unknown rule {rid!r}"
+        )
+    for w in result.reasonless_waivers:
+        out.append(
+            f"{w.path}:{w.line}: WARNING waiver without a reason is inert — "
+            f"append `— <why>`"
+        )
+    for fp in result.stale_baseline:
+        out.append(f"baseline: STALE entry (fixed? ratchet it out): {fp}")
+    if verbose:
+        for f in result.waived:
+            out.append(
+                f"{f.path}:{f.line}: waived [{f.rule}] {f.message} "
+                f"({f.waive_reason})"
+            )
+    n_active = len(result.findings)
+    out.append(
+        f"drep-lint: {n_active} violation(s), {len(result.waived)} waived, "
+        f"{len(result.baselined)} baselined"
+        + (", CLEAN" if result.ok else "")
+    )
+    return "\n".join(out)
+
+
+def format_json(result: Result) -> str:
+    return json.dumps(
+        {
+            "ok": result.ok,
+            "findings": [f.to_dict() for f in result.findings],
+            "waived": [f.to_dict() for f in result.waived],
+            "baselined": [f.to_dict() for f in result.baselined],
+            "stale_baseline": result.stale_baseline,
+            "parse_errors": [
+                {"path": p, "error": e} for p, e in result.parse_errors
+            ],
+        },
+        indent=1, sort_keys=True,
+    )

@@ -1,0 +1,63 @@
+"""Rule ``clock-mono``: local elapsed-time math uses time.monotonic().
+
+``time.time()`` is reserved for CROSS-HOST comparisons (note timestamps
+judged against file mtimes by the staleness protocol, trace alignment,
+Prometheus convention) — every such site carries a waiver saying so.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, Rule
+from .model import RepoModel
+
+RULE_ID = "clock-mono"
+
+EXPLAIN = """\
+An NTP step (or a VM migration's clock jump) stretches or collapses any
+window computed from time.time() deltas: a heartbeat cadence gate that
+stops firing, a collective-timeout deadline that trips instantly and
+fences a healthy pod member, a stall budget that never expires. PR 12
+converted every purely-LOCAL elapsed/deadline computation (heartbeat
+cadence + suspect confirmation, join/barrier/collective deadlines,
+streaming + ring stall trackers) to time.monotonic().
+
+time.time() remains CORRECT — and waived, with the reason written at
+the site — where the value crosses hosts: note "at" timestamps and
+pod_t0, which the staleness protocol compares against file MTIMES
+stamped by the shared filesystem's server clock (server-clock-to-
+server-clock by design, PR 3); the telemetry event schema's wall key
+(trace_report aligns members by it, PR 10); Prometheus epoch-seconds.
+
+Fix: time.monotonic() for elapsed/deadline math; keep wall + waive with
+the cross-host reason otherwise.
+"""
+
+
+def run(model: RepoModel) -> list[Finding]:
+    out: list[Finding] = []
+    for sf in model.prod_files():
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "time"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "time"
+            ):
+                out.append(Finding(
+                    rule=RULE_ID, path=sf.path, line=node.lineno,
+                    message="time.time() — wall clock in code that is "
+                            "usually elapsed-time math",
+                    hint="use time.monotonic() for local elapsed/deadline "
+                         "math; waive with the cross-host reason if this "
+                         "value is compared against another host's clock "
+                         "or file mtimes",
+                ))
+    return out
+
+
+RULES = [Rule(id=RULE_ID, title="clock discipline", run=run, explain=EXPLAIN)]

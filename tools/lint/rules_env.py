@@ -1,0 +1,155 @@
+"""Rule ``env-knob``: every DREP_TPU_* knob is declared and read
+through drep_tpu/utils/envknobs.py."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding, Rule
+from .model import RepoModel, iter_calls
+
+RULE_ID = "env-knob"
+ENVKNOBS_PATH = "drep_tpu/utils/envknobs.py"
+KNOB_RE = re.compile(r"^DREP_TPU_[A-Z0-9_]+$")
+KNOB_IN_TEXT_RE = re.compile(r"DREP_TPU_[A-Z0-9_]+")
+
+EXPLAIN = """\
+Nineteen env knobs accumulated over PRs 2-11, each parsed inline at its
+read site. Two failure modes: a typo'd knob name (in an export, a test,
+or a new read site) silently configures NOTHING, and bespoke parsing
+drifts ("0" disables here, any-non-empty enables there). PR 12 made
+drep_tpu/utils/envknobs.py the registry: one declaration per knob
+(name, type, default, doc) and typed accessors (env_str/env_int/
+env_float/env_bool). This rule closes the loop both ways: any
+DREP_TPU_* string literal not declared in the registry is a violation
+(catches typos and dead knobs anywhere, tests included), and any direct
+os.environ read of one outside envknobs.py is a violation (catches
+parse drift). Setting env vars (os.environ[...] = ..., child-process
+env dicts) is not a read and stays legal.
+
+Fix: declare the knob in envknobs.KNOBS via _declare(...), then read it
+with the matching typed accessor.
+"""
+
+
+def _declared_knobs(model: RepoModel) -> set[str]:
+    """Statically extract `_declare("NAME", ...)` calls — the linter must
+    not import the tree it lints."""
+    sf = model.files.get(ENVKNOBS_PATH)
+    if sf is None:
+        return set()
+    out: set[str] = set()
+    for call in iter_calls(sf.tree):
+        fn = call.func
+        name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", None)
+        if name != "_declare" or not call.args:
+            continue
+        first = call.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.add(first.value)
+    return out
+
+
+def _is_os_environ(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "environ"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "os"
+    )
+
+
+def _const_str(node, sf) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return sf.str_constants.get(node.id)
+    return None
+
+
+def _env_read_key(call: ast.Call, sf) -> str | None:
+    """The key of an `os.environ.get(...)` / `os.getenv(...)` read, when
+    it is a literal or a module-level string constant."""
+    fn = call.func
+    is_get = (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "get"
+        and _is_os_environ(fn.value)
+    )
+    is_getenv = (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "getenv"
+        and isinstance(fn.value, ast.Name)
+        and fn.value.id == "os"
+    )
+    if not (is_get or is_getenv) or not call.args:
+        return None
+    return _const_str(call.args[0], sf)
+
+
+def run(model: RepoModel) -> list[Finding]:
+    declared = _declared_knobs(model)
+    out: list[Finding] = []
+    if not declared:
+        out.append(Finding(
+            rule=RULE_ID, path=ENVKNOBS_PATH, line=1,
+            message="no knob declarations found — is the registry intact?",
+        ))
+        return out
+
+    for sf in model.files.values():
+        if sf.path == ENVKNOBS_PATH:
+            continue
+        # (a) undeclared literals, everywhere (tests included): a name
+        # nothing reads is dead weight; a misspelt one is a silent no-op
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Constant) and isinstance(node.value, str)
+            ):
+                continue
+            for name in KNOB_IN_TEXT_RE.findall(node.value):
+                if name not in declared:
+                    out.append(Finding(
+                        rule=RULE_ID, path=sf.path, line=node.lineno,
+                        message=f"undeclared env knob {name!r}",
+                        hint="declare it in drep_tpu/utils/envknobs.py "
+                             "(or fix the typo — nothing reads this name)",
+                    ))
+        # (b) direct reads outside the registry, production scope only
+        # (tests may inspect raw env to assert harness state)
+        if sf.path.startswith("tests/"):
+            continue
+        for call in iter_calls(sf.tree):
+            key = _env_read_key(call, sf)
+            if key is not None and KNOB_RE.match(key):
+                out.append(Finding(
+                    rule=RULE_ID, path=sf.path, line=call.lineno,
+                    message=f"direct os.environ read of {key} bypasses the "
+                            f"typed accessors",
+                    hint="use drep_tpu.utils.envknobs.env_str/env_int/"
+                         "env_float/env_bool (save/restore around a child "
+                         "env override may be waived with a reason)",
+                ))
+        # subscript READS — os.environ["DREP_TPU_X"] — are the other
+        # direct-read spelling; writes (Store/Del ctx: env setup for a
+        # child, monkeypatch-style restore) stay legal
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and _is_os_environ(node.value)
+            ):
+                continue
+            key = _const_str(node.slice, sf)
+            if key is not None and KNOB_RE.match(key):
+                out.append(Finding(
+                    rule=RULE_ID, path=sf.path, line=node.lineno,
+                    message=f"direct os.environ[{key!r}] read bypasses the "
+                            f"typed accessors",
+                    hint="use the matching drep_tpu.utils.envknobs accessor",
+                ))
+    return out
+
+
+RULES = [Rule(id=RULE_ID, title="env-knob registry", run=run, explain=EXPLAIN)]

@@ -1,0 +1,165 @@
+"""Rule ``fault-site``: fault sites/modes exist in the registry and
+every site has chaos-test coverage."""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding, Rule
+from .model import RepoModel, iter_calls
+
+RULE_ID = "fault-site"
+FAULTS_PATH = "drep_tpu/utils/faults.py"
+SPEC_HEAD_RE = re.compile(r"^([a-z_][a-z0-9_]*):([a-z_][a-z0-9_]*)")
+
+EXPLAIN = """\
+utils/faults.py (PR 2) is the ONE registry of injection sites precisely
+so a typo'd chaos spec raises at parse time instead of silently
+injecting nothing and "passing". But the registry only validates specs
+it is HANDED at runtime: a fire("streaming_tiel") call site, or a spec
+literal in a test that never executes on this platform, drifts
+undetected. This rule closes the gap statically: every site string at a
+fire()/torn_write()/spec literal must exist in SITES, every spec-shaped
+literal's mode in MODES, and every registered site must be referenced
+by at least one file under tests/ — an uncovered site means the
+failure mode it models is no longer chaos-tested (the coverage half of
+ISSUE 12's contract).
+
+Fix: correct the typo, or register the new site in faults.SITES and add
+a chaos test that exercises it.
+"""
+
+
+def _registry(model: RepoModel) -> tuple[set[str], set[str]]:
+    """SITES and MODES extracted from faults.py's AST (the linter never
+    imports the tree it lints)."""
+    sf = model.files.get(FAULTS_PATH)
+    sites: set[str] = set()
+    modes: set[str] = set()
+    if sf is None:
+        return sites, modes
+
+    def tuple_strs(node) -> list[str]:
+        if isinstance(node, ast.Tuple):
+            return [
+                e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            return tuple_strs(node.left) + tuple_strs(node.right)
+        if isinstance(node, ast.Name):
+            for n in sf.tree.body:
+                if (
+                    isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and n.targets[0].id == node.id
+                ):
+                    return tuple_strs(n.value)
+        return []
+
+    for n in sf.tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t = n.targets[0]
+            if isinstance(t, ast.Name) and t.id == "SITES":
+                sites.update(tuple_strs(n.value))
+            elif isinstance(t, ast.Name) and t.id == "MODES":
+                modes.update(tuple_strs(n.value))
+    return sites, modes
+
+
+def _site_args(call: ast.Call) -> list[tuple[str, int]]:
+    """Literal site strings passed to fire()/torn_write()/configure-style
+    calls, with their line numbers."""
+    fn = call.func
+    name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", None)
+    out: list[tuple[str, int]] = []
+    if name in ("fire", "torn_write"):
+        if call.args and isinstance(call.args[0], ast.Constant) and isinstance(
+            call.args[0].value, str
+        ):
+            out.append((call.args[0].value, call.args[0].lineno))
+        for kw in call.keywords:
+            if kw.arg == "site" and isinstance(kw.value, ast.Constant) and (
+                isinstance(kw.value.value, str)
+            ):
+                out.append((kw.value.value, kw.value.lineno))
+    return out
+
+
+def run(model: RepoModel) -> list[Finding]:
+    sites, modes = _registry(model)
+    out: list[Finding] = []
+    if not sites or not modes:
+        out.append(Finding(
+            rule=RULE_ID, path=FAULTS_PATH, line=1,
+            message="could not extract SITES/MODES from the fault registry",
+        ))
+        return out
+
+    for sf in model.files.values():
+        if sf.path == FAULTS_PATH:
+            continue
+        for call in iter_calls(sf.tree):
+            for site, line in _site_args(call):
+                if site not in sites:
+                    out.append(Finding(
+                        rule=RULE_ID, path=sf.path, line=line,
+                        message=f"fault site {site!r} is not in the "
+                                f"faults.SITES registry",
+                        hint=f"known sites: {', '.join(sorted(sites))}",
+                    ))
+        # spec-shaped literals ("site:mode[...]") anywhere, tests incl.:
+        # a literal is spec-shaped when EITHER half matches the registry,
+        # so both halves of a half-typo'd spec are caught
+        for node in ast.walk(sf.tree):
+            if not (
+                isinstance(node, ast.Constant) and isinstance(node.value, str)
+            ):
+                continue
+            for part in node.value.split(","):
+                m = SPEC_HEAD_RE.match(part.strip())
+                if not m:
+                    continue
+                site, mode = m.group(1), m.group(2)
+                if site not in sites and mode not in modes:
+                    continue  # not a fault spec (e.g. "host:port")
+                if site not in sites:
+                    out.append(Finding(
+                        rule=RULE_ID, path=sf.path, line=node.lineno,
+                        message=f"fault spec names unknown site {site!r}",
+                        hint=f"known sites: {', '.join(sorted(sites))}",
+                    ))
+                elif mode not in modes:
+                    out.append(Finding(
+                        rule=RULE_ID, path=sf.path, line=node.lineno,
+                        message=f"fault spec names unknown mode {mode!r} "
+                                f"for site {site!r}",
+                        hint=f"known modes: {', '.join(sorted(modes))}",
+                    ))
+
+    # coverage: every registered site appears in some test file
+    test_text = {sf.path: sf.text for sf in model.test_files()}
+    sites_node_line = 1
+    faults_sf = model.files.get(FAULTS_PATH)
+    if faults_sf is not None:
+        for n in faults_sf.tree.body:
+            if (
+                isinstance(n, ast.Assign)
+                and isinstance(n.targets[0], ast.Name)
+                and n.targets[0].id == "SITES"
+            ):
+                sites_node_line = n.lineno
+    for site in sorted(sites):
+        if not any(site in text for text in test_text.values()):
+            out.append(Finding(
+                rule=RULE_ID, path=FAULTS_PATH, line=sites_node_line,
+                message=f"registered fault site {site!r} is referenced by "
+                        f"no test — its failure mode is not chaos-covered",
+                hint="add a chaos test exercising the site (or retire it)",
+            ))
+    return out
+
+
+RULES = [Rule(id=RULE_ID, title="fault-site coherence", run=run, explain=EXPLAIN)]

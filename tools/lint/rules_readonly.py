@@ -1,0 +1,159 @@
+"""Rule ``reader-purity``: the read-only readers never reach a write.
+
+classify (PR 6), the serve daemon (PR 11), pod_status + trace_report
+(PR 10), and the scrubber's scan mode (PR 5) are byte-for-byte READERS
+by contract — concurrent updates publish beside them precisely because
+they never mutate the store. This rule walks the intra-repo call graph
+from those entrypoints and flags every reachable write-capable call:
+payload writes, destructive filesystem calls (remove/mkdir/rmtree), and
+calls INTO the durable-write funnel's API.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from .engine import Finding, Rule
+from .model import (
+    RepoModel, destructive_call_kind, funnel_call_name, iter_calls,
+    write_call_kind,
+)
+
+RULE_ID = "reader-purity"
+
+# (file, qualname) roots of the pure-reader contract
+ENTRYPOINTS = (
+    ("drep_tpu/index/classify.py", "index_classify"),
+    ("drep_tpu/index/classify.py", "classify_batch"),
+    ("drep_tpu/index/classify.py", "load_resident_index"),
+    ("drep_tpu/index/classify.py", "sketch_queries"),
+    ("drep_tpu/serve/daemon.py", "IndexServer.run"),
+    ("drep_tpu/serve/daemon.py", "IndexServer.start"),
+    ("drep_tpu/serve/daemon.py", "IndexServer.serve_batches"),
+    ("drep_tpu/serve/daemon.py", "IndexServer._accept_loop"),
+    ("drep_tpu/serve/daemon.py", "IndexServer._poll_generations"),
+    ("tools/pod_status.py", "collect"),
+    ("tools/pod_status.py", "main"),
+    ("tools/trace_report.py", "load_events"),
+    ("tools/trace_report.py", "text_report"),
+    ("tools/trace_report.py", "chrome_trace"),
+    ("tools/trace_report.py", "stall_diagnosis"),
+    ("tools/trace_report.py", "main"),
+    ("tools/scrub_store.py", "scrub"),
+    ("tools/scrub_store.py", "main"),
+)
+
+# modules the walk does not enter — each writes only under an explicit
+# gate the reader contract documents:
+# - durableio: calls INTO its write API are themselves flagged at the
+#   caller (funnel_call_name); its read API is pure.
+# - telemetry: event emission is gated (--events) and appends to the
+#   run's OWN log sink, never the store being read (classify keeps it
+#   off outright).
+# - logger: console by default; a file handler only exists when a RUN
+#   configures a log dir.
+# - faults: chaos injection fires only under DREP_TPU_FAULTS.
+SKIP_MODULES = frozenset({
+    "drep_tpu/utils/durableio.py",
+    "drep_tpu/utils/telemetry.py",
+    "drep_tpu/utils/logger.py",
+    "drep_tpu/utils/faults.py",
+})
+
+EXPLAIN = """\
+The pure-reader contract is what makes the serving story safe: N serve
+daemons, pod_status --follow, trace_report forensics, and scrub scans
+can all run against a LIVE store while `index update` publishes new
+generations beside them, because none of them writes a byte into it
+(PRs 6/10/11 each pinned their reader byte-for-byte in tests). A write
+reached from a reader entrypoint — even a "harmless" mkdir or a
+self-heal delete — breaks that concurrency story and the tests that
+assert digests.
+
+The walk is static and cannot see config gates (e.g. the rect compare
+shares the streaming engine but classify runs it with no checkpoint
+store). A reader-purity waiver ON A CALL LINE is an EDGE waiver: the
+walk does not enter that call, and the written reason documents the
+gate at the exact place it is applied — one waiver at the gated
+boundary instead of dozens at shared-engine internals the writer paths
+legitimately use. The rule's job is to make the NEXT write reachable
+from a reader loudly visible. Pinned by PRs 6/10/11; enforced since
+PR 12.
+"""
+
+
+def _lookup(model: RepoModel, path: str, qualname: str):
+    sf = model.files.get(path)
+    if sf is None:
+        return None
+    if "." in qualname:
+        cls, meth = qualname.split(".", 1)
+        return sf.classes.get(cls, {}).get(meth)
+    return sf.functions.get(qualname)
+
+
+def run(model: RepoModel) -> list[Finding]:
+    out: list[Finding] = []
+    seen_sites: set[tuple[str, int]] = set()
+    for path, qualname in ENTRYPOINTS:
+        root = _lookup(model, path, qualname)
+        if root is None:
+            out.append(Finding(
+                rule=RULE_ID, path=path, line=1,
+                message=f"reader entrypoint {qualname} not found — the "
+                        f"purity rule's root list in tools/lint/"
+                        f"rules_readonly.py needs updating",
+            ))
+            continue
+        # BFS with parent pointers so each finding can name its chain
+        visited: dict[str, str | None] = {root.key: None}
+        queue = deque([root])
+        while queue:
+            fi = queue.popleft()
+            sf = model.files[fi.path]
+            for call in iter_calls(fi.node):
+                kind = (
+                    write_call_kind(call)
+                    or destructive_call_kind(call)
+                    or funnel_call_name(call)
+                )
+                if kind is not None:
+                    site = (fi.path, call.lineno)
+                    if site not in seen_sites:
+                        seen_sites.add(site)
+                        chain: list[str] = []
+                        k: str | None = fi.key
+                        while k is not None:
+                            chain.append(k.split("::")[1])
+                            k = visited.get(k)
+                        out.append(Finding(
+                            rule=RULE_ID, path=fi.path, line=call.lineno,
+                            message=(
+                                f"write-capable call ({kind}) reachable from "
+                                f"read-only entrypoint {path}::{qualname} via "
+                                + " <- ".join(reversed(chain))
+                            ),
+                            hint="readers must not write; if this site is "
+                                 "config-gated off for every reader, waive "
+                                 "with the gate as the reason",
+                        ))
+                if write_call_kind(call) is not None:
+                    continue  # raw write: no need to also traverse
+                # EDGE waiver: a reader-purity waiver on the call line
+                # stops the walk here (the engine will mark it used when
+                # it suppresses the matching call-site finding; pure
+                # traversal edges mark it used themselves)
+                w = sf.waiver_for(RULE_ID, call.lineno)
+                if w is not None and w.reason:
+                    w.used = True
+                    continue
+                for target in model.resolve_call(call, sf, fi):
+                    if target.path in SKIP_MODULES:
+                        continue
+                    if target.key not in visited:
+                        visited[target.key] = fi.key
+                        queue.append(target)
+    return out
+
+
+RULES = [Rule(id=RULE_ID, title="read-only reader purity", run=run, explain=EXPLAIN)]

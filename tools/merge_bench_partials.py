@@ -31,6 +31,11 @@ import glob
 import json
 import os
 import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from drep_tpu.utils.durableio import atomic_write_bytes  # noqa: E402
 
 
 def _rate(rec) -> float | None:
@@ -189,9 +194,10 @@ def main() -> None:
     # provenance: WHICH files fed this artifact — once folded in, the
     # source partials are safe to delete (this note replaces them)
     merged["merged_from_files"] = [os.path.basename(p) for _, _, p in triples]
-    with open(args.out, "w") as f:
-        json.dump(merged, f, indent=1)
-        f.write("\n")
+    # atomic publish (PR 5 funnel): a crash mid-merge must not replace the
+    # durable artifact the source partials were deleted in favor of with
+    # a torn half-document
+    atomic_write_bytes(args.out, (json.dumps(merged, indent=1) + "\n").encode())
     covered = [k for k in merged["stages"] if not k.endswith("_error")]
     failed = [k for k in merged["stages"] if k.endswith("_error")]
     print(

@@ -97,6 +97,7 @@ def _ring_total_blocks(meta: dict) -> int | None:
 def collect(ckpt_dir: str, now: float | None = None) -> dict:
     """One read-only snapshot of the store. Never writes, deletes, or
     touches anything under `ckpt_dir`."""
+    # drep-lint: allow[clock-mono] — staleness is judged against note mtimes (server clock), like the protocol
     now = time.time() if now is None else now
     try:
         names = sorted(os.listdir(ckpt_dir))

@@ -193,6 +193,7 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
         action = ""
         if delete:
             try:
+                # drep-lint: allow[reader-purity] — --delete repair mode: operator-requested removal of VERIFIED-damaged payloads; the default scan never reaches here
                 os.remove(path)
                 action = " [deleted — next resume recomputes it]"
             except OSError as e:
@@ -202,6 +203,7 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
         action = ""
         if delete:
             try:
+                # drep-lint: allow[reader-purity] — --delete repair mode: crash-orphaned tmp artifacts, same operator gate as above
                 os.remove(path)
                 action = " [deleted]"
             except OSError as e:

@@ -46,6 +46,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from drep_tpu.serve.client import ServeClient, ServeError  # noqa: E402
+from drep_tpu.utils.durableio import atomic_write_bytes  # noqa: E402
 
 
 # ---- client modes ---------------------------------------------------------
@@ -88,10 +89,10 @@ def _plant_genomes(out_dir: str, n: int, length: int = 4000, seed: int = 0) -> l
         seq[pos] = (seq[pos] + rng.integers(1, 4, size=int(pos.sum()))) % 4
         s = bases[seq].tobytes().decode()
         p = os.path.join(out_dir, f"bench{i:03d}.fasta")
-        with open(p, "w") as f:
-            f.write(f">bench{i}\n")
-            for o in range(0, len(s), 80):
-                f.write(s[o : o + 80] + "\n")
+        body = f">bench{i}\n" + "\n".join(
+            s[o : o + 80] for o in range(0, len(s), 80)
+        ) + "\n"
+        atomic_write_bytes(p, body.encode())
         paths.append(p)
     return paths
 
@@ -281,8 +282,7 @@ def run_bench(args) -> int:
         "startup_amortization_ok": amort >= args.amortization,
     }
     out = args.out
-    with open(out, "w") as f:
-        json.dump(record, f, indent=1, sort_keys=True)
+    atomic_write_bytes(out, json.dumps(record, indent=1, sort_keys=True).encode())
     print(json.dumps({k: record[k] for k in
                       ("batched_speedup_x", "guards", "backend", "proxy_metrics")}))
     print(f"bench: record -> {out}", file=sys.stderr)
