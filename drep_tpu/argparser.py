@@ -273,6 +273,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="completed compare/dereplicate workdir to snapshot "
                         "(sketches, edge graph, labels, winners); omit to "
                         "bootstrap from -g FASTAs instead")
+    b.add_argument("--partitions", type=int, default=0,
+                   help="create a FEDERATED index: split the genome space "
+                        "into this many range partitions (each a full index "
+                        "store) under one atomically-published meta-manifest "
+                        "(index/federation.py). Bootstrap (-g) builds only; "
+                        "routing is by sketch-derived range code, pinned at "
+                        "creation. 0/absent = ordinary single-store index")
+    b.add_argument("--fed_pods", type=int, default=None,
+                   help="with --partitions: concurrency for later update "
+                        "pods (partition materialization itself runs "
+                        "in-process; see `index update --fed_pods`)")
     bp = b.add_argument_group("INDEX PARAMETERS (bootstrap build only; "
                               "workdir builds pin the source run's)")
     bp.add_argument("-pa", "--P_ani", type=float, default=None)
@@ -312,6 +323,12 @@ def build_parser() -> argparse.ArgumentParser:
     u.add_argument("--prune_join_chunk", type=int, default=0,
                    help="memory bound for the bucket join's host expansion "
                         "(0 = one-pass; same semantics as the pipeline flag)")
+    u.add_argument("--fed_pods", type=int, default=None,
+                   help="FEDERATED index only: run per-partition updates as "
+                        "up to this many CONCURRENT subprocess pods (each the "
+                        "ordinary `index update` on one partition store, "
+                        "crash-resumable on its own). Default: "
+                        "DREP_TPU_FED_PODS (0 = in-process, one at a time)")
 
     c = isub.add_parser(
         "classify",

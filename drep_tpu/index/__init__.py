@@ -13,9 +13,23 @@ The resident-core split (ISSUE 11): `load_resident_index` /
 `sketch_queries` / `classify_batch` are the separable halves of
 classify that the long-lived `index serve` daemon (drep_tpu/serve/)
 amortizes — load once, classify many, never mutate the resident index.
+
+The federated tier (ISSUE 13, index/federation.py + index/meta.py):
+`build --partitions N` splits the genome space into range partitions —
+each a full index store — under one atomically-published meta-manifest;
+`update` routes batches by sketch-derived range code and runs one
+independent update per dirty partition; only boundary LSH buckets cross
+partitions. `load_index` (and therefore classify/serve) consumes a
+federated root transparently as the assembled union.
 """
 
 from drep_tpu.index.build import build_from_paths, build_from_workdir  # noqa: F401
+from drep_tpu.index.federation import (  # noqa: F401
+    FederationStore,
+    build_federated,
+    fed_update,
+    load_federated,
+)
 from drep_tpu.index.classify import (  # noqa: F401
     SketchedQueries,
     classify_batch,

@@ -45,6 +45,17 @@ _UNSUPPORTED_SNAPSHOT_FLAGS = (
 )
 
 
+def _refuse_federated_root(index_loc: str) -> None:
+    from drep_tpu.index import meta as fedmeta
+
+    if fedmeta.is_federated(index_loc):
+        raise UserInputError(
+            f"{index_loc} already holds a FEDERATED index "
+            f"({fedmeta.META_NAME}); `index update` grows it — build "
+            f"refuses to overwrite"
+        )
+
+
 def resolve_params(**kwargs) -> dict:
     """The index's pinned parameter set, from CLUSTER_DEFAULTS/
     SCORE_DEFAULTS/FILTER_DEFAULTS with explicit overrides."""
@@ -153,6 +164,7 @@ def build_from_workdir(index_loc: str, wd_loc: str) -> dict:
 
     logger = get_logger()
     store = IndexStore(index_loc)
+    _refuse_federated_root(index_loc)
     if store.exists():
         raise UserInputError(
             f"{index_loc} already holds an index (generation "
@@ -255,6 +267,7 @@ def build_from_paths(
     from drep_tpu.utils.profiling import counters
 
     store = IndexStore(index_loc)
+    _refuse_federated_root(index_loc)
     if store.exists():
         raise UserInputError(
             f"{index_loc} already holds an index; `index update` grows it — "

@@ -1,0 +1,1106 @@
+"""Federated genome index: range-partitioned stores under one meta-manifest.
+
+The single-manifest index (ISSUE 6) tops out at one host's bucket join
+and one store's shard families. This module is the multi-pod scale path
+(ISSUE 13): the genome space is split into P range partitions keyed by a
+sketch-derived code (index/meta.py — the splitmix64-finalized min-hash,
+bisected over equal uint64 ranges pinned at creation), each partition a
+FULL existing index store (own ``manifest.json``, own sketch/edge/state
+families, self-healing exactly as today), with one federation layer
+above them::
+
+    federation.json               -- THE meta-manifest (index/meta.py):
+                                     every partition's (range, generation,
+                                     manifest checksum), the cross-shard
+                                     list, and the union state pointer.
+                                     The federation-level commit point.
+    part_000/ ... part_NNN/       -- one complete index store each.
+    cross/cross_g%06d.npz         -- per-federation-generation CROSS-
+                                     partition retained edges in union
+                                     coordinates (jj in [lo, hi)), plus
+                                     the (pid, local) mapping for that
+                                     union range — the mapping's
+                                     redundant copy (heal anchor when
+                                     the union state rots).
+    state/fedstate_g%06d.npz      -- the union derived state: the
+                                     append-only (pid, local) admission
+                                     order, union primary/secondary
+                                     labels, scores, and the winner
+                                     table.
+
+Update protocol (``index update`` on a federated root): new genomes are
+sketched once, routed to partitions by range code, and each dirty
+partition runs its OWN K x N rect compare as an INDEPENDENT unit —
+in-process one at a time, or as concurrent subprocess pods
+(``--fed_pods`` / ``DREP_TPU_FED_PODS``; each pod is the ordinary
+``index update`` CLI on one partition store, crash-resumable on its own
+pending checkpoint exactly as today). A partition-level failure leaves
+that partition at its old generation and the run publishes an HONEST
+PARTIAL meta-manifest (the failed partitions and their unadmitted
+genomes named in the summary and in the meta's ``partial`` note) — never
+a torn federation generation.
+
+Only boundary LSH buckets cross partitions: partition packs rank ids
+locally (two stores' packed ids cannot be joined), so the cross join
+bands the RAW bottom hashes into a shared 2^30 code space
+(rangepart.hash_code_matrix), range-shards that code space with
+``rangepart.partition_by_range`` (band-key-sharded: every shard's
+(pair-code, count) partial is independently computable), and folds the
+partials through ``ops.lsh.merge_code_counts`` — the multi-process
+generalization of the single-host ``--prune_join_chunk`` fold. A
+retained cross-partition pair shares at least one band code (the lsh.py
+recall derivation with a many-to-one monotone key map), so candidates
+have recall 1.0; exact distances then run through the real streaming
+engine over just the candidate-involved subset (pair distances are
+pack-independent, so the values are bit-identical to a union run's).
+
+Commit order per federation generation: partitions first (each its own
+atomic manifest publish), then the cross shard and union state under
+deterministic generation-stamped names, then ``federation.json`` LAST.
+A SIGKILL anywhere leaves readers at the old federation generation —
+``load_federated`` TRUNCATES every partition to the genome count the
+meta records, so a partition that published ahead of a killed meta
+publish is invisible until the rerun converges (chaos-tested; the
+``partition_update`` and ``meta_publish`` fault sites make the worst
+points deterministic).
+
+Pinned invariant (property-tested like PR 6's): federated ==
+from-scratch dereplicate on the union — labels up to renumbering and
+winner sets — across partition counts, split schedules including the
+K=1 trickle, and near-boundary pairs the routing separates.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+from drep_tpu.errors import UserInputError
+from drep_tpu.index import meta as fedmeta
+from drep_tpu.index.store import IndexStore, LoadedIndex, empty_index, load_index
+from drep_tpu.index.update import (
+    _admit_batch,
+    _rect_edges,
+    _retention,
+    index_update,
+    publish_generation,
+    recluster,
+    sketch_batch,
+)
+from drep_tpu.utils.logger import get_logger
+
+_STAT_COLS = ("length", "N50", "contigs", "n_kmers")
+_EMPTY_EDGES = lambda: (  # noqa: E731 — one-line triple used five times
+    np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.float32)
+)
+
+
+class FederationStore:
+    """Path bookkeeping + federation-level shard (de)serialization."""
+
+    def __init__(self, location: str):
+        self.location = os.path.abspath(location)
+
+    # ---- paths -----------------------------------------------------------
+    @property
+    def meta_path(self) -> str:
+        return fedmeta.meta_path(self.location)
+
+    def exists(self) -> bool:
+        return fedmeta.is_federated(self.location)
+
+    def partition_dir(self, pid: int) -> str:
+        return os.path.join(self.location, fedmeta.partition_dir_name(pid))
+
+    def cross_shard_name(self, gen: int) -> str:
+        return os.path.join("cross", f"cross_g{gen:06d}.npz")
+
+    def fedstate_name(self, gen: int) -> str:
+        return os.path.join("state", f"fedstate_g{gen:06d}.npz")
+
+    def abspath(self, rel: str) -> str:
+        return os.path.join(self.location, rel)
+
+    def ensure_dirs(self) -> None:
+        for sub in ("cross", "state", "log"):
+            os.makedirs(os.path.join(self.location, sub), exist_ok=True)
+
+    # ---- meta ------------------------------------------------------------
+    def read_meta(self) -> dict:
+        return fedmeta.read_meta(self.location)
+
+    def publish_meta(self, meta: dict) -> None:
+        fedmeta.publish_meta(self.location, meta)
+
+    # ---- federation shard families --------------------------------------
+    def write_cross_shard(
+        self, rel: str, ii, jj, dd, map_pid, map_local
+    ) -> None:
+        """One federation generation's cross-partition edges (union
+        coords, canonically sorted) + the (pid, local) mapping of the
+        union range the generation admitted — the mapping's redundant
+        copy, like state's redundant names for sketch shards."""
+        from drep_tpu.utils.ckptmeta import atomic_savez
+
+        order = np.lexsort((jj, ii))
+        os.makedirs(os.path.dirname(self.abspath(rel)), exist_ok=True)
+        atomic_savez(
+            self.abspath(rel),
+            ii=np.asarray(ii, np.int64)[order],
+            jj=np.asarray(jj, np.int64)[order],
+            dist=np.asarray(dd, np.float32)[order],
+            map_pid=np.asarray(map_pid, np.int64),
+            map_local=np.asarray(map_local, np.int64),
+        )
+
+    def write_fedstate(
+        self, rel: str, idx: LoadedIndex, part_of: np.ndarray, local_of: np.ndarray
+    ) -> None:
+        from drep_tpu.utils.ckptmeta import atomic_savez
+
+        os.makedirs(os.path.dirname(self.abspath(rel)), exist_ok=True)
+        atomic_savez(
+            self.abspath(rel),
+            part_of=np.asarray(part_of, np.int64),
+            local_of=np.asarray(local_of, np.int64),
+            admitted_generation=np.asarray(idx.admitted, np.int64),
+            primary=np.asarray(idx.primary, np.int64),
+            suffix=np.asarray(idx.suffix, np.int64),
+            score=np.asarray(idx.score, np.float64),
+            winner_cluster=idx.winners["cluster"].to_numpy().astype(str),
+            winner_genome=idx.winners["genome"].to_numpy().astype(str),
+            winner_score=idx.winners["score"].to_numpy().astype(np.float64),
+        )
+
+    def gc_states(self, keep_rel: str) -> None:
+        """Best-effort removal of superseded union states — strictly
+        AFTER the meta publish (same rule as IndexStore.gc_states)."""
+        import contextlib
+
+        state_dir = os.path.join(self.location, "state")
+        keep = os.path.basename(keep_rel)
+        if os.path.isdir(state_dir):
+            for f in os.listdir(state_dir):
+                if f != keep and f.startswith("fedstate_g") and f.endswith(".npz"):
+                    with contextlib.suppress(OSError):
+                        os.remove(os.path.join(state_dir, f))
+
+
+# ---------------------------------------------------------------------------
+# boundary-bucket cross-partition join
+# ---------------------------------------------------------------------------
+
+
+def cross_candidates(
+    bottoms: list[np.ndarray], part_of: np.ndarray, min_col: int = 0
+) -> tuple[np.ndarray, np.ndarray]:
+    """Every cross-partition pair that can survive the retention bound:
+    band the raw bottom hashes into the shared 2^30 code space, range-
+    shard the code space (``rangepart.partition_by_range`` — boundary
+    buckets are exactly the band codes present in more than one
+    partition), join within each shard, and fold the per-shard
+    (pair-code, count) partials through ``lsh.merge_code_counts``.
+
+    `min_col` keeps only pairs reaching the union's new-genome tail
+    (the federated update's rectangular restriction). Returns union-
+    coordinate (ii, jj) with ii < jj. Recall 1.0: a retained pair shares
+    a raw bottom hash inside both sketches (the lsh.py derivation), and
+    the code map is many-to-one — shared hash implies shared code."""
+    from drep_tpu.ops import rangepart
+    from drep_tpu.ops.lsh import _iter_pair_codes, merge_code_counts
+    from drep_tpu.ops.minhash import PAD_ID
+    from drep_tpu.utils import envknobs
+
+    n = len(bottoms)
+    part_of = np.asarray(part_of, np.int64)
+    empty = (np.empty(0, np.int64), np.empty(0, np.int64))
+    if n < 2 or len(np.unique(part_of)) < 2:
+        return empty
+    codes = rangepart.hash_code_matrix(bottoms)
+    shard_max = envknobs.env_int("DREP_TPU_FED_SHARD_MAX")
+    mats: list[np.ndarray] = []
+    owners: list[np.ndarray] = []
+    for p in np.unique(part_of):
+        rows = np.nonzero(part_of == p)[0]
+        mats.append(codes[rows])
+        owners.append(rows)
+
+    def shard_partials():
+        # one iteration = one disjoint band-code range = one join shard;
+        # a multi-process deployment computes these partials on separate
+        # hosts and folds them through the same accumulator
+        for _origin, buckets in rangepart.partition_by_range(mats, shard_max):
+            flat_codes: list[np.ndarray] = []
+            flat_owner: list[np.ndarray] = []
+            for b, own in zip(buckets, owners):
+                r, c = np.nonzero(b != PAD_ID)
+                flat_codes.append(b[r, c])
+                flat_owner.append(own[r])
+            fc = np.concatenate(flat_codes)
+            fo = np.concatenate(flat_owner)
+            order = np.argsort(fc, kind="stable")
+            ks, gs = fc[order], fo[order]
+            starts = np.flatnonzero(np.r_[True, ks[1:] != ks[:-1]])
+            sizes = np.diff(np.r_[starts, len(ks)])
+            for batch in _iter_pair_codes(starts, sizes, gs, n, 1 << 20):
+                lo, hi = batch // n, batch % n
+                sel = part_of[lo] != part_of[hi]
+                if min_col > 0:
+                    sel &= hi >= min_col
+                if sel.any():
+                    yield batch[sel]
+
+    uniq, _counts = merge_code_counts(shard_partials())
+    if not len(uniq):
+        return empty
+    return uniq // n, uniq % n
+
+
+def cross_edges(
+    union: LoadedIndex,
+    part_of: np.ndarray,
+    cand_ii: np.ndarray,
+    cand_jj: np.ndarray,
+    min_col: int = 0,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Exact retained cross-partition edges for the candidate pairs:
+    pack ONLY the candidate-involved genomes and run the real streaming
+    engine over candidate-occupied tiles (pair distances are pack-
+    independent, so values are bit-identical to a union run's). Returns
+    (ii, jj, dist, pairs_compared) in union coords, canonically sorted,
+    filtered to cross-partition pairs with jj >= min_col."""
+    from drep_tpu.ops.lsh import CandidateSet
+    from drep_tpu.ops.minhash import pack_sketches
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+
+    if not len(cand_ii):
+        return (*_EMPTY_EDGES(), 0)
+    p = union.params
+    _, keep = _retention(p)
+    subset = np.unique(np.concatenate([cand_ii, cand_jj]))
+    li = np.searchsorted(subset, cand_ii)
+    lj = np.searchsorted(subset, cand_jj)
+    packed = pack_sketches(
+        [union.bottom[int(u)] for u in subset],
+        [union.names[int(u)] for u in subset],
+        int(p["sketch_size"]),
+    )
+    prune = CandidateSet(
+        ii=li, jj=lj, n=len(subset), params={"prune_scheme": "fed_boundary"}
+    )
+    ii, jj, dd, pairs = streaming_mash_edges(
+        packed, int(p["kmer_size"]), keep,
+        block=int(p["streaming_block"]), prune=prune,
+    )
+    ui, uj = subset[ii], subset[jj]
+    # candidate-occupied tiles also emit co-resident intra-partition and
+    # old-old pairs — both already stored elsewhere; keep only the
+    # shard's own slice of the union edge set
+    sel = np.asarray(part_of)[ui] != np.asarray(part_of)[uj]
+    if min_col > 0:
+        sel &= uj >= min_col
+    ui, uj, dd = ui[sel], uj[sel], dd[sel]
+    order = np.lexsort((uj, ui))
+    return ui[order], uj[order], dd[order], int(pairs)
+
+
+# ---------------------------------------------------------------------------
+# federated load (the union view every reader consumes)
+# ---------------------------------------------------------------------------
+
+
+def _truncate_partition(pidx: LoadedIndex, n_p: int) -> LoadedIndex:
+    """The partition AS OF the meta's recorded generation: its first
+    `n_p` genomes and the edges among them. Partition stores are append-
+    only in genome-index space, so the prefix IS the old generation's
+    content — this is how a stale meta never exposes a half-published
+    federation generation."""
+    if pidx.n <= n_p:
+        return pidx
+    ii, jj, dd = pidx.edges
+    sel = jj < n_p  # ii < jj, so both endpoints are inside the prefix
+    return LoadedIndex(
+        location=pidx.location, params=pidx.params, generation=pidx.generation,
+        names=pidx.names[:n_p], locations=pidx.locations[:n_p],
+        gdb=pidx.gdb.iloc[:n_p].reset_index(drop=True),
+        admitted=pidx.admitted[:n_p],
+        bottom=pidx.bottom[:n_p], scaled=pidx.scaled[:n_p],
+        edges=(ii[sel], jj[sel], dd[sel]),
+        primary=pidx.primary[:n_p], suffix=pidx.suffix[:n_p],
+        score=pidx.score[:n_p], winners=pidx.winners,
+        healed=pidx.healed,
+    )
+
+
+def _read_npz_or_refuse(path: str, what: str, location: str, heal: bool):
+    """corrupt-vs-missing classification for the federation families,
+    heal-mode aware — the store.py `_read_or_none` contract at the
+    federation level."""
+    from drep_tpu.utils import durableio
+
+    if heal:
+        return durableio.load_npz_or_none(
+            path, what=what, convert=lambda z: z,
+            warn=f"federated index {what}: corrupt %s — healing via recompute",
+        )
+    try:
+        return durableio.load_npz_checked(path, what=what)
+    except FileNotFoundError:
+        return None
+    except durableio.CorruptPayloadError as e:
+        raise UserInputError(
+            f"federated index {what} {path} is corrupt ({e}). classify/serve "
+            f"are read-only; run `drep-tpu index update {location}` (no "
+            f"genomes needed) to heal it"
+        ) from e
+
+
+def load_federated(location: str, heal: bool = False) -> LoadedIndex:
+    """The whole federation at its meta-manifest generation, assembled
+    as ONE union ``LoadedIndex`` — what classify/serve consume
+    transparently (store.load_index delegates here). Every partition is
+    loaded through the ordinary store loader (its own heal matrix
+    applies) and TRUNCATED to the genome count the meta records; union
+    labels/scores/winners come from the federation state; edges are the
+    partitions' intra edges translated to union coordinates plus the
+    cross shards.
+
+    Heal matrix at the federation level (update-time; read-only refuses):
+
+    - union state rotted -> mapping recovered from the cross shards'
+      redundant copies; the caller re-clusters the whole union
+      (``state_missing``), exactly the store's state-rot path.
+    - cross shard rotted -> its candidate join + distances recompute
+      deterministically for the shard's union range (pair distances are
+      pack-independent) and the shard rewrites byte-identically.
+    - union state AND a cross shard both rotted -> fatal: the double
+      fault the redundancy cannot cover.
+
+    The returned index carries ``fed_part_of`` / ``fed_local_of`` /
+    ``fed_meta`` attributes for the federation machinery."""
+    logger = get_logger()
+    store = FederationStore(location)
+    m = store.read_meta()
+    params = m["params"]
+    gen = int(m["generation"])
+    healed: list[str] = []
+    if gen < 0:
+        if not heal:
+            raise UserInputError(
+                f"federated index at {location} is an empty skeleton "
+                f"(generation -1) — finish the initial `drep-tpu index "
+                f"update {location} -g ...` before serving from it"
+            )
+        idx = empty_index(params, location=store.location)
+        idx.fed_part_of = np.empty(0, np.int64)  # type: ignore[attr-defined]
+        idx.fed_local_of = np.empty(0, np.int64)  # type: ignore[attr-defined]
+        idx.fed_meta = m  # type: ignore[attr-defined]
+        return idx
+
+    # 1. partitions, each at the meta's recorded generation ---------------
+    loaded: dict[int, LoadedIndex | None] = {}
+    for e in m["partitions"]:
+        pid = int(e["pid"])
+        n_p = int(e["n_genomes"])
+        if n_p <= 0:
+            loaded[pid] = None
+            continue
+        pdir = store.partition_dir(pid)
+        pidx = load_index(pdir, heal=heal)
+        healed.extend(f"{fedmeta.partition_dir_name(pid)}/{h}" for h in pidx.healed)
+        g_meta = int(e["generation"])
+        if pidx.generation < g_meta:
+            raise UserInputError(
+                f"federated index: partition {pid} is at generation "
+                f"{pidx.generation} but the meta-manifest recorded "
+                f"{g_meta} — the partition store was rolled back or "
+                f"restored out of band; restore a matching backup pair"
+            )
+        if pidx.generation > g_meta + 1:
+            raise UserInputError(
+                f"federated index: partition {pid} is {pidx.generation - g_meta} "
+                f"generations ahead of the meta-manifest — partitions of a "
+                f"federation must only be updated THROUGH `index update` on "
+                f"the federation root"
+            )
+        if pidx.generation == g_meta and e.get("manifest_crc") is not None:
+            crc = fedmeta.manifest_crc(pdir)
+            if crc is not None and int(crc) != int(e["manifest_crc"]):
+                raise UserInputError(
+                    f"federated index: partition {pid}'s manifest checksum "
+                    f"does not match what the meta-manifest was published "
+                    f"against — the partition was swapped out from under "
+                    f"the federation"
+                )
+        if pidx.n < n_p:
+            raise UserInputError(
+                f"federated index: partition {pid} holds {pidx.n} genomes "
+                f"but the meta-manifest records {n_p}"
+            )
+        loaded[pid] = _truncate_partition(pidx, n_p)
+
+    # 2. union state (mapping + labels) -----------------------------------
+    n = int(m["n_genomes"])
+    state = None
+    if m.get("state"):
+        state = _read_npz_or_refuse(
+            store.abspath(m["state"]), "union state", location, heal
+        )
+        if state is None and not heal:
+            raise UserInputError(
+                f"federated index union state {store.abspath(m['state'])} is "
+                f"missing; run `drep-tpu index update {location}` to heal"
+            )
+
+    cross_entries = list(m.get("cross_shards", ()))
+    cross_payloads = [
+        _read_npz_or_refuse(store.abspath(e["file"]), "cross shard", location, heal)
+        for e in cross_entries
+    ]
+    for e, z in zip(cross_entries, cross_payloads):
+        if z is None and not heal:
+            raise UserInputError(
+                f"federated index cross shard {store.abspath(e['file'])} is "
+                f"missing; classify/serve are read-only — run `drep-tpu "
+                f"index update {location}` to heal the store first"
+            )
+
+    if state is not None:
+        part_of = state["part_of"].astype(np.int64)
+        local_of = state["local_of"].astype(np.int64)
+    else:
+        # heal: the mapping's redundant copy lives range-sliced in the
+        # cross shards — all of them must be readable, or it is the
+        # double fault the redundancy cannot cover
+        parts_map: list[np.ndarray] = []
+        locals_map: list[np.ndarray] = []
+        for e, z in zip(cross_entries, cross_payloads):
+            if z is None:
+                raise UserInputError(
+                    f"federated index at {location}: the union state AND "
+                    f"cross shard {e['file']} are both unreadable — the "
+                    f"double fault the federation's redundancy cannot "
+                    f"cover. Rebuild the federation."
+                )
+            parts_map.append(z["map_pid"].astype(np.int64))
+            locals_map.append(z["map_local"].astype(np.int64))
+        part_of = np.concatenate(parts_map) if parts_map else np.empty(0, np.int64)
+        local_of = (
+            np.concatenate(locals_map) if locals_map else np.empty(0, np.int64)
+        )
+    if len(part_of) != n:
+        raise UserInputError(
+            f"federated index at {location}: union mapping covers "
+            f"{len(part_of)} genomes but the meta-manifest records {n}"
+        )
+
+    # 3. union assembly ----------------------------------------------------
+    names: list = [None] * n
+    locations_l: list = [None] * n
+    bottom: list = [None] * n
+    scaled: list = [None] * n
+    admitted = np.zeros(n, np.int64)
+    stats = {c: np.zeros(n, np.int64) for c in _STAT_COLS}
+    l2u: dict[int, np.ndarray] = {}
+    for pid, pidx in loaded.items():
+        if pidx is None:
+            continue
+        sel = np.nonzero(part_of == pid)[0]
+        locs = local_of[sel]
+        arr = np.full(pidx.n, -1, np.int64)
+        arr[locs] = sel
+        l2u[pid] = arr
+        for c in _STAT_COLS:
+            stats[c][sel] = pidx.gdb[c].to_numpy()[locs]
+        for u, loc in zip(sel, locs):
+            names[u] = pidx.names[loc]
+            locations_l[u] = pidx.locations[loc]
+            bottom[u] = pidx.bottom[loc]
+            scaled[u] = pidx.scaled[loc]
+    missing = [g for g in range(n) if names[g] is None]
+    if missing:
+        raise UserInputError(
+            f"federated index at {location}: union slot(s) {missing[:5]} "
+            f"resolve to no partition genome — meta/mapping mismatch"
+        )
+
+    parts_ii: list[np.ndarray] = []
+    parts_jj: list[np.ndarray] = []
+    parts_dd: list[np.ndarray] = []
+    for pid in sorted(loaded):
+        pidx = loaded[pid]
+        if pidx is None or not len(pidx.edges[0]):
+            continue
+        ii, jj, dd = pidx.edges
+        parts_ii.append(l2u[pid][ii])
+        parts_jj.append(l2u[pid][jj])
+        parts_dd.append(dd)
+
+    idx = LoadedIndex(
+        location=store.location, params=params, generation=gen,
+        names=[str(x) for x in names],
+        locations=[str(x) for x in locations_l],
+        gdb=pd.DataFrame({"genome": [str(x) for x in names], **stats}),
+        admitted=admitted, bottom=bottom, scaled=scaled,
+        edges=_EMPTY_EDGES(),
+        primary=np.zeros(n, np.int64), suffix=np.zeros(n, np.int64),
+        score=np.zeros(n, np.float64),
+        winners=pd.DataFrame({"cluster": [], "genome": [], "score": []}),
+        healed=healed,
+    )
+    idx.fed_part_of = part_of  # type: ignore[attr-defined]
+    idx.fed_local_of = local_of  # type: ignore[attr-defined]
+    idx.fed_meta = m  # type: ignore[attr-defined]
+
+    # 4. cross shards (healing rotted ones now that bottoms are resident) -
+    for e, z in zip(cross_entries, cross_payloads):
+        lo, hi = int(e["lo"]), int(e["hi"])
+        if z is None:
+            logger.warning(
+                "federated index: recomputing cross range [%d, %d) to heal %s",
+                lo, hi, e["file"],
+            )
+            ci, cj = cross_candidates(bottom, part_of, min_col=lo)
+            keep_range = cj < hi
+            ui, uj, dd, _pairs = cross_edges(
+                idx, part_of, ci[keep_range], cj[keep_range], min_col=lo
+            )
+            store.write_cross_shard(
+                e["file"], ui, uj, dd, part_of[lo:hi], local_of[lo:hi]
+            )
+            healed.append(e["file"])
+        else:
+            ui = z["ii"].astype(np.int64)
+            uj = z["jj"].astype(np.int64)
+            dd = z["dist"].astype(np.float32)
+        parts_ii.append(ui)
+        parts_jj.append(uj)
+        parts_dd.append(dd)
+
+    # canonical union edge order: ONE global lexsort, identical however
+    # the shards were produced (the federation's own convention)
+    if parts_ii:
+        ii = np.concatenate(parts_ii)
+        jj = np.concatenate(parts_jj)
+        dd = np.concatenate(parts_dd)
+        order = np.lexsort((jj, ii))
+        idx.edges = (ii[order], jj[order], dd[order])
+
+    # 5. union derived state ----------------------------------------------
+    if state is not None:
+        idx.admitted = state["admitted_generation"].astype(np.int64)
+        idx.primary = state["primary"].astype(np.int64)
+        idx.suffix = state["suffix"].astype(np.int64)
+        idx.score = state["score"].astype(np.float64)
+        idx.winners = pd.DataFrame(
+            {
+                "cluster": [str(x) for x in state["winner_cluster"]],
+                "genome": [str(x) for x in state["winner_genome"]],
+                "score": state["winner_score"].astype(np.float64),
+            }
+        )
+    else:
+        # admission generations recoverable per cross-shard range
+        for e in cross_entries:
+            idx.admitted[int(e["lo"]): int(e["hi"])] = int(e["generation"])
+        idx.state_missing = True  # caller (fed_update) re-clusters the union
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# federated build + update
+# ---------------------------------------------------------------------------
+
+
+def build_federated(
+    location: str, genome_paths: list[str], partitions: int,
+    processes: int = 1, fed_pods: int | None = None, **kwargs,
+) -> dict:
+    """`index build --partitions N`: create a federated index and admit
+    the whole input set as federation generation 0. The build is an
+    empty-skeleton meta publish followed by one ordinary federated
+    update, so a killed build resumes through the exact update machinery
+    (`index update <root> -g <same paths>`) and converges.
+
+    Note: partition MATERIALIZATION (a partition's first batch) runs
+    in-process even under ``fed_pods`` — the pinned params come verbatim
+    from the meta, which the CLI bootstrap build cannot fully express
+    (see the ROADMAP follow-on); subsequent updates of existing
+    partitions parallelize as pods."""
+    store = FederationStore(location)
+    if store.exists() or IndexStore(location).exists():
+        raise UserInputError(
+            f"{location} already holds an index; `index update` grows it — "
+            f"build refuses to overwrite"
+        )
+    from drep_tpu.index.build import resolve_params
+
+    params = resolve_params(**kwargs)
+    bounds = fedmeta.partition_bounds(partitions)
+    skeleton = {
+        "format": fedmeta.FED_FORMAT,
+        "generation": -1,
+        "n_genomes": 0,
+        "n_partitions": int(partitions),
+        "params": params,
+        "partitions": [
+            {
+                "pid": p,
+                "dir": fedmeta.partition_dir_name(p),
+                "range": [int(lo), int(hi)],
+                "generation": -1,
+                "n_genomes": 0,
+                "manifest_crc": None,
+            }
+            for p, (lo, hi) in enumerate(bounds)
+        ],
+        "cross_shards": [],
+        "state": None,
+    }
+    store.ensure_dirs()
+    store.publish_meta(skeleton)
+    summary = fed_update(
+        location, genome_paths, processes=processes, fed_pods=fed_pods
+    )
+    get_logger().info(
+        "index build: federated %d genomes over %d partitions -> %s "
+        "(federation generation 0)",
+        summary.get("n_genomes", 0), partitions, location,
+    )
+    return summary
+
+
+def _build_partition(
+    part_dir: str, paths: list[str], params: dict, processes: int
+) -> None:
+    """Materialize an empty partition's generation 0 with the
+    federation's PINNED params (the ordinary bootstrap build takes CLI
+    kwargs; a partition must inherit the meta's params verbatim so
+    build-time and update-time numerics can never drift)."""
+    from drep_tpu.utils.profiling import counters
+
+    store = IndexStore(part_dir)
+    idx = empty_index(dict(params), location=store.location)
+    batch, results = sketch_batch(idx, paths, processes=processes)
+    if not len(batch):
+        raise UserInputError(
+            f"partition {part_dir}: no routed genome survived the length "
+            f"filter — nothing to materialize"
+        )
+    _admit_batch(idx, batch, results, 0)
+    with counters.stage("index_rect_compare"):
+        ii, jj, dd, pairs = _rect_edges(idx, 0, store.pending_dir(0))
+    counters.stages["index_rect_compare"].pairs += pairs
+    order = np.lexsort((jj, ii))
+    idx.edges = (ii[order], jj[order], dd[order])
+    recluster(idx, 0, processes=processes)
+    publish_generation(store, idx, 0, 0, idx.edges)
+
+
+def _partition_generation(part_dir: str) -> int:
+    """The partition's current manifest generation, -1 when the store
+    does not exist yet — the ONLY read the happy path (partition exactly
+    at the meta's generation) pays per update."""
+    store = IndexStore(part_dir)
+    if not store.exists():
+        return -1
+    return int(store.read_manifest()["generation"])
+
+
+def _partition_names(part_dir: str, lo: int = 0) -> list[str]:
+    """Genome names at index >= `lo`, read from only the sketch shards
+    whose range reaches there — the resume skip-detection's tail probe.
+    Deliberately NOT a full partition load: only the rare resume
+    branches pay it, and only for the tail shards they compare."""
+    from drep_tpu.utils import durableio
+
+    store = IndexStore(part_dir)
+    names: list[str] = []
+    for e in store.read_manifest()["sketch_shards"]:
+        if int(e["hi"]) <= lo:
+            continue
+        z = durableio.load_npz_checked(store.abspath(e["file"]), what="sketch shard")
+        names.extend(
+            str(x) for i, x in enumerate(z["names"], start=int(e["lo"])) if i >= lo
+        )
+    return names
+
+
+def _run_pods(
+    jobs: list[tuple[int, str, list[str], dict]], pods: int, processes: int
+) -> dict[int, object]:
+    """Run partition-update jobs as detached `index update` CLI pods, up
+    to `pods` concurrently. Each pod is the ordinary single-store update
+    — crash-resumable on its own pending checkpoint, publishing its own
+    manifest atomically. Pod output goes to a temp file per pod (a PIPE
+    left undrained until exit would deadlock a chatty pod against the OS
+    pipe buffer). The ``partition_update`` fault site fires immediately
+    before EACH pod launch (the registered skip=N semantics); a raise
+    there books that partition failed, like the in-process path. Returns
+    {pid: returncode or failure-message}."""
+    import tempfile
+
+    from drep_tpu.utils import faults
+
+    logger = get_logger()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    queue = list(jobs)
+    running: dict[int, tuple[subprocess.Popen, object]] = {}
+    results: dict[int, object] = {}
+    while queue or running:
+        while queue and len(running) < max(1, pods):
+            pid, part_dir, paths, prune_flags = queue.pop(0)
+            try:
+                faults.fire("partition_update")
+            except Exception as e:  # noqa: BLE001 — same partition-level
+                # failure tolerance as the in-process path
+                results[pid] = f"{type(e).__name__}: {e}"
+                logger.error(
+                    "federated update: partition %d pod launch failed: %s", pid, e
+                )
+                continue
+            cmd = [sys.executable, "-m", "drep_tpu", "index", "update", part_dir,
+                   "-g", *paths, "-p", str(processes)]
+            for flag, val in prune_flags.items():
+                if val:
+                    cmd += [f"--{flag}", str(val)]
+            logger.info("federated update: launching pod for partition %d "
+                        "(%d genome(s))", pid, len(paths))
+            log = tempfile.TemporaryFile(mode="w+")
+            proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log, text=True)
+            running[pid] = (proc, log)
+        for pid, (proc, log) in list(running.items()):
+            rc = proc.poll()
+            if rc is None:
+                continue
+            log.seek(0)
+            out = log.read()
+            log.close()
+            results[pid] = rc
+            del running[pid]
+            if rc != 0:
+                logger.error(
+                    "federated update: partition %d pod failed (rc=%d):\n%s",
+                    pid, rc, out[-2000:],
+                )
+        if running:
+            time.sleep(0.05)
+    return results
+
+
+def _routed_batches(
+    batch: pd.DataFrame, results: dict[str, dict], bounds: list
+) -> dict[int, pd.DataFrame]:
+    """Route the sketched batch to partitions by range code, preserving
+    batch order within each partition (the deterministic admission order
+    a resume must reproduce)."""
+    pids = [
+        fedmeta.route_partition(
+            fedmeta.route_code(results[g]["bottom"]), bounds
+        )
+        for g in batch["genome"]
+    ]
+    out: dict[int, pd.DataFrame] = {}
+    for pid in sorted(set(pids)):
+        sel = [p == pid for p in pids]
+        out[pid] = batch[sel].reset_index(drop=True)
+    return out
+
+
+def fed_update(
+    location: str, genome_paths: list[str] | None, processes: int = 1,
+    fed_pods: int | None = None, primary_prune: str = "off",
+    prune_bands: int = 0, prune_min_shared: int = 0, prune_join_chunk: int = 0,
+) -> dict:
+    """`index update` on a federated root: sketch + route the batch, run
+    one INDEPENDENT update per dirty partition (in-process, or as
+    `--fed_pods` concurrent subprocess pods), join the boundary buckets
+    across partitions, re-cluster the union's dirty components, and
+    publish the next federation generation through the meta-manifest.
+
+    Partition-level failure is tolerated honestly: the failed partition
+    stays at its old generation, its routed genomes are NOT admitted,
+    and the published meta carries a ``partial`` note naming them (the
+    summary lists them too — re-submit those genomes to finish). With no
+    genomes this is a pure HEAL pass over every partition plus the
+    federation families; the generation stays put."""
+    from drep_tpu.utils import faults, telemetry
+    from drep_tpu.utils import envknobs
+
+    logger = get_logger()
+    store = FederationStore(location)
+    m = store.read_meta()
+    params = m["params"]
+    gen = int(m["generation"])
+    gen_new = gen + 1
+    if fed_pods is None:
+        fed_pods = envknobs.env_int("DREP_TPU_FED_PODS")
+    union = load_federated(location, heal=True)
+    part_of = np.asarray(union.fed_part_of, np.int64)  # type: ignore[attr-defined]
+    local_of = np.asarray(union.fed_local_of, np.int64)  # type: ignore[attr-defined]
+
+    batch = results = None
+    if genome_paths:
+        batch, results = sketch_batch(union, genome_paths, processes=processes)
+    if batch is None or not len(batch):
+        summary = {
+            "admitted": 0, "generation": gen, "healed": union.healed,
+            "n_partitions": int(m["n_partitions"]),
+        }
+        if union.state_missing and union.n:
+            summary.update(recluster(union, union.n, processes=processes))
+            store.write_fedstate(
+                store.fedstate_name(gen), union, part_of, local_of
+            )
+            logger.warning("federated index: union state healed via full recompute")
+        if union.healed:
+            logger.info("federated heal pass: repaired %s", union.healed)
+        return summary
+
+    bounds = [tuple(e["range"]) for e in m["partitions"]]
+    meta_gen = {int(e["pid"]): int(e["generation"]) for e in m["partitions"]}
+    meta_n = {int(e["pid"]): int(e["n_genomes"]) for e in m["partitions"]}
+    routed = _routed_batches(batch, results, bounds)
+    prune_flags = {
+        "primary_prune": primary_prune if primary_prune != "off" else "",
+        "prune_bands": prune_bands, "prune_min_shared": prune_min_shared,
+        "prune_join_chunk": prune_join_chunk,
+    }
+
+    # -- per-partition resume/skip classification -------------------------
+    # a partition AHEAD of the meta that this batch does NOT route to is
+    # a killed PREVIOUS update mid-resume (this covers meta-empty
+    # partitions a crashed attempt materialized, too): admitting a
+    # different batch now would strand its already-admitted tail outside
+    # the union forever — refuse with the resume instruction instead
+    for e in m["partitions"]:
+        pid = int(e["pid"])
+        if pid in routed:
+            continue
+        if _partition_generation(store.partition_dir(pid)) > int(e["generation"]):
+            raise UserInputError(
+                f"federated index: partition {pid} is ahead of the "
+                f"meta-manifest from an interrupted earlier update, and "
+                f"this batch routes nothing to it — re-run the "
+                f"interrupted update with ITS batch first (its admitted "
+                f"tail must reach the union before a new batch lands)"
+            )
+    jobs: list[tuple[int, str, list[str], dict]] = []  # update pods
+    builds: list[int] = []
+    done: set[int] = set()
+    for pid in sorted(routed):
+        pdir = store.partition_dir(pid)
+        want = list(routed[pid]["genome"])
+        actual_gen = _partition_generation(pdir)
+        base_n = meta_n[pid]
+        if meta_gen[pid] < 0:
+            if actual_gen < 0:
+                builds.append(pid)
+            elif actual_gen == 0 and sorted(_partition_names(pdir)) == sorted(want):
+                done.add(pid)  # a killed prior attempt already materialized it
+            else:
+                raise UserInputError(
+                    f"federated index: empty partition {pid} holds an "
+                    f"unexpected store (generation {actual_gen}) — it was "
+                    f"written out of band, or a DIFFERENT interrupted batch "
+                    f"materialized it; re-run that batch first, or remove "
+                    f"{pdir} / restore the federation backup"
+                )
+        elif actual_gen == meta_gen[pid]:
+            jobs.append((pid, pdir, list(routed[pid]["location"]), prune_flags))
+        elif actual_gen == meta_gen[pid] + 1 and sorted(
+            _partition_names(pdir, lo=base_n)
+        ) == sorted(want):
+            done.add(pid)  # a killed prior attempt already admitted the batch
+        else:
+            raise UserInputError(
+                f"federated index: partition {pid} is at generation "
+                f"{actual_gen} (meta records {meta_gen[pid]}) with a tail "
+                f"that does not match this batch — it was updated out of "
+                f"band, or a different batch is being resumed"
+            )
+
+    # -- run the dirty partitions as independent units --------------------
+    failed: dict[int, str] = {}
+    for pid in builds:
+        try:
+            faults.fire("partition_update")
+            _build_partition(
+                store.partition_dir(pid), list(routed[pid]["location"]),
+                params, processes,
+            )
+            telemetry.event("federation_partition", pid=pid, op="build",
+                            n=len(routed[pid]))
+        except Exception as e:  # noqa: BLE001 — partition-level failure is
+            # tolerated: the partition stays absent, the publish is partial
+            failed[pid] = f"{type(e).__name__}: {e}"
+            logger.error("federated update: partition %d build failed: %s", pid, e)
+    if fed_pods > 0 and jobs:
+        rcs = _run_pods(jobs, fed_pods, processes)
+        for pid, rc in rcs.items():
+            if rc != 0:
+                failed[pid] = (
+                    f"pod exited rc={rc}" if isinstance(rc, int) else str(rc)
+                )
+    else:
+        for pid, pdir, paths, _pf in jobs:
+            try:
+                faults.fire("partition_update")
+                index_update(
+                    pdir, paths, processes=processes,
+                    primary_prune=primary_prune, prune_bands=prune_bands,
+                    prune_min_shared=prune_min_shared,
+                    prune_join_chunk=prune_join_chunk,
+                )
+                telemetry.event("federation_partition", pid=pid, op="update",
+                                n=len(paths))
+            except Exception as e:  # noqa: BLE001 — same partial-publish
+                # tolerance as the pod path (a SIGKILL still kills us whole)
+                failed[pid] = f"{type(e).__name__}: {e}"
+                logger.error(
+                    "federated update: partition %d update failed: %s", pid, e
+                )
+
+    succeeded = sorted((set(routed) - set(failed)) | done)
+    if not succeeded:
+        raise UserInputError(
+            f"federated update: every dirty partition failed "
+            f"({sorted(failed)}) — nothing to publish. Per-partition "
+            f"errors: {failed}"
+        )
+
+    # -- append the admitted tails to the union ---------------------------
+    n_old = union.n
+    part_of_l = list(part_of)
+    local_of_l = list(local_of)
+    new_intra: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    unadmitted: list[str] = []
+    for pid in sorted(routed):
+        if pid in failed:
+            unadmitted.extend(routed[pid]["genome"])
+            continue
+        pdir = store.partition_dir(pid)
+        pidx = load_index(pdir)
+        base_n = meta_n[pid]
+        tail = list(range(base_n, pidx.n))
+        want = sorted(routed[pid]["genome"])
+        if sorted(pidx.names[base_n:]) != want:
+            raise UserInputError(
+                f"federated update: partition {pid} admitted "
+                f"{pidx.names[base_n:]} but this batch routed {want} — "
+                f"concurrent out-of-band update detected"
+            )
+        # the union admission order is (pid, local) over this batch —
+        # deterministic, so a killed run's rerun reproduces it exactly
+        l2u = np.full(pidx.n, -1, np.int64)
+        sel = np.nonzero(part_of == pid)[0]
+        l2u[local_of[sel]] = sel
+        for loc in tail:
+            l2u[loc] = len(part_of_l)
+            part_of_l.append(pid)
+            local_of_l.append(loc)
+            union.names.append(pidx.names[loc])
+            union.locations.append(pidx.locations[loc])
+            union.bottom.append(pidx.bottom[loc])
+            union.scaled.append(pidx.scaled[loc])
+        rows = pidx.gdb.iloc[tail][["genome", *_STAT_COLS]]
+        union.gdb = pd.concat([union.gdb, rows], ignore_index=True)
+        union.admitted = np.concatenate(
+            [union.admitted, np.full(len(tail), gen_new, np.int64)]
+        )
+        ii, jj, dd = pidx.edges
+        sel_new = jj >= base_n
+        new_intra.append((l2u[ii[sel_new]], l2u[jj[sel_new]], dd[sel_new]))
+    part_of = np.asarray(part_of_l, np.int64)
+    local_of = np.asarray(local_of_l, np.int64)
+    admitted_k = union.n - n_old
+
+    # -- boundary-bucket cross join over the grown union ------------------
+    ci, cj = cross_candidates(union.bottom, part_of, min_col=n_old)
+    xi, xj, xd, cross_pairs = cross_edges(union, part_of, ci, cj, min_col=n_old)
+    ii = np.concatenate([union.edges[0], *(e[0] for e in new_intra), xi])
+    jj = np.concatenate([union.edges[1], *(e[1] for e in new_intra), xj])
+    dd = np.concatenate([union.edges[2], *(e[2] for e in new_intra), xd])
+    order = np.lexsort((jj, ii))
+    union.edges = (ii[order], jj[order], dd[order])
+
+    summary = recluster(union, n_old, processes=processes)
+
+    # -- publish: cross shard + union state first, the meta LAST ----------
+    store.ensure_dirs()
+    cr_rel = store.cross_shard_name(gen_new)
+    st_rel = store.fedstate_name(gen_new)
+    store.write_cross_shard(
+        cr_rel, xi, xj, xd, part_of[n_old:], local_of[n_old:]
+    )
+    union.generation = gen_new
+    store.write_fedstate(st_rel, union, part_of, local_of)
+    new_n = {pid: meta_n[pid] for pid in meta_n}
+    new_gen = dict(meta_gen)
+    for pid in sorted(routed):
+        if pid in failed:
+            continue
+        new_gen[pid] = max(meta_gen[pid] + 1, 0)
+        new_n[pid] = meta_n[pid] + len(routed[pid])
+    meta_new = {
+        "format": fedmeta.FED_FORMAT,
+        "generation": gen_new,
+        "n_genomes": union.n,
+        "n_partitions": int(m["n_partitions"]),
+        "params": params,
+        "partitions": [
+            {
+                "pid": int(e["pid"]),
+                "dir": e["dir"],
+                "range": [int(e["range"][0]), int(e["range"][1])],
+                "generation": new_gen[int(e["pid"])],
+                "n_genomes": new_n[int(e["pid"])],
+                "manifest_crc": (
+                    fedmeta.manifest_crc(store.partition_dir(int(e["pid"])))
+                    if new_n[int(e["pid"])] > 0
+                    else None
+                ),
+            }
+            for e in m["partitions"]
+        ],
+        "cross_shards": list(m.get("cross_shards", ()))
+        + [{"file": cr_rel, "lo": n_old, "hi": union.n, "generation": gen_new}],
+        "state": st_rel,
+    }
+    if failed:
+        meta_new["partial"] = {
+            "failed_partitions": sorted(failed),
+            "unadmitted": sorted(unadmitted),
+        }
+    store.publish_meta(meta_new)
+    store.gc_states(st_rel)
+
+    summary.update(
+        {
+            "admitted": admitted_k,
+            "n_genomes": union.n,
+            "generation": gen_new,
+            "n_partitions": int(m["n_partitions"]),
+            "partitions_updated": succeeded,
+            "partitions_failed": sorted(failed),
+            "unadmitted": sorted(unadmitted),
+            "cross_edges": int(len(xi)),
+            "cross_pairs_compared": cross_pairs,
+            "healed": union.healed,
+        }
+    )
+    logger.info(
+        "federated update: +%d genomes over %d partition(s) -> federation "
+        "generation %d (%d genomes, %d cross edge(s)%s)",
+        admitted_k, len(succeeded), gen_new, union.n, len(xi),
+        f"; PARTIAL — {len(unadmitted)} genome(s) unadmitted in "
+        f"partition(s) {sorted(failed)}" if failed else "",
+    )
+    return summary

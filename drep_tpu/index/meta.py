@@ -1,0 +1,170 @@
+"""The federation meta-manifest: ONE commit point above N partition stores.
+
+A federated index (drep_tpu/index/federation.py) splits the genome space
+into range partitions keyed by a sketch-derived code; each partition is a
+full, self-contained index store (own ``manifest.json``, own shard
+families, self-healing as today). This module owns the layer ABOVE them:
+
+``federation.json``
+    The atomically-published federation root (checked JSON, in-band
+    "crc" — the same durable contract as every store manifest). It
+    records, for every partition, the ``(range, generation, manifest
+    checksum)`` triple the federation generation was published against,
+    plus the federation-level shard families (cross-partition edge
+    shards + the union derived state). Everything a partition publishes
+    is INVISIBLE to federated readers until this file moves — a SIGKILL
+    between a partition's publish and the meta publish leaves readers at
+    the old federation generation, loading each partition TRUNCATED to
+    the genome count the stale meta records (chaos-tested: a stale meta
+    never exposes a half-published generation).
+
+Routing
+    A genome's range code is the splitmix64 finalizer of its smallest
+    bottom-sketch hash — sketch-derived (similar genomes collide on the
+    min-hash with probability ~= their Jaccard, so relatives co-locate),
+    uniform over the uint64 space (equal range splits stay balanced).
+    Partition bounds are the equal split of ``[0, 2^64)`` into P ranges,
+    pinned in the meta at creation; routing is a bisect over them. Pairs
+    that the routing separates are exactly the federation's boundary
+    problem — covered by the band-key-sharded LSH join in federation.py.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+
+import numpy as np
+
+from drep_tpu.errors import UserInputError
+
+META_NAME = "federation.json"
+FED_FORMAT = 1
+MAX_PARTITIONS = 999  # part_%03d naming
+
+_U64 = 1 << 64
+
+
+def meta_path(location: str) -> str:
+    return os.path.join(os.path.abspath(location), META_NAME)
+
+
+def is_federated(location: str) -> bool:
+    return os.path.exists(meta_path(location))
+
+
+def partition_dir_name(pid: int) -> str:
+    return f"part_{pid:03d}"
+
+
+def partition_bounds(n_partitions: int) -> list[tuple[int, int]]:
+    """Equal split of the uint64 code space into `n_partitions` ranges —
+    the rangepart idiom (disjoint, covering, monotone) applied to the
+    routing code space. Pinned into the meta at federation creation."""
+    if not 2 <= n_partitions <= MAX_PARTITIONS:
+        raise UserInputError(
+            f"--partitions must be in [2, {MAX_PARTITIONS}] (got "
+            f"{n_partitions}); a 1-partition federation is just a plain "
+            f"index — use `index build` without --partitions"
+        )
+    edges = [i * _U64 // n_partitions for i in range(n_partitions + 1)]
+    return [(edges[i], edges[i + 1]) for i in range(n_partitions)]
+
+
+def route_code(bottom: np.ndarray) -> int:
+    """The genome's sketch-derived range code: splitmix64-finalized
+    smallest bottom-sketch hash. Deterministic per genome CONTENT (the
+    sketch is the genome's identity in this system), uniform over
+    ``[0, 2^64)`` whatever the genome's size."""
+    if len(bottom) == 0:
+        return 0
+    x = int(bottom[0]) & (_U64 - 1)
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & (_U64 - 1)
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & (_U64 - 1)
+    return x ^ (x >> 31)
+
+
+def route_partition(code: int, bounds: list) -> int:
+    """bisect the pinned range bounds — the rule every admission and
+    every query router shares (a genome can never silently move)."""
+    los = [int(lo) for lo, _hi in bounds]
+    pid = bisect.bisect_right(los, int(code)) - 1
+    return max(0, min(pid, len(bounds) - 1))
+
+
+def read_meta(location: str) -> dict:
+    """The federation root document. Corruption is fatal by design, like
+    a store manifest: the meta is tiny, rewritten every federation
+    generation, and carries the only record of which partition
+    generations belong together."""
+    from drep_tpu.utils.durableio import CorruptPayloadError, read_json_checked
+
+    path = meta_path(location)
+    if not os.path.exists(path):
+        raise UserInputError(
+            f"{location} is not a federated genome index (no {META_NAME}); "
+            f"create one with `drep-tpu index build --partitions N`"
+        )
+    try:
+        m = read_json_checked(path, what="federation meta-manifest")
+    except CorruptPayloadError as e:
+        raise UserInputError(
+            f"federation meta-manifest {path} is corrupt ({e}); restore it "
+            f"from a backup — the partition stores underneath are intact, "
+            f"but only the meta records which generations belong together"
+        ) from e
+    if not isinstance(m, dict) or m.get("format") != FED_FORMAT:
+        raise UserInputError(
+            f"federation meta-manifest {path} has unsupported format "
+            f"{m.get('format') if isinstance(m, dict) else type(m).__name__!r} "
+            f"(this build reads format {FED_FORMAT})"
+        )
+    return m
+
+
+def publish_meta(location: str, meta: dict) -> None:
+    """THE federation commit point: every partition publish and every
+    federation-level shard written before this is invisible to federated
+    readers; after it, the recorded (range, generation, checksum)
+    triples ARE the federation generation."""
+    from drep_tpu.utils import faults, telemetry
+    from drep_tpu.utils.durableio import atomic_write_json
+
+    faults.fire("meta_publish")  # the chaos cells' deterministic kill point
+    atomic_write_json(meta_path(location), meta)
+    telemetry.event(
+        "federation_generation",
+        generation=int(meta.get("generation", -1)),
+        n_genomes=int(meta.get("n_genomes", 0)),
+        n_partitions=int(meta.get("n_partitions", 0)),
+    )
+
+
+def manifest_crc(part_location: str) -> int | None:
+    """The in-band "crc" of a partition's CURRENT manifest — what the
+    meta records at publish so a federated load can prove the partition
+    manifest it reads is the exact one the federation generation was
+    committed against (same-generation swap detection)."""
+    from drep_tpu.utils import durableio
+
+    try:
+        body = durableio.read_json_unverified(
+            os.path.join(part_location, "manifest.json"), what="manifest"
+        )
+    except (OSError, ValueError):
+        return None
+    if isinstance(body, dict):
+        crc = body.get(durableio.JSON_CRC_KEY)
+        return int(crc) if crc is not None else None
+    return None
+
+
+def current_generation(location: str) -> int:
+    """The published generation of a plain OR federated index — the one
+    read the serve daemon's hot-swap poller needs (read-only: a checked
+    JSON read either way)."""
+    if is_federated(location):
+        return int(read_meta(location).get("generation", -1))
+    from drep_tpu.index.store import IndexStore
+
+    return int(IndexStore(location).read_manifest().get("generation", -1))

@@ -336,7 +336,18 @@ def load_index(location: str, heal: bool = False) -> LoadedIndex:
     flagged (``state_missing``) for the caller to recluster. `heal=False`
     (classify — read-only by contract) raises an actionable error instead
     of touching the store.
+
+    A FEDERATED root (index/federation.py — ``federation.json`` above N
+    partition stores) loads transparently as the assembled union at the
+    meta-manifest's generation, so classify and the serve daemon consume
+    either store shape through this one front door.
     """
+    from drep_tpu.index import meta as fedmeta
+
+    if fedmeta.is_federated(location):
+        from drep_tpu.index.federation import load_federated
+
+        return load_federated(location, heal=heal)
     from drep_tpu.utils import durableio
 
     logger = get_logger()

@@ -246,13 +246,39 @@ def recluster(idx: LoadedIndex, n_old: int, processes: int = 1) -> dict:
         "processes": processes,
         "mesh_shape": None,
     }
+    # incremental verdict assembly (ISSUE 13 satellite): only a touched
+    # cluster's winner can change, so the winner table is SPLICED — reused
+    # clusters keep their old winner row verbatim (identical member sets
+    # have identical scores), recomputed clusters pick locally — instead
+    # of re-running choose.pick_winners + the score pandas path over all
+    # N per batch (the serving tier's per-query recluster floor). The
+    # argmax/tie rule is pick_winners' exactly (score desc, genome asc;
+    # output ordered by cluster name ascending), oracle-pinned in tests.
     reused = recomputed = 0
+    win_rows: list[tuple[str, str, float]] = []  # (cluster, genome, score)
+    old_win: dict[str, tuple[str, float]] = {}
+    if old_groups:
+        for row in idx.winners.itertuples():
+            old_win[str(row.cluster)] = (str(row.genome), float(row.score))
+
+    def _pick(cands: list[tuple[str, float]]) -> tuple[str, float]:
+        return min(cands, key=lambda t: (-t[1], t[0]))
+
     for pc, members in enumerate(groups, start=1):
         fs = frozenset(members)
         if fs in old_groups:
             suffix[members] = old_suffix[members]
             score[members] = old_score[members]
             reused += 1
+            by_s: dict[int, list[int]] = {}
+            for i in members:
+                by_s.setdefault(int(old_suffix[i]), []).append(i)
+            for s_val, mem in sorted(by_s.items()):
+                old_name = f"{int(old_primary[mem[0]])}_{s_val}"
+                won = old_win.get(old_name) or _pick(
+                    [(idx.names[i], float(old_score[i])) for i in mem]
+                )
+                win_rows.append((f"{pc}_{s_val}", won[0], won[1]))
             continue
         recomputed += 1
         if len(members) == 1:
@@ -261,30 +287,33 @@ def recluster(idx: LoadedIndex, n_old: int, processes: int = 1) -> dict:
             score[i] = _score_cluster(
                 idx, members, [f"{pc}_1"], pd.DataFrame({"querry": [], "reference": [], "ani": []})
             )[0]
+            win_rows.append((f"{pc}_1", idx.names[i], float(score[i])))
             continue
         ndb, labs, _link = secondary_for_cluster(gs, bdb, list(members), pc, kw)
         suffix[members] = labs
         sec_names = [f"{pc}_{int(l)}" for l in labs]
         score[members] = _score_cluster(idx, list(members), sec_names, ndb)
+        by_s = {}
+        for i, lab in zip(members, labs):
+            by_s.setdefault(int(lab), []).append(i)
+        for s_val, mem in sorted(by_s.items()):
+            won = _pick([(idx.names[i], float(score[i])) for i in mem])
+            win_rows.append((f"{pc}_{s_val}", won[0], won[1]))
 
     idx.primary = labels
     idx.suffix = suffix
     idx.score = score
-    # winners: one deterministic global pass over (cluster, score, name) —
-    # the same argmax/tie rule as choose.pick_winners
-    from drep_tpu.choose import pick_winners
-
-    sdb_like = pd.DataFrame(
+    win_rows.sort(key=lambda r: r[0])  # pick_winners' output order
+    idx.winners = pd.DataFrame(
         {
-            "genome": idx.names,
-            "secondary_cluster": idx.secondary_names(),
-            "score": score,
+            "cluster": [r[0] for r in win_rows],
+            "genome": [r[1] for r in win_rows],
+            "score": np.array([r[2] for r in win_rows], np.float64),
         }
     )
-    idx.winners = pick_winners(sdb_like)[["cluster", "genome", "score"]]
     return {
         "primary_clusters": int(labels.max()) if n else 0,
-        "secondary_clusters": int(sdb_like["secondary_cluster"].nunique()),
+        "secondary_clusters": len(win_rows),
         "components_reclustered": reclustered_comps,
         "clusters_reused": reused,
         "clusters_recomputed": recomputed,
@@ -385,20 +414,35 @@ def publish_generation(
 def index_update(
     index_loc: str, genome_paths: list[str] | None, processes: int = 1,
     primary_prune: str = "off", prune_bands: int = 0, prune_min_shared: int = 0,
-    prune_join_chunk: int = 0,
+    prune_join_chunk: int = 0, fed_pods: int | None = None,
 ) -> dict:
     """`index update`: admit K new genomes (sketch K, compare K x N,
     re-cluster dirty components, re-score touched clusters) and publish
     the next generation. With no genomes this is a pure HEAL pass:
     corrupt/missing shards repair and the generation stays put.
 
+    A FEDERATED root (index/federation.py) takes this same front door:
+    the batch routes to range partitions by sketch-derived code, each
+    dirty partition updates as an independent unit (``fed_pods`` > 0
+    runs them as concurrent subprocess pods), and the federation
+    generation publishes through the meta-manifest.
+
     `primary_prune="lsh"` routes the rect compare through the LSH
     candidate set (see _rect_edges) — a per-invocation execution knob,
     never pinned in the manifest, because the admitted edges are
     identical either way (recall 1.0 at the retention bound)."""
+    from drep_tpu.index import meta as fedmeta
     from drep_tpu.utils import faults
     from drep_tpu.utils.profiling import counters
 
+    if fedmeta.is_federated(index_loc):
+        from drep_tpu.index.federation import fed_update
+
+        return fed_update(
+            index_loc, genome_paths, processes=processes, fed_pods=fed_pods,
+            primary_prune=primary_prune, prune_bands=prune_bands,
+            prune_min_shared=prune_min_shared, prune_join_chunk=prune_join_chunk,
+        )
     logger = get_logger()
     store = IndexStore(index_loc)
     idx = load_index(index_loc, heal=True)

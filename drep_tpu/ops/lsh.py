@@ -224,7 +224,7 @@ def _iter_pair_codes(starts, sizes, g_sorted, n: int, chunk: int):
             yield _codes(members[:, ai].ravel(), members[:, bi].ravel(), n)
 
 
-def _join_codes(code_batches) -> tuple[np.ndarray, np.ndarray]:
+def merge_code_counts(code_batches) -> tuple[np.ndarray, np.ndarray]:
     """Fold pair-code batches into (unique codes, per-code counts)
     WITHOUT concatenating the duplicate-heavy expansion: each batch is
     uniqued locally and two-way SORTED-MERGED into the running
@@ -233,7 +233,13 @@ def _join_codes(code_batches) -> tuple[np.ndarray, np.ndarray]:
     peak memory is O(output + one batch) instead of O(total expanded
     pairs). Identical output to ``np.unique(concat,
     return_counts=True)`` (counts are additive over any partition of the
-    multiset) — the property tests pin it."""
+    multiset) — the property tests pin it.
+
+    Public since the federated index (index/federation.py): the same
+    fold that bounds the single-host ``--prune_join_chunk`` join is the
+    merge step of the federation's band-key-sharded boundary join — each
+    range shard's (code, count) partial (computable by an independent
+    process) folds in through exactly this accumulator."""
     codes = np.empty(0, np.int64)
     counts = np.empty(0, np.int64)
     for batch in code_batches:
@@ -275,7 +281,7 @@ def build_candidates(
     expansion and runs ONE ``np.unique`` over it — fine to ~1M genomes
     on a fat host; > 0 bounds the join's working set to ~that many codes
     at a time (chunked expansion + incremental sorted-merge fold,
-    :func:`_join_codes`) so thin hosts survive beyond-1M runs. A pure
+    :func:`merge_code_counts`) so thin hosts survive beyond-1M runs. A pure
     execution knob: the candidate set is IDENTICAL for every value
     (property-tested), so it is deliberately NOT pinned into the
     checkpoint meta params — resuming under a different chunk size is
@@ -315,7 +321,7 @@ def build_candidates(
     # (default), or the memory-bounded chunked fold (join_chunk > 0) —
     # identical (codes, counts) either way
     if join_chunk > 0:
-        uniq, shared = _join_codes(
+        uniq, shared = merge_code_counts(
             _iter_pair_codes(starts, sizes, g_sorted, n, join_chunk)
         )
     else:

@@ -36,6 +36,38 @@ from drep_tpu.ops.minhash import PAD_ID, U16_PAD, pad_sentinel
 
 MIN_BUCKET_WIDTH = 128  # lane width — never repack below one full lane row
 
+# raw uint64 sketch hashes -> int32 band codes: drop 34 low bits so the
+# code space is 2^30 (< PAD_ID, so the pad sentinel can never collide
+# with a real code). The map is monotone and many-to-one: two sketches
+# sharing a hash ALWAYS share the code (the recall direction the
+# federated boundary join leans on); distinct hashes may merge into one
+# code (the false-positive direction, paid in candidate count only).
+HASH_CODE_SHIFT = 34
+
+
+def hash_code_matrix(hash_rows: list[np.ndarray], shift: int = HASH_CODE_SHIFT) -> np.ndarray:
+    """Sorted uint64 hash rows (raw bottom sketches) -> one [N, W] int32
+    PAD-padded matrix of DISTINCT sorted band codes per row.
+
+    This is the federation boundary join's front door (index/
+    federation.py): partition stores pack their own LOCAL rank spaces
+    (ops/minhash.pack_sketches ranks are pack-relative, so two
+    partitions' packed ids can never be joined), but the raw hashes are
+    global — shifting them into a shared 2^30 code space gives every
+    partition the same monotone banding, and the result is exactly the
+    sorted-distinct-id layout :func:`partition_by_range` shards.
+    """
+    n = len(hash_rows)
+    codes = [
+        np.unique((np.asarray(r, np.uint64) >> np.uint64(shift)).astype(np.int32))
+        for r in hash_rows
+    ]
+    width = max((len(c) for c in codes), default=0)
+    out = np.full((n, max(1, width)), PAD_ID, dtype=np.int32)
+    for i, c in enumerate(codes):
+        out[i, : len(c)] = c
+    return out
+
 
 def vocab_extent(ids: np.ndarray) -> int:
     """1 + max real id (0 when everything is padding) — THE extent rule:

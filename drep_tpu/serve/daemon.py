@@ -54,7 +54,6 @@ from drep_tpu.index.classify import (
     load_resident_index,
     sketch_queries,
 )
-from drep_tpu.index.store import IndexStore
 from drep_tpu.serve import protocol
 from drep_tpu.serve.batcher import AdmissionQueue, PendingRequest
 from drep_tpu.utils import telemetry
@@ -298,16 +297,18 @@ class IndexServer:
 
     # ---- generation hot-swap --------------------------------------------
     def _poll_generations(self) -> None:
-        """Re-read the manifest on a cadence; a bumped generation loads
+        """Re-read the published generation on a cadence; a bump loads
         into a NEW resident object and swaps in atomically (one
         reference assignment — in-flight batches keep the old object).
-        The pure-reader contract holds: polling is a read_manifest, the
-        reload is load_index(heal=False)."""
-        store = IndexStore(self.cfg.index_loc)
+        The pure-reader contract holds: polling is a checked JSON read
+        (the store manifest, or a federated root's meta-manifest —
+        index/meta.py resolves either shape), the reload is
+        load_index(heal=False)."""
+        from drep_tpu.index import meta as fedmeta
+
         while not self._stop_poll.wait(max(0.05, float(self.cfg.poll_generation_s))):
             try:
-                manifest = store.read_manifest()
-                gen = int(manifest.get("generation", -1))
+                gen = fedmeta.current_generation(self.cfg.index_loc)
             except Exception:  # noqa: BLE001 — a torn/in-flight publish reads as "not yet"
                 continue
             if self._resident is None or gen <= int(self._resident.generation):
@@ -378,19 +379,41 @@ class IndexServer:
     def _pending_update_status(self) -> dict | None:
         """pod_status.collect() over the newest in-flight update pod (if
         any) — the daemon's health view names the very update whose
-        publish it will hot-swap to. Best-effort: the tool lives in
-        tools/ (repo layout); when unreachable the field is omitted."""
-        pending = os.path.join(os.path.abspath(self.cfg.index_loc), "pending")
+        publish it will hot-swap to. A federated root's pending stores
+        live under its partitions, so those are scanned too. Best-effort:
+        the tool lives in tools/ (repo layout); when unreachable the
+        field is omitted."""
+        root = os.path.abspath(self.cfg.index_loc)
+        pending_dirs = [os.path.join(root, "pending")]
         try:
-            gens = sorted(
-                d for d in os.listdir(pending)
-                if d.startswith("g") and os.path.isdir(os.path.join(pending, d))
+            pending_dirs += sorted(
+                os.path.join(root, d, "pending")
+                for d in os.listdir(root)
+                if d.startswith("part_") and os.path.isdir(os.path.join(root, d))
             )
         except OSError:
+            pass
+        candidates: list[tuple[float, str]] = []
+        for pending in pending_dirs:
+            try:
+                gens = [
+                    d for d in os.listdir(pending)
+                    if d.startswith("g") and os.path.isdir(os.path.join(pending, d))
+                ]
+            except OSError:
+                continue
+            for d in gens:
+                path = os.path.join(pending, d)
+                try:
+                    candidates.append((os.stat(path).st_mtime, path))
+                except OSError:
+                    continue
+        if not candidates:
             return None
-        if not gens:
-            return None
-        ckpt = os.path.join(pending, gens[-1])
+        # the NEWEST in-flight pod across the root and every partition —
+        # concurrent --fed_pods updates leave several; mtime picks the
+        # most recently active one, not the highest-numbered directory
+        ckpt = max(candidates)[1]
         try:
             collect = _pod_status_collect()
             if collect is None:

@@ -160,6 +160,19 @@ _declare(
     "Prometheus textfile flush cadence (s) for <wd>/log/metrics.prom; "
     "0 = off.",
 )
+# -- federated index ---------------------------------------------------------
+_declare(
+    "DREP_TPU_FED_PODS", "int", 0,
+    "Federated `index update`: run per-partition updates as up to this many "
+    "CONCURRENT subprocess pods (index/federation.py); 0 = in-process, one "
+    "partition at a time. The CLI --fed_pods overrides.",
+)
+_declare(
+    "DREP_TPU_FED_SHARD_MAX", "int", 4096,
+    "Boundary-bucket cross-partition join: max repacked band-code bucket "
+    "width per range shard (pow2; rangepart.partition_by_range). Execution "
+    "knob only — the candidate set is identical for every value.",
+)
 # -- ingest ------------------------------------------------------------------
 _declare(
     "DREP_TPU_INGEST_BARRIER_S", "float", 600.0,

@@ -75,6 +75,11 @@ SITES = (
     "index_update",  # per-update-batch points, drep_tpu/index/update.py
     # (fires at batch admission AND again just before the manifest
     # publish — skip=1 targets the pre-publish point deterministically)
+    "partition_update",  # per-partition point of a federated update,
+    # drep_tpu/index/federation.py (fires once before EACH dirty
+    # partition's update dispatch — skip=N targets partition N+1)
+    "meta_publish",  # just before the federation meta-manifest's atomic
+    # publish, drep_tpu/index/federation.py (the federation commit point)
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):

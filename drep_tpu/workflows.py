@@ -145,17 +145,32 @@ def index_build_wrapper(
     work_directory: str | None = None, **kwargs,
 ) -> dict:
     """`index build`: generation 0 from a completed workdir snapshot
-    (--work_directory) or bootstrapped from FASTAs (-g)."""
-    from drep_tpu.index import build_from_paths, build_from_workdir
+    (--work_directory) or bootstrapped from FASTAs (-g). With
+    ``--partitions N`` the bootstrap creates a FEDERATED index
+    (index/federation.py): N range-partitioned stores under one
+    meta-manifest, the whole input admitted as federation generation 0."""
+    from drep_tpu.index import build_federated, build_from_paths, build_from_workdir
 
     _init_index(index_loc)
     if work_directory and genomes:
         raise UserInputError(
             "index build takes --work_directory OR -g genomes, not both"
         )
+    partitions = int(kwargs.pop("partitions", 0) or 0)
     if work_directory:
+        if partitions:
+            raise UserInputError(
+                "index build --partitions is a bootstrap (-g) mode: a "
+                "workdir snapshot has no per-genome routing pass — build "
+                "federated from the FASTAs instead"
+            )
         return build_from_workdir(index_loc, work_directory)
     if genomes:
+        if partitions:
+            return build_federated(
+                index_loc, genomes, partitions,
+                processes=kwargs.pop("processes", 1) or 1, **kwargs,
+            )
         return build_from_paths(
             index_loc, genomes,
             processes=kwargs.pop("processes", 1) or 1, **kwargs,
@@ -169,7 +184,9 @@ def index_build_wrapper(
 def index_update_wrapper(
     index_loc: str, genomes: list[str] | None = None, **kwargs
 ) -> dict:
-    """`index update`: admit a batch (or heal, with no genomes)."""
+    """`index update`: admit a batch (or heal, with no genomes). A
+    federated root routes by range code and updates partitions as
+    independent units (``--fed_pods`` for concurrent subprocess pods)."""
     from drep_tpu.index import index_update
 
     _init_index(index_loc)
@@ -179,6 +196,7 @@ def index_update_wrapper(
         prune_bands=kwargs.get("prune_bands", 0) or 0,
         prune_min_shared=kwargs.get("prune_min_shared", 0) or 0,
         prune_join_chunk=kwargs.get("prune_join_chunk", 0) or 0,
+        fed_pods=kwargs.get("fed_pods"),
     )
 
 

@@ -90,6 +90,31 @@ def tree_digest(root: str, exclude_dirs: tuple[str, ...] = ("log",)) -> dict[str
     return out
 
 
+def assert_stores_equal(got: str, want: str) -> None:
+    """Byte-identical modulo npz zip timestamps, store- or federation-
+    wide: same relative file set (log dirs excluded), every JSON family
+    (manifests, federation.json) byte-equal, every npz payload
+    array-equal. The recovery-convergence comparison the index and
+    federation chaos suites share."""
+
+    def files(root):
+        out = set()
+        for dirpath, dirs, fs in os.walk(root):
+            dirs[:] = [d for d in dirs if d != "log"]
+            for f in fs:
+                out.add(os.path.relpath(os.path.join(dirpath, f), root))
+        return out
+
+    assert files(got) == files(want)
+    for rel in sorted(files(got)):
+        a, b = os.path.join(got, rel), os.path.join(want, rel)
+        if rel.endswith(".json"):
+            with open(a, "rb") as fa, open(b, "rb") as fb:
+                assert fa.read() == fb.read(), f"JSON differs after recovery: {rel}"
+        elif rel.endswith(".npz"):
+            assert npz_payloads_equal(a, b), f"payload differs after recovery: {rel}"
+
+
 def npz_payloads_equal(a: str, b: str) -> bool:
     """Semantic npz equality (member names + exact array bytes) — the
     'byte-identical modulo timestamps' comparison: zip containers embed

@@ -1,0 +1,363 @@
+"""Federated index (ISSUE 13): the pinned invariant and the new surface.
+
+The acceptance contract: a range-partitioned federation — built whole or
+grown through update batches including the K=1 trickle — yields cluster
+labels (up to renumbering) and winner sets IDENTICAL to a from-scratch
+`dereplicate` on the union, across >= 3 partition counts, with
+near-boundary pairs (secondary clusters the routing splits across
+partitions) genuinely exercised; `index classify` consumes the federated
+store transparently and read-only; the scrubber and pod_status learn the
+federated families; per-partition updates can run as independent
+subprocess pods.
+"""
+
+import json
+import os
+import shutil
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import (  # noqa: E402
+    build_federated,
+    build_from_paths,
+    index_classify,
+    index_update,
+    load_index,
+)
+from drep_tpu.index import meta as fedmeta  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# 7 genomes in 3 groups, seed 3: the routing (content-deterministic)
+# splits BOTH multi-member groups across partitions at P=3 — the
+# adversarial near-boundary layout the cross-partition join must cover
+GROUPS = [3, 2, 2]
+SEED = 3
+
+
+@pytest.fixture(scope="module")
+def fed_genomes(tmp_path_factory):
+    td = tmp_path_factory.mktemp("fed_genomes")
+    return lib.write_genome_set(str(td), GROUPS, seed=SEED)
+
+
+@pytest.fixture(scope="module")
+def fed_oracle(tmp_path_factory, fed_genomes):
+    """From-scratch dereplicate on the union — the invariant's oracle
+    (streaming primary, the numerics every index compare shares)."""
+    from drep_tpu.workflows import dereplicate_wrapper
+
+    wd = str(tmp_path_factory.mktemp("fed_oracle_wd"))
+    wdb = dereplicate_wrapper(
+        wd, fed_genomes, skip_plots=True, streaming_primary=True, length=0
+    )
+    cdb = pd.read_csv(os.path.join(wd, "data_tables", "Cdb.csv"))
+    prim: dict[int, set] = {}
+    sec: dict[str, set] = {}
+    for g, p, s in zip(cdb["genome"], cdb["primary_cluster"], cdb["secondary_cluster"]):
+        prim.setdefault(int(p), set()).add(g)
+        sec.setdefault(str(s), set()).add(g)
+    by = cdb.set_index("genome")["secondary_cluster"]
+    winners = {}
+    for row in wdb.itertuples():
+        members = frozenset(g for g in cdb["genome"] if by[g] == row.cluster)
+        winners[members] = row.genome
+    return (
+        set(map(frozenset, prim.values())),
+        set(map(frozenset, sec.values())),
+        winners,
+    )
+
+
+def _assert_matches_oracle(idx, oracle):
+    po, so, wo = oracle
+    assert lib.primary_partition(idx) == po
+    assert lib.secondary_partition(idx) == so
+    assert lib.winners_by_members(idx) == wo
+
+
+def _spanning_clusters(idx) -> int:
+    """How many secondary clusters span >= 2 partitions — the
+    near-boundary pairs only the cross-partition join can connect."""
+    part_of = idx.fed_part_of
+    spans = 0
+    for members in lib.secondary_partition(idx):
+        name_to_i = {g: i for i, g in enumerate(idx.names)}
+        if len({int(part_of[name_to_i[g]]) for g in members}) >= 2:
+            spans += 1
+    return spans
+
+
+@pytest.fixture(scope="module")
+def fed_store(tmp_path_factory, fed_genomes):
+    """The shared federated store: P=3, built from a base then grown by
+    a batch and a K=1 trickle (the schedule the acceptance names)."""
+    loc = str(tmp_path_factory.mktemp("fed_idx") / "fed")
+    build_federated(loc, fed_genomes[:4], 3, length=0)
+    s1 = index_update(loc, fed_genomes[4:6])
+    s2 = index_update(loc, fed_genomes[6:])  # K=1 trickle
+    assert (s1["generation"], s2["generation"]) == (1, 2)
+    assert s1["admitted"] == 2 and s2["admitted"] == 1
+    return loc
+
+
+@pytest.mark.parametrize("partitions", [2, 5])
+def test_federated_build_matches_union_oracle(
+    tmp_path, fed_genomes, fed_oracle, partitions
+):
+    """Whole-set federated build == from-scratch dereplicate on the
+    union, at two more partition counts (P=3 is the grown fed_store
+    below — >= 3 partition counts total, as the acceptance pins)."""
+    loc = str(tmp_path / "fed")
+    summary = build_federated(loc, fed_genomes, partitions, length=0)
+    assert summary["generation"] == 0
+    assert summary["n_genomes"] == len(fed_genomes)
+    idx = load_index(loc)
+    assert idx.generation == 0
+    _assert_matches_oracle(idx, fed_oracle)
+    m = fedmeta.read_meta(loc)
+    assert m["n_partitions"] == partitions
+    assert sum(e["n_genomes"] for e in m["partitions"]) == len(fed_genomes)
+
+
+def test_federated_trickle_updates_match_oracle(fed_store, fed_oracle):
+    """Base build + batch + K=1 trickle on a P=3 federation == the
+    from-scratch union, with near-boundary pairs PROVABLY exercised:
+    at least one secondary cluster spans two partitions, so dropping
+    the boundary join could not pass this test."""
+    idx = load_index(fed_store)
+    assert idx.generation == 2
+    _assert_matches_oracle(idx, fed_oracle)
+    assert _spanning_clusters(idx) >= 1, (
+        "no secondary cluster spans partitions — the near-boundary "
+        "adversarial layout regressed (routing or seeds changed?)"
+    )
+    # the cross family holds real boundary edges
+    m = fedmeta.read_meta(fed_store)
+    total_cross = 0
+    for e in m["cross_shards"]:
+        with np.load(os.path.join(fed_store, e["file"])) as z:
+            total_cross += len(z["ii"])
+    assert total_cross >= 1
+
+
+def test_federated_classify_transparent_and_read_only(fed_store, tmp_path):
+    """`index classify` consumes the federated root through the same
+    front door as a plain store: an indexed genome answers with its own
+    cluster, a novel genome classifies novel, every verdict is stamped
+    with the FEDERATION generation, and the whole tree (meta, partitions,
+    cross, state) is byte-for-byte unwritten."""
+    idx = load_index(fed_store)
+    member = idx.locations[0]
+    group0 = {g for g, p in zip(idx.names, idx.primary) if p == idx.primary[0]}
+    novel = lib.write_genome_set(str(tmp_path / "q"), [1], seed=97, prefix="q")
+    digest = lib.tree_digest(fed_store, exclude_dirs=("log",))
+    verdicts = index_classify(fed_store, [member] + novel)
+    assert lib.tree_digest(fed_store, exclude_dirs=("log",)) == digest
+    v_member, v_novel = verdicts
+    assert v_member["genome"] == os.path.basename(member)
+    assert not v_member["novel_primary"]
+    assert set(v_member["cluster_members"]) == group0
+    assert v_member["nearest_dist"] == 0.0
+    assert v_novel["novel_primary"] and v_novel["would_win"]
+    assert all(v["generation"] == 2 for v in verdicts)
+
+
+def test_federated_scrub_and_heal_targets_right_partition(fed_store, tmp_path):
+    """The scrubber walks a federated root: federation.json verifies as
+    a checked-JSON family, partitions recurse, and damage is reported
+    WITH the partition id; after --delete, a heal pass on the federation
+    root repairs exactly that partition's store."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "scrub_store", os.path.join(REPO, "tools", "scrub_store.py")
+    )
+    ss = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ss)
+
+    loc = str(tmp_path / "fed_copy")
+    shutil.copytree(fed_store, loc)
+    report = ss.scrub([loc])
+    assert not report["damaged"]
+    # federation.json + 3 partition manifests + cross/state families all
+    # checksum-verified (no legacy payloads in a fresh federation)
+    assert report["verified"] >= 10 and report["legacy"] == 0
+
+    from drep_tpu.utils.durableio import _flip_bit
+
+    control = load_index(loc)
+    victims = sorted(
+        os.path.join(dp, f)
+        for dp, _d, fs in os.walk(loc)
+        for f in fs
+        if f.startswith("sketch_g") and "part_" in dp
+    )
+    _flip_bit(victims[0])
+    part_id = victims[0].split(os.sep)
+    part_id = next(p for p in part_id if p.startswith("part_"))
+    report = ss.scrub([loc])
+    assert report["by_partition"] == {part_id: 1}
+    ss.scrub([loc], delete=True)
+    assert not os.path.exists(victims[0])
+    summary = index_update(loc, None)  # heal pass on the federation root
+    assert any(h.startswith(part_id) for h in summary["healed"])
+    assert os.path.exists(victims[0])
+    healed = load_index(loc)
+    assert healed.names == control.names
+    np.testing.assert_array_equal(healed.primary, control.primary)
+    assert not ss.scrub([loc])["damaged"]
+
+
+def test_pod_status_renders_federated_store(fed_store):
+    """pod_status on a federated root: one row per partition (recorded
+    vs actual generation), a federation summary line, byte-for-byte
+    read-only — reusing the existing collect path for any in-flight
+    update pods."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "pod_status", os.path.join(REPO, "tools", "pod_status.py")
+    )
+    ps = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ps)
+
+    digest = lib.tree_digest(fed_store, exclude_dirs=("log",))
+    status = ps.collect_federation(fed_store)
+    assert lib.tree_digest(fed_store, exclude_dirs=("log",)) == digest
+    assert status["generation"] == 2 and status["n_partitions"] == 3
+    assert len(status["partitions"]) == 3
+    assert status["summary"]["clean"] == 3
+    assert all(e["state"] == "clean" for e in status["partitions"])
+    text = ps.render_federation(status)
+    assert "part_000" in text and "3 clean" in text
+    # the dispatching front door picks the federation view for a fed root
+    assert "federation" in ps._collect_any(fed_store)
+    m = json.load(open(fedmeta.meta_path(fed_store)))
+    assert int(m["generation"]) == 2
+
+
+@pytest.mark.slow  # two subprocess pods = two JAX imports; the tier-1
+# budget is knife-edge and the CLI-subprocess path is already exercised
+# per-commit by the federation chaos cells (which run the real CLI)
+def test_fed_pods_subprocess_update_matches_in_process(tmp_path):
+    """The multi-process story: `--fed_pods 2` runs the two dirty
+    partitions as CONCURRENT subprocess pods (each the ordinary CLI
+    `index update` on one partition store); the resulting federation is
+    byte-identical (modulo npz timestamps) to the in-process control."""
+    base = lib.write_genome_set(str(tmp_path / "base"), [2, 1], seed=72)
+    batch = lib.write_genome_set(str(tmp_path / "batch"), [1, 1], seed=73, prefix="n")
+    loc = str(tmp_path / "fed")
+    build_federated(loc, base, 2, length=0)
+    control = str(tmp_path / "control")
+    shutil.copytree(loc, control)
+    s_ctrl = index_update(control, batch)
+    assert len(s_ctrl["partitions_updated"]) == 2  # genuinely two pods' worth
+    s_pods = index_update(loc, batch, fed_pods=2)
+    assert s_pods["generation"] == s_ctrl["generation"] == 1
+    assert not s_pods["partitions_failed"]
+    lib.assert_stores_equal(loc, control)
+
+
+def test_build_refuses_federated_misuse(tmp_path, fed_genomes):
+    from drep_tpu.errors import UserInputError
+
+    with pytest.raises(UserInputError, match="partitions"):
+        build_federated(str(tmp_path / "f1"), fed_genomes, 1)
+    loc = str(tmp_path / "f2")
+    build_federated(loc, fed_genomes[:2], 2, length=0)
+    with pytest.raises(UserInputError, match="refuses to overwrite"):
+        build_federated(loc, fed_genomes, 2)
+    with pytest.raises(UserInputError, match="refuses to overwrite"):
+        build_from_paths(loc, fed_genomes)
+    # duplicate basenames refuse at the federation front door
+    with pytest.raises(UserInputError, match="already indexed"):
+        index_update(loc, [fed_genomes[0]])
+
+
+def test_interrupted_update_into_empty_partition_must_resume_first(tmp_path):
+    """A meta-empty partition MATERIALIZED by an interrupted update (the
+    partition published, the meta publish did not happen) must not be
+    silently abandoned: a different batch refuses with the resume
+    instruction, re-running the interrupted batch converges."""
+    from drep_tpu.errors import UserInputError
+    from drep_tpu.index import meta as fedmeta
+    from drep_tpu.ingest import make_bdb, sketch_paths
+    from drep_tpu.utils import faults
+
+    base = lib.write_genome_set(str(tmp_path / "g"), [2], seed=72)
+    loc = str(tmp_path / "fed")
+    build_federated(loc, base, 3, length=0)
+    m = fedmeta.read_meta(loc)
+    empty_pids = {
+        int(e["pid"]) for e in m["partitions"] if int(e["n_genomes"]) == 0
+    }
+    assert empty_pids, "seed 72 must leave an empty partition at P=3"
+    bounds = [tuple(e["range"]) for e in m["partitions"]]
+
+    def _routes_to(paths):
+        res = sketch_paths(make_bdb(paths), 21, 1000, 200, "splitmix64")
+        return {
+            fedmeta.route_partition(fedmeta.route_code(res[g]["bottom"]), bounds)
+            for g in res
+        }
+
+    # find a novel genome routing INTO an empty partition, and one that
+    # routes elsewhere (deterministic; bounded seed scan)
+    into_empty = elsewhere = None
+    for seed in range(200, 240):
+        cand = lib.write_genome_set(
+            str(tmp_path / f"c{seed}"), [1], seed=seed, prefix=f"c{seed}_"
+        )
+        dest = _routes_to(cand)
+        if dest & empty_pids and into_empty is None:
+            into_empty = cand
+        elif not (dest & empty_pids) and elsewhere is None:
+            elsewhere = cand
+        if into_empty and elsewhere:
+            break
+    assert into_empty and elsewhere
+
+    # interrupt the update AFTER the partition materialized, BEFORE the
+    # meta publish (raise at the commit point — in-process kill stand-in)
+    faults.configure("meta_publish:raise:1.0")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            index_update(loc, into_empty)
+    finally:
+        faults.configure(None)
+    assert fedmeta.read_meta(loc)["generation"] == 0  # commit never happened
+
+    # a DIFFERENT batch must refuse with the resume instruction
+    with pytest.raises(UserInputError, match="interrupted earlier update"):
+        index_update(loc, elsewhere)
+    # re-running the interrupted batch converges
+    summary = index_update(loc, into_empty)
+    assert summary["generation"] == 1 and summary["admitted"] == 1
+    assert sorted(load_index(loc).names) == sorted(
+        os.path.basename(p) for p in base + into_empty
+    )
+
+
+def test_fed_fault_site_spec_validation():
+    """The partition_update/meta_publish fault sites exist and reject
+    no-op mode combos at parse time (the lint coverage contract)."""
+    from drep_tpu.utils import faults
+
+    faults.configure("partition_update:kill:1.0:skip=1")  # the chaos cells'
+    faults.configure("meta_publish:kill:1.0")
+    faults.configure("partition_update:raise:0.5:seed=1")
+    for bad in (
+        "partition_update:torn",  # torn is shard_write-only
+        "meta_publish:io_error",  # io modes live on the io site
+        "meta_publish:raise:path=federation",  # compute sites carry no path
+    ):
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure(bad)
+    faults.configure(None)

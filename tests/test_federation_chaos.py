@@ -1,0 +1,153 @@
+"""Chaos cells for the federated index (ISSUE 13).
+
+The acceptance contract: SIGKILL mid-partition-update and mid-meta-
+publish both leave federated READERS at the old federation generation
+(the stale meta-manifest never exposes a half-published generation —
+partitions that published ahead are truncated out of the union view),
+and a rerun of the same update converges on an uninterrupted control
+byte-identically (modulo npz zip timestamps). A partition-level FAILURE
+(not a kill) is tolerated with an honest partial publish: the failed
+partition stays at its old generation and the meta names the unadmitted
+genomes. All CPU-only under the `chaos` marker, wired into
+``tools/chaos_matrix.py --federated``.
+
+The kill cells run the real CLI (`python -m drep_tpu index update` on
+the federated root) as a subprocess victim with deterministic
+``partition_update:kill`` / ``meta_publish:kill`` fault specs.
+"""
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import build_federated, index_update, load_index  # noqa: E402
+from drep_tpu.index import meta as fedmeta  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _setup(tmp_path, partitions=2, seed=72):
+    """Federated base index + a batch routed to BOTH partitions, plus an
+    uninterrupted CONTROL copy of the same update."""
+    base = lib.write_genome_set(str(tmp_path / "base"), [2, 1], seed=seed)
+    batch = lib.write_genome_set(
+        str(tmp_path / "batch"), [1, 1], seed=seed + 1, prefix="n"
+    )
+    loc = str(tmp_path / "fed")
+    build_federated(loc, base, partitions, length=0)
+    control = str(tmp_path / "control")
+    shutil.copytree(loc, control)
+    summary = index_update(control, batch)
+    # the cell needs >= 2 dirty partitions so a skip=1 kill lands BETWEEN
+    # partition publishes — the seeds above route the two new genomes to
+    # different partitions (routing is content-deterministic)
+    assert len(summary["partitions_updated"]) >= 2, summary
+    return loc, control, batch
+
+
+def _update_subprocess(loc: str, batch: list[str], fault_spec: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DREP_TPU_FAULTS"] = fault_spec
+    return subprocess.run(
+        [sys.executable, "-m", "drep_tpu", "index", "update", loc, "-g", *batch],
+        capture_output=True, text=True, cwd=REPO, timeout=300, env=env,
+    )
+
+
+_assert_fed_stores_equal = lib.assert_stores_equal
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_partition_update_rerun_converges(tmp_path):
+    """SIGKILL between partition publishes (partition_update:kill:skip=1
+    fires before the SECOND dirty partition's update): one partition is
+    ahead of the meta, yet readers still see the old federation
+    generation exactly — and the rerun skips the already-admitted
+    partition, finishes the rest, and converges on the control."""
+    loc, control, batch = _setup(tmp_path)
+    before = load_index(loc)
+    res = _update_subprocess(loc, batch, "partition_update:kill:1.0:skip=1")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    # stale meta: the union view is EXACTLY the old generation — the
+    # partition that published ahead is truncated out
+    m = fedmeta.read_meta(loc)
+    assert int(m["generation"]) == 0
+    stale = load_index(loc)
+    assert stale.generation == 0 and stale.n == before.n
+    assert stale.names == before.names
+    # at least one partition really did publish ahead (the kill was
+    # mid-flight, not before any work)
+    ahead = [
+        e for e in m["partitions"]
+        if os.path.exists(os.path.join(loc, e["dir"], "manifest.json"))
+        and load_index(os.path.join(loc, e["dir"])).generation
+        > int(e["generation"])
+    ]
+    assert ahead, "the kill left no partition ahead of the meta"
+    summary = index_update(loc, batch)  # the rerun, no faults
+    assert summary["generation"] == 1 and not summary["partitions_failed"]
+    _assert_fed_stores_equal(loc, control)
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_meta_publish_resumes(tmp_path):
+    """SIGKILL at the federation commit point (meta_publish:kill fires
+    just before the atomic meta write): EVERY partition is ahead and the
+    federation shards are already on disk, yet the stale meta keeps
+    readers at the old generation; the rerun recomputes the federation
+    families deterministically and publishes — byte-identical to the
+    uninterrupted control."""
+    loc, control, batch = _setup(tmp_path)
+    before = load_index(loc)
+    res = _update_subprocess(loc, batch, "meta_publish:kill:1.0")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    m = fedmeta.read_meta(loc)
+    assert int(m["generation"]) == 0  # the commit never happened
+    stale = load_index(loc)
+    assert stale.generation == 0 and stale.names == before.names
+    summary = index_update(loc, batch)
+    assert summary["generation"] == 1
+    _assert_fed_stores_equal(loc, control)
+
+
+@pytest.mark.chaos
+def test_partition_failure_publishes_honest_partial(tmp_path):
+    """A partition-level FAILURE (partition_update:raise on the second
+    dirty partition) is tolerated: the failed partition stays at its old
+    generation, the published meta carries the honest `partial` note
+    naming the unadmitted genomes, and re-submitting exactly those
+    genomes converges on the full union."""
+    from drep_tpu.utils import faults
+
+    loc, control, batch = _setup(tmp_path)
+    faults.configure("partition_update:raise:1.0:skip=1")
+    try:
+        summary = index_update(loc, batch)
+    finally:
+        faults.configure(None)
+    assert summary["generation"] == 1
+    assert len(summary["partitions_failed"]) == 1
+    unadmitted = summary["unadmitted"]
+    assert len(unadmitted) == 1
+    m = fedmeta.read_meta(loc)
+    assert m["partial"]["unadmitted"] == unadmitted
+    union = load_index(loc)
+    assert union.n == load_index(control).n - 1  # honest partial union
+    # re-submit ONLY the unadmitted genomes (the summary's instruction)
+    by_name = {os.path.basename(p): p for p in batch}
+    summary2 = index_update(loc, [by_name[g] for g in unadmitted])
+    assert summary2["generation"] == 2 and not summary2["partitions_failed"]
+    got, want = load_index(loc), load_index(control)
+    assert sorted(got.names) == sorted(want.names)
+    assert lib.primary_partition(got) == lib.primary_partition(want)
+    assert lib.secondary_partition(got) == lib.secondary_partition(want)
+    assert lib.winners_by_members(got) == lib.winners_by_members(want)

@@ -321,6 +321,39 @@ def test_update_refuses_duplicate_basenames(tmp_path):
         index_update(loc, [paths[0]])
 
 
+def test_incremental_winner_assembly_matches_pick_winners(tmp_path):
+    """ISSUE 13 satellite (ROADMAP serve follow-on (a)): the recluster's
+    winner table is now SPLICED — reused clusters keep their old winner
+    row, recomputed clusters pick locally — instead of re-running
+    choose.pick_winners + the score pandas path over all N per batch.
+    The oracle guard: the spliced table must equal a full pick_winners
+    pass over the final scores, byte for byte, through an update that
+    actually REUSES clusters (so the spliced path is load-bearing)."""
+    from drep_tpu.choose import pick_winners
+
+    paths = lib.write_genome_set(str(tmp_path / "g"), [3, 2, 1], seed=3)
+    loc = str(tmp_path / "idx")
+    build_from_paths(loc, paths[:5], length=0)
+    summary = index_update(loc, paths[5:])
+    assert summary["clusters_reused"] >= 1  # the spliced path engaged
+    idx = load_index(loc)
+    sdb_like = pd.DataFrame(
+        {
+            "genome": idx.names,
+            "secondary_cluster": idx.secondary_names(),
+            "score": idx.score,
+        }
+    )
+    want = pick_winners(sdb_like)[["cluster", "genome", "score"]]
+    got = idx.winners
+    assert list(got["cluster"]) == list(want["cluster"])
+    assert list(got["genome"]) == list(want["genome"])
+    np.testing.assert_allclose(
+        got["score"].to_numpy(), want["score"].to_numpy(), rtol=0, atol=0
+    )
+    assert summary["secondary_clusters"] == len(want)
+
+
 def test_index_update_fault_site_spec_validation():
     """The index_update fault site exists, and no-op mode combos are
     rejected at parse time (the satellite contract): torn is

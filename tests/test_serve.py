@@ -528,6 +528,56 @@ def test_sigkill_daemon_clean_error_restart_same_generation(tmp_path):
 # ---- satellites ------------------------------------------------------------
 
 
+def test_multi_replica_serve_federated_update_beside(tmp_path):
+    """ISSUE 13 satellite — the multi-replica story the ROADMAP says was
+    never demonstrated: TWO daemons resident on ONE federated index
+    while an `index update` publishes the next federation generation
+    beside them. Both replicas hot-swap without restart, every verdict
+    is generation-stamped and equal to the one-shot answer at its own
+    generation, and the store is byte-for-byte exactly what the update
+    published (the daemons are pure readers)."""
+    from drep_tpu.index import build_federated
+
+    base = lib.write_genome_set(str(tmp_path / "g"), [2, 1], seed=72)
+    batch = lib.write_genome_set(str(tmp_path / "n"), [1, 1], seed=73, prefix="n")
+    loc = str(tmp_path / "fed")
+    build_federated(loc, base, 2, length=0)
+    want_gen0 = index_classify(loc, [base[1]])[0]
+    servers = [
+        _start_server(loc, batch_window_ms=1.0, poll_generation_s=0.1)
+        for _ in range(2)
+    ]
+    try:
+        for _srv, addr, _t in servers:
+            with ServeClient(addr) as c:
+                r = c.classify(base[1])
+            assert r["generation"] == 0 and r["verdict"] == want_gen0
+        # publish federation generation 1 beside the two live daemons
+        # (the batch routes to BOTH partitions — a genuinely federated
+        # update, not a single-store publish)
+        summary = index_update(loc, batch)
+        assert summary["generation"] == 1
+        assert len(summary["partitions_updated"]) == 2
+        digest_after = lib.tree_digest(loc, exclude_dirs=("log",))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not all(
+            s.stats.swaps_total >= 1 for s, _a, _t in servers
+        ):
+            time.sleep(0.05)
+        assert [s.stats.swaps_total for s, _a, _t in servers] == [1, 1]
+        want_gen1 = index_classify(loc, [batch[0]])[0]
+        for _srv, addr, _t in servers:
+            with ServeClient(addr) as c:
+                r = c.classify(batch[0])
+            assert r["generation"] == 1
+            assert r["verdict"] == want_gen1
+    finally:
+        for srv, _addr, t in servers:
+            _stop_server(srv, t)
+    # the daemons wrote nothing: the tree is exactly the update's publish
+    assert lib.tree_digest(loc, exclude_dirs=("log",)) == digest_after
+
+
 def test_pod_status_follow_renders_in_place(tmp_path):
     """--follow: poll + re-render on an interval, read-only, bounded by
     --count for scripting; the snapshot function is the same collect()

@@ -41,6 +41,15 @@ Two tiers:
   result, and ``io:corrupt`` bit rot on index shards self-healing
   through recompute/re-sketch on the next update. Delegate to their
   pytest chaos tests (tests/test_index_chaos.py), CPU-only.
+- federated-index cells (``--federated``): the range-partitioned
+  federation (ISSUE 13, drep_tpu/index/federation.py) — SIGKILL
+  mid-partition-update (a partition published ahead of the meta; the
+  stale meta keeps readers at the old federation generation and the
+  rerun converges byte-identical to an uninterrupted control) and
+  SIGKILL mid-meta-publish (every partition ahead, the meta publish
+  itself the only missing piece — readers still see the old union, the
+  rerun recomputes the federation families deterministically and
+  publishes). Delegate to tests/test_federation_chaos.py, CPU-only.
 - serve cells (``--serve``): the resident serving tier (ISSUE 11,
   drep_tpu/serve/) — SIGKILL the `index serve` daemon mid-batch: every
   connected client gets a clean disconnection error (never a hang or a
@@ -62,6 +71,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py           # in-process grid
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --io      # + storage cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --index   # + index cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --federated # + federation cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --elastic # + join/drain cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --serve   # + serving-tier cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
@@ -446,6 +456,19 @@ INDEX_CELLS = [
 ]
 
 
+# federated-index cells (--federated, ISSUE 13): the range-partitioned
+# federation's crash story. Kill cells need a subprocess victim (the
+# real CLI on a federated root) — delegate to their pytest chaos tests.
+FED_CELLS = [
+    ("partition_update", "kill", "SIGKILL mid-partition-update -> stale meta hides it; rerun converges",
+     "survive", "tests/test_federation_chaos.py::test_sigkill_mid_partition_update_rerun_converges"),
+    ("meta_publish", "kill", "SIGKILL mid-meta-publish -> old generation served; rerun converges",
+     "survive", "tests/test_federation_chaos.py::test_sigkill_mid_meta_publish_resumes"),
+    ("partition_update", "raise", "one partition fails -> honest partial meta publish",
+     "survive", "tests/test_federation_chaos.py::test_partition_failure_publishes_honest_partial"),
+]
+
+
 # elastic membership-churn cells (--elastic, ISSUE 9): the grow-and-drain
 # half of the pod protocol. All four delegate to their multi-process
 # pytest chaos tests (tests/test_elastic_updown.py — each needs a real
@@ -510,6 +533,7 @@ def main() -> int:
     pod = "--pod" in sys.argv
     io_cells = "--io" in sys.argv
     index_cells = "--index" in sys.argv
+    federated_cells = "--federated" in sys.argv
     prune_cells = "--prune" in sys.argv
     elastic_cells = "--elastic" in sys.argv
     serve_cells = "--serve" in sys.argv
@@ -555,6 +579,7 @@ def main() -> int:
 
     _pytest_cells(PRUNE_PYTEST_CELLS, "--prune", prune_cells)
     _pytest_cells(INDEX_CELLS, "--index", index_cells)
+    _pytest_cells(FED_CELLS, "--federated", federated_cells)
     _pytest_cells(ELASTIC_CELLS, "--elastic", elastic_cells)
     _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)

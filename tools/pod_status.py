@@ -14,6 +14,14 @@ Usage::
     python tools/pod_status.py <wd>/data/streaming_primary        # human text
     python tools/pod_status.py <ckpt_dir> --json                  # machine
     python tools/pod_status.py <ckpt_dir> --follow [SECONDS]      # live view
+    python tools/pod_status.py <federated index root>             # federation view
+
+A FEDERATED index root (``federation.json`` present — drep_tpu/index/
+federation.py) renders one row per partition (recorded vs actual
+generation, genome count, any in-flight update pod's progress/ETA via
+the same byte-for-byte :func:`collect` path) plus a federation summary
+line (partitions clean / updating / ahead-of-meta / empty / damaged).
+``--follow`` and ``--json`` compose with it.
 
 ``--follow`` (ISSUE 11 satellite, the PR 10 follow-on) polls the
 checkpoint dir on an interval and re-renders the status/ETA in place
@@ -244,6 +252,122 @@ def collect(ckpt_dir: str, now: float | None = None) -> dict:
     return out
 
 
+def collect_federation(root: str, now: float | None = None) -> dict:
+    """One read-only snapshot of a FEDERATED index root: the recorded
+    meta-manifest state per partition, each partition's actual manifest
+    generation, and — for partitions with an in-flight update — the same
+    :func:`collect` pod view the single-store path serves (byte-for-byte
+    read-only, reused verbatim so the two views can never disagree)."""
+    meta = _read_note(os.path.join(root, "federation.json"))
+    if meta is None:
+        return {"error": f"cannot read federation meta-manifest under {root}"}
+    partitions: list[dict] = []
+    counts = {"clean": 0, "updating": 0, "ahead": 0, "empty": 0, "damaged": 0}
+    for e in meta.get("partitions", []):
+        pid = int(e.get("pid", len(partitions)))
+        pdir = os.path.join(root, e.get("dir", f"part_{pid:03d}"))
+        rec_gen = int(e.get("generation", -1))
+        rec_n = int(e.get("n_genomes", 0))
+        entry: dict = {
+            "pid": pid, "dir": e.get("dir"),
+            "meta_generation": rec_gen, "meta_n_genomes": rec_n,
+        }
+        manifest = _read_note(os.path.join(pdir, "manifest.json"))
+        actual = (
+            int(manifest["generation"])
+            if manifest and "generation" in manifest
+            else None
+        )
+        entry["generation"] = actual
+        pending = os.path.join(pdir, "pending")
+        try:
+            gens = sorted(
+                d for d in os.listdir(pending)
+                if d.startswith("g") and os.path.isdir(os.path.join(pending, d))
+            )
+        except OSError:
+            gens = []
+        if gens:
+            pod = collect(os.path.join(pending, gens[-1]), now=now)
+            keep = ("epoch", "live", "dead", "draining", "shards_published",
+                    "shards_total", "progress", "eta_s")
+            entry["update_pod"] = {
+                "checkpoint_dir": pod.get("checkpoint_dir"),
+                **{k: pod[k] for k in keep if k in pod},
+            }
+        if gens:
+            # an in-flight pod outranks everything — including a
+            # mid-MATERIALIZATION partition whose first manifest does
+            # not exist yet (meta gen -1): the whole point of the view
+            # is observing exactly that window
+            state = "updating"
+        elif rec_gen < 0 and actual is None:
+            state = "empty"
+        elif actual is None or actual < rec_gen:
+            state = "damaged"  # unreadable manifest, or rolled back behind meta
+        elif actual > rec_gen:
+            state = "ahead"  # published, meta publish pending (or was killed)
+        else:
+            state = "clean"
+        entry["state"] = state
+        counts[state] += 1
+        partitions.append(entry)
+    out = {
+        "federation": os.path.abspath(root),
+        "generation": int(meta.get("generation", -1)),
+        "n_genomes": int(meta.get("n_genomes", 0)),
+        "n_partitions": int(meta.get("n_partitions", len(partitions))),
+        "partitions": partitions,
+        "summary": counts,
+    }
+    if meta.get("partial"):
+        out["partial"] = meta["partial"]
+    return out
+
+
+def render_federation(status: dict) -> str:
+    if "error" in status:
+        return status["error"] + "\n"
+    lines = [
+        f"federated index @ {status['federation']}",
+        f"  generation {status['generation']}  "
+        f"({status['n_genomes']} genomes over {status['n_partitions']} partitions)",
+    ]
+    for e in status["partitions"]:
+        gen = e["generation"] if e["generation"] is not None else "-"
+        detail = f"gen {gen} (meta {e['meta_generation']}), {e['meta_n_genomes']} genomes"
+        pod = e.get("update_pod")
+        if pod:
+            done, total = pod.get("shards_published"), pod.get("shards_total")
+            eta = f", eta ~{pod['eta_s']:.0f}s" if pod.get("eta_s") is not None else ""
+            detail += f"  [pod: {done}/{total or '?'} shards{eta}]"
+        lines.append(f"  part_{e['pid']:03d} {e['state']:<9} {detail}")
+    c = status["summary"]
+    lines.append(
+        f"  partitions: {c['clean']} clean / {c['updating']} updating / "
+        f"{c['ahead']} ahead-of-meta / {c['empty']} empty / {c['damaged']} damaged"
+    )
+    if status.get("partial"):
+        p = status["partial"]
+        lines.append(
+            f"  PARTIAL publish: partition(s) {p.get('failed_partitions')} failed; "
+            f"{len(p.get('unadmitted', []))} genome(s) unadmitted"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def _collect_any(path: str, now: float | None = None) -> dict:
+    """Dispatch: a federated index root gets the federation view, any
+    other directory the ordinary pod-checkpoint view."""
+    if os.path.exists(os.path.join(path, "federation.json")):
+        return collect_federation(path, now=now)
+    return collect(path, now=now)
+
+
+def _render_any(status: dict) -> str:
+    return render_federation(status) if "federation" in status else render(status)
+
+
 def render(status: dict) -> str:
     if "error" in status:
         return status["error"] + "\n"
@@ -293,11 +417,11 @@ def follow(
     status: dict = {}
     try:
         while True:
-            status = collect(ckpt_dir)
+            status = _collect_any(ckpt_dir)
             body = (
                 json.dumps(status, indent=1, sort_keys=True) + "\n"
                 if as_json
-                else render(status)
+                else _render_any(status)
             )
             if clear:
                 out.write(clear + body)
@@ -330,11 +454,11 @@ def main(argv: list[str] | None = None) -> int:
             args.checkpoint_dir, interval_s=args.follow, count=args.count,
             as_json=args.json,
         )
-    status = collect(args.checkpoint_dir)
+    status = _collect_any(args.checkpoint_dir)
     if args.json:
         print(json.dumps(status, indent=1, sort_keys=True))
     else:
-        sys.stdout.write(render(status))
+        sys.stdout.write(_render_any(status))
     return 1 if "error" in status else 0
 
 

@@ -22,11 +22,17 @@ Verified payload families (everything else is left alone):
   (``sketch_g*.npz``, ``edges_g*.npz``, ``state_g*.npz`` — sketches,
   edge graph, labels/winner table; drep_tpu/index/store.py). Zero-byte,
   truncated, unparseable, or checksum-mismatched shards are DAMAGE.
-- ``meta.json``, the genome-index ``manifest.json``, and the pod
-  protocol's JSON notes (``.pod-done.*``, ``.pod-dead.*``, and the
-  elastic membership family ``.pod-drain.*`` / ``.pod-join.*`` /
+- ``meta.json``, the genome-index ``manifest.json``, the FEDERATED
+  index's ``federation.json`` meta-manifest (drep_tpu/index/meta.py),
+  and the pod protocol's JSON notes (``.pod-done.*``, ``.pod-dead.*``,
+  and the elastic membership family ``.pod-drain.*`` / ``.pod-join.*`` /
   ``.pod-admit.*``) — unparseable or checksum-mismatched is DAMAGE,
   never an orphan.
+- a federated index root recurses into its ``part_NNN/`` partition
+  stores (each an ordinary index store) plus the federation families
+  (``cross_g*.npz`` cross-partition edges, ``fedstate_g*.npz`` union
+  state); damage under a partition is reported WITH the partition id,
+  so an `index update` heal pass can be pointed at the right store.
 - ``events.p*.jsonl`` telemetry logs (utils/telemetry.py) — every
   complete line must parse as JSON (mid-file rot is DAMAGE); a torn
   FINAL line is a killed writer's expected crash evidence, reported as
@@ -71,6 +77,11 @@ import re  # noqa: E402
 # MID-FILE line is damage
 _EVENTS_RE = re.compile(r"^events\.p\d+\.jsonl$")
 
+# federated index partition dirs (drep_tpu/index/federation.py): damage
+# under one is reported with the partition id so heal passes target the
+# right store
+_PARTITION_RE = re.compile(r"(?:^|[\\/])(part_\d{3})[\\/]")
+
 
 def _is_json_note(name: str) -> bool:
     # every checked-JSON family the pipeline publishes: store meta, the
@@ -79,7 +90,7 @@ def _is_json_note(name: str) -> bool:
     # argument snapshots, ingest poison markers, and the genome-index
     # manifest (drep_tpu/index/store.py) — all carry the in-band "crc"
     return (
-        name in ("meta.json", "manifest.json")
+        name in ("meta.json", "manifest.json", "federation.json")
         or name.startswith(
             (
                 ".pod-done.", ".pod-dead.", ".pod-drain.", ".pod-join.",
@@ -189,6 +200,7 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
             for name in sorted(files):
                 check(os.path.join(dirpath, name), name)
 
+    by_partition: dict[str, int] = {}
     for path, reason in damaged:
         action = ""
         if delete:
@@ -198,7 +210,14 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
                 action = " [deleted — next resume recomputes it]"
             except OSError as e:
                 action = f" [delete failed: {e}]"
-        print(f"DAMAGED  {path}: {reason}{action}", file=out)
+        # federated stores: name the partition so `index update` heal
+        # passes (and operators) target the right store
+        m = _PARTITION_RE.search(path)
+        part = f" [partition {m.group(1)}]" if m else ""
+        if m:
+            by_partition[m.group(1)] = by_partition.get(m.group(1), 0) + 1
+        print(f"DAMAGED {part} {path}: {reason}{action}" if part
+              else f"DAMAGED  {path}: {reason}{action}", file=out)
     for path in artifacts:
         action = ""
         if delete:
@@ -213,6 +232,12 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
     for path in torn_tails:
         print(f"TORN-TAIL {path}: event log ends mid-line (expected crash "
               f"evidence from a killed writer, not damage)", file=out)
+    if by_partition:
+        print(
+            "scrub: federated damage by partition: "
+            + ", ".join(f"{p}={c}" for p, c in sorted(by_partition.items())),
+            file=out,
+        )
     print(
         f"scrub: {verified} payload(s) checksum-verified, {legacy} legacy "
         f"(readable, no in-band checksum), {len(damaged)} damaged"
@@ -222,7 +247,8 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
         file=out,
     )
     return {"verified": verified, "legacy": legacy, "damaged": damaged,
-            "artifacts": artifacts, "torn_tails": torn_tails}
+            "artifacts": artifacts, "torn_tails": torn_tails,
+            "by_partition": by_partition}
 
 
 def main(argv: list[str] | None = None) -> int:
