@@ -281,9 +281,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "routing is by sketch-derived range code, pinned at "
                         "creation. 0/absent = ordinary single-store index")
     b.add_argument("--fed_pods", type=int, default=None,
-                   help="with --partitions: concurrency for later update "
-                        "pods (partition materialization itself runs "
-                        "in-process; see `index update --fed_pods`)")
+                   help="with --partitions: run per-partition work as up to "
+                        "this many concurrent subprocess pods — including "
+                        "generation-0 materialization (sketches + pinned "
+                        "params ride a --params_file handoff into each pod)")
     bp = b.add_argument_group("INDEX PARAMETERS (bootstrap build only; "
                               "workdir builds pin the source run's)")
     bp.add_argument("-pa", "--P_ani", type=float, default=None)
@@ -329,6 +330,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "ordinary `index update` on one partition store, "
                         "crash-resumable on its own). Default: "
                         "DREP_TPU_FED_PODS (0 = in-process, one at a time)")
+    u.add_argument("--params_file", default=None, metavar="NPZ",
+                   help="sketches+params handoff from a federated router "
+                        "(index/federation.py write_params_handoff): the "
+                        "routed batch's sketches and the federation's PINNED "
+                        "params ride this file, so a partition pod never "
+                        "re-sketches its batch and an EMPTY partition can "
+                        "materialize generation 0 in a pod (params that the "
+                        "CLI bootstrap cannot express). With it, -g is "
+                        "ignored — the handoff IS the batch")
 
     c = isub.add_parser(
         "classify",
@@ -402,6 +412,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="manifest re-read cadence for generation hot-swap: "
                         "a published generation G+1 is adopted between "
                         "batches within this many seconds. Default 2s")
+    s.add_argument("--resident_mb", type=int, default=None,
+                   help="FEDERATED index only: byte budget (MiB) for "
+                        "resident partition sketch payloads — the streaming "
+                        "per-partition classify path keeps only hot "
+                        "partitions loaded (LRU eviction past the budget). "
+                        "Default: DREP_TPU_SERVE_RESIDENT_MB (0 = unlimited)")
     s.add_argument("--log_dir", default=None,
                    help="home for the daemon's logs, Prometheus textfile "
                         "flush (DREP_TPU_METRICS_FLUSH_S), and event "

@@ -25,10 +25,13 @@ federated root transparently as the assembled union.
 
 from drep_tpu.index.build import build_from_paths, build_from_workdir  # noqa: F401
 from drep_tpu.index.federation import (  # noqa: F401
+    FederatedResident,
     FederationStore,
     build_federated,
     fed_update,
     load_federated,
+    read_params_handoff,
+    write_params_handoff,
 )
 from drep_tpu.index.classify import (  # noqa: F401
     SketchedQueries,

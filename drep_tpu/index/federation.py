@@ -68,6 +68,12 @@ Pinned invariant (property-tested like PR 6's): federated ==
 from-scratch dereplicate on the union — labels up to renumbering and
 winner sets — across partition counts, split schedules including the
 K=1 trickle, and near-boundary pairs the routing separates.
+
+Serving (ISSUE 14): union assembly is the ORACLE path; a serve replica
+runs the streaming per-partition classify instead — see
+:class:`FederatedResident` below (coarse-code routing, LRU partition
+residency, partition health state machine, PARTIAL verdicts), pinned
+identical to the union path's verdicts.
 """
 
 from __future__ import annotations
@@ -76,6 +82,7 @@ import os
 import subprocess
 import sys
 import time
+from dataclasses import dataclass
 
 import numpy as np
 import pandas as pd
@@ -85,10 +92,8 @@ from drep_tpu.index import meta as fedmeta
 from drep_tpu.index.store import IndexStore, LoadedIndex, empty_index, load_index
 from drep_tpu.index.update import (
     _admit_batch,
-    _rect_edges,
     _retention,
     index_update,
-    publish_generation,
     recluster,
     sketch_batch,
 )
@@ -123,11 +128,14 @@ class FederationStore:
     def fedstate_name(self, gen: int) -> str:
         return os.path.join("state", f"fedstate_g{gen:06d}.npz")
 
+    def routing_name(self, gen: int) -> str:
+        return os.path.join("routing", f"summary_g{gen:06d}.npz")
+
     def abspath(self, rel: str) -> str:
         return os.path.join(self.location, rel)
 
     def ensure_dirs(self) -> None:
-        for sub in ("cross", "state", "log"):
+        for sub in ("cross", "state", "routing", "log"):
             os.makedirs(os.path.join(self.location, sub), exist_ok=True)
 
     # ---- meta ------------------------------------------------------------
@@ -177,18 +185,53 @@ class FederationStore:
             winner_score=idx.winners["score"].to_numpy().astype(np.float64),
         )
 
-    def gc_states(self, keep_rel: str) -> None:
-        """Best-effort removal of superseded union states — strictly
-        AFTER the meta publish (same rule as IndexStore.gc_states)."""
+    def write_routing_summary(
+        self, rel: str, bottoms: list[np.ndarray], part_of: np.ndarray,
+        n_partitions: int,
+    ) -> None:
+        """The partition routing summaries (ISSUE 14): one coarse-code
+        bitmap per partition (rangepart.code_summary_bitmap) over the
+        CURRENT union — what lets a serve replica route a query batch to
+        only the partitions whose genomes can share a band code with it,
+        without holding any sketch payload resident. Deterministic per
+        union content, so a killed run's rerun rewrites it identically."""
+        from drep_tpu.ops import rangepart
+        from drep_tpu.utils.ckptmeta import atomic_savez
+
+        part_of = np.asarray(part_of, np.int64)
+        bitmaps = np.stack(
+            [
+                rangepart.code_summary_bitmap(
+                    [bottoms[int(i)] for i in np.nonzero(part_of == p)[0]]
+                )
+                for p in range(int(n_partitions))
+            ]
+        ) if n_partitions else np.zeros((0, 1), np.uint64)
+        os.makedirs(os.path.dirname(self.abspath(rel)), exist_ok=True)
+        atomic_savez(
+            self.abspath(rel),
+            bitmaps=bitmaps,
+            bits=np.int64(rangepart.ROUTE_SUMMARY_BITS),
+        )
+
+    def gc_states(self, keep_rel: str, keep_routing_rel: str | None = None) -> None:
+        """Best-effort removal of superseded union states (and routing
+        summaries) — strictly AFTER the meta publish (same rule as
+        IndexStore.gc_states)."""
         import contextlib
 
-        state_dir = os.path.join(self.location, "state")
-        keep = os.path.basename(keep_rel)
-        if os.path.isdir(state_dir):
-            for f in os.listdir(state_dir):
-                if f != keep and f.startswith("fedstate_g") and f.endswith(".npz"):
-                    with contextlib.suppress(OSError):
-                        os.remove(os.path.join(state_dir, f))
+        families = [("state", "fedstate_g", os.path.basename(keep_rel))]
+        if keep_routing_rel is not None:
+            families.append(
+                ("routing", "summary_g", os.path.basename(keep_routing_rel))
+            )
+        for sub, prefix, keep in families:
+            fam_dir = os.path.join(self.location, sub)
+            if os.path.isdir(fam_dir):
+                for f in os.listdir(fam_dir):
+                    if f != keep and f.startswith(prefix) and f.endswith(".npz"):
+                        with contextlib.suppress(OSError):
+                            os.remove(os.path.join(fam_dir, f))
 
 
 # ---------------------------------------------------------------------------
@@ -360,6 +403,22 @@ def _read_npz_or_refuse(path: str, what: str, location: str, heal: bool):
         ) from e
 
 
+def partition_refusal(pid: int, rng, gen: int, err: BaseException) -> str:
+    """THE unreadable-partition message (ISSUE 14 fix): the refusal names
+    the partition id and its recorded (range, generation) — not just the
+    underlying OSError — and the streaming path's quarantine instant
+    carries this exact text, so the union-assembly refusal and the
+    containment verdict can never describe the same fault differently."""
+    lo, hi = (int(rng[0]), int(rng[1])) if rng is not None else (0, 0)
+    return (
+        f"federated index: partition {pid} (range [{lo:#x}, {hi:#x}), "
+        f"meta-recorded generation {gen}) is unreadable: "
+        f"{type(err).__name__}: {err} — scope the damage with "
+        f"`python tools/scrub_store.py <root> --partition {pid}` and heal "
+        f"with `drep-tpu index update <root>` (no genomes needed)"
+    )
+
+
 def load_federated(location: str, heal: bool = False) -> LoadedIndex:
     """The whole federation at its meta-manifest generation, assembled
     as ONE union ``LoadedIndex`` — what classify/serve consume
@@ -411,7 +470,16 @@ def load_federated(location: str, heal: bool = False) -> LoadedIndex:
             loaded[pid] = None
             continue
         pdir = store.partition_dir(pid)
-        pidx = load_index(pdir, heal=heal)
+        try:
+            pidx = load_index(pdir, heal=heal)
+        except Exception as err:  # noqa: BLE001 — a bare OSError (and even
+            # the store's own UserInputError) used to surface naming only
+            # the failing path; the federated refusal must name WHICH
+            # partition and its recorded (range, generation) — and the
+            # streaming path's quarantine instant carries this same text
+            raise UserInputError(
+                partition_refusal(pid, e.get("range"), int(e["generation"]), err)
+            ) from err
         healed.extend(f"{fedmeta.partition_dir_name(pid)}/{h}" for h in pidx.healed)
         g_meta = int(e["generation"])
         if pidx.generation < g_meta:
@@ -613,6 +681,1010 @@ def load_federated(location: str, heal: bool = False) -> LoadedIndex:
 
 
 # ---------------------------------------------------------------------------
+# streaming per-partition serving (ISSUE 14)
+# ---------------------------------------------------------------------------
+#
+# ``load_federated`` assembles the whole union in one process's memory —
+# the right shape for update machinery (which mutates the union anyway)
+# and for the oracle, but the WRONG shape for a serve replica: it pays
+# O(total sketch bytes) residency, and one damaged partition fails the
+# entire load. ``FederatedResident`` is the serving view: it loads only
+# the cheap SPINE (meta + union state + cross shards + per-partition
+# names/stats/intra-edges — O(N) metadata, no sketch payloads), routes
+# each query to the partitions whose genomes can share a band code with
+# it (rangepart coarse-code summaries, recall 1.0 by the same monotone
+# many-to-one derivation as the boundary join), lazily loads ONLY the
+# consulted partitions' sketch payloads (LRU residency under a byte
+# budget), runs an ordinary per-partition rect compare against each,
+# and merges per-partition edges into per-query verdicts through the
+# exact recluster machinery one-shot classify runs — so streaming
+# verdicts are IDENTICAL to union-assembled classify (oracle-pinned).
+#
+# Fault containment is partition-scoped: a partition that fails to
+# load, fails mid-compare, or is truncated/swapped under a stale meta
+# moves through a health state machine (healthy -> suspect ->
+# quarantined, bounded-backoff reload probes) and the affected queries
+# return honest PARTIAL verdicts stamped with ``partitions_consulted``
+# / ``partitions_unavailable`` — never an exception out of the daemon.
+
+PARTITION_HEALTHY = "healthy"
+PARTITION_SUSPECT = "suspect"
+PARTITION_QUARANTINED = "quarantined"
+
+
+def partition_heal_hint(pid: int) -> str:
+    """The quarantine instant's scrub-informed heal hint: the cheap
+    partition-scoped probe an operator (or orchestrator) shells to."""
+    return (
+        f"python tools/scrub_store.py <root> --partition {pid} "
+        f"(then `drep-tpu index update <root>` to heal)"
+    )
+
+
+@dataclass
+class _PartitionSlot:
+    """One partition's health + residency bookkeeping in a serve replica."""
+
+    pid: int
+    dir: str
+    range: tuple[int, int]
+    meta_generation: int
+    n: int  # genome count AT the federation generation (meta-recorded)
+    state: str = PARTITION_HEALTHY
+    reason: str | None = None  # quarantine/suspect cause (partition_refusal text)
+    failures: int = 0  # consecutive
+    backoff_s: float = 0.0
+    next_probe_mono: float = 0.0
+    last_probe_mono: float | None = None
+    # spine (loaded once, cheap): union slots in partition-local order
+    u_of_local: np.ndarray | None = None
+    intra: tuple | None = None  # union-coord intra edges (ii, jj, dd)
+    # resident sketch payload (the heavy, lazily-loaded part)
+    resident: bool = False
+    resident_bytes: int = 0
+    last_used: int = 0
+    loads: int = 0
+
+
+class FederatedResident:
+    """The streaming serving view of a federated index (ISSUE 14).
+
+    Quacks like the resident ``LoadedIndex`` where the serve tier needs
+    it (``.params`` / ``.generation`` / ``.n`` / ``.location``), but
+    holds sketch payloads per-partition under an LRU byte budget and
+    contains partition failure at the partition boundary. Construction
+    refuses (read-only, like ``load_resident_index``) only on faults
+    that leave NOTHING answerable — a corrupt meta-manifest or union
+    state; any per-partition damage quarantines that partition instead.
+
+    State machine per partition: ``healthy`` -> (one load/compare
+    failure) ``suspect`` (retried immediately on next consult) -> (a
+    second consecutive failure, or any spine-level failure at startup)
+    ``quarantined`` (consulted again only by bounded-backoff reload
+    probes; a successful probe emits ``partition_recovered`` and goes
+    straight back to ``healthy``). Every failure's recorded reason is
+    the same :func:`partition_refusal` text the union-assembly path
+    raises — one message per fault, wherever it surfaces.
+    """
+
+    def __init__(
+        self,
+        location: str,
+        resident_mb: int | None = None,
+        probe_backoff_s: float | None = None,
+        probe_max_s: float | None = None,
+    ):
+        from drep_tpu.utils import envknobs
+
+        logger = get_logger()
+        self.store = FederationStore(location)
+        self.location = self.store.location
+        m = self.store.read_meta()
+        if int(m["generation"]) < 0:
+            raise UserInputError(
+                f"federated index at {location} is an empty skeleton "
+                f"(generation -1) — finish the initial `drep-tpu index "
+                f"update {location} -g ...` before serving from it"
+            )
+        self.fed_meta = m
+        self.params = m["params"]
+        self.generation = int(m["generation"])
+        if resident_mb is None:
+            resident_mb = envknobs.env_int("DREP_TPU_SERVE_RESIDENT_MB")
+        self.budget_bytes = int(resident_mb) << 20 if resident_mb else 0
+        self.probe_backoff_s = (
+            envknobs.env_float("DREP_TPU_SERVE_PROBE_BACKOFF_S")
+            if probe_backoff_s is None else float(probe_backoff_s)
+        )
+        self.probe_max_s = (
+            envknobs.env_float("DREP_TPU_SERVE_PROBE_MAX_S")
+            if probe_max_s is None else float(probe_max_s)
+        )
+        self.stats = {
+            "loads": 0, "evictions": 0, "recoveries": 0,
+            "peak_resident_partitions": 0,
+        }
+        self._tick = 0
+        self._resident_total = 0
+        self._edge_cache: dict[frozenset, tuple] = {}
+
+        # -- union state: the spine nothing can be answered without ---------
+        n = int(m["n_genomes"])
+        state = _read_npz_or_refuse(
+            self.store.abspath(m["state"]), "union state", location, heal=False
+        ) if m.get("state") else None
+        if state is None:
+            raise UserInputError(
+                f"federated index union state under {location} is missing or "
+                f"was never published; serve is read-only — run `drep-tpu "
+                f"index update {location}` to heal the store first"
+            )
+        self.part_of = state["part_of"].astype(np.int64)
+        self.local_of = state["local_of"].astype(np.int64)
+        if len(self.part_of) != n:
+            raise UserInputError(
+                f"federated index at {location}: union mapping covers "
+                f"{len(self.part_of)} genomes but the meta-manifest records {n}"
+            )
+
+        # -- cross shards (federation-level, required like the state) -------
+        cross_ii: list[np.ndarray] = []
+        cross_jj: list[np.ndarray] = []
+        cross_dd: list[np.ndarray] = []
+        for e in m.get("cross_shards", ()):
+            z = _read_npz_or_refuse(
+                self.store.abspath(e["file"]), "cross shard", location, heal=False
+            )
+            if z is None:
+                raise UserInputError(
+                    f"federated index cross shard {self.store.abspath(e['file'])} "
+                    f"is missing; serve is read-only — run `drep-tpu index "
+                    f"update {location}` to heal the store first"
+                )
+            cross_ii.append(z["ii"].astype(np.int64))
+            cross_jj.append(z["jj"].astype(np.int64))
+            cross_dd.append(z["dist"].astype(np.float32))
+        self._cross = (
+            np.concatenate(cross_ii) if cross_ii else np.empty(0, np.int64),
+            np.concatenate(cross_jj) if cross_jj else np.empty(0, np.int64),
+            np.concatenate(cross_dd) if cross_dd else np.empty(0, np.float32),
+        )
+        self._cross_pi = self.part_of[self._cross[0]] if len(self._cross[0]) else (
+            np.empty(0, np.int64)
+        )
+        self._cross_pj = self.part_of[self._cross[1]] if len(self._cross[1]) else (
+            np.empty(0, np.int64)
+        )
+
+        # -- routing summaries (optional: absent/corrupt -> consult-all) ----
+        self._route_bitmaps = self._route_bits = None
+        if m.get("routing"):
+            try:
+                from drep_tpu.utils import durableio
+
+                z = durableio.load_npz_checked(
+                    self.store.abspath(m["routing"]), what="routing summary"
+                )
+                self._route_bitmaps = z["bitmaps"].astype(np.uint64)
+                self._route_bits = int(z["bits"])
+            except Exception as err:  # noqa: BLE001 — routing is an
+                # optimization: losing it degrades to consult-all, honestly
+                logger.warning(
+                    "federated serve: routing summary unreadable (%s) — "
+                    "every query consults every partition until the next "
+                    "`index update` rewrites it", err,
+                )
+
+        # -- per-partition spine (contained: failure -> quarantine) ---------
+        self._stats_arrays = {c: np.zeros(n, np.int64) for c in _STAT_COLS}
+        names: list[str] = [f"?part?:{int(p)}:{int(l)}" for p, l in zip(
+            self.part_of, self.local_of
+        )]
+        locations: list[str] = [""] * n
+        self._slots: dict[int, _PartitionSlot] = {}
+        for e in m["partitions"]:
+            pid = int(e["pid"])
+            slot = _PartitionSlot(
+                pid=pid, dir=e["dir"],
+                range=(int(e["range"][0]), int(e["range"][1])),
+                meta_generation=int(e["generation"]),
+                n=int(e["n_genomes"]),
+            )
+            self._slots[pid] = slot
+            if slot.n <= 0:
+                continue
+            try:
+                self._load_spine(slot, names, locations)
+            except Exception as err:  # noqa: BLE001 — THE containment
+                # boundary: one damaged partition must not take the
+                # replica down with it
+                self._book_failure(slot, err, during="spine")
+
+        admitted = np.zeros(n, np.int64)
+        for e in m.get("cross_shards", ()):
+            admitted[int(e["lo"]): int(e["hi"])] = int(e["generation"])
+        self.union = LoadedIndex(
+            location=self.location, params=self.params, generation=self.generation,
+            names=names, locations=locations,
+            gdb=pd.DataFrame({"genome": list(names), **self._stats_arrays}),
+            admitted=admitted,
+            bottom=[None] * n, scaled=[None] * n,
+            edges=_EMPTY_EDGES(),
+            primary=state["primary"].astype(np.int64),
+            suffix=state["suffix"].astype(np.int64),
+            score=state["score"].astype(np.float64),
+            winners=pd.DataFrame(
+                {
+                    "cluster": [str(x) for x in state["winner_cluster"]],
+                    "genome": [str(x) for x in state["winner_genome"]],
+                    "score": state["winner_score"].astype(np.float64),
+                }
+            ),
+        )
+        quarantined = sorted(
+            p for p, s in self._slots.items() if s.state == PARTITION_QUARANTINED
+        )
+        logger.info(
+            "federated serve: generation %d spine resident (%d genomes over "
+            "%d partitions, 0 sketch payloads loaded%s)",
+            self.generation, n, len(self._slots),
+            f"; QUARANTINED at startup: {quarantined}" if quarantined else "",
+        )
+
+    # ---- LoadedIndex-compatible surface ---------------------------------
+    @property
+    def n(self) -> int:
+        return len(self.union.names)
+
+    @property
+    def names(self) -> list[str]:
+        return self.union.names
+
+    # ---- spine / residency loads ----------------------------------------
+    def _partition_manifest(self, slot: _PartitionSlot) -> dict:
+        """The partition's CURRENT manifest, re-read on every residency
+        load (not cached) with the same identity checks the union
+        assembly applies — a rollback, an out-of-band swap, or rot lands
+        here, at consult time, as a containable failure."""
+        pdir = os.path.join(self.location, slot.dir)
+        manifest = IndexStore(pdir).read_manifest()
+        g_meta = slot.meta_generation
+        actual = int(manifest["generation"])
+        if actual < g_meta:
+            raise UserInputError(
+                f"partition store is at generation {actual} but the "
+                f"meta-manifest recorded {g_meta} — rolled back or restored "
+                f"out of band"
+            )
+        if actual > g_meta + 1:
+            raise UserInputError(
+                f"partition store is {actual - g_meta} generations ahead of "
+                f"the meta-manifest — updated outside `index update` on the "
+                f"federation root"
+            )
+        e = next(
+            e for e in self.fed_meta["partitions"] if int(e["pid"]) == slot.pid
+        )
+        if actual == g_meta and e.get("manifest_crc") is not None:
+            crc = fedmeta.manifest_crc(pdir)
+            if crc is not None and int(crc) != int(e["manifest_crc"]):
+                raise UserInputError(
+                    "partition manifest checksum does not match what the "
+                    "meta-manifest was published against — swapped out from "
+                    "under the federation"
+                )
+        if int(manifest["n_genomes"]) < slot.n:
+            raise UserInputError(
+                f"partition holds {manifest['n_genomes']} genomes but the "
+                f"meta-manifest records {slot.n} — truncated by a stale meta"
+            )
+        return manifest
+
+    def _load_spine(self, slot: _PartitionSlot, names: list, locations: list) -> None:
+        """Names/locations/stats + intra edges for one partition —
+        O(n_p) metadata, NO sketch payloads (those load lazily on first
+        consult)."""
+        from drep_tpu.utils import durableio
+
+        pdir = os.path.join(self.location, slot.dir)
+        manifest = self._partition_manifest(slot)
+        state = durableio.load_npz_checked(
+            os.path.join(pdir, manifest["state"]), what="partition state"
+        )
+        sel = np.nonzero(self.part_of == slot.pid)[0]
+        locs = self.local_of[sel]
+        u_of_local = np.full(slot.n, -1, np.int64)
+        u_of_local[locs] = sel
+        if (u_of_local < 0).any():
+            raise UserInputError(
+                "union mapping does not cover every partition-local genome"
+            )
+        p_names = [str(x) for x in state["names"][: slot.n]]
+        p_locs = [str(x) for x in state["locations"][: slot.n]]
+        for loc in range(slot.n):
+            names[int(u_of_local[loc])] = p_names[loc]
+            locations[int(u_of_local[loc])] = p_locs[loc]
+        for c in _STAT_COLS:
+            self._stats_arrays[c][sel] = state[c].astype(np.int64)[locs]
+        ii_l: list[np.ndarray] = []
+        jj_l: list[np.ndarray] = []
+        dd_l: list[np.ndarray] = []
+        for e in manifest["edge_shards"]:
+            if int(e["lo"]) >= slot.n:
+                continue  # published ahead of the meta: truncated out
+            z = durableio.load_npz_checked(
+                os.path.join(pdir, e["file"]), what="partition edge shard"
+            )
+            ii, jj, dd = (
+                z["ii"].astype(np.int64), z["jj"].astype(np.int64),
+                z["dist"].astype(np.float32),
+            )
+            keep = jj < slot.n  # ii < jj: both endpoints inside the prefix
+            ii_l.append(u_of_local[ii[keep]])
+            jj_l.append(u_of_local[jj[keep]])
+            dd_l.append(dd[keep])
+        slot.u_of_local = u_of_local
+        slot.intra = (
+            np.concatenate(ii_l) if ii_l else np.empty(0, np.int64),
+            np.concatenate(jj_l) if jj_l else np.empty(0, np.int64),
+            np.concatenate(dd_l) if dd_l else np.empty(0, np.float32),
+        )
+        self._edge_cache.clear()
+
+    def _load_sketches(self, slot: _PartitionSlot) -> None:
+        from drep_tpu.ingest import unpack_ragged
+        from drep_tpu.utils import durableio
+
+        pdir = os.path.join(self.location, slot.dir)
+        manifest = self._partition_manifest(slot)
+        # STAGE everything before installing anything: a mid-way shard
+        # failure (second shard corrupt) must leave union.bottom exactly
+        # as it was — a partial install would hold bytes outside the
+        # residency accounting forever (the budget contract would leak)
+        staged: list[tuple[int, np.ndarray, np.ndarray]] = []
+        nbytes = 0
+        for e in manifest["sketch_shards"]:
+            lo = int(e["lo"])
+            if lo >= slot.n:
+                continue
+            hi = min(int(e["hi"]), slot.n)
+            z = durableio.load_npz_checked(
+                os.path.join(pdir, e["file"]), what="partition sketch shard"
+            )
+            m = int(e["hi"]) - lo
+            bot = unpack_ragged(z["bottom"], z["bottom_offsets"], m)
+            sca = unpack_ragged(z["scaled"], z["scaled_offsets"], m)
+            for loc in range(lo, hi):
+                staged.append(
+                    (int(slot.u_of_local[loc]), bot[loc - lo], sca[loc - lo])
+                )
+                nbytes += bot[loc - lo].nbytes + sca[loc - lo].nbytes
+        for u, b, s in staged:
+            self.union.bottom[u] = b
+            self.union.scaled[u] = s
+        slot.resident_bytes = nbytes
+
+    # ---- health state machine -------------------------------------------
+    def _book_failure(self, slot: _PartitionSlot, err: BaseException, during: str) -> None:
+        from drep_tpu.utils import telemetry
+        from drep_tpu.utils.profiling import counters
+
+        msg = partition_refusal(slot.pid, slot.range, slot.meta_generation, err)
+        now = time.monotonic()
+        slot.failures += 1
+        slot.reason = msg
+        slot.last_probe_mono = now
+        self._drop_residency(slot)
+        was = slot.state
+        # spine-level damage at startup/probe goes straight to quarantine
+        # (a corrupt manifest will not heal by immediate retry); load or
+        # mid-compare failures get one suspect retry first
+        if during == "spine" or was in (PARTITION_SUSPECT, PARTITION_QUARANTINED):
+            slot.state = PARTITION_QUARANTINED
+            slot.backoff_s = min(
+                self.probe_max_s,
+                max(self.probe_backoff_s, slot.backoff_s * 2.0),
+            )
+            slot.next_probe_mono = now + slot.backoff_s
+            if was != PARTITION_QUARANTINED:
+                counters.add_fault("partition_quarantined")
+            telemetry.event(
+                "partition_quarantine", pid=slot.pid, during=during,
+                reason=msg, heal_hint=partition_heal_hint(slot.pid),
+                backoff_s=round(slot.backoff_s, 3),
+            )
+        else:
+            slot.state = PARTITION_SUSPECT
+        get_logger().warning(
+            "federated serve: partition %d %s after a %s failure: %s",
+            slot.pid, slot.state, during, msg,
+        )
+
+    def _mark_recovered(self, slot: _PartitionSlot) -> None:
+        from drep_tpu.utils import telemetry
+
+        slot.state = PARTITION_HEALTHY
+        slot.failures = 0
+        slot.backoff_s = 0.0
+        slot.reason = None
+        self.stats["recoveries"] += 1
+        telemetry.event("partition_recovered", pid=slot.pid, loads=slot.loads)
+        get_logger().info(
+            "federated serve: partition %d recovered (probe load succeeded) "
+            "— full coverage restored for its range", slot.pid,
+        )
+
+    def _drop_residency(self, slot: _PartitionSlot) -> None:
+        if not slot.resident:
+            return
+        for u in slot.u_of_local if slot.u_of_local is not None else ():
+            self.union.bottom[int(u)] = None
+            self.union.scaled[int(u)] = None
+        self._resident_total -= slot.resident_bytes
+        slot.resident = False
+        slot.resident_bytes = 0
+
+    def _evict(self, slot: _PartitionSlot) -> None:
+        from drep_tpu.utils import telemetry
+
+        nbytes = slot.resident_bytes
+        self._drop_residency(slot)
+        self.stats["evictions"] += 1
+        telemetry.event("partition_evict", pid=slot.pid, bytes=nbytes)
+
+    def _evict_to_budget(self, pin: set[int]) -> None:
+        from drep_tpu.utils.profiling import counters
+
+        resident = [s for s in self._slots.values() if s.resident]
+        self.stats["peak_resident_partitions"] = max(
+            self.stats["peak_resident_partitions"], len(resident)
+        )
+        if self.budget_bytes:
+            evictable = sorted(
+                (s for s in resident if s.pid not in pin),
+                key=lambda s: s.last_used,
+            )
+            while self._resident_total > self.budget_bytes and evictable:
+                self._evict(evictable.pop(0))
+        counters.set_gauge(
+            "serve_partitions_resident",
+            float(sum(1 for s in self._slots.values() if s.resident)),
+        )
+        counters.set_gauge("serve_resident_bytes", float(self._resident_total))
+
+    def ensure_resident(self, pid: int, pin: frozenset | set = frozenset()) -> bool:
+        """Make partition `pid`'s sketch payload resident (lazily loading
+        it on first consult, re-probing a quarantined partition once its
+        backoff elapsed). Returns False — the caller's PARTIAL verdict —
+        when the partition is (or just became) unavailable."""
+        from drep_tpu.utils import faults, telemetry
+
+        slot = self._slots[pid]
+        if slot.n <= 0:
+            return True
+        if slot.resident:
+            self._tick += 1
+            slot.last_used = self._tick
+            return True
+        now = time.monotonic()
+        if slot.state == PARTITION_QUARANTINED and now < slot.next_probe_mono:
+            return False
+        probing = slot.state != PARTITION_HEALTHY
+        try:
+            with telemetry.span("partition_load", pid=pid, probe=probing):
+                faults.fire("partition_load")
+                if slot.u_of_local is None:
+                    self._load_spine(slot, self.union.names, self.union.locations)
+                    self.union.gdb = pd.DataFrame(
+                        {"genome": list(self.union.names), **self._stats_arrays}
+                    )
+                self._load_sketches(slot)
+        except Exception as err:  # noqa: BLE001 — containment: book and degrade
+            self._book_failure(slot, err, during="load")
+            return False
+        slot.resident = True
+        slot.loads += 1
+        self._tick += 1
+        slot.last_used = self._tick
+        slot.last_probe_mono = now
+        self._resident_total += slot.resident_bytes
+        self.stats["loads"] += 1
+        if probing:
+            self._mark_recovered(slot)
+        self._evict_to_budget(set(pin) | {pid})
+        return True
+
+    # ---- routing + per-partition compare --------------------------------
+    def route_candidates(self, q_bottoms: list[np.ndarray]) -> list[set[int]]:
+        """Per-query candidate partitions: the partitions whose genomes
+        can share a band code with the query (coarse-summary intersect —
+        recall 1.0, see rangepart.ROUTE_SUMMARY_BITS). Without a usable
+        routing summary every non-empty partition is a candidate."""
+        from drep_tpu.ops import rangepart
+
+        active = [pid for pid, s in self._slots.items() if s.n > 0]
+        if self._route_bitmaps is None:
+            return [set(active) for _ in q_bottoms]
+        out: list[set[int]] = []
+        for b in q_bottoms:
+            codes = rangepart.coarse_codes(b, self._route_bits)
+            out.append(
+                {
+                    pid for pid in active
+                    if pid < len(self._route_bitmaps)
+                    and rangepart.bitmap_contains_any(
+                        self._route_bitmaps[pid], codes
+                    )
+                }
+            )
+        return out
+
+    def classify_partition(
+        self, pid: int, q_names: list[str], q_bottoms: list[np.ndarray],
+        prune_cfg: dict | None,
+    ):
+        """One routed batch vs one resident partition: an ordinary rect
+        compare over [partition | queries] with ``min_col = n_p`` —
+        distances are pack-independent, so the retained (indexed, query)
+        edges are bit-identical to the union compare's slice for this
+        partition. Returns (union_i, query_idx, dist) or None after
+        booking a mid-compare failure (suspect/quarantine)."""
+        from drep_tpu.utils import faults, telemetry
+
+        slot = self._slots[pid]
+        try:
+            with telemetry.span("partition_classify", pid=pid, k=len(q_names)):
+                faults.fire("partition_classify")
+                return self._rect_compare(slot, q_names, q_bottoms, prune_cfg)
+        except Exception as err:  # noqa: BLE001 — mid-classify containment
+            self._book_failure(slot, err, during="classify")
+            return None
+
+    def _rect_compare(
+        self, slot: _PartitionSlot, q_names: list[str],
+        q_bottoms: list[np.ndarray], prune_cfg: dict | None,
+    ):
+        from drep_tpu.ops.minhash import pack_sketches
+        from drep_tpu.parallel.streaming import streaming_mash_edges
+
+        p = self.params
+        _, keep = _retention(p)
+        n_p = slot.n
+        part_names = [self.union.names[int(u)] for u in slot.u_of_local]
+        part_bottoms = [self.union.bottom[int(u)] for u in slot.u_of_local]
+        packed = pack_sketches(
+            part_bottoms + list(q_bottoms), part_names + list(q_names),
+            int(p["sketch_size"]),
+        )
+        prune = None
+        if prune_cfg and prune_cfg.get("primary_prune", "off") == "lsh":
+            from drep_tpu.ops.lsh import build_candidates
+
+            prune = build_candidates(
+                packed, keep=keep, k=int(p["kmer_size"]),
+                bands=int(prune_cfg.get("prune_bands", 0)),
+                min_shared=int(prune_cfg.get("prune_min_shared", 0)),
+                min_col=n_p,
+                join_chunk=int(prune_cfg.get("prune_join_chunk", 0)),
+            )
+        ii, jj, dd, _pairs = streaming_mash_edges(
+            packed, int(p["kmer_size"]), keep,
+            block=int(p["streaming_block"]), min_col=n_p, prune=prune,
+        )
+        sel = (jj >= n_p) & (ii < n_p)  # (indexed, query) pairs only
+        return slot.u_of_local[ii[sel]], jj[sel] - n_p, dd[sel]
+
+    # ---- union edge view -------------------------------------------------
+    def _spineless(self) -> set[int]:
+        return {
+            pid for pid, s in self._slots.items()
+            if s.n > 0 and s.u_of_local is None
+        }
+
+    def edges_excluding(self, excluded: set[int]):
+        """The union retained-edge graph with every edge incident to an
+        excluded (or spine-less) partition's genomes removed, in the
+        canonical global (ii, jj) lexsort order — the degraded graph a
+        PARTIAL verdict reclusters over (full graph when nothing is
+        excluded)."""
+        eff = frozenset(set(excluded) | self._spineless())
+        hit = self._edge_cache.get(eff)
+        if hit is not None:
+            return hit
+        parts_ii: list[np.ndarray] = []
+        parts_jj: list[np.ndarray] = []
+        parts_dd: list[np.ndarray] = []
+        for pid in sorted(self._slots):
+            slot = self._slots[pid]
+            if pid in eff or slot.intra is None or not len(slot.intra[0]):
+                continue
+            parts_ii.append(slot.intra[0])
+            parts_jj.append(slot.intra[1])
+            parts_dd.append(slot.intra[2])
+        ci, cj, cd = self._cross
+        if len(ci):
+            if eff:
+                bad = np.asarray(sorted(eff), np.int64)
+                mask = ~np.isin(self._cross_pi, bad) & ~np.isin(self._cross_pj, bad)
+                ci, cj, cd = ci[mask], cj[mask], cd[mask]
+            parts_ii.append(ci)
+            parts_jj.append(cj)
+            parts_dd.append(cd)
+        if parts_ii:
+            ii = np.concatenate(parts_ii)
+            jj = np.concatenate(parts_jj)
+            dd = np.concatenate(parts_dd)
+            order = np.lexsort((jj, ii))
+            out = (ii[order], jj[order], dd[order])
+        else:
+            out = _EMPTY_EDGES()
+        self._edge_cache[eff] = out
+        return out
+
+    def scratch_excluding(self, excluded: set[int]) -> LoadedIndex:
+        """A classify-scratch union copy (fresh containers, shared
+        immutable payloads — the _scratch_index contract); the caller
+        installs its own per-query edge view.
+
+        Excluded partitions' genomes keep their OLD primary labels —
+        the clean-cluster structure (and with it the from-scratch
+        renumbering) is untouched, which is what keeps unaffected
+        partitions' verdicts byte-identical to the oracle under a
+        quarantine — but are marked FROZEN (``frozen_rows``):
+        ``recluster`` carries their old suffix/score verbatim and never
+        routes them into a secondary recompute, because their sketch
+        payloads are exactly what is unavailable. A split cluster's
+        AVAILABLE remainder still re-clusters (the honest degraded
+        answer a PARTIAL verdict reports), which is why the component
+        closure makes remainders resident too."""
+        u = self.union
+        sq = LoadedIndex(
+            location=u.location, params=u.params, generation=u.generation,
+            names=list(u.names), locations=list(u.locations),
+            gdb=u.gdb, admitted=u.admitted,
+            bottom=list(u.bottom), scaled=list(u.scaled),
+            edges=u.edges, primary=u.primary, suffix=u.suffix,
+            score=u.score, winners=u.winners,
+        )
+        eff = set(excluded) | self._spineless()
+        if eff:
+            bad = np.isin(self.part_of, np.asarray(sorted(eff), np.int64))
+            sq.frozen_rows = np.nonzero(bad)[0]  # type: ignore[attr-defined]
+        return sq
+
+    # ---- health surface ---------------------------------------------------
+    def retry_hint_s(self) -> float:
+        """The strict-mode refusal's retry_after hint: the soonest any
+        quarantined partition will be probed again."""
+        now = time.monotonic()
+        waits = [
+            max(0.0, s.next_probe_mono - now)
+            for s in self._slots.values()
+            if s.state == PARTITION_QUARANTINED
+        ]
+        return round(max(0.05, min(waits) if waits else self.probe_backoff_s), 4)
+
+    def health_map(self) -> dict:
+        """The partition health map `/healthz` and `pod_status --serve`
+        render: per-partition state / residency / probe schedule, plus
+        the replica-level residency accounting."""
+        now = time.monotonic()
+        parts: dict[str, dict] = {}
+        for pid in sorted(self._slots):
+            s = self._slots[pid]
+            entry: dict = {
+                "state": s.state if s.n > 0 else "empty",
+                "resident": bool(s.resident),
+                "resident_bytes": int(s.resident_bytes),
+                "n_genomes": int(s.n),
+                "generation": int(s.meta_generation),
+                "loads": int(s.loads),
+                "last_probe_ago_s": (
+                    round(now - s.last_probe_mono, 3)
+                    if s.last_probe_mono is not None else None
+                ),
+            }
+            if s.state == PARTITION_QUARANTINED:
+                entry["next_probe_in_s"] = round(
+                    max(0.0, s.next_probe_mono - now), 3
+                )
+                entry["heal_hint"] = partition_heal_hint(pid)
+            if s.reason:
+                entry["reason"] = s.reason
+            parts[str(pid)] = entry
+        return {
+            "generation": self.generation,
+            "n_partitions": len(self._slots),
+            "resident_partitions": sum(
+                1 for s in self._slots.values() if s.resident
+            ),
+            "resident_bytes": int(self._resident_total),
+            "budget_bytes": int(self.budget_bytes),
+            "peak_resident_partitions": self.stats["peak_resident_partitions"],
+            "loads": self.stats["loads"],
+            "evictions": self.stats["evictions"],
+            "recoveries": self.stats["recoveries"],
+            "quarantined": sorted(
+                p for p, s in self._slots.items()
+                if s.state == PARTITION_QUARANTINED
+            ),
+            "suspect": sorted(
+                p for p, s in self._slots.items()
+                if s.state == PARTITION_SUSPECT
+            ),
+            "partitions": parts,
+        }
+
+
+# ---------------------------------------------------------------------------
+# streaming classify over a FederatedResident
+# ---------------------------------------------------------------------------
+
+
+def _query_query_edges(fed: FederatedResident, q_names: list[str], q_bottoms: list):
+    """Retained query-query edges for the JOINT mode, from a K-only pack
+    (pair distances are pack-independent: identical to the union rect
+    compare's query-query slice). Returns pack-local (ti, tj, dd)."""
+    from drep_tpu.ops.minhash import pack_sketches
+    from drep_tpu.parallel.streaming import streaming_mash_edges
+
+    if len(q_names) < 2:
+        return (np.empty(0, np.int64), np.empty(0, np.int64),
+                np.empty(0, np.float32))
+    p = fed.params
+    _, keep = _retention(p)
+    packed = pack_sketches(list(q_bottoms), list(q_names), int(p["sketch_size"]))
+    ii, jj, dd, _ = streaming_mash_edges(
+        packed, int(p["kmer_size"]), keep, block=int(p["streaming_block"])
+    )
+    return ii, jj, dd
+
+
+def _component_closure(
+    fed: FederatedResident,
+    q_edges: list[tuple[np.ndarray, np.ndarray]],  # per query: (union_i, dd)
+    unavailable: set[int],
+):
+    """Grow the consulted set until every member of every query's dirty
+    component is sketch-resident (the per-query recluster's secondary
+    stage needs co-member sketches), excluding — and stamping — the
+    partitions that cannot be loaded. Returns (base edge view, per-query
+    filtered direct edges, consulted-by-closure, unavailable)."""
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components as _cc
+
+    n_old = fed.n
+    k = len(q_edges)
+    excluded = set(unavailable)
+    closure_consulted: set[int] = set()
+    for _ in range(len(fed._slots) + 1):
+        base = fed.edges_excluding(excluded)
+        eff = excluded | fed._spineless()
+        filt: list[tuple[np.ndarray, np.ndarray]] = []
+        for ui, dd in q_edges:
+            if len(ui) and eff:
+                bad = np.asarray(sorted(eff), np.int64)
+                m = ~np.isin(fed.part_of[ui], bad)
+                ui, dd = ui[m], dd[m]
+            filt.append((ui, dd))
+        n_tot = n_old + k
+        ii = np.concatenate([base[0]] + [f[0] for f in filt])
+        jj = np.concatenate(
+            [base[1]]
+            + [np.full(len(f[0]), n_old + t, np.int64) for t, f in enumerate(filt)]
+        )
+        graph = coo_matrix(
+            (np.ones(len(ii), np.int8), (ii, jj)), shape=(n_tot, n_tot)
+        )
+        _, comp = _cc(graph, directed=False)
+        q_comps = {comp[n_old + t] for t in range(k)}
+        members = np.nonzero(np.isin(comp[:n_old], sorted(q_comps)))[0]
+        need = {int(p) for p in np.unique(fed.part_of[members])} if len(members) else set()
+        # a cluster SPLIT by the exclusion re-clusters its available
+        # remainder (the degraded answer) — multi-member remainders run
+        # the secondary stage, so their sketches must be resident too
+        if eff:
+            bad = np.isin(fed.part_of, np.asarray(sorted(eff), np.int64))
+            for lab in np.unique(fed.union.primary[bad]) if bad.any() else ():
+                rem = np.nonzero((fed.union.primary == lab) & ~bad)[0]
+                if len(rem) >= 2:
+                    need |= {int(p) for p in np.unique(fed.part_of[rem])}
+        need -= excluded
+        missing = set()
+        for pid in sorted(need - excluded):
+            if not fed.ensure_resident(pid, pin=need):
+                missing.add(pid)
+        closure_consulted |= need - missing - excluded
+        if not missing:
+            return base, filt, closure_consulted, excluded
+        excluded |= missing
+    return base, filt, closure_consulted, excluded  # pragma: no cover — bounded
+
+
+def _affected_by_exclusion(
+    fed: FederatedResident,
+    q_edges: list[tuple[np.ndarray, np.ndarray]],
+    eff: set[int],
+) -> list[set[int]]:
+    """Per query: the excluded partitions whose genomes are connected to
+    its UNFILTERED component — the transitive coverage holes the
+    filtered graph can no longer see. A quarantined partition's genome
+    can co-cluster with the query purely through dropped edges (an
+    a--b cross edge where the query only reaches `a`), in which case the
+    degraded answer differs from the oracle even though the partition
+    was never routed to or needed by the filtered closure — the verdict
+    must still stamp it unavailable, or a strict client would silently
+    accept the degraded answer. Built from every spine-loaded
+    partition's intra edges (a spine-less partition contributes only its
+    cross edges — its internal chains are unknowable, which can only
+    under-extend a component WITHIN that already-stamped partition)."""
+    from scipy.sparse import coo_matrix
+    from scipy.sparse.csgraph import connected_components as _cc
+
+    if not eff:
+        return [set() for _ in q_edges]
+    n_old = fed.n
+    k = len(q_edges)
+    parts_ii = [fed._cross[0]]
+    parts_jj = [fed._cross[1]]
+    for pid in sorted(fed._slots):
+        slot = fed._slots[pid]
+        if slot.intra is not None and len(slot.intra[0]):
+            parts_ii.append(slot.intra[0])
+            parts_jj.append(slot.intra[1])
+    ii = np.concatenate(parts_ii + [e[0] for e in q_edges])
+    jj = np.concatenate(
+        parts_jj
+        + [np.full(len(e[0]), n_old + t, np.int64) for t, e in enumerate(q_edges)]
+    )
+    n_tot = n_old + k
+    graph = coo_matrix((np.ones(len(ii), np.int8), (ii, jj)), shape=(n_tot, n_tot))
+    _, comp = _cc(graph, directed=False)
+    out: list[set[int]] = []
+    for t in range(k):
+        members = np.nonzero(comp[:n_old] == comp[n_old + t])[0]
+        pids = {int(p) for p in np.unique(fed.part_of[members])} if len(members) else set()
+        out.append(pids & eff)
+    return out
+
+
+def _stamp(verdict: dict, consulted: set[int], unavailable: set[int]) -> dict:
+    verdict["partitions_consulted"] = sorted(consulted)
+    verdict["partitions_unavailable"] = sorted(unavailable)
+    if unavailable:
+        verdict["partial"] = True
+    return verdict
+
+
+def classify_batch_federated(
+    fed: FederatedResident,
+    queries,
+    processes: int = 1,
+    prune_cfg: dict | None = None,
+    joint: bool = True,
+) -> list[dict]:
+    """Streaming per-partition classify (ISSUE 14 tentpole): route, run
+    one rect compare per (consulted partition x batch), merge the
+    per-partition edges, and assemble per-query verdicts through the
+    exact recluster machinery the union path runs — verdicts IDENTICAL
+    to union-assembled ``classify_batch`` (oracle-pinned in tests) when
+    every consulted partition is healthy, honest PARTIAL verdicts
+    (stamped ``partitions_consulted`` / ``partitions_unavailable``)
+    when one is not. No K-pad shape bucketing here: device shapes vary
+    with the consulted partition sizes anyway, and each per-partition
+    pack is already block-padded by the streaming executor."""
+    from drep_tpu.index.classify import _assemble_verdicts
+
+    if not queries.n:
+        return []
+    gen = int(fed.generation)
+    n_old = fed.n
+    q_names = list(queries.admitted["genome"])
+    q_bottoms = [
+        np.asarray(queries.results[g]["bottom"], np.uint64) for g in q_names
+    ]
+    k = len(q_names)
+    cand = fed.route_candidates(q_bottoms)
+    consulted: set[int] = set()
+    unavailable: set[int] = set()
+    q_edges: list[tuple[np.ndarray, np.ndarray]] = [
+        (np.empty(0, np.int64), np.empty(0, np.float32)) for _ in range(k)
+    ]
+    for pid in sorted(set().union(*cand) if cand else ()):
+        cols = [t for t in range(k) if pid in cand[t]]
+        if not fed.ensure_resident(pid, pin={pid}):
+            unavailable.add(pid)
+            continue
+        res = fed.classify_partition(
+            pid, [q_names[t] for t in cols], [q_bottoms[t] for t in cols],
+            prune_cfg,
+        )
+        if res is None:
+            unavailable.add(pid)
+            continue
+        consulted.add(pid)
+        ui, qt, dd = res
+        for j, t in enumerate(cols):
+            s = qt == j
+            if s.any():
+                old_ui, old_dd = q_edges[t]
+                q_edges[t] = (
+                    np.concatenate([old_ui, ui[s]]),
+                    np.concatenate([old_dd, dd[s].astype(np.float32)]),
+                )
+
+    routed_unavailable = set(unavailable)
+    base, filt, closure_consulted, excluded = _component_closure(
+        fed, q_edges, unavailable
+    )
+    closure_missing = excluded - routed_unavailable
+    unavailable = excluded  # closure started from the routed failures
+    # a partition can be consulted for the compare and THEN fail its
+    # closure reload (evicted + rot landed in between): its edges were
+    # re-filtered out, so "consulted" must not keep claiming it — the
+    # two stamps are one-or-the-other by contract
+    consulted = (consulted | closure_consulted) - unavailable
+    closure_consulted -= unavailable
+    # transitive coverage holes: excluded partitions reachable from a
+    # query's component only through DROPPED edges still degrade its
+    # answer and must be stamped (see _affected_by_exclusion)
+    affected = _affected_by_exclusion(
+        fed, q_edges, unavailable | fed._spineless()
+    )
+
+    if joint:
+        sq = fed.scratch_excluding(excluded)
+        _admit_batch(sq, queries.admitted, queries.results, gen + 1)
+        ti, tj, td = _query_query_edges(fed, q_names, q_bottoms)
+        new_ii = np.concatenate([f[0] for f in filt] + [n_old + ti])
+        new_jj = np.concatenate(
+            [np.full(len(f[0]), n_old + t, np.int64) for t, f in enumerate(filt)]
+            + [n_old + tj]
+        )
+        new_dd = np.concatenate([f[1] for f in filt] + [td])
+        order = np.lexsort((new_jj, new_ii))
+        new_ii, new_jj, new_dd = new_ii[order], new_jj[order], new_dd[order]
+        sq.edges = (
+            np.concatenate([base[0], new_ii]),
+            np.concatenate([base[1], new_jj]),
+            np.concatenate([base[2], new_dd]),
+        )
+        recluster(sq, n_old, processes=processes)
+        out = _assemble_verdicts(sq, n_old, new_ii, new_jj, new_dd, gen)
+        fed._evict_to_budget(set())  # settle under the budget between batches
+        joint_unavail = unavailable | set().union(*affected)
+        return [_stamp(v, consulted - joint_unavail, joint_unavail) for v in out]
+
+    out: list[dict] = []
+    for t in range(k):
+        sq = fed.scratch_excluding(excluded)
+        _admit_batch(sq, queries.admitted.iloc[[t]], queries.results, gen + 1)
+        ui, dd = filt[t]
+        order = np.argsort(ui, kind="stable")
+        qii, qdd = ui[order], dd[order]
+        qjj = np.full(len(qii), n_old, np.int64)
+        sq.edges = (
+            np.concatenate([base[0], qii]),
+            np.concatenate([base[1], qjj]),
+            np.concatenate([base[2], qdd]),
+        )
+        recluster(sq, n_old, processes=processes)
+        v = _assemble_verdicts(sq, n_old, qii, qjj, qdd, gen)[0]
+        # this query's coverage: its routed candidates plus whatever the
+        # component closure pulled in (closure needs are graph-global —
+        # attributed to every query, honestly erring toward "consulted")
+        unavail_t = (routed_unavailable & cand[t]) | closure_missing | affected[t]
+        consulted_t = ((consulted & cand[t]) | closure_consulted) - unavail_t
+        out.append(_stamp(v, consulted_t, unavail_t))
+    # one batch's working set (every query component's sketches) is
+    # legitimately pinned above the budget while in flight; settle back
+    # under it before the next batch — residency is an inter-batch
+    # contract, the peak gauge records the in-flight truth
+    fed._evict_to_budget(set())
+    return out
+
+
+# ---------------------------------------------------------------------------
 # federated build + update
 # ---------------------------------------------------------------------------
 
@@ -627,11 +1699,11 @@ def build_federated(
     update, so a killed build resumes through the exact update machinery
     (`index update <root> -g <same paths>`) and converges.
 
-    Note: partition MATERIALIZATION (a partition's first batch) runs
-    in-process even under ``fed_pods`` — the pinned params come verbatim
-    from the meta, which the CLI bootstrap build cannot fully express
-    (see the ROADMAP follow-on); subsequent updates of existing
-    partitions parallelize as pods."""
+    Under ``fed_pods`` even partition MATERIALIZATION (each partition's
+    generation 0) parallelizes: the router's sketches and the meta's
+    pinned params ride a ``--params_file`` handoff into each pod
+    (:func:`write_params_handoff` — the ISSUE 14 fix for the old
+    pods-can't-ride-the-CLI limitation)."""
     store = FederationStore(location)
     if store.exists() or IndexStore(location).exists():
         raise UserInputError(
@@ -675,31 +1747,81 @@ def build_federated(
     return summary
 
 
+def write_params_handoff(
+    path: str, params: dict, batch: pd.DataFrame, results: dict[str, dict]
+) -> None:
+    """The router -> partition-pod handoff (ISSUE 14 satellite): the
+    routed batch's ALREADY-COMPUTED sketches plus the federation's
+    PINNED params, serialized as one durable npz — so a ``--fed_pods``
+    pod neither re-sketches its batch nor needs the CLI bootstrap to
+    express the meta's params (which it cannot: generation-0
+    materialization now parallelizes as pods too). The in-process path
+    passes the same (batch, results) directly (``presketched``)."""
+    import json
+
+    from drep_tpu.ingest import pack_ragged
+    from drep_tpu.utils.ckptmeta import atomic_savez
+
+    names = list(batch["genome"])
+    payload: dict[str, np.ndarray] = {
+        "names": np.array(names, dtype=str),
+        "locations": np.array(list(batch["location"]), dtype=str),
+        "params_json": np.array(json.dumps(params, sort_keys=True)),
+    }
+    for c in _STAT_COLS:
+        payload[c] = np.array([results[g][c] for g in names], np.int64)
+    for key in ("bottom", "scaled"):
+        payload[key], payload[f"{key}_offsets"] = pack_ragged(
+            [results[g][key] for g in names]
+        )
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    atomic_savez(path, **payload)
+
+
+def read_params_handoff(path: str) -> dict:
+    """Read a :func:`write_params_handoff` file back into
+    {"params", "batch", "results"} — the exact shapes ``sketch_batch``
+    produces, so the consuming update is bit-identical to an in-process
+    one (sketches were computed once, by the router)."""
+    import json
+
+    from drep_tpu.ingest import unpack_ragged
+    from drep_tpu.utils.durableio import load_npz_checked
+
+    z = load_npz_checked(path, what="params handoff")
+    names = [str(x) for x in z["names"]]
+    bottom = unpack_ragged(z["bottom"], z["bottom_offsets"], len(names))
+    scaled = unpack_ragged(z["scaled"], z["scaled_offsets"], len(names))
+    results = {
+        g: {
+            "bottom": bottom[i], "scaled": scaled[i],
+            **{c: int(z[c][i]) for c in _STAT_COLS},
+        }
+        for i, g in enumerate(names)
+    }
+    batch = pd.DataFrame(
+        {"genome": names, "location": [str(x) for x in z["locations"]]}
+    )
+    return {
+        "params": json.loads(str(z["params_json"])),
+        "batch": batch,
+        "results": results,
+    }
+
+
 def _build_partition(
-    part_dir: str, paths: list[str], params: dict, processes: int
+    part_dir: str, params: dict, batch: pd.DataFrame, results: dict,
+    processes: int,
 ) -> None:
     """Materialize an empty partition's generation 0 with the
-    federation's PINNED params (the ordinary bootstrap build takes CLI
-    kwargs; a partition must inherit the meta's params verbatim so
-    build-time and update-time numerics can never drift)."""
-    from drep_tpu.utils.profiling import counters
+    federation's PINNED params and the router's sketches (never
+    re-sketched — the shared ``materialize_generation0`` core the
+    ``--params_file`` pod path runs too)."""
+    from drep_tpu.index.update import materialize_generation0
 
-    store = IndexStore(part_dir)
-    idx = empty_index(dict(params), location=store.location)
-    batch, results = sketch_batch(idx, paths, processes=processes)
-    if not len(batch):
-        raise UserInputError(
-            f"partition {part_dir}: no routed genome survived the length "
-            f"filter — nothing to materialize"
-        )
-    _admit_batch(idx, batch, results, 0)
-    with counters.stage("index_rect_compare"):
-        ii, jj, dd, pairs = _rect_edges(idx, 0, store.pending_dir(0))
-    counters.stages["index_rect_compare"].pairs += pairs
-    order = np.lexsort((jj, ii))
-    idx.edges = (ii[order], jj[order], dd[order])
-    recluster(idx, 0, processes=processes)
-    publish_generation(store, idx, 0, 0, idx.edges)
+    materialize_generation0(
+        IndexStore(part_dir), params, batch, results, processes=processes
+    )
 
 
 def _partition_generation(part_dir: str) -> int:
@@ -732,16 +1854,20 @@ def _partition_names(part_dir: str, lo: int = 0) -> list[str]:
 
 
 def _run_pods(
-    jobs: list[tuple[int, str, list[str], dict]], pods: int, processes: int
+    jobs: list[tuple[int, str, str, dict]], pods: int, processes: int
 ) -> dict[int, object]:
     """Run partition-update jobs as detached `index update` CLI pods, up
     to `pods` concurrently. Each pod is the ordinary single-store update
     — crash-resumable on its own pending checkpoint, publishing its own
-    manifest atomically. Pod output goes to a temp file per pod (a PIPE
-    left undrained until exit would deadlock a chatty pod against the OS
-    pipe buffer). The ``partition_update`` fault site fires immediately
-    before EACH pod launch (the registered skip=N semantics); a raise
-    there books that partition failed, like the in-process path. Returns
+    manifest atomically — consuming the router's sketches + pinned
+    params through a ``--params_file`` handoff (never re-sketching, and
+    MATERIALIZING an empty partition's generation 0 when the store does
+    not exist yet — the ISSUE 14 pods-can't-ride-the-CLI fix). Pod
+    output goes to a temp file per pod (a PIPE left undrained until exit
+    would deadlock a chatty pod against the OS pipe buffer). The
+    ``partition_update`` fault site fires immediately before EACH pod
+    launch (the registered skip=N semantics); a raise there books that
+    partition failed, like the in-process path. Returns
     {pid: returncode or failure-message}."""
     import tempfile
 
@@ -756,7 +1882,7 @@ def _run_pods(
     results: dict[int, object] = {}
     while queue or running:
         while queue and len(running) < max(1, pods):
-            pid, part_dir, paths, prune_flags = queue.pop(0)
+            pid, part_dir, handoff, prune_flags = queue.pop(0)
             try:
                 faults.fire("partition_update")
             except Exception as e:  # noqa: BLE001 — same partition-level
@@ -767,12 +1893,13 @@ def _run_pods(
                 )
                 continue
             cmd = [sys.executable, "-m", "drep_tpu", "index", "update", part_dir,
-                   "-g", *paths, "-p", str(processes)]
+                   "--params_file", handoff, "-p", str(processes)]
             for flag, val in prune_flags.items():
                 if val:
                     cmd += [f"--{flag}", str(val)]
             logger.info("federated update: launching pod for partition %d "
-                        "(%d genome(s))", pid, len(paths))
+                        "(sketches ride the params handoff %s)",
+                        pid, os.path.basename(handoff))
             log = tempfile.TemporaryFile(mode="w+")
             proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log, text=True)
             running[pid] = (proc, log)
@@ -860,6 +1987,37 @@ def fed_update(
                 store.fedstate_name(gen), union, part_of, local_of
             )
             logger.warning("federated index: union state healed via full recompute")
+        # routing-summary heal/upgrade: the streaming serve router needs
+        # the per-partition coarse-code bitmaps (ISSUE 14); a rotted file
+        # recomputes deterministically from the resident union, and a
+        # pre-routing federation gains one on its first heal pass (the
+        # meta republishes at the SAME generation with the family added)
+        if union.n and gen >= 0:
+            rt_rel = m.get("routing") or store.routing_name(gen)
+            rt_ok = False
+            if m.get("routing"):
+                from drep_tpu.utils import durableio
+
+                try:
+                    durableio.load_npz_checked(
+                        store.abspath(rt_rel), what="routing summary"
+                    )
+                    rt_ok = True
+                except Exception:  # noqa: BLE001 — missing/corrupt -> rewrite
+                    rt_ok = False
+            if not rt_ok:
+                store.ensure_dirs()
+                store.write_routing_summary(
+                    rt_rel, union.bottom, part_of, int(m["n_partitions"])
+                )
+                summary["healed"] = list(summary["healed"]) + [rt_rel]
+                if m.get("routing") != rt_rel:
+                    m2 = dict(m)
+                    m2["routing"] = rt_rel
+                    store.publish_meta(m2)
+                logger.info(
+                    "federated heal pass: routing summary rewritten (%s)", rt_rel
+                )
         if union.healed:
             logger.info("federated heal pass: repaired %s", union.healed)
         return summary
@@ -892,8 +2050,7 @@ def fed_update(
                 f"interrupted update with ITS batch first (its admitted "
                 f"tail must reach the union before a new batch lands)"
             )
-    jobs: list[tuple[int, str, list[str], dict]] = []  # update pods
-    builds: list[int] = []
+    dirty: list[tuple[int, str, str]] = []  # (pid, part_dir, build|update)
     done: set[int] = set()
     for pid in sorted(routed):
         pdir = store.partition_dir(pid)
@@ -902,7 +2059,7 @@ def fed_update(
         base_n = meta_n[pid]
         if meta_gen[pid] < 0:
             if actual_gen < 0:
-                builds.append(pid)
+                dirty.append((pid, pdir, "build"))
             elif actual_gen == 0 and sorted(_partition_names(pdir)) == sorted(want):
                 done.add(pid)  # a killed prior attempt already materialized it
             else:
@@ -914,7 +2071,7 @@ def fed_update(
                     f"{pdir} / restore the federation backup"
                 )
         elif actual_gen == meta_gen[pid]:
-            jobs.append((pid, pdir, list(routed[pid]["location"]), prune_flags))
+            dirty.append((pid, pdir, "update"))
         elif actual_gen == meta_gen[pid] + 1 and sorted(
             _partition_names(pdir, lo=base_n)
         ) == sorted(want):
@@ -928,44 +2085,65 @@ def fed_update(
             )
 
     # -- run the dirty partitions as independent units --------------------
+    # The router already sketched the whole batch — partitions consume
+    # those sketches (never re-sketching): in-process via `presketched`,
+    # pods via a `--params_file` handoff that also carries the pinned
+    # params, so BUILDS (generation-0 materialization) parallelize as
+    # pods too (the ROADMAP federated follow-on (b) fix).
     failed: dict[int, str] = {}
-    for pid in builds:
-        try:
-            faults.fire("partition_update")
-            _build_partition(
-                store.partition_dir(pid), list(routed[pid]["location"]),
-                params, processes,
+    if fed_pods > 0 and dirty:
+        store.ensure_dirs()
+        jobs: list[tuple[int, str, str, dict]] = []
+        handoffs: list[str] = []
+        for pid, pdir, _kind in dirty:
+            handoff = store.abspath(
+                os.path.join("log", f"handoff_p{pid:03d}_g{gen_new:06d}.npz")
             )
-            telemetry.event("federation_partition", pid=pid, op="build",
-                            n=len(routed[pid]))
-        except Exception as e:  # noqa: BLE001 — partition-level failure is
-            # tolerated: the partition stays absent, the publish is partial
-            failed[pid] = f"{type(e).__name__}: {e}"
-            logger.error("federated update: partition %d build failed: %s", pid, e)
-    if fed_pods > 0 and jobs:
-        rcs = _run_pods(jobs, fed_pods, processes)
+            write_params_handoff(handoff, params, routed[pid], results)
+            handoffs.append(handoff)
+            jobs.append((pid, pdir, handoff, prune_flags))
+        try:
+            rcs = _run_pods(jobs, fed_pods, processes)
+        finally:
+            import contextlib
+
+            for handoff in handoffs:
+                with contextlib.suppress(OSError):
+                    os.remove(handoff)
         for pid, rc in rcs.items():
             if rc != 0:
                 failed[pid] = (
                     f"pod exited rc={rc}" if isinstance(rc, int) else str(rc)
                 )
+            else:
+                telemetry.event(
+                    "federation_partition", pid=pid, op="pod",
+                    n=len(routed[pid]),
+                )
     else:
-        for pid, pdir, paths, _pf in jobs:
+        for pid, pdir, kind in dirty:
             try:
                 faults.fire("partition_update")
-                index_update(
-                    pdir, paths, processes=processes,
-                    primary_prune=primary_prune, prune_bands=prune_bands,
-                    prune_min_shared=prune_min_shared,
-                    prune_join_chunk=prune_join_chunk,
-                )
-                telemetry.event("federation_partition", pid=pid, op="update",
-                                n=len(paths))
-            except Exception as e:  # noqa: BLE001 — same partial-publish
-                # tolerance as the pod path (a SIGKILL still kills us whole)
+                if kind == "build":
+                    _build_partition(
+                        pdir, params, routed[pid], results, processes
+                    )
+                else:
+                    index_update(
+                        pdir, None, processes=processes,
+                        primary_prune=primary_prune, prune_bands=prune_bands,
+                        prune_min_shared=prune_min_shared,
+                        prune_join_chunk=prune_join_chunk,
+                        presketched=(routed[pid], results),
+                    )
+                telemetry.event("federation_partition", pid=pid, op=kind,
+                                n=len(routed[pid]))
+            except Exception as e:  # noqa: BLE001 — partition-level failure
+                # is tolerated: the partition stays at its old generation
+                # (or absent), the publish is PARTIAL
                 failed[pid] = f"{type(e).__name__}: {e}"
                 logger.error(
-                    "federated update: partition %d update failed: %s", pid, e
+                    "federated update: partition %d %s failed: %s", pid, kind, e
                 )
 
     succeeded = sorted((set(routed) - set(failed)) | done)
@@ -1037,11 +2215,15 @@ def fed_update(
     store.ensure_dirs()
     cr_rel = store.cross_shard_name(gen_new)
     st_rel = store.fedstate_name(gen_new)
+    rt_rel = store.routing_name(gen_new)
     store.write_cross_shard(
         cr_rel, xi, xj, xd, part_of[n_old:], local_of[n_old:]
     )
     union.generation = gen_new
     store.write_fedstate(st_rel, union, part_of, local_of)
+    store.write_routing_summary(
+        rt_rel, union.bottom, part_of, int(m["n_partitions"])
+    )
     new_n = {pid: meta_n[pid] for pid in meta_n}
     new_gen = dict(meta_gen)
     for pid in sorted(routed):
@@ -1073,6 +2255,7 @@ def fed_update(
         "cross_shards": list(m.get("cross_shards", ()))
         + [{"file": cr_rel, "lo": n_old, "hi": union.n, "generation": gen_new}],
         "state": st_rel,
+        "routing": rt_rel,
     }
     if failed:
         meta_new["partial"] = {
@@ -1080,7 +2263,7 @@ def fed_update(
             "unadmitted": sorted(unadmitted),
         }
     store.publish_meta(meta_new)
-    store.gc_states(st_rel)
+    store.gc_states(st_rel, rt_rel)
 
     summary.update(
         {

@@ -214,13 +214,26 @@ def _score_cluster(
 def recluster(idx: LoadedIndex, n_old: int, processes: int = 1) -> dict:
     """Recompute the index's derived state after `idx` gained genomes
     beyond `n_old` (sketches + edges already extended in memory). Mutates
-    idx.primary/suffix/score/winners; returns an honest summary."""
+    idx.primary/suffix/score/winners; returns an honest summary.
+
+    ``idx.frozen_rows`` (set by the streaming federated serving path,
+    ISSUE 14) marks genomes whose sketch payloads are UNAVAILABLE
+    (quarantined partitions): they keep their old primary label (the
+    clean-cluster structure and renumbering are untouched), carry their
+    old suffix/score verbatim when their cluster is reused whole, and
+    when a recompute would touch them (their cluster was split by the
+    exclusion) they are carried with sentinel suffix 0 + old score while
+    only the AVAILABLE members re-cluster — never routed into a
+    secondary engine their sketches cannot feed."""
     from drep_tpu.cluster.controller import secondary_for_cluster
 
     t0 = time.perf_counter()
     old_primary = idx.primary
     old_suffix = idx.suffix
     old_score = idx.score
+    frozen: set[int] = set(
+        int(i) for i in getattr(idx, "frozen_rows", ())
+    )
     # member-set-keyed reuse: any union primary cluster whose member set
     # equals an old one has IDENTICAL secondary results and scores (they
     # depend only on the members) — old indices are stable, so frozensets
@@ -281,6 +294,18 @@ def recluster(idx: LoadedIndex, n_old: int, processes: int = 1) -> dict:
                 win_rows.append((f"{pc}_{s_val}", won[0], won[1]))
             continue
         recomputed += 1
+        if frozen:
+            held = [i for i in members if i in frozen]
+            if held:
+                # unavailable members ride along with sentinel suffix 0
+                # (never a real secondary) and their old score; only the
+                # available remainder re-clusters below
+                for i in held:
+                    suffix[i] = 0
+                    score[i] = old_score[i] if i < len(old_score) else 0.0
+                members = [i for i in members if i not in frozen]
+                if not members:
+                    continue  # whole cluster unavailable: no winner row
         if len(members) == 1:
             i = members[0]
             suffix[i] = 1  # the pipeline's singleton convention ("pc_1")
@@ -411,10 +436,50 @@ def publish_generation(
     store.gc_states(st_rel)
 
 
+def materialize_generation0(
+    store: IndexStore, params: dict, batch: pd.DataFrame,
+    results: dict[str, dict], processes: int = 1,
+) -> dict:
+    """Generation 0 of a NEW store from pre-sketched genomes and PINNED
+    params — the federated partition-materialization core (ISSUE 14
+    satellite): the ordinary bootstrap build resolves params from CLI
+    kwargs, but a federation partition must inherit the meta's params
+    verbatim (build-time and update-time numerics can never drift), and
+    under ``--fed_pods`` the pinned params cannot ride the CLI — they
+    arrive through the params-file handoff instead."""
+    from drep_tpu.index.store import empty_index
+    from drep_tpu.utils.profiling import counters
+
+    if not len(batch):
+        raise UserInputError(
+            f"partition {store.location}: no routed genome survived the "
+            f"length filter — nothing to materialize"
+        )
+    idx = empty_index(dict(params), location=store.location)
+    _admit_batch(idx, batch, results, 0)
+    with counters.stage("index_rect_compare"):
+        ii, jj, dd, pairs = _rect_edges(idx, 0, store.pending_dir(0))
+    counters.stages["index_rect_compare"].pairs += pairs
+    order = np.lexsort((jj, ii))
+    idx.edges = (ii[order], jj[order], dd[order])
+    summary = recluster(idx, 0, processes=processes)
+    publish_generation(store, idx, 0, 0, idx.edges)
+    summary.update(
+        {
+            "admitted": idx.n, "n_genomes": idx.n, "generation": 0,
+            "new_edges": int(len(ii)), "pairs_compared": int(pairs),
+            "healed": [],
+        }
+    )
+    return summary
+
+
 def index_update(
     index_loc: str, genome_paths: list[str] | None, processes: int = 1,
     primary_prune: str = "off", prune_bands: int = 0, prune_min_shared: int = 0,
     prune_join_chunk: int = 0, fed_pods: int | None = None,
+    params_file: str | None = None,
+    presketched: tuple[pd.DataFrame, dict] | None = None,
 ) -> dict:
     """`index update`: admit K new genomes (sketch K, compare K x N,
     re-cluster dirty components, re-score touched clusters) and publish
@@ -430,7 +495,17 @@ def index_update(
     `primary_prune="lsh"` routes the rect compare through the LSH
     candidate set (see _rect_edges) — a per-invocation execution knob,
     never pinned in the manifest, because the admitted edges are
-    identical either way (recall 1.0 at the retention bound)."""
+    identical either way (recall 1.0 at the retention bound).
+
+    ``params_file`` (ISSUE 14 satellite, the pods-can't-ride-the-CLI
+    fix): a sketches+params handoff written by a federated router
+    (``federation.write_params_handoff``). The routed batch's sketches
+    ride it — the pod never re-sketches what the router already
+    sketched — and a store that does not exist yet MATERIALIZES
+    generation 0 with the handoff's pinned params, so even a partition's
+    first batch parallelizes under ``--fed_pods``. ``presketched`` is
+    the in-process equivalent (the router passes its (batch, results)
+    directly)."""
     from drep_tpu.index import meta as fedmeta
     from drep_tpu.utils import faults
     from drep_tpu.utils.profiling import counters
@@ -438,6 +513,11 @@ def index_update(
     if fedmeta.is_federated(index_loc):
         from drep_tpu.index.federation import fed_update
 
+        if params_file or presketched:
+            raise UserInputError(
+                "--params_file targets ONE partition store (the router "
+                "writes it); the federation root takes plain -g genomes"
+            )
         return fed_update(
             index_loc, genome_paths, processes=processes, fed_pods=fed_pods,
             primary_prune=primary_prune, prune_bands=prune_bands,
@@ -445,12 +525,41 @@ def index_update(
         )
     logger = get_logger()
     store = IndexStore(index_loc)
+    handoff_params = None
+    if params_file:
+        from drep_tpu.index.federation import read_params_handoff
+
+        handoff = read_params_handoff(params_file)
+        handoff_params = handoff["params"]
+        presketched = (handoff["batch"], handoff["results"])
+        if not store.exists():
+            # partition materialization in a pod: generation 0 under the
+            # handoff's PINNED params (the same `index_update` fault
+            # site as the ordinary path fires inside publish_generation)
+            return materialize_generation0(
+                store, handoff_params, *presketched, processes=processes
+            )
     idx = load_index(index_loc, heal=True)
+    if handoff_params is not None and dict(idx.params) != dict(handoff_params):
+        raise UserInputError(
+            f"params handoff {params_file} pins different params than the "
+            f"store at {index_loc} — the handoff belongs to a different "
+            f"federation (or generation); refuse rather than drift numerics"
+        )
     faults.fire("index_update")  # batch admission point (chaos)
     gen_new = idx.generation + 1
 
     batch = results = None
-    if genome_paths:
+    if presketched is not None:
+        batch, results = presketched
+        dup = sorted(set(batch["genome"]) & set(idx.names))
+        if dup:
+            raise UserInputError(
+                f"{len(dup)} handoff genome basename(s) already indexed: "
+                f"{dup[:5]} — the router routed a batch this store already "
+                f"admitted (resume the interrupted update instead)"
+            )
+    elif genome_paths:
         batch, results = sketch_batch(idx, genome_paths, processes=processes)
     if batch is None or not len(batch):
         # heal-only pass: rotted state recomputes (all components dirty),

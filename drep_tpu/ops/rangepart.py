@@ -44,6 +44,15 @@ MIN_BUCKET_WIDTH = 128  # lane width — never repack below one full lane row
 # code (the false-positive direction, paid in candidate count only).
 HASH_CODE_SHIFT = 34
 
+# coarse ROUTING-summary code space (ISSUE 14, the streaming federated
+# classify router): top 16 bits of the raw hash — a further monotone
+# many-to-one coarsening of the band code (coarse = band >> 14), so the
+# recall chain composes: a retained pair shares a raw hash => shares a
+# band code => shares a coarse code. 2^16 codes pack into an 8 KiB
+# bitmap per partition — small enough to keep EVERY partition's summary
+# resident while the sketch payloads themselves stay lazily loaded.
+ROUTE_SUMMARY_BITS = 16
+
 
 def hash_code_matrix(hash_rows: list[np.ndarray], shift: int = HASH_CODE_SHIFT) -> np.ndarray:
     """Sorted uint64 hash rows (raw bottom sketches) -> one [N, W] int32
@@ -67,6 +76,43 @@ def hash_code_matrix(hash_rows: list[np.ndarray], shift: int = HASH_CODE_SHIFT) 
     for i, c in enumerate(codes):
         out[i, : len(c)] = c
     return out
+
+
+def coarse_codes(hash_row: np.ndarray, bits: int = ROUTE_SUMMARY_BITS) -> np.ndarray:
+    """Distinct sorted coarse routing codes (top `bits` bits) of one raw
+    uint64 hash row — the query side of the partition routing summary."""
+    return np.unique(
+        (np.asarray(hash_row, np.uint64) >> np.uint64(64 - bits)).astype(np.int64)
+    )
+
+
+def code_summary_bitmap(
+    hash_rows: list[np.ndarray], bits: int = ROUTE_SUMMARY_BITS
+) -> np.ndarray:
+    """One packed-uint64 bitmap over the 2^bits coarse code space with a
+    set bit for every coarse code present in ANY of `hash_rows` — a
+    partition's routing summary. Exact (no false negatives): membership
+    here is a superset test, never a probabilistic filter, so the
+    streaming router keeps the boundary join's recall-1.0 chain."""
+    bm = np.zeros((1 << bits) >> 6, np.uint64)
+    for r in hash_rows:
+        c = coarse_codes(r, bits)
+        np.bitwise_or.at(
+            bm, c >> 6, np.left_shift(np.uint64(1), (c & 63).astype(np.uint64))
+        )
+    return bm
+
+
+def bitmap_contains_any(bitmap: np.ndarray, codes: np.ndarray) -> bool:
+    """Does the summary bitmap hold ANY of the (distinct int64) coarse
+    codes? The router's per-(query, partition) consult decision."""
+    if not len(codes):
+        return False
+    codes = np.asarray(codes, np.int64)
+    hits = bitmap[codes >> 6] & np.left_shift(
+        np.uint64(1), (codes & 63).astype(np.uint64)
+    )
+    return bool(np.any(hits != 0))
 
 
 def vocab_extent(ids: np.ndarray) -> int:

@@ -38,6 +38,9 @@ class PendingRequest:
     genome: str  # absolute FASTA path
     reply: Callable[[dict], None]  # writes one response to the client
     req_id: Any = None
+    # strict partition-coverage mode (ISSUE 14): a PARTIAL verdict is
+    # converted into a partial_coverage refusal with retry_after_s
+    strict: bool = False
     enqueued_at: float = field(default_factory=time.monotonic)
 
     @property

@@ -108,16 +108,23 @@ class ServeClient:
                              reason=resp.get("reason"))
         return resp["status"]
 
-    def classify(self, genome: str, retries: int = 0) -> dict:
+    def classify(self, genome: str, retries: int = 0, strict: bool = False) -> dict:
         """Classify one genome; returns the full classify response
         (``verdict``, ``generation``, ``batch_size``, latencies).
         Honors backpressure up to `retries` times, sleeping the
-        daemon's own ``retry_after_s`` hint between attempts."""
+        daemon's own ``retry_after_s`` hint between attempts.
+
+        ``strict`` (federated serving): refuse PARTIAL partition
+        coverage — a verdict that would be stamped with
+        ``partitions_unavailable`` comes back as a ``partial_coverage``
+        refusal carrying ``retry_after_s`` (the next reload-probe
+        instant), which the retry loop here honors like backpressure."""
         attempt = 0
         while True:
-            resp = self.request(
-                {"op": "classify", "genome": genome, "id": uuid.uuid4().hex[:8]}
-            )
+            req = {"op": "classify", "genome": genome, "id": uuid.uuid4().hex[:8]}
+            if strict:
+                req["strict"] = True
+            resp = self.request(req)
             if resp.get("ok"):
                 return resp
             retry_after = resp.get("retry_after_s")
@@ -130,7 +137,7 @@ class ServeClient:
                 reason=resp.get("reason"), retry_after_s=retry_after,
             )
 
-    def classify_many(self, genomes: list[str]) -> list[dict]:
+    def classify_many(self, genomes: list[str], strict: bool = False) -> list[dict]:
         """PIPELINED classify: all requests go out before any reply is
         read, so the daemon's batch window sees them together (the
         coalescing path). Replies are matched by request id; returns
@@ -140,7 +147,10 @@ class ServeClient:
             for g in genomes:
                 rid = uuid.uuid4().hex[:8]
                 ids.append(rid)
-                self._send({"op": "classify", "genome": g, "id": rid})
+                req = {"op": "classify", "genome": g, "id": rid}
+                if strict:
+                    req["strict"] = True
+                self._send(req)
             by_id: dict[str, dict] = {}
             for _ in genomes:
                 resp = self._recv()

@@ -79,6 +79,9 @@ class ServeConfig:
     processes: int = 1
     prune_cfg: dict | None = None
     log_dir: str | None = None  # metrics/telemetry home — never the index
+    # streaming federated serving (ISSUE 14): byte budget (MiB) for
+    # resident partition sketch payloads; None -> DREP_TPU_SERVE_RESIDENT_MB
+    resident_mb: int | None = None
 
     def address(self) -> str:
         return self.socket_path if self.socket_path else f"{self.host}:{self.port}"
@@ -92,6 +95,7 @@ class _ServeStats:
     errors_total: int = 0
     batches_total: int = 0
     swaps_total: int = 0
+    partial_refusals: int = 0  # strict-mode refusals on PARTIAL coverage
 
 
 class IndexServer:
@@ -122,7 +126,9 @@ class IndexServer:
         and generation-poller threads. Returns the bound address."""
         t0 = time.monotonic()
         with telemetry.span("serve_load", index=self.cfg.index_loc):
-            self._resident = load_resident_index(self.cfg.index_loc)
+            self._resident = load_resident_index(
+                self.cfg.index_loc, resident_mb=self.cfg.resident_mb
+            )
         counters.set_gauge("serve_generation", float(self._resident.generation))
         get_logger().info(
             "index serve: generation %d (%d genomes) resident in %.2fs",
@@ -285,6 +291,22 @@ class IndexServer:
                     path_err.get(base, f"no verdict produced for {req.genome}"),
                     req_id=req.req_id, reason="classify_failed",
                 )
+            elif req.strict and verdict.get("partitions_unavailable"):
+                # the --strict contract (ISSUE 14): a PARTIAL verdict —
+                # quarantined partition(s) left a coverage hole — refuses
+                # with the soonest reload-probe instant as the retry hint,
+                # instead of handing a degraded answer to a client that
+                # asked for full coverage
+                with self._lock:
+                    self.stats.partial_refusals += 1
+                counters.add_fault("serve_partial_refused")
+                resp = protocol.error_response(
+                    f"partial partition coverage: partition(s) "
+                    f"{verdict['partitions_unavailable']} unavailable "
+                    f"(consulted {verdict.get('partitions_consulted', [])})",
+                    req_id=req.req_id, reason="partial_coverage",
+                    retry_after_s=self._partial_retry_hint(),
+                )
             else:
                 resp = protocol.classify_response(
                     verdict, req_id=req.req_id, batch_size=len(batch),
@@ -316,7 +338,9 @@ class IndexServer:
             try:
                 t0 = time.monotonic()
                 with telemetry.span("generation_load", generation=gen):
-                    fresh = load_resident_index(self.cfg.index_loc)
+                    fresh = load_resident_index(
+                        self.cfg.index_loc, resident_mb=self.cfg.resident_mb
+                    )
             except Exception as e:  # noqa: BLE001 — keep serving the old generation
                 get_logger().warning(
                     "serve: failed to load generation %d (%s) — still serving %d",
@@ -371,10 +395,23 @@ class IndexServer:
             "generation_swaps": self.stats.swaps_total,
             "latency_ms": hists,
         }
+        out["partial_refusals"] = self.stats.partial_refusals
+        # streaming federated resident (ISSUE 14): the partition health
+        # map — resident/evicted/suspect/quarantined, last probe,
+        # residency bytes — rides the same snapshot /healthz serves, and
+        # pod_status --serve renders (the two views cannot drift)
+        if hasattr(resident, "health_map"):
+            out["partitions"] = resident.health_map()
         pod = self._pending_update_status()
         if pod is not None:
             out["update_pod"] = pod
         return out
+
+    def _partial_retry_hint(self) -> float:
+        resident = self._resident
+        if hasattr(resident, "retry_hint_s"):
+            return float(resident.retry_hint_s())
+        return _RETRY_AFTER_FLOOR_S
 
     def _pending_update_status(self) -> dict | None:
         """pod_status.collect() over the newest in-flight update pod (if
@@ -539,7 +576,10 @@ class IndexServer:
                 f"no such genome file: {genome}", req_id=req_id, reason="bad_request",
             ))
             return
-        pending = PendingRequest(genome=genome, reply=send, req_id=req_id)
+        pending = PendingRequest(
+            genome=genome, reply=send, req_id=req_id,
+            strict=bool(req.get("strict", False)),
+        )
         refused = self.queue.submit(pending)
         if refused is not None:
             with self._lock:
@@ -589,7 +629,9 @@ class IndexServer:
         done.wait()
         resp = box.get("resp", protocol.error_response("no response"))
         status = 200 if resp.get("ok") else (
-            503 if resp.get("reason") in ("backpressure", "draining") else 400
+            503
+            if resp.get("reason") in ("backpressure", "draining", "partial_coverage")
+            else 400
         )
         with contextlib.suppress(OSError):
             conn.sendall(protocol.http_response(

@@ -4,9 +4,18 @@ listener (auto-detected per connection from the first bytes).
 
 NDJSON requests (the native protocol — what ServeClient speaks)::
 
-    {"op": "classify", "genome": "/abs/path.fasta", "id": "optional"}
+    {"op": "classify", "genome": "/abs/path.fasta", "id": "optional",
+     "strict": false}
     {"op": "status"}        # the daemon's health/metrics snapshot
     {"op": "ping"}          # liveness + current generation
+
+``strict`` (optional, federated serving only — ISSUE 14): a verdict
+answered with PARTIAL partition coverage (one or more candidate
+partitions quarantined — the verdict carries ``partitions_unavailable``)
+is converted into a refusal with ``reason: "partial_coverage"`` and a
+``retry_after_s`` hint (the soonest quarantined-partition reload probe)
+instead of returning the degraded answer. Non-strict clients get the
+honest PARTIAL verdict, stamped.
 
 Responses always carry ``ok``. A classify success::
 
@@ -76,6 +85,8 @@ def parse_request(line: bytes) -> dict:
         genome = req.get("genome")
         if not isinstance(genome, str) or not genome:
             raise ProtocolError('classify needs a "genome" FASTA path')
+        if "strict" in req and not isinstance(req["strict"], bool):
+            raise ProtocolError('"strict" must be a JSON boolean')
     return req
 
 
@@ -172,5 +183,13 @@ def http_to_request(method: str, path: str, body: bytes) -> dict:
             raise ProtocolError(f"classify body is not valid JSON: {e}") from e
         if not isinstance(doc, dict) or not doc.get("genome"):
             raise ProtocolError('POST /classify body needs {"genome": "<path>"}')
-        return {"op": "classify", "genome": str(doc["genome"]), "id": doc.get("id")}
+        out = {"op": "classify", "genome": str(doc["genome"]), "id": doc.get("id")}
+        if "strict" in doc:
+            # same type discipline as the NDJSON path: bool("false") is
+            # True, so a coerced string would silently INVERT the
+            # client's intent on one protocol but not the other
+            if not isinstance(doc["strict"], bool):
+                raise ProtocolError('"strict" must be a JSON boolean')
+            out["strict"] = doc["strict"]
+        return out
     raise ProtocolError(f"no route {method} {route} (try GET /healthz or POST /classify)")

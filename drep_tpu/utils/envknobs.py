@@ -173,6 +173,22 @@ _declare(
     "width per range shard (pow2; rangepart.partition_by_range). Execution "
     "knob only — the candidate set is identical for every value.",
 )
+# -- partition-scoped federated serving --------------------------------------
+_declare(
+    "DREP_TPU_SERVE_RESIDENT_MB", "int", 0,
+    "Streaming federated serve: byte budget (MiB) for resident partition "
+    "sketch payloads (index/federation.py FederatedResident — LRU eviction "
+    "past it); 0 = unlimited. The CLI `index serve --resident_mb` overrides.",
+)
+_declare(
+    "DREP_TPU_SERVE_PROBE_BACKOFF_S", "float", 1.0,
+    "First reload-probe delay after a partition quarantine (streaming "
+    "federated serve); doubles per failed probe.",
+)
+_declare(
+    "DREP_TPU_SERVE_PROBE_MAX_S", "float", 60.0,
+    "Cap on the partition reload-probe backoff (s).",
+)
 # -- ingest ------------------------------------------------------------------
 _declare(
     "DREP_TPU_INGEST_BARRIER_S", "float", 600.0,

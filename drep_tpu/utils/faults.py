@@ -80,6 +80,14 @@ SITES = (
     # partition's update dispatch — skip=N targets partition N+1)
     "meta_publish",  # just before the federation meta-manifest's atomic
     # publish, drep_tpu/index/federation.py (the federation commit point)
+    "partition_load",  # a serve replica's lazy partition-residency load,
+    # drep_tpu/index/federation.py FederatedResident (fires before the
+    # sketch-payload read — the containment boundary: a raise here must
+    # quarantine the partition and yield PARTIAL verdicts, never kill
+    # the daemon)
+    "partition_classify",  # the per-partition rect compare of a routed
+    # query batch, drep_tpu/index/federation.py (mid-classify partition
+    # failure: same quarantine containment as partition_load)
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):

@@ -197,6 +197,7 @@ def index_update_wrapper(
         prune_min_shared=kwargs.get("prune_min_shared", 0) or 0,
         prune_join_chunk=kwargs.get("prune_join_chunk", 0) or 0,
         fed_pods=kwargs.get("fed_pods"),
+        params_file=kwargs.get("params_file"),
     )
 
 
@@ -285,6 +286,7 @@ def index_serve_wrapper(index_loc: str, genomes: list[str] | None = None, **kwar
             "prune_join_chunk": int(kwargs.get("prune_join_chunk", 0) or 0),
         },
         log_dir=log_dir,
+        resident_mb=kwargs.get("resident_mb"),
     )
     server = IndexServer(cfg)
     install_signal_handlers(server)

@@ -1,0 +1,208 @@
+"""Containment chaos cells for partition-scoped federated serving
+(ISSUE 14, `tools/chaos_matrix.py --serve-federated`).
+
+Both cells run the REAL `index serve` daemon as a subprocess over a
+federated root with event tracing on, and pin the acceptance contract:
+damage one partition under live traffic -> the daemon stays up, queries
+touching the partition return stamped PARTIAL verdicts (strict clients
+are refused with retry_after), unaffected partitions' verdicts stay
+byte-identical to the pre-damage oracle, and after heal the next
+bounded-backoff reload probe restores full coverage with a
+``partition_recovered`` event in the trace.
+
+Marked slow+chaos: each cell pays a daemon subprocess (a full JAX
+import) and the tier-1 budget sits at the 870s knife edge —
+chaos_matrix runs them by test id, like the PR 13 federation cells.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import build_federated, index_classify, load_resident_index  # noqa: E402
+from drep_tpu.serve import ServeClient, ServeError  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+
+def _strip(verdict: dict) -> dict:
+    out = dict(verdict)
+    out.pop("partitions_consulted", None)
+    out.pop("partitions_unavailable", None)
+    out.pop("partial", None)
+    return out
+
+
+def _build(tmp_path):
+    """The test_fed_serve layout: P=3, groups split across partitions,
+    group 1 (paths[3], paths[4]) co-located — the unaffected control."""
+    paths = lib.write_genome_set(str(tmp_path / "g"), [3, 2, 2], seed=3)
+    loc = str(tmp_path / "fed")
+    build_federated(loc, paths, 3, length=0)
+    fed = load_resident_index(loc)
+    victim_pid = int(fed.part_of[fed.names.index(os.path.basename(paths[0]))])
+    safe = paths[3]
+    assert int(fed.part_of[fed.names.index(os.path.basename(safe))]) != victim_pid
+    return loc, paths, victim_pid, safe
+
+
+def _spawn_daemon(loc, log_dir, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               DREP_TPU_SERVE_PROBE_BACKOFF_S="0.2",
+               DREP_TPU_SERVE_PROBE_MAX_S="0.5")
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "drep_tpu", "index", "serve", loc,
+         "--batch_window_ms", "20", "--events", "on", "--log_dir", log_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env,
+    )
+    line = proc.stdout.readline()
+    assert line, "daemon died before its ready line"
+    return proc, json.loads(line)
+
+
+def _events(log_dir):
+    out = []
+    for fn in sorted(os.listdir(log_dir)):
+        if fn.startswith("events.p") and fn.endswith(".jsonl"):
+            with open(os.path.join(log_dir, fn)) as f:
+                for ln in f:
+                    if ln.strip():
+                        try:
+                            out.append(json.loads(ln))
+                        except ValueError:
+                            pass  # torn final line: expected crash evidence
+    return out
+
+
+def _classify_until(c, path, pred, deadline_s=60, strict=False):
+    """Poll a classify until `pred(resp)` holds (probe backoffs make the
+    exact recovery instant timing-dependent)."""
+    deadline = time.monotonic() + deadline_s
+    resp = None
+    while time.monotonic() < deadline:
+        resp = c.classify(path, strict=strict)
+        if pred(resp):
+            return resp
+        time.sleep(0.1)
+    raise AssertionError(f"condition never held; last response: {resp}")
+
+
+def test_corrupt_partition_manifest_under_serve(tmp_path):
+    """Corrupt one partition's manifest under a LIVE daemon: containment,
+    honest PARTIAL + strict refusal, byte-identical unaffected verdicts,
+    scrub --partition names the damage, heal + probe restores full
+    coverage with partition_recovered in the trace, daemon exits 0."""
+    from drep_tpu.utils.durableio import _flip_bit
+
+    loc, paths, victim_pid, safe = _build(tmp_path)
+    oracle_victim = index_classify(loc, [paths[0]])[0]
+    oracle_safe = index_classify(loc, [safe])[0]
+    log_dir = str(tmp_path / "serve_log")
+    os.makedirs(log_dir)
+    proc, ready = _spawn_daemon(loc, log_dir)
+    mf = os.path.join(loc, f"part_{victim_pid:03d}", "manifest.json")
+    orig = open(mf, "rb").read()
+    try:
+        assert ready["generation"] == 0
+        # damage lands BEFORE any sketch payload is resident: the next
+        # consult re-reads the partition manifest and must contain it
+        _flip_bit(mf)
+        with ServeClient(ready["serving"], timeout_s=300) as c:
+            # strict client: refused with the probe-schedule retry hint
+            with pytest.raises(ServeError) as ei:
+                c.classify(paths[0], strict=True)
+            assert ei.value.reason == "partial_coverage"
+            assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+            # non-strict: honest PARTIAL, victim stamped unavailable
+            r = c.classify(paths[0])
+            assert r["ok"] and r["verdict"]["partial"] is True
+            assert victim_pid in r["verdict"]["partitions_unavailable"]
+            # unaffected partition: byte-identical to the oracle
+            r_safe = c.classify(safe)
+            assert _strip(r_safe["verdict"]) == oracle_safe
+            assert proc.poll() is None, "daemon died on partition damage"
+
+            # the heal hint's probe: scoped scrub names the damage class
+            res = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools", "scrub_store.py"),
+                 loc, "--partition", str(victim_pid)],
+                capture_output=True, text=True, timeout=120,
+            )
+            assert res.returncode == 1
+            assert "damage class: manifest" in res.stdout
+
+            # heal (restore) -> the bounded-backoff probe recovers
+            with open(mf, "wb") as f:
+                f.write(orig)
+            r2 = _classify_until(
+                c, paths[0], lambda r: not r["verdict"].get("partitions_unavailable")
+            )
+            assert _strip(r2["verdict"]) == oracle_victim
+            # health map agrees: nothing quarantined anymore
+            st = c.status()
+            assert st["partitions"]["quarantined"] == []
+            assert st["partitions"]["recoveries"] >= 1
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        with open(mf, "wb") as f:
+            f.write(orig)
+    evs = [e["ev"] for e in _events(log_dir)]
+    assert "partition_quarantine" in evs
+    assert "partition_recovered" in evs
+    assert evs.index("partition_quarantine") < evs.index("partition_recovered")
+
+
+def test_partition_load_fault_injection_under_serve(tmp_path):
+    """Deterministic partition_load failures mid-classify (the fault
+    site): the daemon contains them as PARTIAL verdicts and recovers on
+    its own once the injected fires exhaust — no restart, no heal pass,
+    full-coverage verdicts byte-identical to the oracle."""
+    loc, paths, _victim_pid, _safe = _build(tmp_path)
+    oracle = index_classify(loc, [paths[0]])[0]
+    log_dir = str(tmp_path / "serve_log")
+    os.makedirs(log_dir)
+    proc, ready = _spawn_daemon(
+        loc, log_dir, extra_env={"DREP_TPU_FAULTS": "partition_load:raise:1.0:max=2"}
+    )
+    try:
+        with ServeClient(ready["serving"], timeout_s=300) as c:
+            r = c.classify(paths[0])
+            assert r["ok"], r
+            assert r["verdict"].get("partitions_unavailable"), (
+                "injected partition_load failures produced no PARTIAL verdict"
+            )
+            assert proc.poll() is None
+            # fires exhausted (max=2): suspect partitions retry on the
+            # next consult and recover without intervention
+            r2 = _classify_until(
+                c, paths[0], lambda r: not r["verdict"].get("partitions_unavailable")
+            )
+            assert _strip(r2["verdict"]) == oracle
+            st = c.status()
+            assert st["partitions"]["recoveries"] >= 1
+            assert int(st.get("partial_refusals", 0)) == 0  # no strict traffic
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=120) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    evs = [e["ev"] for e in _events(log_dir)]
+    assert "partition_recovered" in evs
+    # the injected failures are visible in the trace as load spans
+    assert any(e["ev"] == "partition_load" for e in _events(log_dir))

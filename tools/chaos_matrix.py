@@ -50,6 +50,17 @@ Two tiers:
   itself the only missing piece — readers still see the old union, the
   rerun recomputes the federation families deterministically and
   publishes). Delegate to tests/test_federation_chaos.py, CPU-only.
+- federated-serving cells (``--serve-federated``): partition-scoped
+  fault containment under the STREAMING federated serve path (ISSUE 14,
+  index/federation.py FederatedResident) — corrupt one partition's
+  manifest under a live daemon (daemon stays up, affected queries
+  return stamped PARTIAL verdicts, strict clients are refused with
+  retry_after, unaffected partitions' verdicts stay byte-identical,
+  and after heal the next bounded-backoff probe restores full coverage
+  with a ``partition_recovered`` trace event), and a deterministic
+  ``partition_load`` fault mid-classify (same containment + recovery
+  once the injected fires exhaust). Delegate to
+  tests/test_fed_serve_chaos.py, CPU-only.
 - serve cells (``--serve``): the resident serving tier (ISSUE 11,
   drep_tpu/serve/) — SIGKILL the `index serve` daemon mid-batch: every
   connected client gets a clean disconnection error (never a hang or a
@@ -74,6 +85,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --federated # + federation cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --elastic # + join/drain cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --serve   # + serving-tier cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --serve-federated # + partition containment
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
@@ -486,6 +498,29 @@ ELASTIC_CELLS = [
 ]
 
 
+# federated-serving cells (--serve-federated, ISSUE 14): partition
+# fault containment under streaming per-partition classify. Both need a
+# subprocess daemon with live clients + events on — delegate to their
+# pytest chaos cells. CPU-only, tens of seconds.
+FED_SERVE_CELLS = [
+    ("partition_load", "corrupt",
+     "corrupt partition manifest under serve -> daemon up, PARTIAL stamped, "
+     "strict refused, heal+probe recovers (partition_recovered traced)",
+     "survive",
+     "tests/test_fed_serve_chaos.py::test_corrupt_partition_manifest_under_serve"),
+    ("partition_load", "raise",
+     "injected partition-load failure mid-classify -> containment, then "
+     "probe recovery once fires exhaust",
+     "survive",
+     "tests/test_fed_serve_chaos.py::test_partition_load_fault_injection_under_serve"),
+    ("partition_classify", "raise",
+     "in-process mid-compare partition failure -> suspect/quarantine, "
+     "PARTIAL verdict, unaffected partitions byte-identical",
+     "survive",
+     "tests/test_fed_serve.py::test_partition_fault_containment_partial_verdict"),
+]
+
+
 # serve cells (--serve, ISSUE 11): the resident serving tier's crash
 # story. SIGKILL needs a subprocess daemon + live clients — delegate to
 # the pytest chaos cell. CPU-only, tens of seconds.
@@ -537,6 +572,7 @@ def main() -> int:
     prune_cells = "--prune" in sys.argv
     elastic_cells = "--elastic" in sys.argv
     serve_cells = "--serve" in sys.argv
+    fed_serve_cells = "--serve-federated" in sys.argv
     events_cells = "--events" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
@@ -582,6 +618,7 @@ def main() -> int:
     _pytest_cells(FED_CELLS, "--federated", federated_cells)
     _pytest_cells(ELASTIC_CELLS, "--elastic", elastic_cells)
     _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
+    _pytest_cells(FED_SERVE_CELLS, "--serve-federated", fed_serve_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)
 

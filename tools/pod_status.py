@@ -356,6 +356,79 @@ def render_federation(status: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def collect_serve(address: str, timeout_s: float = 10.0) -> dict:
+    """One read-only snapshot of a RUNNING `index serve` daemon via its
+    HTTP ``/healthz`` shim (ISSUE 14 satellite) — the same snapshot the
+    daemon's ``status`` op serves, so this view and the daemon can never
+    disagree. For a streaming federated resident it carries the
+    partition health map (resident / evicted / suspect / quarantined,
+    last probe, residency bytes) that :func:`render_serve` renders."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://{address}/healthz", timeout=timeout_s
+        ) as resp:
+            return json.loads(resp.read())
+    except Exception as e:  # noqa: BLE001 — a dead daemon is a report, not a crash
+        return {"error": f"cannot reach serve daemon at {address}: {e}"}
+
+
+def render_serve(status: dict) -> str:
+    if "error" in status:
+        return status["error"] + "\n"
+    lines = [
+        f"serve daemon @ {status.get('address')}  (pid {status.get('pid')})",
+        f"  generation {status.get('generation')}  "
+        f"({status.get('n_genomes')} genomes)  "
+        f"queue {status.get('queue_depth')}/{status.get('max_queue')}  "
+        f"requests {status.get('requests_total')}  "
+        f"swaps {status.get('generation_swaps')}"
+        + (f"  partial refusals {status['partial_refusals']}"
+           if status.get("partial_refusals") else ""),
+    ]
+    fed = status.get("partitions")
+    if fed:
+        budget = fed.get("budget_bytes") or 0
+        lines.append(
+            f"  partitions: {fed['resident_partitions']}/{fed['n_partitions']} "
+            f"resident ({fed['resident_bytes']} B"
+            + (f" of {budget} B budget" if budget else ", no budget")
+            + f"; peak {fed['peak_resident_partitions']}), "
+            f"{fed['loads']} load(s), {fed['evictions']} eviction(s), "
+            f"{fed['recoveries']} recover(ies)"
+        )
+        for pid, e in sorted(fed["partitions"].items(), key=lambda kv: int(kv[0])):
+            state = e["state"] + ("" if e["resident"] else
+                                  " (evicted)" if e["state"] == "healthy"
+                                  and e["loads"] else "")
+            detail = (
+                f"gen {e['generation']}, {e['n_genomes']} genomes, "
+                f"{e['resident_bytes']} B resident, {e['loads']} load(s)"
+            )
+            if e.get("last_probe_ago_s") is not None:
+                detail += f", last probe {e['last_probe_ago_s']:.1f}s ago"
+            if e.get("next_probe_in_s") is not None:
+                detail += f", next probe in {e['next_probe_in_s']:.1f}s"
+            lines.append(f"  part_{int(pid):03d} {state:<20} {detail}")
+            if e.get("reason"):
+                lines.append(f"            reason: {e['reason'][:160]}")
+        if fed.get("quarantined"):
+            lines.append(
+                f"  QUARANTINED partition(s) {fed['quarantined']}: verdicts "
+                f"touching them are PARTIAL (strict clients are refused); "
+                f"probe with tools/scrub_store.py --partition <pid>"
+            )
+    if status.get("update_pod"):
+        pod = status["update_pod"]
+        lines.append(
+            f"  update pod: {pod.get('shards_published')}/"
+            f"{pod.get('shards_total') or '?'} shards @ "
+            f"{pod.get('checkpoint_dir')}"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def _collect_any(path: str, now: float | None = None) -> dict:
     """Dispatch: a federated index root gets the federation view, any
     other directory the ordinary pod-checkpoint view."""
@@ -439,7 +512,8 @@ def follow(
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("checkpoint_dir", help="the pod's shared checkpoint dir "
+    ap.add_argument("checkpoint_dir", nargs="?", default=None,
+                    help="the pod's shared checkpoint dir "
                     "(e.g. <wd>/data/streaming_primary)")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     ap.add_argument("--follow", nargs="?", const=5.0, type=float, default=None,
@@ -448,7 +522,22 @@ def main(argv: list[str] | None = None) -> int:
                          "until Ctrl-C — the live pod view")
     ap.add_argument("--count", type=int, default=0,
                     help="with --follow: stop after N renders (0 = forever)")
+    ap.add_argument("--serve", default=None, metavar="HOST:PORT",
+                    help="render a RUNNING `index serve` daemon's health "
+                         "snapshot (read-only GET /healthz) — for a "
+                         "federated daemon this includes the partition "
+                         "health map: resident / evicted / suspect / "
+                         "quarantined, last probe, residency bytes")
     args = ap.parse_args(argv)
+    if args.serve:
+        status = collect_serve(args.serve)
+        if args.json:
+            print(json.dumps(status, indent=1, sort_keys=True))
+        else:
+            sys.stdout.write(render_serve(status))
+        return 1 if "error" in status else 0
+    if not args.checkpoint_dir:
+        ap.error("need a checkpoint dir (or --serve HOST:PORT)")
     if args.follow is not None:
         return follow(
             args.checkpoint_dir, interval_s=args.follow, count=args.count,

@@ -251,6 +251,69 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
             "by_partition": by_partition}
 
 
+# severity-ordered damage classes for a partition-scoped scrub: the
+# manifest is unhealable, state/sketch/edges heal through the store's
+# own matrix (state recluster, re-sketch, range recompute)
+_DAMAGE_CLASSES = (
+    ("manifest", lambda n: n == "manifest.json"),
+    ("state", lambda n: n.startswith("state_g")),
+    ("sketch", lambda n: n.startswith("sketch_g")),
+    ("edges", lambda n: n.startswith("edges_g")),
+    ("other", lambda n: True),
+)
+
+
+def damage_class(damaged: list[tuple[str, str]]) -> str:
+    """The worst damage family among the damaged paths — "clean" when
+    empty. The one-word verdict a serve daemon's heal hint (or an
+    orchestrator) consumes from the partition-scoped probe."""
+    names = {os.path.basename(p) for p, _ in damaged}
+    for cls, match in _DAMAGE_CLASSES:
+        if any(match(n) for n in names):
+            return cls
+    return "clean"
+
+
+def scrub_partition(root: str, pid: int, delete: bool = False, out=sys.stdout) -> dict:
+    """`--partition <pid>` (ISSUE 14 satellite): scope a federated scrub
+    to ONE partition store — the cheap, targeted probe a serve daemon's
+    quarantine heal hint shells to. The report gains ``damage_class``
+    (manifest > state > sketch > edges > other severity order; "clean"
+    when undamaged) so callers branch on one word."""
+    if not os.path.exists(os.path.join(root, "federation.json")):
+        print(f"scrub: {root} is not a federated index root (no "
+              f"federation.json) — --partition needs one", file=out)
+        return {"error": "not federated", "damaged": [], "damage_class": "clean"}
+    # resolve the partition's RECORDED dir from the meta (the same field
+    # the unscoped federated walk honors); a rotted meta falls back to
+    # the default naming so the scoped scrub still reaches the store
+    part_dirname = f"part_{pid:03d}"
+    try:
+        meta = durableio.read_json_checked(
+            os.path.join(root, "federation.json"), what="federation meta"
+        )
+        entry = next(
+            (e for e in meta.get("partitions", ())
+             if int(e.get("pid", -1)) == pid),
+            None,
+        )
+        if entry is not None and entry.get("dir"):
+            part_dirname = str(entry["dir"])
+    except (OSError, ValueError, durableio.CorruptPayloadError):
+        pass
+    pdir = os.path.join(root, part_dirname)
+    if not os.path.isdir(pdir):
+        print(f"scrub: no partition {pid} under {root} ({pdir} missing)", file=out)
+        return {"error": "no such partition",
+                "damaged": [(pdir, "partition directory missing")],
+                "damage_class": "other"}
+    report = scrub([pdir], delete=delete, out=out)
+    report["damage_class"] = damage_class(report["damaged"])
+    print(f"scrub: partition part_{pid:03d} damage class: "
+          f"{report['damage_class']}", file=out)
+    return report
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("roots", nargs="+", help="store directories (or files) to scrub")
@@ -258,7 +321,24 @@ def main(argv: list[str] | None = None) -> int:
         "--delete", action="store_true",
         help="remove damaged payloads so the next resume recomputes them",
     )
+    ap.add_argument(
+        "--partition", type=int, default=None, metavar="PID",
+        help="scope a FEDERATED-index scrub to one partition store "
+             "(part_PID under the single given root) and report its "
+             "damage class — the serve daemon's quarantine heal hint "
+             "names this probe",
+    )
     args = ap.parse_args(argv)
+    if args.partition is not None:
+        if len(args.roots) != 1:
+            ap.error("--partition takes exactly one federated root")
+        report = scrub_partition(
+            args.roots[0], args.partition, delete=args.delete
+        )
+        # a probe that could not even run (wrong root, no such partition)
+        # must NOT exit 0 — automation branching on the exit code would
+        # read "clean" and skip the heal the quarantine is waiting for
+        return 1 if (report["damaged"] or report.get("error")) else 0
     report = scrub(args.roots, delete=args.delete)
     return 1 if report["damaged"] else 0
 

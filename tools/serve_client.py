@@ -52,19 +52,28 @@ from drep_tpu.utils.durableio import atomic_write_bytes  # noqa: E402
 # ---- client modes ---------------------------------------------------------
 
 
-def run_classify(address: str, genomes: list[str], retries: int) -> int:
+def run_classify(
+    address: str, genomes: list[str], retries: int, strict: bool = False
+) -> int:
     """Serial classify (one per turn) so `--retries` can honor each
-    refusal's retry_after_s hint; the pipelined path is the loadgen's."""
+    refusal's retry_after_s hint; the pipelined path is the loadgen's.
+    ``strict`` (federated serving, ISSUE 14) refuses PARTIAL partition
+    coverage: the daemon answers ``reason=partial_coverage`` with a
+    retry_after_s hint (honored by the same retry loop) instead of a
+    degraded verdict."""
     rc = 0
     with ServeClient(address) as c:
         for g in genomes:
             try:
-                resp = c.classify(os.path.abspath(g), retries=retries)
+                resp = c.classify(os.path.abspath(g), retries=retries,
+                                  strict=strict)
                 print(json.dumps(resp["verdict"]))
             except ServeError as e:
                 rc = 1
                 print(json.dumps({"ok": False, "genome": g, "error": str(e),
-                                  "reason": e.reason}), file=sys.stderr)
+                                  "reason": e.reason,
+                                  "retry_after_s": e.retry_after_s}),
+                      file=sys.stderr)
     return rc
 
 
@@ -305,6 +314,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--retries", type=int, default=3,
                     help="backpressure retries per classify (sleeps the "
                          "daemon's retry_after_s hint)")
+    ap.add_argument("--strict", action="store_true",
+                    help="FEDERATED serving: refuse PARTIAL partition "
+                         "coverage — a verdict that would be stamped with "
+                         "partitions_unavailable (a quarantined partition) "
+                         "comes back as a partial_coverage refusal with a "
+                         "retry_after_s hint (the next reload probe) "
+                         "instead of a degraded answer")
     ap.add_argument("--bench", action="store_true",
                     help="spawn daemons + loadgen: the serving perf guard")
     ap.add_argument("--index", default=None,
@@ -346,7 +362,8 @@ def main(argv: list[str] | None = None) -> int:
                 print(json.dumps(c.ping()))
             return 0
         if args.genomes:
-            return run_classify(args.address, args.genomes, args.retries)
+            return run_classify(args.address, args.genomes, args.retries,
+                                strict=args.strict)
     except ServeError as e:
         print(f"serve error: {e} (reason={e.reason})", file=sys.stderr)
         return 1
