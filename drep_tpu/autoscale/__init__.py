@@ -1,0 +1,25 @@
+"""Autoscaling controller (ISSUE 15): elasticity as POLICY.
+
+PRs 9-11 built every MECHANISM for mid-run membership change — JOIN
+admission, graceful DRAIN, epoch re-deals, `pod_status --follow` with
+shard-progress ETA — but nothing ever DECIDED to scale. This package is
+that missing layer, shaped like a k8s operator:
+
+- :mod:`drep_tpu.autoscale.policy` — the pure, deterministic decision
+  function ``decide(snapshot, targets, history) -> Decision`` (no clock,
+  no env, no I/O: snapshot in, decision out — unit-testable without any
+  pod).
+- :mod:`drep_tpu.autoscale.controller` — the long-lived loop around
+  ``pod_status.collect()`` (the same read-only snapshot ``--follow``
+  renders) that feeds the policy and ACTUATES only through the existing
+  pod protocol: joiner processes spawned with ``DREP_TPU_POD_JOIN=auto``,
+  drains via SIGTERM. Workers need NO changes to be governed, and the
+  controller's death is harmless — workers never depend on it.
+
+CLI entrypoint: ``tools/pod_autoscale.py``.
+"""
+
+from drep_tpu.autoscale.controller import AutoscaleController
+from drep_tpu.autoscale.policy import Decision, Targets, decide
+
+__all__ = ["AutoscaleController", "Decision", "Targets", "decide"]

@@ -1,0 +1,206 @@
+"""The deadline-driven elastic policy: PURE decision function.
+
+``decide(snapshot, targets, history)`` is deliberately a pure function —
+no clock reads, no env reads, no I/O, no randomness: the only notion of
+"now" is the snapshot's own ``observed_at`` stamp, and everything the
+verdict depends on rides in the three arguments. That is what makes the
+policy unit-testable over synthetic snapshots without any pod, replayable
+from the decision log (same inputs -> byte-same Decision), and safe to
+evolve: the controller (drep_tpu/autoscale/controller.py) is a thin loop
+around it.
+
+Model (documented PROXIES, not theorems):
+
+- ETA: the snapshot's publish-rate ``eta_s`` (tools/pod_status.py — the
+  slope of the shard mtimes). Work is assumed to scale ~linearly with
+  live process count, so the capacity needed to make a deadline is
+  ``ceil(n_live * eta / remaining)``.
+- cost: proc-seconds of the REMAINING work, ``n_live * eta``. Under the
+  ideal-scaling model this is invariant — the knob exists because real
+  pods scale sub-linearly and reserved capacity is what operators pay
+  for; a run comfortably inside its deadline sheds capacity back.
+
+Stability machinery:
+
+- HYSTERESIS: scale-up fires only past ``eta > remaining*(1+h)``,
+  scale-down only under ``eta' < remaining*(1-h)`` for the SHRUNK pod's
+  projected eta — the dead band between them is a hold, so the policy
+  cannot oscillate around the deadline.
+- COOLDOWN: no scaling decision within ``cooldown_s`` of the last one
+  (judged from `history` timestamps against the snapshot clock — never
+  a wall-clock read), so a just-spawned joiner gets to show up in the
+  snapshot before the policy piles on.
+- CLAMPS: ``max_procs`` bounds capacity (live + pending joins) from
+  above; ``min_procs`` is the scale-DOWN floor only — the policy never
+  spawns just to reach it (capacity is added strictly under deadline
+  pressure; a pod legitimately runs below the floor when the deadline
+  is comfortably met). Per-decision spawn is capped by ``max_spawn``
+  (0 = decide-but-never-spawn: misses record as ``spawn-clamped``
+  holds).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+__all__ = ["Targets", "Decision", "decide"]
+
+
+@dataclass(frozen=True)
+class Targets:
+    """The operator's goal, resolved once at controller start.
+
+    ``deadline_at`` is an ABSOLUTE wall-clock instant (same clock family
+    as the snapshot's ``observed_at`` — the controller derives it from
+    ``--deadline`` seconds at startup); None = no deadline (the policy
+    never scales up). ``cost_proc_s`` is the proc-seconds budget for the
+    remaining work; None = capacity is free (the policy never scales
+    down below what the deadline needs)."""
+
+    deadline_at: float | None = None
+    cost_proc_s: float | None = None
+    min_procs: int = 1
+    max_procs: int = 8
+    cooldown_s: float = 30.0
+    hysteresis: float = 0.1
+    max_spawn: int = 1
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One policy verdict: ``scale_up`` (spawn `delta` joiners),
+    ``scale_down`` (drain `-delta` members), or ``hold``. `reason` is a
+    stable machine-readable slug (tests pin them); `inputs` records the
+    numbers the verdict was derived from — the decision log and the
+    ``autoscale_decision`` telemetry instant carry both, so every scaling
+    event is auditable after the fact."""
+
+    verdict: str  # "scale_up" | "scale_down" | "hold"
+    delta: int
+    reason: str
+    inputs: dict = field(default_factory=dict)
+
+
+def _hold(reason: str, inputs: dict) -> Decision:
+    return Decision(verdict="hold", delta=0, reason=reason, inputs=inputs)
+
+
+def decide(snapshot: dict, targets: Targets, history: list[dict]) -> Decision:
+    """One pure decision from one read-only pod snapshot.
+
+    `snapshot` is a ``tools/pod_status.collect()`` dict (``observed_at``,
+    ``live``, ``pending_joins``, ``shards_published``/``shards_total``,
+    ``eta_s``, ...). `history` is the controller's ordered decision
+    record: dicts with at least ``at`` (the snapshot clock when decided),
+    ``verdict`` and ``delta`` — only non-hold entries gate the cooldown.
+    """
+    if "error" in snapshot:
+        return _hold("snapshot-error", {"error": snapshot["error"]})
+    now = float(snapshot["observed_at"])
+    live = list(snapshot.get("live", ()))
+    pending = list(snapshot.get("pending_joins", ()))
+    n_live = len(live)
+    capacity = n_live + len(pending)
+    done = int(snapshot.get("shards_published") or 0)
+    total = snapshot.get("shards_total")
+    eta = snapshot.get("eta_s")
+    inputs: dict = {
+        "n_live": n_live,
+        "pending_joins": len(pending),
+        "shards_published": done,
+        "shards_total": total,
+        "eta_s": eta,
+    }
+    if targets.deadline_at is not None:
+        inputs["remaining_s"] = round(targets.deadline_at - now, 3)
+    if eta is not None and n_live:
+        inputs["projected_cost_proc_s"] = round(n_live * float(eta), 3)
+
+    if not n_live:
+        # nothing to govern: the pod has not started, or every member is
+        # finished/gone — actuating against ghosts helps nobody
+        return _hold("no-live-members", inputs)
+    if total is not None and done >= int(total):
+        return _hold("finished", inputs)
+    if targets.deadline_at is None and targets.cost_proc_s is None:
+        return _hold("no-targets", inputs)
+
+    # cooldown: the last SCALING decision must age out before another —
+    # a spawned joiner needs interpreter startup + admission before it
+    # shows in the snapshot, and piling on during that window overshoots
+    for past in reversed(history):
+        if past.get("verdict") in ("scale_up", "scale_down"):
+            age = now - float(past.get("at", now))
+            if age < targets.cooldown_s:
+                inputs["cooldown_remaining_s"] = round(
+                    targets.cooldown_s - age, 3
+                )
+                return _hold("cooldown", inputs)
+            break
+
+    h = max(0.0, float(targets.hysteresis))
+    remaining = (
+        targets.deadline_at - now if targets.deadline_at is not None else None
+    )
+
+    # -- scale UP: the deadline projection misses --------------------------
+    if remaining is not None:
+        if eta is None and remaining > 0:
+            # too little publish-rate signal for an ETA (first shards
+            # still landing) and the deadline still holds: scaling on no
+            # evidence would thrash. A BLOWN deadline needs no ETA — any
+            # live pod with work left wants max capacity (below).
+            return _hold("warming", inputs)
+        miss = (
+            float(eta) > remaining * (1.0 + h) if remaining > 0 else True
+        )
+        if miss:
+            if capacity >= targets.max_procs:
+                return _hold("at-max-procs", inputs)
+            if remaining > 0:
+                needed = math.ceil(n_live * float(eta) / remaining)
+            else:
+                needed = targets.max_procs  # deadline already blown: all in
+            inputs["needed_procs"] = needed
+            if capacity >= needed:
+                # pending joins already cover the projection (the ETA is
+                # measured on the CURRENT live set — admitted capacity
+                # has not moved it yet): spawning more would pile on
+                return _hold("pending-covers", inputs)
+            delta = min(
+                needed - capacity,
+                targets.max_spawn,
+                targets.max_procs - capacity,
+            )
+            if delta <= 0:
+                # max_spawn 0 is "decide but never spawn" (recommend-only
+                # clamping): record the miss without commanding an
+                # actuation the clamp forbids
+                return _hold("spawn-clamped", inputs)
+            return Decision(
+                verdict="scale_up", delta=int(delta),
+                reason="eta-misses-deadline" if remaining > 0 else "deadline-passed",
+                inputs=inputs,
+            )
+
+    # -- scale DOWN: cost pressure with deadline headroom ------------------
+    # the floor is max(min_procs, 1): a pod cannot shrink below one live
+    # member (and the shrunk-eta projection would divide by zero at 1)
+    if targets.cost_proc_s is not None and n_live > max(targets.min_procs, 1):
+        if eta is None:
+            return _hold("warming", inputs)
+        over_cost = n_live * float(eta) > targets.cost_proc_s
+        # shedding one proc must not bust the deadline (with the same
+        # hysteresis margin the scale-up side honors — the dead band)
+        shrunk_eta = float(eta) * n_live / (n_live - 1)
+        fits = remaining is None or shrunk_eta < remaining * (1.0 - h)
+        if over_cost and fits:
+            return Decision(
+                verdict="scale_down", delta=-1,
+                reason="cost-over-budget", inputs=inputs,
+            )
+
+    return _hold(
+        "deadline-met" if remaining is not None else "within-cost", inputs
+    )

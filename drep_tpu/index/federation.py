@@ -476,10 +476,16 @@ def load_federated(location: str, heal: bool = False) -> LoadedIndex:
             # the store's own UserInputError) used to surface naming only
             # the failing path; the federated refusal must name WHICH
             # partition and its recorded (range, generation) — and the
-            # streaming path's quarantine instant carries this same text
-            raise UserInputError(
+            # streaming path's quarantine instant carries this same text.
+            # The machine-readable partition id rides the exception
+            # (fed_partition) so the update path's PARTIAL contract
+            # (ISSUE 15 satellite) can stamp a degraded meta instead of
+            # refusing outright.
+            refusal = UserInputError(
                 partition_refusal(pid, e.get("range"), int(e["generation"]), err)
-            ) from err
+            )
+            refusal.fed_partition = pid  # type: ignore[attr-defined]
+            raise refusal from err
         healed.extend(f"{fedmeta.partition_dir_name(pid)}/{h}" for h in pidx.healed)
         g_meta = int(e["generation"])
         if pidx.generation < g_meta:
@@ -1941,6 +1947,52 @@ def _routed_batches(
     return out
 
 
+def _publish_unavailable_meta(
+    store: FederationStore, m: dict, pid: int, reason: str,
+    genome_paths: list[str] | None, logger,
+) -> dict:
+    """The degraded-but-honest PARTIAL meta: same generation, the
+    unreadable partition stamped ``partial.partitions_unavailable`` (its
+    recorded generation/count untouched), this batch's genomes recorded
+    unadmitted. Idempotent — a repeat update against the still-broken
+    partition merges into the existing stamp."""
+    from drep_tpu.utils import telemetry
+
+    partial = dict(m.get("partial") or {})
+    unavailable = sorted(set(partial.get("partitions_unavailable", ())) | {pid})
+    partial["partitions_unavailable"] = unavailable
+    partial["reason"] = reason
+    if genome_paths:
+        partial["unadmitted"] = sorted(
+            set(partial.get("unadmitted", ()))
+            | {os.path.basename(p) for p in genome_paths}
+        )
+    m2 = dict(m)
+    m2["partial"] = partial
+    store.publish_meta(m2)
+    telemetry.event(
+        "federation_partial_meta", partitions_unavailable=unavailable,
+        unadmitted=len(partial.get("unadmitted", ())),
+    )
+    logger.error(
+        "federated update: partition %d is unreadable — publishing a "
+        "DEGRADED meta at generation %d (partitions_unavailable=%s, %d "
+        "genome(s) unadmitted; serve answers PARTIAL beside it). Heal the "
+        "partition and re-run `index update` — a clean heal pass clears "
+        "the stamp. %s",
+        pid, int(m.get("generation", -1)), unavailable,
+        len(partial.get("unadmitted", ())), reason,
+    )
+    return {
+        "admitted": 0,
+        "generation": int(m.get("generation", -1)),
+        "n_partitions": int(m.get("n_partitions", 0)),
+        "partitions_unavailable": unavailable,
+        "unadmitted": list(partial.get("unadmitted", ())),
+        "partial": partial,
+    }
+
+
 def fed_update(
     location: str, genome_paths: list[str] | None, processes: int = 1,
     fed_pods: int | None = None, primary_prune: str = "off",
@@ -1969,7 +2021,57 @@ def fed_update(
     gen_new = gen + 1
     if fed_pods is None:
         fed_pods = envknobs.env_int("DREP_TPU_FED_PODS")
-    union = load_federated(location, heal=True)
+    try:
+        union = load_federated(location, heal=True)
+    except UserInputError as err:
+        bad_pid = getattr(err, "fed_partition", None)
+        if bad_pid is None:
+            raise  # not a partition-scoped fault: refuse as before
+        # PARTIAL update contract (ROADMAP federated follow-on (e),
+        # ISSUE 15 satellite): one quarantined/unreadable partition no
+        # longer refuses the whole operation — the update DEGRADES
+        # honestly instead. Nothing can be admitted (the union's cross
+        # edges need the broken partition's sketches), so the meta is
+        # republished at the SAME generation with the partition stamped
+        # ``partitions_unavailable`` and the batch recorded unadmitted:
+        # the serving tier keeps answering PARTIAL beside it (the
+        # streaming resident quarantines the partition on its own
+        # probes), pod_status renders the degradation, and the next
+        # heal pass that finds the partition readable again clears the
+        # stamp. Old generation retained, nothing laundered.
+        return _publish_unavailable_meta(
+            store, m, int(bad_pid), str(err), genome_paths, logger
+        )
+    stale_unavail = (m.get("partial") or {}).get("partitions_unavailable")
+    if stale_unavail:
+        # every meta-recorded partition just loaded (healed where
+        # needed): the degradation is over — clear the stamp so serve's
+        # meta view and pod_status stop reporting a recovered partition
+        # as unavailable. Genomes unadmitted under the degraded window
+        # stay listed until a batch/heal republish supersedes them only
+        # if a real failed_partitions note needs them; here the window
+        # closed, so the operator's cue is this log line + the summary.
+        partial = dict(m["partial"])
+        partial.pop("partitions_unavailable", None)
+        partial.pop("reason", None)
+        if not partial.get("failed_partitions"):
+            partial.pop("unadmitted", None)
+        m2 = dict(m)
+        if partial:
+            m2["partial"] = partial
+        else:
+            m2.pop("partial", None)
+        store.publish_meta(m2)
+        m = m2
+        telemetry.event(
+            "federation_partial_cleared", partitions_recovered=stale_unavail
+        )
+        logger.warning(
+            "federated index: previously unavailable partition(s) %s are "
+            "readable again — PARTIAL stamp cleared at generation %d "
+            "(genomes unadmitted during the window must be re-submitted)",
+            stale_unavail, int(m.get("generation", -1)),
+        )
     part_of = np.asarray(union.fed_part_of, np.int64)  # type: ignore[attr-defined]
     local_of = np.asarray(union.fed_local_of, np.int64)  # type: ignore[attr-defined]
 

@@ -397,6 +397,14 @@ def ring_schedule(n_devices: int, half: bool) -> list[tuple[int, int]]:
     ]
 
 
+def ring_step_of(a: int, b: int, n_devices: int) -> int:
+    """The ring step that produces block (a, b): device `a` computes
+    column block ``(a - i) mod D`` at step `i`. The ring-phase JOIN
+    upgrade deals by STEP through this — a joiner eats whole steps from
+    the schedule tail while the pod's collective ring works the head."""
+    return (a - b) % n_devices
+
+
 def _ring_step_shard(a_ids, a_counts, b_ids, b_counts, tile_fn, n_devices, rotate):
     """One ring step under shard_map: compute this step's tile from the
     resident A block and the CURRENT B operand, then rotate B one hop.
@@ -894,18 +902,51 @@ def _ring_allpairs_stepwise(
             n_computed += 1
             _save_block(blk, mem[blk], hb.epoch if hb is not None else 0)
 
+    def _join_covered_tail(step_i: int) -> bool:
+        """Has an admitted joiner made every block PAST `step_i` durable?
+        (The ring-phase JOIN shortcut's exit test — cheap: one cached
+        store lookup per still-unseen tail block, only once a join has
+        actually been admitted with no deaths/drains in the mix.)"""
+        if (
+            hb is None or not hb.joined or hb.dead or hb.drained
+            or store is None or step_i >= n_steps - 1
+        ):
+            return False
+        for blk in schedule:
+            if ring_step_of(*blk, D) <= step_i:
+                continue
+            if blk in mem or blk in shard_of:
+                continue
+            loc = _find_block(store, *blk)
+            if loc is None:
+                return False
+            shard_of[blk] = loc
+        return True
+
     # recovery executor (lazy): the per-block redoable unit — round-robin
     # retrying dispatch over the LOCAL devices, CPU recompute last
     ex: TileExecutor | None = None
     devices = jax.local_devices()
     tile_jit, _ = _block_tile_fn(kind, k)
 
-    def _compute_block(blk: tuple[int, int]) -> tuple:
+    def _compute_block(blk: tuple[int, int], tail_step: int | None = None) -> tuple:
         nonlocal ex, n_computed
         n_computed += 1
         if ex is None:
             ex = TileExecutor(devices, cfg, fault_site="ring_dispatch")
         a, b = blk
+        if tail_step is not None:
+            # ring-phase JOIN (ISSUE 15): this block is a joiner's share
+            # of ring step `tail_step` — traced as step PARTICIPATION
+            # (the scaling timeline shows the joiner working the same
+            # step axis as the pod), not as failure recovery
+            with telemetry.span(
+                "ring_step", step=tail_step, steps=n_steps, joiner=True,
+                block=f"{a},{b}",
+            ):
+                out = _compute_block_tiles(a, b)
+            counters.add_fault("ring_join_tail_blocks")
+            return out
         with telemetry.span("ring_block_recover", a=a, b=b):
             return _compute_block_tiles(a, b)
 
@@ -1001,13 +1042,17 @@ def _ring_allpairs_stepwise(
                 # a survivor wedged INSIDE dispatch, never reaching the
                 # monitored finalize loop) — so the dispatch loop runs
                 # under heartbeat monitoring too; on a confirmed death
-                # everything falls to per-block recovery
+                # everything falls to per-block recovery. Pure-JOIN
+                # admissions do NOT abandon (join_tolerant, ISSUE 15):
+                # the pod mesh is whole — the joiner works the schedule
+                # tail beside the collective instead
                 ok, res = wait_elastic(
                     _dispatch_all,
                     hb,
                     collective_timeout_s(),
                     what=f"dense ring step dispatch ({kind}, {n_steps} steps)",
                     site="ring_dispatch",
+                    join_tolerant=True,
                 )
                 if ok:
                     pending = res
@@ -1040,6 +1085,7 @@ def _ring_allpairs_stepwise(
                                 collective_timeout_s(),
                                 what=f"dense ring step {i + 1}/{n_steps} ({kind})",
                                 site="ring_dispatch",
+                                join_tolerant=True,
                             )
                             if not ok:
                                 aborted = "pod membership changed"
@@ -1072,6 +1118,25 @@ def _ring_allpairs_stepwise(
                     # out, and the peers re-deal the rest with no
                     # staleness wait
                     _maybe_drain()
+                if aborted is None and _join_covered_tail(i):
+                    # ring-phase JOIN shortcut (ISSUE 15): admitted
+                    # joiner(s) eat whole steps from the schedule TAIL
+                    # while this collective works the head — the moment
+                    # every later step's blocks are durable in the store,
+                    # the remaining waits are dead weight (their tiles
+                    # exist; the queued device work completes harmlessly
+                    # in the background) and the dense phase ENDS here.
+                    telemetry.event(
+                        "ring_join_shortcut", after_step=i,
+                        steps=n_steps, joined=list(hb.joined),
+                    )
+                    counters.add_fault("ring_join_shortcuts")
+                    logger.info(
+                        "dense ring: joiner(s) %s covered every block past "
+                        "step %d/%d — ending the collective schedule early",
+                        hb.joined, i + 1, n_steps,
+                    )
+                    break
             derived = auto.derived()
             if derived is not None:
                 # the per-step watchdog deadline the run derived from its
@@ -1124,19 +1189,47 @@ def _ring_allpairs_stepwise(
                         )
                     last_deal_epoch = hb.epoch
                 computed = False
-                for blk in list(missing):
+                # ring-phase JOIN (ISSUE 15): while the pod is WHOLE
+                # (pure-join churn only) its original members never enter
+                # this per-block path — they are still inside the
+                # collective step loop, producing blocks in STEP order —
+                # so a joiner deals itself blocks from the schedule TAIL
+                # (reverse order, split across joiners by rank) and meets
+                # the advancing ring in the middle; the pod exits its
+                # schedule early the moment the tail is covered (the
+                # ring_join_shortcut). Any death/drain collapses everyone
+                # back to the standard forward schedule-index deal.
+                tail_mode = joining and not hb.dead and not hb.drained
+                if tail_mode:
+                    joiners = sorted(p for p in live if p >= pc) or [pid]
+                    rank = joiners.index(pid) if pid in joiners else 0
+                    claim = [
+                        blk
+                        for r, blk in enumerate(reversed(missing))
+                        if r % len(joiners) == rank
+                    ]
+                else:
                     # schedule-index dealing over the CURRENT live set —
                     # deaths and drains shrink it, admitted joiners grow
                     # it, and only still-missing blocks are ever dealt
-                    if live[sched_idx[blk] % len(live)] != pid:
-                        continue
+                    claim = [
+                        blk for blk in missing
+                        if live[sched_idx[blk] % len(live)] == pid
+                    ]
+                for blk in claim:
                     computed = True
-                    mem[blk] = _compute_block(blk)
+                    mem[blk] = _compute_block(
+                        blk,
+                        tail_step=ring_step_of(*blk, D) if tail_mode else None,
+                    )
                     missing.remove(blk)
                     _save_block(blk, mem[blk], hb.epoch)
                     _maybe_drain()
-                    if hb.maybe_check():
-                        break  # epoch bumped mid-pass: re-deal promptly
+                    if tail_mode or hb.maybe_check():
+                        # tail mode re-scans after EVERY block: the pod is
+                        # publishing the head concurrently, and a stale
+                        # claim list would duplicate its work
+                        break
                 if not missing and not done_written:
                     # publish completion BEFORE leaving: a done-note peer
                     # is never declared dead however stale its beats go

@@ -882,14 +882,17 @@ class HeartbeatManager:
         from drep_tpu.utils.durableio import atomic_write_json
         from drep_tpu.utils.profiling import counters
 
-        atomic_write_json(
-            self.drain_path(),
-            {
-                "seq": self.seq, "epoch": self.epoch,
-                # drep-lint: allow[clock-mono] — cross-host note timestamp (read by pod_status/forensics)
-                "pairs": int(pairs), "at": time.time(),
-            },
-        )
+        note = {
+            "seq": self.seq, "epoch": self.epoch,
+            # drep-lint: allow[clock-mono] — cross-host note timestamp (read by pod_status/forensics)
+            "pairs": int(pairs), "at": time.time(),
+        }
+        if envknobs.env_bool("DREP_TPU_AUTOSCALE_SPAWNED"):
+            # controller-governed capacity departing: peers adopting this
+            # note book autoscale_churn, so bench records of the governed
+            # run refuse as measured perf (tools/missing_stages.py)
+            note["autoscale"] = True
+        atomic_write_json(self.drain_path(), note)
         counters.add_fault("drain_announced")
         telemetry.event("drain_announce", pid=self.pid, pairs=int(pairs))
         get_logger().warning(
@@ -908,6 +911,7 @@ class HeartbeatManager:
 
         departed: list[int] = []
         latency = 0.0
+        autoscaled = 0
         for p in self.live:
             if p == self.pid:
                 continue
@@ -917,6 +921,7 @@ class HeartbeatManager:
             if note is None:
                 continue
             departed.append(p)
+            autoscaled += bool(note.get("autoscale"))
             try:
                 latency = max(
                     latency, now - os.stat(self.drain_path(p)).st_mtime
@@ -925,6 +930,10 @@ class HeartbeatManager:
                 pass
         if not departed:
             return False
+        if autoscaled:
+            # the departure was DECIDED by the autoscaling controller, not
+            # an operator/preemption: provenance for bench honesty
+            counters.add_fault("autoscale_churn", autoscaled)
         telemetry.event(
             "drain_adopted", peers=departed, latency_s=round(latency, 3)
         )
@@ -1091,21 +1100,26 @@ class HeartbeatManager:
                 try:
                     from drep_tpu.utils.durableio import atomic_write_json
 
-                    atomic_write_json(
-                        self.admit_path(j),
-                        {
-                            "pid": j, "epoch": self.epoch + 1,
-                            "live": sorted(self.live + [j]), "pc": self.pc,
-                            "seq": self.seq, "token": note.get("token"),
-                            "at": now,
-                        },
-                    )
+                    admit_note = {
+                        "pid": j, "epoch": self.epoch + 1,
+                        "live": sorted(self.live + [j]), "pc": self.pc,
+                        "seq": self.seq, "token": note.get("token"),
+                        "at": now,
+                    }
+                    if note.get("autoscale"):
+                        # relay the joiner's autoscale stamp so adopting
+                        # peers (who only ever read the admit note) book
+                        # the same churn provenance the leader does
+                        admit_note["autoscale"] = True
+                    atomic_write_json(self.admit_path(j), admit_note)
                 except OSError:
                     continue
             telemetry.event(
                 "join_admitted" if admitting else "join_adopted",
                 peer=j, by=self.pid,
             )
+            if note.get("autoscale"):
+                counters.add_fault("autoscale_churn")
             self.live = sorted(self.live + [j])
             self.joined.append(j)
             self._adopted_admits.add(j)
@@ -1263,10 +1277,16 @@ def join_elastic_pod(
             else max(_next_join_id(note_dir), floor)
         )
         _beat(jid)  # beat first: admission requires a live candidate
+        # drep-lint: allow[clock-mono] — cross-host note timestamp
+        join_note: dict = {"token": token, "at": time.time()}
+        if envknobs.env_bool("DREP_TPU_AUTOSCALE_SPAWNED"):
+            # spawned by the autoscaling controller: the stamp rides the
+            # join note into the leader's admit note, so every member
+            # books autoscale_churn and the run's bench records refuse
+            # as measured perf (the PR 9 membership-churn rule)
+            join_note["autoscale"] = True
         atomic_write_json(
-            os.path.join(note_dir, f".pod-join.p{jid}"),
-            # drep-lint: allow[clock-mono] — cross-host note timestamp
-            {"token": token, "at": time.time()},
+            os.path.join(note_dir, f".pod-join.p{jid}"), join_note
         )
         logger.info(
             "elastic pod: requesting mid-run JOIN as process %d (note dir %s)",
@@ -1397,6 +1417,8 @@ def join_elastic_pod(
     with contextlib.suppress(OSError):
         os.remove(os.path.join(note_dir, f".pod-join.p{jid}"))
     counters.add_fault("pod_join_accepted")
+    if envknobs.env_bool("DREP_TPU_AUTOSCALE_SPAWNED"):
+        counters.add_fault("autoscale_churn")
     # the joiner's stream must re-home to its ADMITTED id (a production
     # joiner configured telemetry as a pid-0 single-process run — without
     # this its events would interleave into member 0's log) and stamp the
@@ -1466,6 +1488,7 @@ def wait_elastic(
     timeout_s: float,
     what: str,
     site: str = "allgather",
+    join_tolerant: bool = False,
 ) -> tuple[bool, Any]:
     """Bounded wait on a (possibly collective) blocking call with live
     heartbeat monitoring — THE primitive that turns "a peer died inside /
@@ -1489,6 +1512,15 @@ def wait_elastic(
     - `timeout_s` passes with every heartbeat fresh -> CollectiveTimeout
       (a peer is wedged, not dead — re-dealing cannot help).
 
+    ``join_tolerant=True`` (the ring-phase JOIN upgrade, ISSUE 15): an
+    epoch bump that only ADDED members — no new deaths, no new drains —
+    does NOT abandon the wait. A pure-join admission leaves the original
+    pod's collective whole (the joiner's devices were never part of the
+    mesh), so the in-flight program is still valid; abandoning it would
+    demote every original member from the pipelined ring to per-block
+    recovery, making scale-up SLOWER. The caller keeps waiting while the
+    joiner consumes re-dealt work beside the collective.
+
     ``hb.check()`` raising (max_dead exceeded, or a verdict fencing THIS
     process) propagates."""
     from drep_tpu.utils.profiling import counters
@@ -1506,6 +1538,7 @@ def wait_elastic(
 
     threading.Thread(target=work, daemon=True, name=f"drep-elastic-{site}").start()
     epoch0 = hb.epoch
+    gone0 = (len(hb.dead), len(hb.drained))
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
     poll = min(1.0, max(0.05, hb.cadence if hb.cadence > 0 else 0.25))
     held: BaseException | None = None
@@ -1523,7 +1556,12 @@ def wait_elastic(
             done.clear()  # keep polling: the death verdict must mature
         hb.check()
         if hb.epoch != epoch0:
-            return False, None
+            if join_tolerant and (len(hb.dead), len(hb.drained)) == gone0:
+                # pure-join bump(s): capacity arrived, nobody left — the
+                # collective is whole, keep waiting under the new epoch
+                epoch0 = hb.epoch
+            else:
+                return False, None
         if deadline is not None and time.monotonic() > deadline:
             counters.add_fault("watchdog_trips")
             if held is not None:

@@ -641,29 +641,15 @@ class IndexServer:
             conn.close()
 
 
-_POD_STATUS: list = []  # [collect-or-None], resolved once per process
-
-
 def _pod_status_collect():
-    """Import tools/pod_status.py's collect() from the repo layout
-    (tools/ is not a package), once per process — /healthz probes fire
-    every few seconds and must not re-execute the module each time.
-    Returns None when the file is not reachable (installed-package
-    deployments)."""
-    if _POD_STATUS:
-        return _POD_STATUS[0]
-    import importlib.util
+    """tools/pod_status.py's collect() via the SHARED per-process loader
+    (drep_tpu/utils/hosttools.py) — one resolution rule for this
+    daemon's /healthz and the autoscaling controller, so their snapshot
+    implementation can never drift. None when unreachable
+    (installed-package deployments)."""
+    from drep_tpu.utils.hosttools import pod_status_collect
 
-    collect = None
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    path = os.path.join(repo, "tools", "pod_status.py")
-    if os.path.exists(path):
-        spec = importlib.util.spec_from_file_location("_drep_pod_status", path)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        collect = mod.collect
-    _POD_STATUS.append(collect)
-    return collect
+    return pod_status_collect()
 
 
 def install_signal_handlers(server: IndexServer) -> None:

@@ -189,6 +189,32 @@ _declare(
     "DREP_TPU_SERVE_PROBE_MAX_S", "float", 60.0,
     "Cap on the partition reload-probe backoff (s).",
 )
+# -- autoscaling controller --------------------------------------------------
+_declare(
+    "DREP_TPU_AUTOSCALE_INTERVAL_S", "float", 5.0,
+    "Autoscaling controller (tools/pod_autoscale.py): seconds between "
+    "pod_status.collect() snapshots / decide() calls. The CLI --interval "
+    "overrides.",
+)
+_declare(
+    "DREP_TPU_AUTOSCALE_COOLDOWN_S", "float", 30.0,
+    "Autoscaling controller: minimum seconds between two SCALING decisions "
+    "(holds are free) — the anti-flap window a just-spawned joiner needs to "
+    "show up in the snapshot. The CLI --cooldown overrides.",
+)
+_declare(
+    "DREP_TPU_AUTOSCALE_MAX_SPAWN", "int", 1,
+    "Autoscaling controller: max joiner processes spawned per scale-up "
+    "decision (the per-decision clamp on top of --max_procs). The CLI "
+    "--max_spawn overrides.",
+)
+_declare(
+    "DREP_TPU_AUTOSCALE_SPAWNED", "bool", False,
+    "Set by the autoscaling controller on processes IT spawns/drains: the "
+    "join/drain notes such a process publishes carry an `autoscale` stamp, "
+    "so every pod member books `autoscale_churn` and bench records refuse "
+    "the run as measured perf (tools/missing_stages.py). Never set by hand.",
+)
 # -- ingest ------------------------------------------------------------------
 _declare(
     "DREP_TPU_INGEST_BARRIER_S", "float", 600.0,
@@ -216,6 +242,12 @@ _declare(
     "DREP_TPU_TEST_JOIN_AFTER_DRAIN", "str", "",
     "Chaos-test joiner: hold the join request until a departure note "
     "exists (drain-then-join churn cell).",
+    test_only=True,
+)
+_declare(
+    "DREP_TPU_TEST_CPU_DEVICES", "int", 2,
+    "Chaos-test worker: forced host CPU devices per process (the D=3 "
+    "ring-phase JOIN cell runs 3 processes x 1 device).",
     test_only=True,
 )
 

@@ -88,6 +88,10 @@ SITES = (
     "partition_classify",  # the per-partition rect compare of a routed
     # query batch, drep_tpu/index/federation.py (mid-classify partition
     # failure: same quarantine containment as partition_load)
+    "autoscale_decide",  # the autoscaling controller's per-tick decision
+    # point, drep_tpu/autoscale/controller.py (fires BEFORE the snapshot
+    # + decide; raise/hang/kill take the controller down — which must be
+    # harmless: workers never depend on it — and sleep paces the loop)
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):

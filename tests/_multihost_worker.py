@@ -24,13 +24,16 @@ def main() -> None:
 
     import jax
 
+    from drep_tpu.utils import envknobs
+
     # jax 0.9: the forced-host XLA_FLAGS route no longer multiplies CPU
     # devices; the config knob does, and must be set pre-backend-init.
     # Older releases within the pyproject pin (e.g. 0.4.37) lack the knob
     # and rely on the XLA_FLAGS the parent test already exported.
+    ndev = envknobs.env_int("DREP_TPU_TEST_CPU_DEVICES")
     jax.config.update("jax_platforms", "cpu")
     try:
-        jax.config.update("jax_num_cpu_devices", 2)
+        jax.config.update("jax_num_cpu_devices", ndev)
     except AttributeError:
         pass
     if mode in ("join_streaming", "join_ring"):
@@ -75,8 +78,8 @@ def main() -> None:
             coordinator_address=coord, num_processes=nproc, process_id=pid
         )
     assert jax.process_count() == nproc, jax.process_count()
-    assert len(jax.devices()) == 2 * nproc, jax.devices()
-    assert len(jax.local_devices()) == 2
+    assert len(jax.devices()) == ndev * nproc, jax.devices()
+    assert len(jax.local_devices()) == ndev
 
     if mode == "barrier_timeout":
         _barrier_timeout_case(pid, nproc, outdir)

@@ -253,11 +253,13 @@ def test_drain_mid_streaming_bit_identical(tmp_path, healthy_edges):
 def test_join_mid_ring_bit_identical(tmp_path):
     """Mid-run JOIN into the step-wise dense ring: the pod (2 processes,
     4-device mesh) is gated on the join note; admission lands during the
-    monitored step waits, the survivors abandon the collective schedule,
-    and the remaining blocks re-deal over the GROWN set — the joiner
-    computes standalone blocks under the POD's geometry (D from the
-    store meta, not its own 2-device mesh) and every member's assembled
-    matrix is byte-identical to a fixed-membership ppermute oracle."""
+    monitored step waits and — the ring-phase JOIN upgrade (ISSUE 15) —
+    the pod KEEPS its pipelined collective schedule (a pure-join epoch
+    bump is join-tolerant, never an abandon) while the joiner consumes
+    whole ring steps from the schedule TAIL under the POD's geometry
+    (D from the store meta, not its own 2-device mesh). Every member's
+    assembled matrix is byte-identical to a fixed-membership ppermute
+    oracle."""
     from drep_tpu.parallel.allpairs import configure_ring, sharded_mash_allpairs
     from drep_tpu.parallel.mesh import make_mesh
 
@@ -272,7 +274,12 @@ def test_join_mid_ring_bit_identical(tmp_path):
     outdir, ckpt = str(tmp_path / "out"), str(tmp_path / "ring")
     pod = _launch_pod(
         outdir, ckpt, "ring", nproc=2,
-        faults="ring_step:sleep:1.0:secs=0.6",
+        # pace each step wide enough that the (already-admitted, gated)
+        # joiner lands tail blocks while the pod's collective ring is
+        # still working the head — the upgrade keeps the pod FAST, so the
+        # old 0.6s pacing would let it finish before the joiner's first
+        # jit compile lands
+        faults="ring_step:sleep:1.0:secs=1.2",
         extra_env={
             "DREP_TPU_TEST_MAX_JOINS": "1",
             "DREP_TPU_TEST_WAIT_JOIN": "1",
@@ -289,10 +296,12 @@ def test_join_mid_ring_bit_identical(tmp_path):
         assert got.tobytes() == oracle.tobytes(), (
             f"member {who}'s ring matrix differs from the oracle"
         )
-    # the joiner computed standalone blocks under the pod's geometry
+    # the joiner computed blocks under the pod's geometry — and as STEP
+    # participation (tail consumption), not only standalone recovery
     jc = _ctr(outdir, "joiner")
     assert jc.get("pod_join_accepted") == 1, jc
     assert jc.get("ring_blocks_recovered", 0) >= 1, jc
+    assert jc.get("ring_join_tail_blocks", 0) >= 1, jc
     for i in range(2):
         assert _ctr(outdir, i).get("pod_joins", 0) >= 1, _ctr(outdir, i)
     blocks = sorted(f for f in os.listdir(ckpt) if f.startswith("blk_"))

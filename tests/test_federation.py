@@ -147,6 +147,58 @@ def test_federated_trickle_updates_match_oracle(fed_store, fed_oracle):
     assert total_cross >= 1
 
 
+@pytest.mark.slow
+def test_partial_update_contract_with_unavailable_partition(tmp_path, fed_genomes):
+    """The federated PARTIAL update contract (ROADMAP follow-on (e),
+    ISSUE 15 satellite): `index update` against a root with one
+    QUARANTINED (unreadable) partition publishes a degraded-but-honest
+    meta — same generation, the partition's old entry retained, a
+    ``partial.partitions_unavailable`` stamp, the batch recorded
+    unadmitted — instead of refusing outright; pod_status renders the
+    degradation; a heal pass that finds the partition readable again
+    CLEARS the stamp and the batch then admits normally."""
+    from tools import pod_status
+
+    loc = str(tmp_path / "fed")
+    build_federated(loc, fed_genomes[:4], 2, length=0)
+    m0 = fedmeta.read_meta(loc)
+    target = next(e for e in m0["partitions"] if e["n_genomes"] > 0)
+    pid = int(target["pid"])
+    manifest = os.path.join(loc, target["dir"], "manifest.json")
+    hidden = manifest + ".hidden"
+    os.rename(manifest, hidden)  # quarantine-class damage: store unreadable
+
+    summary = index_update(loc, fed_genomes[4:5])
+    assert summary["admitted"] == 0
+    assert summary["generation"] == 0  # old generation retained
+    assert summary["partitions_unavailable"] == [pid]
+    assert summary["unadmitted"] == [os.path.basename(fed_genomes[4])]
+    m1 = fedmeta.read_meta(loc)
+    assert m1["generation"] == 0
+    assert m1["partial"]["partitions_unavailable"] == [pid]
+    # the broken partition's meta entry is untouched — nothing laundered
+    e1 = next(e for e in m1["partitions"] if int(e["pid"]) == pid)
+    assert e1 == target
+    # idempotent: a second degraded attempt merges, never duplicates
+    summary2 = index_update(loc, fed_genomes[4:5])
+    assert summary2["partitions_unavailable"] == [pid]
+    assert fedmeta.read_meta(loc)["partial"]["partitions_unavailable"] == [pid]
+
+    # the operator's view renders the degradation (read-only)
+    st = pod_status.collect_federation(loc)
+    assert st["partial"]["partitions_unavailable"] == [pid]
+    assert "UNAVAILABLE" in pod_status.render_federation(st)
+
+    # heal the partition -> a pure heal pass clears the stamp
+    os.rename(hidden, manifest)
+    index_update(loc, None)
+    m2 = fedmeta.read_meta(loc)
+    assert "partial" not in m2, m2.get("partial")
+    # and the batch now admits normally
+    s3 = index_update(loc, fed_genomes[4:5])
+    assert s3["admitted"] == 1 and s3["generation"] == 1
+
+
 def test_federated_classify_transparent_and_read_only(fed_store, tmp_path):
     """`index classify` consumes the federated root through the same
     front door as a plain store: an indexed genome answers with its own

@@ -601,10 +601,13 @@ def test_pod_status_follow_renders_in_place(tmp_path):
     text = out.getvalue()
     assert text.count("pod status @") == 2
     assert text.count("--- poll") == 2  # non-TTY: separators, not ANSI
-    # --json follow emits machine-readable snapshots
+    # --json follow is an NDJSON STREAM (ISSUE 15 satellite): one compact
+    # JSON object per line, no banners — machine-consumable as-is
     out = io.StringIO()
     ps.follow(str(ckpt), interval_s=0.01, count=1, out=out, as_json=True)
-    doc = json.loads(out.getvalue().split("---", 2)[-1].split("\n", 1)[1])
+    lines = out.getvalue().splitlines()
+    assert len(lines) == 1 and "--- poll" not in out.getvalue()
+    doc = json.loads(lines[0])
     assert doc["shards_published"] == 0
 
 

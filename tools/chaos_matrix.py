@@ -77,6 +77,16 @@ Two tiers:
   ``epoch_history`` exactly. Delegate to tests/test_trace_report.py,
   CPU-only.
 
+- autoscaling cells (``--autoscale``): the deadline-driven controller
+  (ISSUE 15, drep_tpu/autoscale/ + tools/pod_autoscale.py) — a real pod
+  under ``--deadline`` pressure gains a CONTROLLER-spawned joiner
+  mid-run (edges bit-identical, ``autoscale_decision`` instants merged
+  into the trace, churn provenance booked by every member), and the
+  ring-phase JOIN upgrade at D=3 (the pod keeps its collective step
+  schedule; the joiner consumes the step tail) pins bit-identity
+  against the monolithic fixed-membership reference. Delegate to
+  tests/test_autoscale_chaos.py, CPU-only.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py           # in-process grid
@@ -87,6 +97,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --serve   # + serving-tier cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --serve-federated # + partition containment
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --autoscale # + controller cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
 
@@ -478,6 +489,28 @@ FED_CELLS = [
      "survive", "tests/test_federation_chaos.py::test_sigkill_mid_meta_publish_resumes"),
     ("partition_update", "raise", "one partition fails -> honest partial meta publish",
      "survive", "tests/test_federation_chaos.py::test_partition_failure_publishes_honest_partial"),
+    ("partition_load", "damage", "quarantined partition at update time -> degraded meta "
+     "(partitions_unavailable stamped, old generation retained), heal pass clears",
+     "survive", "tests/test_federation.py::test_partial_update_contract_with_unavailable_partition"),
+]
+
+
+# autoscaling cells (--autoscale, ISSUE 15): a REAL pod governed from
+# outside by tools/pod_autoscale.py — the controller watches the
+# checkpoint dir read-only, decides against --deadline, and actuates
+# purely through the pod protocol (DREP_TPU_POD_JOIN=auto spawns,
+# SIGTERM drains). Both delegate to multi-process pytest chaos cells.
+AUTOSCALE_CELLS = [
+    ("autoscale_decide", "scale_up",
+     "deadline pressure -> controller-spawned joiner admitted mid-run, "
+     "edges bit-identical, decisions in the merged trace",
+     "survive",
+     "tests/test_autoscale_chaos.py::test_controller_spawned_joiner_meets_deadline_bit_identical"),
+    ("autoscale_decide", "join",
+     "ring-phase JOIN at D=3 -> pod keeps its collective schedule, joiner "
+     "consumes step tail, bit-identical to the monolithic reference",
+     "survive",
+     "tests/test_autoscale_chaos.py::test_ring_phase_join_tail_participation_d3_bit_identical"),
 ]
 
 
@@ -574,6 +607,7 @@ def main() -> int:
     serve_cells = "--serve" in sys.argv
     fed_serve_cells = "--serve-federated" in sys.argv
     events_cells = "--events" in sys.argv
+    autoscale_cells = "--autoscale" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
 
@@ -620,6 +654,7 @@ def main() -> int:
     _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
     _pytest_cells(FED_SERVE_CELLS, "--serve-federated", fed_serve_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)
+    _pytest_cells(AUTOSCALE_CELLS, "--autoscale", autoscale_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)
 
     w_site = max(len(r[0]) for r in rows)

@@ -1,8 +1,8 @@
 """Rule ``reader-purity``: the read-only readers never reach a write.
 
 classify (PR 6), the serve daemon (PR 11), pod_status + trace_report
-(PR 10), and the scrubber's scan mode (PR 5) are byte-for-byte READERS
-by contract — concurrent updates publish beside them precisely because
+(PR 10), the scrubber's scan mode (PR 5), and the autoscaling
+controller (PR 15) are byte-for-byte READERS by contract — concurrent updates publish beside them precisely because
 they never mutate the store. This rule walks the intra-repo call graph
 from those entrypoints and flags every reachable write-capable call:
 payload writes, destructive filesystem calls (remove/mkdir/rmtree), and
@@ -41,6 +41,14 @@ ENTRYPOINTS = (
     ("tools/trace_report.py", "main"),
     ("tools/scrub_store.py", "scrub"),
     ("tools/scrub_store.py", "main"),
+    # the autoscaling controller (ISSUE 15) is a pure READER of the
+    # checkpoint dir it governs (byte-for-byte, pinned by digest in
+    # tests/test_autoscale.py) — its only writes are the decision log
+    # (an edge-waived helper living BESIDE the store) and its own
+    # telemetry stream (the skipped telemetry module)
+    ("drep_tpu/autoscale/controller.py", "AutoscaleController.poll_once"),
+    ("drep_tpu/autoscale/controller.py", "AutoscaleController.run"),
+    ("tools/pod_autoscale.py", "main"),
 )
 
 # modules the walk does not enter — each writes only under an explicit

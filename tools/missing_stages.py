@@ -79,6 +79,10 @@ def _degraded(rec: dict) -> bool:
         or rec.get("pod_epochs", 1) > 1
         or rec.get("pod_joins")
         or rec.get("planned_departures")
+        # ISSUE 15: churn DECIDED by the autoscaling controller (the
+        # join/drain notes carry its stamp) — the run's chip count was
+        # policy-elastic, same refusal as hand-driven membership churn
+        or rec.get("autoscale_decisions")
         or rec.get("corrupt_shards_healed")
         or rec.get("io_unrecoverable")
         or ft.get("dead_processes")
@@ -86,6 +90,7 @@ def _degraded(rec: dict) -> bool:
         or ft.get("pod_joins")
         or ft.get("planned_departures")
         or ft.get("drain_announced")
+        or ft.get("autoscale_churn")
         or ft.get("ring_step_failures")
         or ft.get("corrupt_shards_healed")
         or ft.get("io_unrecoverable")

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Deadline-driven autoscaling controller for a RUNNING elastic pod.
+
+Watches a pod's shared checkpoint dir through the same read-only
+``pod_status.collect()`` snapshot loop that ``--follow`` renders, feeds
+each snapshot to the pure policy (drep_tpu/autoscale/policy.py), and
+actuates ONLY through the existing pod protocol — joiners spawned with
+``DREP_TPU_POD_JOIN=auto``, drains via SIGTERM to capacity the
+controller itself added. Workers need no changes to be governed, and
+the controller's death is harmless (they never depend on it).
+
+Usage::
+
+    python tools/pod_autoscale.py <wd>/data/streaming_primary \\
+        --deadline 600 --max_procs 8 \\
+        --spawn "python my_worker.py ..."        # the joiner command
+
+    python tools/pod_autoscale.py <ckpt_dir> --deadline 600
+        # recommend-only: decisions logged + traced, nothing spawned
+
+Every decision lands in ``autoscale.jsonl`` beside (never inside) the
+checkpoint dir and — with ``--log_dir`` + ``DREP_TPU_EVENTS=on`` — as an
+``autoscale_decision`` telemetry instant tools/trace_report.py merges
+next to the membership timeline. Knobs: DREP_TPU_AUTOSCALE_INTERVAL_S /
+_COOLDOWN_S / _MAX_SPAWN (drep_tpu/utils/envknobs.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from drep_tpu.autoscale.controller import (  # noqa: E402
+    AUTOSCALE_TELEMETRY_PID,
+    AutoscaleController,
+)
+from drep_tpu.autoscale.policy import Targets  # noqa: E402
+from drep_tpu.utils import envknobs, telemetry  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("checkpoint_dir",
+                    help="the pod's shared checkpoint dir "
+                         "(e.g. <wd>/data/streaming_primary)")
+    ap.add_argument("--deadline", type=float, default=None, metavar="SECONDS",
+                    help="finish-by target, seconds from controller start; "
+                         "the policy scales up when the publish-rate ETA "
+                         "projects past it")
+    ap.add_argument("--cost", type=float, default=None, metavar="PROC_SECONDS",
+                    help="proc-seconds budget for the remaining work; the "
+                         "policy drains controller-spawned capacity when the "
+                         "projection exceeds it AND the deadline still holds")
+    ap.add_argument("--min_procs", type=int, default=1)
+    ap.add_argument("--max_procs", type=int, default=8)
+    ap.add_argument("--interval", type=float, default=None, metavar="SECONDS",
+                    help="snapshot cadence (default "
+                         "DREP_TPU_AUTOSCALE_INTERVAL_S)")
+    ap.add_argument("--cooldown", type=float, default=None, metavar="SECONDS",
+                    help="minimum spacing between scaling decisions "
+                         "(default DREP_TPU_AUTOSCALE_COOLDOWN_S)")
+    ap.add_argument("--max_spawn", type=int, default=None,
+                    help="joiners spawned per scale-up decision "
+                         "(default DREP_TPU_AUTOSCALE_MAX_SPAWN)")
+    ap.add_argument("--hysteresis", type=float, default=0.1,
+                    help="dead-band fraction around the deadline projection")
+    ap.add_argument("--spawn", default=None, metavar="CMD",
+                    help="full joiner command line; spawned with "
+                         "DREP_TPU_POD_JOIN=auto in its environment. "
+                         "Omit for recommend-only mode.")
+    ap.add_argument("--decision_log", default=None,
+                    help="decision JSONL path (default: autoscale.jsonl "
+                         "beside the checkpoint dir — never inside it)")
+    ap.add_argument("--log_dir", default=None,
+                    help="telemetry sink dir (the pod's <wd>/log) so "
+                         "autoscale_decision instants merge into the trace; "
+                         "gated by DREP_TPU_EVENTS like every emitter")
+    ap.add_argument("--count", type=int, default=0,
+                    help="stop after N decisions (0 = until the pod finishes)")
+    args = ap.parse_args(argv)
+
+    if args.log_dir:
+        telemetry.configure(log_dir=args.log_dir, pid=AUTOSCALE_TELEMETRY_PID)
+    cooldown = (
+        envknobs.env_float("DREP_TPU_AUTOSCALE_COOLDOWN_S")
+        if args.cooldown is None
+        else args.cooldown
+    )
+    max_spawn = (
+        envknobs.env_int("DREP_TPU_AUTOSCALE_MAX_SPAWN")
+        if args.max_spawn is None
+        else args.max_spawn
+    )
+    targets = Targets(
+        deadline_at=(
+            # drep-lint: allow[clock-mono] — the deadline is compared against snapshot observed_at stamps (wall/server clock), like the protocol's note mtimes
+            time.time() + args.deadline if args.deadline is not None else None
+        ),
+        cost_proc_s=args.cost,
+        min_procs=args.min_procs,
+        max_procs=args.max_procs,
+        cooldown_s=cooldown,
+        hysteresis=args.hysteresis,
+        max_spawn=max_spawn,
+    )
+    controller = AutoscaleController(
+        args.checkpoint_dir, targets,
+        spawn_cmd=args.spawn, interval_s=args.interval,
+        decision_log=args.decision_log,
+    )
+    try:
+        return controller.run(count=args.count)
+    finally:
+        telemetry.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -349,10 +349,22 @@ def render_federation(status: dict) -> str:
     )
     if status.get("partial"):
         p = status["partial"]
-        lines.append(
-            f"  PARTIAL publish: partition(s) {p.get('failed_partitions')} failed; "
-            f"{len(p.get('unadmitted', []))} genome(s) unadmitted"
-        )
+        # `unadmitted` is one MERGED list shared by both stamp classes —
+        # render its count once, never once per line (double-counting
+        # would misstate the operator's re-submit workload)
+        bits = []
+        if p.get("partitions_unavailable"):
+            bits.append(
+                f"partition(s) {p['partitions_unavailable']} UNAVAILABLE "
+                f"(update degraded, old generation retained; serve answers "
+                f"PARTIAL while they heal)"
+            )
+        if p.get("failed_partitions"):
+            bits.append(
+                f"partition(s) {p['failed_partitions']} failed mid-update"
+            )
+        bits.append(f"{len(p.get('unadmitted', []))} genome(s) unadmitted")
+        lines.append("  PARTIAL publish: " + "; ".join(bits))
     return "\n".join(lines) + "\n"
 
 
@@ -483,7 +495,15 @@ def follow(
     """Poll + re-render in place every `interval_s` until Ctrl-C (or
     `count` renders, for tests/scripting). Read-only like the one-shot
     path — each iteration IS one :func:`collect` snapshot. Returns the
-    last snapshot's exit status."""
+    last snapshot's exit status.
+
+    ``--follow --json`` composes as an NDJSON STREAM (ISSUE 15
+    satellite): exactly one compact JSON object per line per interval —
+    no ANSI clears, no separator banners, no pretty-printing — so an
+    external operator (or anything piping through ``jq``) consumes the
+    same machine view the autoscaling controller gets in-process from
+    ``collect()``. Pre-fix the two flags did not compose: ``--json``
+    emitted multi-line pretty dumps interleaved with poll banners."""
     out = sys.stdout if out is None else out
     clear = "\x1b[H\x1b[2J" if getattr(out, "isatty", lambda: False)() else ""
     n = 0
@@ -491,15 +511,20 @@ def follow(
     try:
         while True:
             status = _collect_any(ckpt_dir)
-            body = (
-                json.dumps(status, indent=1, sort_keys=True) + "\n"
-                if as_json
-                else _render_any(status)
-            )
-            if clear:
-                out.write(clear + body)
+            if as_json:
+                # one whole line per snapshot, flushed — the NDJSON
+                # contract (telemetry's crash-safe line idiom)
+                out.write(
+                    json.dumps(status, sort_keys=True, separators=(",", ":"))
+                    + "\n"
+                )
+            elif clear:
+                out.write(clear + _render_any(status))
             else:
-                out.write(f"--- poll {n + 1} @ {time.strftime('%H:%M:%S')} ---\n" + body)
+                out.write(
+                    f"--- poll {n + 1} @ {time.strftime('%H:%M:%S')} ---\n"
+                    + _render_any(status)
+                )
             out.flush()
             n += 1
             if count and n >= count:
