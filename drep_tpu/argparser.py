@@ -184,6 +184,14 @@ def build_parser() -> argparse.ArgumentParser:
                               "accepted (plus 'pallas_interpret', the CPU "
                               "equality oracle for tests/bench — never a "
                               "performance mode)")
+        tpu.add_argument("--ring_vmem_mb", type=int, default=None,
+                         help="VMEM budget (MB) the gridded fused ring sizes "
+                              "its per-cell row tiles against "
+                              "(ops/pallas_ring.fused_ring_tile) — a sizing "
+                              "knob, never a refusal: any block size streams "
+                              "through VMEM in tiles that fit. Default from "
+                              "DREP_TPU_RING_VMEM_MB (12). Block tiles and "
+                              "checkpoints are bit-identical at every value")
         tpu.add_argument("--io_retries", type=int, default=None,
                          help="transient shared-filesystem I/O errors "
                               "(EIO/ESTALE/ETIMEDOUT) retried per durable "

@@ -117,6 +117,10 @@ CLUSTER_DEFAULTS: dict[str, Any] = {
     # self-check validates on a real TPU, else lax.ppermute. Block tiles
     # are bit-identical across backends, so never a _RESUME_KEY.
     "ring_comm": "auto",
+    # gridded fused-ring VMEM tile budget (MB); None defers to the
+    # DREP_TPU_RING_VMEM_MB env knob (12). Pure tile-sizing — block tiles
+    # are bit-identical at every value, so never a _RESUME_KEY.
+    "ring_vmem_mb": None,
 }
 
 _RESUME_KEYS = [
@@ -408,6 +412,7 @@ def d_cluster_wrapper(wd: WorkDirectory, bdb: pd.DataFrame, **kwargs) -> pd.Data
         monolithic=True if kw["ring_monolithic"] else None,
         checkpoint_base=os.path.join(wd.location, "data", "dense_ring"),
         comm=None if kw["ring_comm"] == "auto" else kw["ring_comm"],
+        vmem_mb=kw["ring_vmem_mb"],
     )
     snapshot = {k: kw.get(k) for k in _RESUME_KEYS if k != "genomes"}
     # normalize: CLI passes 0.25 explicitly, library callers omit it — the

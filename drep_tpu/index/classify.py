@@ -297,9 +297,21 @@ def classify_batch(
                 dropped=queries.dropped,
             )
     _admit_batch(scratch, admitted, queries.results, gen + 1)
-    # in-memory rectangular compare: checkpoint_dir None => no writes
-    # drep-lint: allow[reader-purity] — ckpt_dir=None gates the streaming engine storeless: no shard publishes, no heartbeat notes, no meta stamps (byte-for-byte pinned by test_index/test_serve digest assertions)
-    ii, jj, dd, _pairs = _rect_edges(scratch, n_old, None, prune_cfg=prune_cfg)
+    ii = jj = dd = None
+    if not joint:
+        # serve fast path: rect compare against the device-resident
+        # sketch matrix (one upload per generation, not per batch); the
+        # per-query jj == n_old + t selection below never reads the
+        # query-query edges this path does not produce. None => classic.
+        from drep_tpu.index.resident_device import rect_edges_device
+
+        fast = rect_edges_device(resident, queries, n_old)
+        if fast is not None:
+            ii, jj, dd = fast
+    if ii is None:
+        # in-memory rectangular compare: checkpoint_dir None => no writes
+        # drep-lint: allow[reader-purity] — ckpt_dir=None gates the streaming engine storeless: no shard publishes, no heartbeat notes, no meta stamps (byte-for-byte pinned by test_index/test_serve digest assertions)
+        ii, jj, dd, _pairs = _rect_edges(scratch, n_old, None, prune_cfg=prune_cfg)
     # canonical (ii, jj) order — the update path's convention: the
     # streaming federated path assembles the same edge SET from
     # per-partition compares, and identical ordering pins identical

@@ -1,4 +1,4 @@
-"""Fused rotate+compare ring step — a Pallas TPU kernel (ISSUE 8).
+"""Gridded fused rotate+compare ring step — a Pallas TPU kernel (ISSUE 8/16).
 
 MULTICHIP_r05 measured the host-stepped dense ring at efficiency 0.806
 with D=8 fixed per-device work: ~1/5 of pod throughput lost to dispatch
@@ -9,10 +9,27 @@ MXU never overlap). This module fuses the two into ONE `pallas_call` per
 ring step (SNIPPETS.md [1]/[2], the JAX Pallas TPU distributed-guide
 pattern): the kernel STARTS an async remote copy of the local B operand
 to the ring neighbor's receive buffer (`pltpu.make_async_remote_copy`,
-DMA semaphores in scratch, `device_id_type=MESH`), computes the current
-tile from the still-resident B block while the ICI transfer is in
+DMA semaphores in scratch, `device_id_type=MESH`), computes the step's
+tiles from the still-resident B block while the ICI transfer is in
 flight, then WAITS the semaphores — rotation hidden entirely behind
 compute.
+
+GRIDDING (ISSUE 16): the PR 8 kernel was single-shot — both whole
+operands pinned in VMEM — and `fused_block_fits` refused any block past
+a 12 MB working set, so exactly the production-size blocks where the
+19% loss bites always fell back to ppermute. The step is now a
+`pallas_call` grid over (row-tile, col-tile) cells: each cell streams a
+[tile, s] slab of A and of B through VMEM (blocked BlockSpecs; the
+Pallas pipeline double-buffers them) and writes one [tile, tile] output
+block, while the full B operand rides separately in compiler-chosen
+(HBM) space as the remote DMA's source. The copy START is pinned to the
+FIRST grid cell and the semaphore WAIT to the LAST (`pl.when` on
+`pl.program_id`; the DMA semaphores live in scratch, which persists
+across the sequential grid), so the ICI transfer overlaps the whole
+grid sweep — comm/compute overlap survives gridding, and ANY block size
+streams. Tile rows are sized against the registered
+``DREP_TPU_RING_VMEM_MB`` budget (:func:`fused_ring_tile`) — a sizing
+knob, never a refusal.
 
 Double buffering: each step's B receive buffer is a fresh `pallas_call`
 output, and the host-stepped driver (parallel/allpairs.py) threads step
@@ -24,12 +41,31 @@ Rotation semantics are pinned to the existing ring's
 ``lax.ppermute(b, axis, [(j, (j+1) % D)])``: after the step, device m
 holds what device m-1 held, so at step i device m computes block
 ``(m - i) mod D`` — the half-ring schedule, the host mirror, and the
-per-block recovery indexing are all untouched. The tile bodies are the
-SAME functions the ppermute ring jit-wraps (ops/minhash.mash_tile_raw,
-ops/containment.containment_inter_tile_raw — imported, not copied), so the
-produced block tiles are bit-identical; tests pin this at D=3/8 in
-interpret mode, and the on-hardware self-check re-proves it per process
-before the fast path is ever selected.
+per-block recovery indexing are all untouched. The merge-network tile
+bodies are the SAME functions the ppermute ring jit-wraps
+(ops/minhash.mash_tile_raw, ops/containment.containment_inter_tile_raw —
+imported, not copied), so the produced block tiles are bit-identical;
+tests pin this at D=3/8 in interpret mode, and the on-hardware
+self-check re-proves it per process before the fast path is ever
+selected.
+
+MXU intersection-matmul variant (the ROADMAP's named escape hatch if
+Mosaic rejects the in-kernel merge network at grid scale): for the
+count-free |A∩B| tile (kind "containment" — packed ids are DENSE ranks,
+ops/containment.pack_scaled_sketches) the tile can instead be computed
+as a bf16 indicator matmul with the SAME DMA overlapped around it. Each
+cell scatters its two id slabs into 0/1 VMEM indicator blocks — the
+exact (hi, lo) = (id >> 7, id & 127) lane-decomposed scatter loop
+proven by ops/pallas_indicator.py — one vocab chunk at a time, and
+accumulates `dot_general(ind_a, ind_b^T)` with
+`preferred_element_type=f32` (ops/minhash_matmul.py's MXU idiom).
+Indicators are exact 0/1, every count < 2^24: the f32 accumulation is
+exact integer arithmetic, bit-identical to the merge-network tile's
+int32→f32 cast. The variant is selected per-step by the existing
+self-check (merge first; matmul as the surviving fallback), or pinned
+with ``DREP_TPU_RING_VARIANT``. Mash stays merge-only: its tile counts
+shared ids within the bottom-s of the UNION (ops/minhash._pair_shared),
+which is not a plain intersection matmul.
 
 Why no neighbor barrier before the DMA: each `pallas_call` here performs
 exactly ONE remote write into a buffer that XLA allocated before any
@@ -68,20 +104,45 @@ from jax.experimental.pallas import tpu as pltpu
 
 from drep_tpu.parallel.mesh import AXIS
 
-# VMEM budget for one fused step's working set (bytes): both sketch
-# operands + the tile output must fit comfortably under the ~16 MB/core
-# VMEM. Blocks past this run the ppermute ring (resolve_comm's caller
-# checks fused_block_fits) — gridding the kernel over row tiles is the
-# documented follow-on once hardware answers.
-_FUSED_VMEM_BYTES = 12 << 20
+LANES = 128
+# vocab chunk one matmul-variant cell scatters+multiplies at a time: two
+# [tile, _MATMUL_V_CHUNK] int8 indicator blocks in VMEM scratch. Power of
+# two so every pow2-bucketed v_pad divides evenly.
+_MATMUL_V_CHUNK = 8192
+
+# kinds whose tile is the plain count-free |A∩B| over dense-ranked ids —
+# the only shape the indicator-matmul variant can express
+MATMUL_TILE_KINDS = ("containment",)
 
 
-def fused_block_fits(n_local: int, sketch_width: int, n_outputs: int = 1) -> bool:
-    """Whether a [n_local, sketch_width] int32 block pair (+ the f32 tile
-    outputs) fits the fused kernel's VMEM budget."""
-    operand = n_local * sketch_width * 4
-    tile = n_local * n_local * 4 * n_outputs
-    return 2 * operand + tile + n_local * 8 <= _FUSED_VMEM_BYTES
+def fused_ring_tile(
+    n_local: int, sketch_width: int, n_outputs: int = 1,
+    *, extra_row_bytes: int = 0, vmem_mb: int | None = None,
+) -> int:
+    """Rows per grid cell for a [n_local, sketch_width] int32 block pair:
+    the largest halving of n_local whose estimated per-cell working set —
+    pipeline-double-buffered A and B slabs (ids + counts) plus the
+    [tile, tile] f32 output blocks plus any per-row scratch the variant
+    adds — fits the ``DREP_TPU_RING_VMEM_MB`` budget. A sizing target for
+    the Pallas pipeline, not a hard guarantee (tile-body temporaries are
+    kernel-dependent); the knob exists so an operator can trade tile
+    height for headroom without touching code. Never refuses: the floor
+    is a single row."""
+    from drep_tpu.utils import envknobs
+
+    budget = (
+        vmem_mb if vmem_mb is not None else envknobs.env_int("DREP_TPU_RING_VMEM_MB")
+    ) << 20
+
+    def working_set(t: int) -> int:
+        slabs = 2 * (t * sketch_width * 4 + t * 4)  # A + B ids/counts
+        tiles = n_outputs * t * t * 4
+        return 2 * (slabs + tiles) + t * extra_row_bytes  # 2x: pipelining
+
+    tile = max(1, int(n_local))
+    while tile > 1 and working_set(tile) > budget:
+        tile = (tile + 1) // 2
+    return tile
 
 
 def _raw_mash_tile(k: int):
@@ -123,73 +184,203 @@ _RAW_TILE_KINDS = {
 }
 
 
+def _scatter_indicator_chunk(ids_ref, out_ref, base, v_chunk: int):
+    """Scatter one vocab chunk [base, base+v_chunk) of sorted id rows into
+    `out_ref` [rows, v_chunk/128, 128] int8 0/1 — the lane-decomposed
+    VMEM scatter loop from ops/pallas_indicator.py, restricted to the
+    chunk. Rows are sorted ascending with a PAD_ID tail, so each row
+    costs exactly its ids-in-chunk plus the skip scan; ids outside the
+    chunk (including ragged-block padding garbage, which may be unsorted)
+    are guarded out — a garbage row can only dirty its own output row,
+    which the blocked out_spec masks on write-back anyway."""
+    rows, w = ids_ref.shape
+    out_ref[...] = jnp.zeros_like(out_ref)
+    lane = lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+
+    def row_body(r, _):
+        # loaded once as a VALUE: while_loop conds must not read refs
+        # (interpret-mode state discharge refuses ref effects in cond)
+        row = ids_ref[r, :]
+        c0 = lax.while_loop(
+            lambda c: jnp.logical_and(c < w, row[c] < base),
+            lambda c: c + 1,
+            0,
+        )
+
+        def step(c):
+            raw = row[c]
+            ok = raw >= base
+            idx = jnp.clip(raw - base, 0, v_chunk - 1)
+            hi = idx // LANES
+            lo = idx - hi * LANES
+            cur = out_ref[r, pl.dslice(hi, 1), :]
+            out_ref[r, pl.dslice(hi, 1), :] = jnp.where(
+                jnp.logical_and(ok, lane == lo), 1, cur
+            ).astype(jnp.int8)
+            return c + 1
+
+        lax.while_loop(
+            lambda c: jnp.logical_and(c < w, row[c] < base + v_chunk),
+            step,
+            c0,
+        )
+        return 0
+
+    lax.fori_loop(0, rows, row_body, 0)
+
+
+def _matmul_intersection_tile(
+    a_ids_ref, b_ids_ref, ind_a_ref, ind_b_ref, *, v_pad: int, v_chunk: int
+):
+    """[tile_a, tile_b] f32 |A∩B| via chunked bf16 indicator matmul —
+    exact integer counts (< 2^24), bit-identical to the merge-network
+    tile's int32→f32 cast. Vocab chunks are disjoint hash ranges, so the
+    per-chunk products sum exactly (the ops/containment.py additivity
+    contract)."""
+    ta = a_ids_ref.shape[0]
+    tb = b_ids_ref.shape[0]
+
+    def chunk_body(c, acc):
+        base = c * v_chunk
+        _scatter_indicator_chunk(a_ids_ref, ind_a_ref, base, v_chunk)
+        _scatter_indicator_chunk(b_ids_ref, ind_b_ref, base, v_chunk)
+        a = ind_a_ref[...].reshape(ta, v_chunk).astype(jnp.bfloat16)
+        b = ind_b_ref[...].reshape(tb, v_chunk).astype(jnp.bfloat16)
+        return acc + lax.dot_general(
+            a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    return lax.fori_loop(
+        0, v_pad // v_chunk, chunk_body, jnp.zeros((ta, tb), jnp.float32)
+    )
+
+
 def _fused_step_kernel(
     a_ids_ref, a_counts_ref, b_ids_ref, b_counts_ref,
-    *refs, tile_fn, n_outputs: int, n_devices: int,
+    b_ids_src_ref, b_counts_src_ref,
+    *refs, tile_fn, n_outputs: int, n_devices: int, matmul_cfg,
 ):
-    """One fused rotate+compare step. `refs` unpacks to (tile_refs...,
-    b_ids_out_ref, b_counts_out_ref, ids_send_sem, ids_recv_sem,
-    cts_send_sem, cts_recv_sem). Counts ride as [n_local, 1] (2-D keeps
-    the DMA shape lane-friendly; the driver reshapes)."""
+    """One grid cell of the fused rotate+compare step. The first four
+    refs are the cell's blocked VMEM slabs (A rows i, B rows j); the
+    `_src` pair is the SAME full B operand in compiler-chosen space — the
+    remote DMA's source. `refs` unpacks to (tile_refs..., b_ids_out_ref,
+    b_counts_out_ref, 4 DMA semaphores, then the matmul variant's two
+    indicator scratch blocks when active). Counts ride as [n, 1] (2-D
+    keeps the DMA shape lane-friendly; the driver reshapes).
+
+    The remote-copy START is pinned to the first grid cell and the WAIT
+    to the last: the semaphores live in scratch, which Pallas carries
+    across the sequential grid, so ONE full-operand ICI transfer
+    overlaps the whole tile sweep."""
     tile_refs = refs[:n_outputs]
     b_ids_out_ref, b_counts_out_ref = refs[n_outputs : n_outputs + 2]
-    ids_send, ids_recv, cts_send, cts_recv = refs[n_outputs + 2 :]
+    ids_send, ids_recv, cts_send, cts_recv = refs[n_outputs + 2 : n_outputs + 6]
+    ind_refs = refs[n_outputs + 6 :]
 
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    ni = pl.num_programs(0)
+    nj = pl.num_programs(1)
     my_id = lax.axis_index(AXIS)
     dst = lax.rem(my_id + 1, n_devices)  # == ppermute perm [(j, j+1) % D]
     copy_ids = pltpu.make_async_remote_copy(
-        src_ref=b_ids_ref, dst_ref=b_ids_out_ref,
+        src_ref=b_ids_src_ref, dst_ref=b_ids_out_ref,
         send_sem=ids_send, recv_sem=ids_recv,
         device_id=dst, device_id_type=pltpu.DeviceIdType.MESH,
     )
     copy_cts = pltpu.make_async_remote_copy(
-        src_ref=b_counts_ref, dst_ref=b_counts_out_ref,
+        src_ref=b_counts_src_ref, dst_ref=b_counts_out_ref,
         send_sem=cts_send, recv_sem=cts_recv,
         device_id=dst, device_id_type=pltpu.DeviceIdType.MESH,
     )
-    # start the ICI transfer FIRST, then compute the tile from the
-    # still-resident operand — the DMA engine and the compute units run
-    # concurrently, which is the whole point of the fusion
-    copy_ids.start()
-    copy_cts.start()
-    tiles = tile_fn(
-        a_ids_ref[...], a_counts_ref[...][:, 0],
-        b_ids_ref[...], b_counts_ref[...][:, 0],
-    )
-    if not isinstance(tiles, tuple):
-        tiles = (tiles,)
-    for ref, t in zip(tile_refs, tiles):
-        # same f32 cast as the step program / standalone block recompute
-        ref[...] = t.astype(jnp.float32)
-    copy_ids.wait()
-    copy_cts.wait()
+
+    # start the ICI transfer in the FIRST cell, then compute every tile
+    # from the still-resident slabs — the DMA engine and the compute
+    # units run concurrently across the whole grid sweep
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _start():
+        copy_ids.start()
+        copy_cts.start()
+
+    if matmul_cfg is not None:
+        v_pad, v_chunk = matmul_cfg
+        tile_refs[0][...] = _matmul_intersection_tile(
+            a_ids_ref, b_ids_ref, ind_refs[0], ind_refs[1],
+            v_pad=v_pad, v_chunk=v_chunk,
+        )
+    else:
+        tiles = tile_fn(
+            a_ids_ref[...], a_counts_ref[...][:, 0],
+            b_ids_ref[...], b_counts_ref[...][:, 0],
+        )
+        if not isinstance(tiles, tuple):
+            tiles = (tiles,)
+        for ref, t in zip(tile_refs, tiles):
+            # same f32 cast as the step program / standalone block recompute
+            ref[...] = t.astype(jnp.float32)
+
+    @pl.when(jnp.logical_and(i == ni - 1, j == nj - 1))
+    def _wait():
+        copy_ids.wait()
+        copy_cts.wait()
 
 
 @functools.lru_cache(maxsize=None)
-def fused_ring_step_fn(kind: str, k: int, mesh, interpret: bool = False):
-    """One jitted shard_map program per (kind, k, mesh, interpret): the
-    fused rotate+compare ring step. Call signature and output layout are
-    IDENTICAL to allpairs._ring_step_fn(..., rotate=True) — the step-wise
-    driver swaps one for the other per the resolved comm backend; the
-    last (rotation-free) step always runs the plain program (nothing to
-    overlap). Returns (fn, n_outputs)."""
+def fused_ring_step_fn(
+    kind: str, k: int, mesh, interpret: bool = False,
+    variant: str = "merge", v_pad: int = 0, vmem_mb: int | None = None,
+):
+    """One jitted shard_map program per (kind, k, mesh, interpret,
+    variant, v_pad): the gridded fused rotate+compare ring step. Call
+    signature and output layout are IDENTICAL to
+    allpairs._ring_step_fn(..., rotate=True) — the step-wise driver swaps
+    one for the other per the resolved comm backend; the last
+    (rotation-free) step always runs the plain program (nothing to
+    overlap). `variant="matmul"` (MATMUL_TILE_KINDS only; `v_pad` = the
+    pow2-bucketed dense-id extent, computed host-side by the driver)
+    swaps the merge-network tile body for the MXU indicator matmul.
+    Returns (fn, n_outputs)."""
     from jax.sharding import PartitionSpec as P
 
     from drep_tpu.utils.jaxcompat import shard_map
 
+    if variant not in ("merge", "matmul"):
+        raise ValueError(f"fused ring variant {variant!r}: expected merge|matmul")
+    if variant == "matmul":
+        if kind not in MATMUL_TILE_KINDS:
+            raise ValueError(
+                f"matmul ring variant supports {MATMUL_TILE_KINDS}, not {kind!r} "
+                "(the mash tile counts union-bottom shared ids, not plain |A∩B|)"
+            )
+        if v_pad <= 0 or v_pad % LANES:
+            raise ValueError(
+                f"matmul ring variant needs a positive 128-multiple v_pad, got {v_pad}"
+            )
     make_tile, n_outputs = _RAW_TILE_KINDS[kind]
     tile_fn = make_tile(k)
     D = mesh.devices.size
+    v_chunk = min(v_pad, _MATMUL_V_CHUNK) if variant == "matmul" else 0
 
     def shard_body(a_ids, a_counts, b_ids, b_counts):
         n_local, s = a_ids.shape
         cts2 = a_counts.reshape(n_local, 1)
         b_cts2 = b_counts.reshape(n_local, 1)
+        tile = fused_ring_tile(
+            n_local, s, n_outputs,
+            extra_row_bytes=2 * v_chunk if variant == "matmul" else 0,
+            vmem_mb=vmem_mb,
+        )
+        n_r = -(-n_local // tile)
+        scratch = [pltpu.SemaphoreType.DMA] * 4
+        if variant == "matmul":
+            scratch += [pltpu.VMEM((tile, v_chunk // LANES, LANES), jnp.int8)] * 2
         out = pl.pallas_call(
             functools.partial(
                 _fused_step_kernel,
                 tile_fn=tile_fn, n_outputs=n_outputs, n_devices=D,
+                matmul_cfg=(v_pad, v_chunk) if variant == "matmul" else None,
             ),
+            grid=(n_r, n_r),
             out_shape=(
                 *[
                     jax.ShapeDtypeStruct((n_local, n_local), jnp.float32)
@@ -198,24 +389,34 @@ def fused_ring_step_fn(kind: str, k: int, mesh, interpret: bool = False):
                 jax.ShapeDtypeStruct((n_local, s), b_ids.dtype),
                 jax.ShapeDtypeStruct((n_local, 1), b_counts.dtype),
             ),
-            # tile compute reads the operands from VMEM; the receive
-            # buffers stay in compiler-chosen (HBM) space — they are the
-            # remote DMA's destination, not compute operands this step
+            # cell (i, j) streams A rows i and B rows j through VMEM
+            # (ragged last blocks are padded on read / masked on write by
+            # the blocked specs); the SAME b operand rides again in
+            # compiler-chosen (HBM) space as the remote DMA's source, and
+            # the receive buffers stay there too — they are the DMA's
+            # destination, not compute operands this step
             in_specs=[
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM),
-                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile, s), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile, s), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((tile, 1), lambda i, j: (j, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
             ],
             out_specs=(
-                *[pl.BlockSpec(memory_space=pltpu.VMEM) for _ in range(n_outputs)],
+                *[
+                    pl.BlockSpec(
+                        (tile, tile), lambda i, j: (i, j), memory_space=pltpu.VMEM
+                    )
+                    for _ in range(n_outputs)
+                ],
                 pl.BlockSpec(memory_space=pltpu.ANY),
                 pl.BlockSpec(memory_space=pltpu.ANY),
             ),
-            scratch_shapes=[pltpu.SemaphoreType.DMA] * 4,
+            scratch_shapes=scratch,
             interpret=interpret,
             compiler_params=pltpu.TPUCompilerParams(collective_id=7),
-        )(a_ids, cts2, b_ids, b_cts2)
+        )(a_ids, cts2, b_ids, b_cts2, b_ids, b_cts2)
         *tiles, b_ids_next, b_cts_next = out
         return (*tiles, b_ids_next, b_cts_next.reshape(n_local))
 
@@ -234,28 +435,80 @@ def fused_ring_step_fn(kind: str, k: int, mesh, interpret: bool = False):
     return fn, n_outputs
 
 
+def matmul_ring_vocab_pad(ids: np.ndarray) -> int:
+    """The static v_pad the matmul variant needs, from the HOST copy of
+    the packed id matrix (the driver holds it before sharding): pow2
+    bucket of the dense-rank extent. Packed ids are ranks into the global
+    vocabulary (ops/containment.pack_scaled_sketches), so the extent is
+    max real id + 1 — PAD_ID (2^31-1) never scatters because every real
+    extent is far below it."""
+    from drep_tpu.ops.containment import _pow2_bucket
+    from drep_tpu.ops.minhash import PAD_ID
+
+    real = ids[ids != PAD_ID]
+    extent = int(real.max()) + 1 if real.size else 1
+    return _pow2_bucket(extent, LANES)
+
+
 # -- the auto-gate: one-time per-process on-device self-check -------------
 
-_SELFTEST: dict[str, object] = {"ok": None, "reason": None}
+_SELFTEST: dict[str, object] = {"ok": None, "reason": None, "variant": None}
 
 
 def pallas_ring_unavailable_reason() -> str | None:
     """Why the fused path is off (None when it is on) — surfaced by the
-    resolve logging so a forced --ring_comm pallas_dma fallback is
-    explainable."""
+    resolve logging, the ring_scaling bench record, and the
+    `ring_comm_fallback_reason` perf-counter note so a forced
+    --ring_comm pallas_dma fallback is explainable."""
     pallas_ring_ok()
     return _SELFTEST["reason"]
+
+
+def fused_ring_variant(kind: str) -> str:
+    """Which tile variant the fused step runs for `kind`: the env pin
+    (``DREP_TPU_RING_VARIANT``) when set, else the self-check's surviving
+    variant. Kinds outside MATMUL_TILE_KINDS are always merge — the
+    matmul tile cannot express them."""
+    from drep_tpu.utils import envknobs
+
+    req = envknobs.env_str("DREP_TPU_RING_VARIANT") or "auto"
+    if req not in ("auto", "merge", "matmul"):
+        raise ValueError(
+            f"DREP_TPU_RING_VARIANT={req!r}: expected auto|merge|matmul"
+        )
+    if kind not in MATMUL_TILE_KINDS:
+        return "merge"
+    if req != "auto":
+        return req
+    return "matmul" if _SELFTEST.get("variant") == "matmul" else "merge"
+
+
+def fused_ring_kind_ok(kind: str) -> bool:
+    """Whether the fused path can serve `kind` on this process: the gate
+    passed AND the surviving variant can express the kind's tile. When
+    only the matmul escape hatch survived the self-check, merge-only
+    kinds (mash) must resolve to ppermute — their tile body is the very
+    merge network Mosaic rejected."""
+    if not pallas_ring_ok():
+        return False
+    if _SELFTEST.get("variant") == "matmul" and kind not in MATMUL_TILE_KINDS:
+        return False
+    return True
 
 
 def pallas_ring_ok() -> bool:
     """One-time per-process gate for the fused ring: False off-TPU, with
     fewer than 2 local TPU devices (no rotation to fuse — and no way to
     self-check one), or when the env pin says no; otherwise compile the
-    fused step on a 2-device LOCAL mesh and require bit-equality of both
-    the tile and the rotated operands against an inline lax.ppermute
-    reference. Any failure — Mosaic rejection, remote-compile outage,
-    wrong numerics — permanently falls back to the ppermute ring for the
-    process: a gate miss costs ~19% pod throughput, never correctness.
+    gridded fused step on a 2-device LOCAL mesh and require bit-equality
+    of both the tile and the rotated operands against an inline
+    lax.ppermute reference. The merge-network variant is tried first; if
+    Mosaic rejects it at grid scale, the MXU indicator-matmul variant is
+    tried as the escape hatch (it then serves MATMUL_TILE_KINDS; merge-
+    only kinds fall back to ppermute). Any remaining failure — Mosaic
+    rejection, remote-compile outage, wrong numerics — permanently falls
+    back to the ppermute ring for the process: a gate miss costs ~19%
+    pod throughput, never correctness.
 
     The self-check runs on LOCAL devices only (no pod collective): every
     pod process runs the same software stack against the same hardware
@@ -280,20 +533,29 @@ def pallas_ring_ok() -> bool:
         if len(jax.local_devices()) < 2:
             _SELFTEST.update(ok=False, reason="fewer than 2 local TPU devices")
             return False
-        _SELFTEST["ok"] = bool(_selftest_fused_step())
-        if not _SELFTEST["ok"]:
-            _SELFTEST["reason"] = "self-check numerics mismatch"
+        if _selftest_fused_step("merge"):
+            _SELFTEST.update(ok=True, variant="merge")
+        elif _selftest_fused_step("matmul"):
+            # the escape hatch is live: matmul-capable kinds run fused,
+            # merge-only kinds resolve to ppermute (fused_ring_variant)
+            _SELFTEST.update(ok=True, variant="matmul")
+        else:
+            _SELFTEST["ok"] = False
+            _SELFTEST["reason"] = "self-check numerics mismatch (both variants)"
     except Exception as e:  # any compile/runtime failure -> permanent fallback
         _SELFTEST.update(ok=False, reason=f"self-check failed: {e!r}")
     return bool(_SELFTEST["ok"])
 
 
-def _selftest_fused_step() -> bool:
-    """Compile-and-verify on the real device: one fused mash step on a
+def _selftest_fused_step(variant: str) -> bool:
+    """Compile-and-verify on the real device: one gridded fused step on a
     tiny 2-device local mesh vs an inline ppermute reference — tile AND
-    rotated operands must match bit-for-bit."""
+    rotated operands must match bit-for-bit. `variant="merge"` checks the
+    mash merge network; `variant="matmul"` checks the containment
+    indicator matmul (each variant's own Mosaic surface)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from drep_tpu.ops.containment import containment_inter_tile
     from drep_tpu.ops.minhash import mash_distance_tile
     from drep_tpu.utils.jaxcompat import shard_map
 
@@ -301,20 +563,37 @@ def _selftest_fused_step() -> bool:
     mesh = jax.make_mesh((2,), (AXIS,), devices=devices)
     rng = np.random.default_rng(0)
     n_local, s = 8, 128
-    ids = np.sort(
-        rng.integers(0, 2**20, size=(2 * n_local, s), dtype=np.int32), axis=1
-    )
+    if variant == "matmul":
+        # containment-shaped data: sorted UNIQUE dense ranks per row
+        v_pad = 1024
+        ids = np.stack(
+            [
+                np.sort(rng.choice(v_pad, size=s, replace=False)).astype(np.int32)
+                for _ in range(2 * n_local)
+            ]
+        )
+    else:
+        v_pad = 0
+        ids = np.sort(
+            rng.integers(0, 2**20, size=(2 * n_local, s), dtype=np.int32), axis=1
+        )
     counts = np.full(2 * n_local, s, np.int32)
     ids_d = jax.device_put(ids, NamedSharding(mesh, P(AXIS, None)))
     cts_d = jax.device_put(counts, NamedSharding(mesh, P(AXIS)))
 
-    fused, _ = fused_ring_step_fn("mash", 21, mesh, interpret=False)
+    kind = "containment" if variant == "matmul" else "mash"
+    fused, _ = fused_ring_step_fn(
+        kind, 21, mesh, interpret=False, variant=variant, v_pad=v_pad
+    )
     tile_f, b_ids_f, b_cts_f = jax.block_until_ready(
         fused(ids_d, cts_d, ids_d, cts_d)
     )
 
     def ref_body(a_ids, a_counts, b_ids, b_counts):
-        d, _j = mash_distance_tile(a_ids, a_counts, b_ids, b_counts, k=21)
+        if variant == "matmul":
+            d = containment_inter_tile(a_ids, b_ids)
+        else:
+            d, _j = mash_distance_tile(a_ids, a_counts, b_ids, b_counts, k=21)
         perm = [(j, (j + 1) % 2) for j in range(2)]
         return (
             d.astype(jnp.float32),
@@ -339,4 +618,4 @@ def _selftest_fused_step() -> bool:
 
 def reset_selftest_for_tests() -> None:
     """Clear the cached gate verdict (tests exercise both outcomes)."""
-    _SELFTEST.update(ok=None, reason=None)
+    _SELFTEST.update(ok=None, reason=None, variant=None)

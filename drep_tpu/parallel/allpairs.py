@@ -102,13 +102,16 @@ RING_STEP_WARMUP = 1
 # controller from the CLI flags (same pattern as faulttol's
 # configure_defaults): engines call ring_allpairs deep inside replicated
 # control flow and cannot thread a workdir down to it.
-_RING_CONFIG: dict = {"monolithic": None, "checkpoint_base": None, "comm": None}
+_RING_CONFIG: dict = {
+    "monolithic": None, "checkpoint_base": None, "comm": None, "vmem_mb": None,
+}
 
 
 def configure_ring(
     monolithic: bool | None = None,
     checkpoint_base: str | None = None,
     comm: str | None = None,
+    vmem_mb: int | None = None,
 ) -> None:
     """Install run-wide ring defaults: `monolithic` forces the single
     collective reference program; `checkpoint_base` roots the step-wise
@@ -124,6 +127,13 @@ def configure_ring(
     _RING_CONFIG["monolithic"] = monolithic
     _RING_CONFIG["checkpoint_base"] = checkpoint_base
     _RING_CONFIG["comm"] = comm
+    _RING_CONFIG["vmem_mb"] = vmem_mb
+
+
+def ring_vmem_mb_override() -> int | None:
+    """The run-wide --ring_vmem_mb override (None defers to the
+    DREP_TPU_RING_VMEM_MB env knob inside fused_ring_tile)."""
+    return _RING_CONFIG["vmem_mb"]
 
 
 def ring_monolithic_default() -> bool:
@@ -147,19 +157,27 @@ def ring_comm_requested() -> str:
 def resolve_ring_comm(
     mesh, requested: str | None = None,
     n_local: int = 0, sketch_width: int = 0, n_outputs: int = 1,
+    kind: str = "",
 ) -> str:
     """The comm backend a step-wise ring over `mesh` actually RUNS:
-    'pallas_dma' (the fused rotate+compare kernel, ops/pallas_ring.py),
-    'pallas_interpret' (the same kernel discharged on the host backend —
-    the CPU equality oracle, never a perf claim), or 'ppermute' (the
-    shard_map reference).
+    'pallas_dma' (the gridded fused rotate+compare kernel,
+    ops/pallas_ring.py), 'pallas_interpret' (the same kernel discharged
+    on the host backend — the CPU equality oracle, never a perf claim),
+    or 'ppermute' (the shard_map reference).
 
     'auto' selects pallas_dma only when the one-time on-device self-check
     passed (real TPU backend, bit-equal numerics — the
-    pallas_indicator_ok gating pattern) AND the block shape fits the
-    fused kernel's VMEM budget; an explicit 'pallas_dma' that cannot be
-    honored falls back to ppermute with a warning naming the reason — a
-    comm knob must never turn into a wedge or a wrong answer."""
+    pallas_indicator_ok gating pattern). There is NO block-size gate any
+    more (ISSUE 16): the gridded kernel streams ANY block through VMEM
+    in `DREP_TPU_RING_VMEM_MB`-sized row tiles, so `n_local` /
+    `sketch_width` no longer influence the verdict (kept in the
+    signature for callers that still pass them). When only the matmul
+    variant survived the self-check, kinds it cannot express (`kind`
+    outside MATMUL_TILE_KINDS) still resolve to ppermute. An explicit
+    'pallas_dma' that cannot be honored falls back to ppermute with a
+    warning naming the reason — a comm knob must never turn into a wedge
+    or a wrong answer."""
+    del n_local, sketch_width, n_outputs  # gridding removed the fits-check
     req = requested if requested is not None else ring_comm_requested()
     if req not in RING_COMM_CHOICES:
         raise ValueError(
@@ -168,29 +186,30 @@ def resolve_ring_comm(
     if req == "ppermute" or mesh.devices.size < 2:
         return "ppermute"
     from drep_tpu.ops.pallas_ring import (
-        fused_block_fits,
+        fused_ring_kind_ok,
         pallas_ring_ok,
         pallas_ring_unavailable_reason,
     )
 
-    fits = (
-        fused_block_fits(n_local, sketch_width, n_outputs)
-        if n_local and sketch_width
-        else True
-    )
     if req == "pallas_interpret":
         # the interpret oracle has no VMEM to overflow — always honored
         return "pallas_interpret"
-    if pallas_ring_ok() and fits:
+    if not kind and pallas_ring_ok():
         return "pallas_dma"
+    if kind and fused_ring_kind_ok(kind):
+        return "pallas_dma"
+    if pallas_ring_ok():
+        reason = (
+            f"only the matmul tile variant passed the self-check and kind "
+            f"{kind!r} needs the merge network"
+        )
+    else:
+        reason = pallas_ring_unavailable_reason()
     if req == "pallas_dma":
         get_logger().warning(
             "dense ring: --ring_comm pallas_dma requested but unavailable "
             "(%s) — falling back to ppermute",
-            pallas_ring_unavailable_reason()
-            if not pallas_ring_ok()
-            else f"block [{n_local}, {sketch_width}] exceeds the fused "
-            f"kernel's VMEM budget",
+            reason,
         )
     return "ppermute"
 
@@ -991,16 +1010,32 @@ def _ring_allpairs_stepwise(
         # call's 1.0
         counters.set_gauge("ring_comm_pallas", 0.0)
         if run_ring:
-            # rotation backend for THIS schedule: the fused pallas kernel
-            # (ICI rotation hidden behind the tile compute) when the
+            # rotation backend for THIS schedule: the gridded fused pallas
+            # kernel (ICI rotation hidden behind the tile sweep) when the
             # resolve gate admits it, the shard_map ppermute otherwise.
             # Block tiles are bit-identical either way (pinned in tests),
             # so the choice never touches the checkpoint/recovery story.
             comm = resolve_ring_comm(
-                mesh, ring_comm, n_local, ids.shape[1], n_outputs
+                mesh, ring_comm, kind=kind
             ) if n_steps > 1 else "ppermute"
             if comm != "ppermute":
                 counters.set_gauge("ring_comm_pallas", 1.0)
+            else:
+                # observability (ISSUE 16): WHY the fused path is off,
+                # beside the gauge in perf_counters.json — a 0.0 gauge
+                # alone cannot distinguish a pinned fallback from a
+                # failed self-check from a one-step schedule
+                from drep_tpu.ops.pallas_ring import (
+                    pallas_ring_unavailable_reason,
+                )
+
+                counters.set_note(
+                    "ring_comm_fallback_reason",
+                    "single-step schedule (nothing to rotate)"
+                    if n_steps <= 1
+                    else pallas_ring_unavailable_reason()
+                    or "ppermute requested or fused path refused for this kind",
+                )
             ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
             counts_d = put_global(counts, NamedSharding(mesh, P(AXIS)))
             # the fused step's cold profile differs from the warm steps
@@ -1020,11 +1055,23 @@ def _ring_allpairs_stepwise(
                 for i in range(n_steps):
                     rotate = i < n_steps - 1
                     if rotate and comm != "ppermute":
-                        from drep_tpu.ops.pallas_ring import fused_ring_step_fn
+                        from drep_tpu.ops.pallas_ring import (
+                            fused_ring_step_fn,
+                            fused_ring_variant,
+                            matmul_ring_vocab_pad,
+                        )
 
+                        variant = fused_ring_variant(kind)
                         fn, _ = fused_ring_step_fn(
                             kind, k, mesh,
                             interpret=comm == "pallas_interpret",
+                            variant=variant,
+                            # static dense-id extent, from the host copy
+                            # the driver already holds (matmul tiles only)
+                            v_pad=matmul_ring_vocab_pad(ids)
+                            if variant == "matmul"
+                            else 0,
+                            vmem_mb=ring_vmem_mb_override(),
                         )
                     else:
                         # the final step has no rotation to overlap — the

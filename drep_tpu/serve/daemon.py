@@ -49,6 +49,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from drep_tpu.errors import UserInputError
+from drep_tpu.index import resident_device
 from drep_tpu.index.classify import (
     classify_batch,
     load_resident_index,
@@ -130,6 +131,9 @@ class IndexServer:
                 self.cfg.index_loc, resident_mb=self.cfg.resident_mb
             )
         counters.set_gauge("serve_generation", float(self._resident.generation))
+        # arm the device-resident rect compare before the first batch:
+        # one sketch-matrix upload per generation, not per batch
+        resident_device.prewarm_resident(self._resident)
         get_logger().info(
             "index serve: generation %d (%d genomes) resident in %.2fs",
             self._resident.generation, self._resident.n, time.monotonic() - t0,
@@ -348,6 +352,9 @@ class IndexServer:
                 )
                 continue
             old = int(self._resident.generation)
+            # the fresh resident carries no device pack yet: upload the
+            # new generation's sketch matrix before batches land on it
+            resident_device.prewarm_resident(fresh)
             self._resident = fresh
             with self._lock:
                 self.stats.swaps_total += 1

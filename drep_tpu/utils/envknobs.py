@@ -104,6 +104,18 @@ _declare(
     "Set 0 to pin the fused Pallas DMA ring off (auto-gate reference "
     "fallback is ppermute).",
 )
+_declare(
+    "DREP_TPU_RING_VARIANT", "str", "",
+    "Fused-ring tile variant: auto|merge|matmul "
+    "(ops/pallas_ring.fused_ring_variant). Empty = auto (self-check "
+    "picks; matmul only ever applies to count-free |A∩B| kinds).",
+)
+_declare(
+    "DREP_TPU_RING_VMEM_MB", "int", 12,
+    "VMEM budget (MB) the gridded fused ring sizes its row tiles against "
+    "(ops/pallas_ring.fused_ring_tile). Sizing knob, never a refusal: any "
+    "block streams through VMEM in tiles that fit. --ring_vmem_mb mirrors it.",
+)
 # -- single-chip kernels -----------------------------------------------------
 _declare(
     "DREP_TPU_PALLAS_INDICATOR", "bool", True,
@@ -174,6 +186,14 @@ _declare(
     "knob only — the candidate set is identical for every value.",
 )
 # -- partition-scoped federated serving --------------------------------------
+_declare(
+    "DREP_TPU_SERVE_DEVICE_RESIDENT", "bool", True,
+    "Serve fast path: keep the resident sketch matrix device-resident "
+    "across classify batches (index/resident_device.py — one upload per "
+    "generation/hot-swap instead of a per-batch union repack). Set 0 to "
+    "pin the classic per-batch rect compare; verdicts are byte-identical "
+    "either way.",
+)
 _declare(
     "DREP_TPU_SERVE_RESIDENT_MB", "int", 0,
     "Streaming federated serve: byte budget (MiB) for resident partition "

@@ -119,6 +119,11 @@ class Counters:
     # --dispatch_timeout was left at 0 (parallel/faulttol.py) — reported so
     # an operator can pin an explicit value from evidence.
     gauges: dict[str, float] = field(default_factory=dict)
+    # short WHY strings riding beside the gauges (ISSUE 16): a 0.0
+    # `ring_comm_pallas` gauge says the fused ring did not run, the
+    # `ring_comm_fallback_reason` note says WHY (env pin / failed
+    # self-check / cpu backend) — last write wins, same as gauges.
+    notes: dict[str, str] = field(default_factory=dict)
     # elastic-pod membership history (ISSUE 9): one entry per ownership-
     # epoch bump, with WHY it bumped (death / drain / join). The faults
     # counters say how many of each happened; this says in what ORDER —
@@ -177,6 +182,12 @@ class Counters:
     def set_gauge(self, name: str, value: float) -> None:
         """Record a derived operational value (last write wins)."""
         self.gauges[name] = float(value)
+
+    def set_note(self, name: str, value: str) -> None:
+        """Record a short WHY string beside the gauges (last write wins) —
+        reasons are strings, gauges are floats; conflating them would
+        corrupt the Prometheus export."""
+        self.notes[name] = str(value)
 
     def observe(self, name: str, value: float) -> None:
         """Record one observation into the named latency histogram
@@ -256,6 +267,8 @@ class Counters:
             out["fault_tolerance"] = dict(sorted(self.faults.items()))
         if self.gauges:
             out["gauges"] = dict(sorted(self.gauges.items()))
+        if self.notes:
+            out["notes"] = dict(sorted(self.notes.items()))
         if self.epoch_history:
             out["epoch_history"] = list(self.epoch_history)
         if self.hists:
@@ -281,6 +294,7 @@ class Counters:
         self.stages.clear()
         self.faults.clear()
         self.gauges.clear()
+        self.notes.clear()
         self.epoch_history.clear()
         self.hists.clear()
 

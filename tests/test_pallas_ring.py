@@ -199,16 +199,189 @@ def test_env_pin_and_bad_comm_validation(monkeypatch):
     }
 
 
-def test_fused_block_fits_budget():
-    """The VMEM guard: bench-scale blocks fit, production-scale sketch
-    blocks (which would overflow a single un-gridded kernel) do not —
-    resolve falls back to ppermute for those rather than compiling a
-    kernel Mosaic would reject."""
-    from drep_tpu.ops.pallas_ring import fused_block_fits
+def test_fused_ring_tile_sizing():
+    """ISSUE 16: the block-size REFUSAL is gone — every shape gets a
+    tile, never a verdict. Bench-scale blocks run un-gridded (tile ==
+    n_local); the 100k-genome/D=16 primary block the old
+    `fused_block_fits` refused now grids down until its per-cell working
+    set fits the `DREP_TPU_RING_VMEM_MB` budget; a starved budget floors
+    at single-row tiles instead of refusing."""
+    from drep_tpu.ops.pallas_ring import fused_ring_tile
 
-    assert fused_block_fits(128, 256)
-    assert fused_block_fits(256, 1024)
-    assert not fused_block_fits(6250, 1024)  # 100k-genome/D=16 primary block
+    assert fused_ring_tile(128, 256) == 128
+    assert fused_ring_tile(256, 1024) == 256
+    big = fused_ring_tile(6250, 1024)  # the block the old gate refused
+    assert 1 <= big < 6250
+    # sized against the budget: pipeline-double-buffered slabs + tiles fit
+    assert 2 * (2 * (big * 1024 * 4 + big * 4) + big * big * 4) <= 12 << 20
+    assert fused_ring_tile(6250, 1024, vmem_mb=1) < big  # knob shrinks tiles
+    assert fused_ring_tile(4096, 4096, vmem_mb=0) == 1  # floor, not refusal
+    assert fused_ring_tile(1, 64) == 1  # single-row block
+
+
+def test_resolve_ring_comm_has_no_fits_check():
+    """`resolve_ring_comm` must not consult any block-size gate: the
+    verdict for a production-size block equals the verdict for a tiny
+    one (here both ppermute, CPU backend — the point is the shape args
+    no longer matter), and the gridded interpret oracle is honored at
+    any size."""
+    mesh = make_mesh(3)
+    assert resolve_ring_comm(mesh, "auto", 6250, 1024) == resolve_ring_comm(
+        mesh, "auto", 8, 64
+    )
+    assert (
+        resolve_ring_comm(mesh, "pallas_interpret", 100_000, 4096)
+        == "pallas_interpret"
+    )
+
+
+@pytest.mark.parametrize("n_dev", [3, 8])
+def test_gridded_fused_ring_nondivisible_and_single_row(rng, n_dev, monkeypatch):
+    """Grid-edge shapes (ISSUE 16): a VMEM budget small enough to force
+    multi-tile grids with a RAGGED last block (n_local not divisible by
+    the tile), and a D-sized input that pads to single-row blocks — both
+    bit-identical to the ppermute reference."""
+    monkeypatch.setenv("DREP_TPU_RING_VMEM_MB", "0")  # tile floor: 1 row
+    mesh = make_mesh(n_dev)
+    n, s = 21, 64
+    packed = pack_sketches(_sketch_set(rng, n, s), [f"g{i}" for i in range(n)], s)
+    want = sharded_mash_allpairs(packed, k=21, mesh=mesh, ring_comm="ppermute")
+    got = sharded_mash_allpairs(packed, k=21, mesh=mesh, ring_comm="pallas_interpret")
+    assert got.tobytes() == want.tobytes(), "gridded fused ring != ppermute ring"
+    # single-row blocks: exactly D genomes -> n_local == 1
+    small = pack_sketches(
+        _sketch_set(rng, n_dev, 32), [f"s{i}" for i in range(n_dev)], 32
+    )
+    want1 = sharded_mash_allpairs(small, k=21, mesh=mesh, ring_comm="ppermute")
+    got1 = sharded_mash_allpairs(small, k=21, mesh=mesh, ring_comm="pallas_interpret")
+    assert got1.tobytes() == want1.tobytes()
+
+
+@pytest.mark.parametrize("n_dev", [3, 8])
+def test_gridded_fused_ring_past_old_vmem_cap(rng, n_dev):
+    """The acceptance pin: a block whose working set exceeds the old
+    12 MB single-shot cap (a shape `fused_block_fits` used to refuse)
+    streams through the gridded kernel bit-identical to ppermute at odd
+    and even D. 1792 rows per device: the [n_local, n_local] f32 output
+    tile alone is ~12.85 MB (> 12 MB) — it is the OUTPUT tile that
+    bursts the old cap, so the sketches stay at the narrowest width
+    (s=2) to keep the D=8 CPU merge compute tier-1-sized; merge-width
+    coverage lives in the other parity pins (s=64 ragged, s=96 MXU)."""
+    from drep_tpu.ops.pallas_ring import fused_ring_tile
+
+    mesh = make_mesh(n_dev)
+    n_local, s = 1792, 2
+    n = n_dev * n_local
+    # the OLD single-shot working set (2 operands + f32 tile + counts)
+    # exceeds the deleted 12 MB cap — this exact shape used to refuse
+    assert 2 * (n_local * s * 4) + n_local * n_local * 4 + n_local * 8 > 12 << 20
+    assert fused_ring_tile(n_local, s) < n_local  # the grid actually engages
+    rng2 = np.random.default_rng(7)
+    ids = np.sort(rng2.integers(0, 2**30, size=(n, s), dtype=np.int32), axis=1)
+    cts = np.full(n, s, np.int32)
+    from drep_tpu.ops.minhash import PackedSketches
+
+    packed = PackedSketches(ids=ids, counts=cts, names=[f"g{i}" for i in range(n)])
+    want = sharded_mash_allpairs(packed, k=21, mesh=mesh, ring_comm="ppermute")
+    got = sharded_mash_allpairs(packed, k=21, mesh=mesh, ring_comm="pallas_interpret")
+    assert got.tobytes() == want.tobytes(), "past-cap gridded ring != ppermute"
+    assert counters.gauges.get("ring_comm_pallas") == 1.0
+
+
+@pytest.mark.parametrize("n_dev", [3, 8])
+def test_mxu_matmul_variant_ring_bit_equals_ppermute(rng, n_dev, monkeypatch):
+    """The MXU intersection-matmul variant (the Mosaic escape hatch) must
+    pass the SAME equality pin as the merge network: containment ring
+    under `DREP_TPU_RING_VARIANT=matmul`, gridded (starved VMEM budget),
+    bit-identical to the ppermute reference."""
+    monkeypatch.setenv("DREP_TPU_RING_VARIANT", "matmul")
+    monkeypatch.setenv("DREP_TPU_RING_VMEM_MB", "0")
+    mesh = make_mesh(n_dev)
+    n = 19
+    packed = pack_scaled_sketches(
+        _sketch_set(rng, n, 96), [f"g{i}" for i in range(n)], pad_multiple=32
+    )
+    a_w, c_w = sharded_containment_allpairs(packed, k=21, mesh=mesh, ring_comm="ppermute")
+    a_g, c_g = sharded_containment_allpairs(
+        packed, k=21, mesh=mesh, ring_comm="pallas_interpret"
+    )
+    assert a_g.tobytes() == a_w.tobytes(), "matmul-variant ring != ppermute"
+    assert c_g.tobytes() == c_w.tobytes()
+    # the fused path really ran (recovery/fallback would zero this gauge)
+    assert counters.gauges.get("ring_comm_pallas") == 1.0
+
+
+def test_mxu_matmul_tile_equals_merge_tile(rng):
+    """Property pin: on the SAME device-resident operands, one fused step
+    with the matmul tile variant produces byte-identical output (tile AND
+    rotated operands) to the merge-network variant — the per-tile
+    equivalence the escape hatch rests on, across ragged grids and
+    several vocab extents (forcing 1..many vocab chunks)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from drep_tpu.ops.pallas_ring import fused_ring_step_fn, matmul_ring_vocab_pad
+    from drep_tpu.parallel.allpairs import put_global
+    from drep_tpu.parallel.mesh import AXIS
+
+    D = 3
+    mesh = make_mesh(D)
+    for n_local, s, vocab in [(5, 32, 200), (8, 64, 9000), (1, 16, 100)]:
+        n = D * n_local
+        ids = np.full((n, s), 2**31 - 1, np.int32)
+        for i in range(n):
+            ln = int(rng.integers(1, s + 1))
+            ids[i, :ln] = np.sort(
+                rng.choice(vocab, size=ln, replace=False).astype(np.int32)
+            )
+        cts = np.minimum((ids != 2**31 - 1).sum(1), s).astype(np.int32)
+        ids_d = put_global(ids, NamedSharding(mesh, P(AXIS, None)))
+        cts_d = put_global(cts, NamedSharding(mesh, P(AXIS)))
+        v_pad = matmul_ring_vocab_pad(ids)
+        merge_fn, _ = fused_ring_step_fn("containment", 21, mesh, interpret=True)
+        mm_fn, _ = fused_ring_step_fn(
+            "containment", 21, mesh, interpret=True, variant="matmul", v_pad=v_pad
+        )
+        t_m, bi_m, bc_m = merge_fn(ids_d, cts_d, ids_d, cts_d)
+        t_x, bi_x, bc_x = mm_fn(ids_d, cts_d, ids_d, cts_d)
+        case = (n_local, s, vocab)
+        assert np.asarray(t_x).tobytes() == np.asarray(t_m).tobytes(), case
+        assert np.asarray(bi_x).tobytes() == np.asarray(bi_m).tobytes(), case
+        assert np.asarray(bc_x).tobytes() == np.asarray(bc_m).tobytes(), case
+
+
+def test_matmul_variant_validation_and_kind_gating():
+    """The matmul variant is containment-only (mash's tile counts shared
+    ids within the union bottom-s, not plain |A∩B|) and demands a static
+    pow2 v_pad; `fused_ring_kind_ok` refuses merge-only kinds when only
+    the matmul escape hatch survived the self-check."""
+    from drep_tpu.ops.pallas_ring import (
+        _SELFTEST,
+        fused_ring_kind_ok,
+        fused_ring_step_fn,
+        fused_ring_variant,
+        reset_selftest_for_tests,
+    )
+
+    mesh = make_mesh(2)
+    with pytest.raises(ValueError, match="matmul ring variant supports"):
+        fused_ring_step_fn("mash", 21, mesh, interpret=True, variant="matmul", v_pad=256)
+    with pytest.raises(ValueError, match="v_pad"):
+        fused_ring_step_fn(
+            "containment", 21, mesh, interpret=True, variant="matmul", v_pad=0
+        )
+    assert fused_ring_variant("mash") == "merge"  # never matmul, any pin
+    reset_selftest_for_tests()
+    try:
+        # simulate: merge rejected by Mosaic, matmul survived
+        _SELFTEST.update(ok=True, reason=None, variant="matmul")
+        assert fused_ring_kind_ok("containment") is True
+        assert fused_ring_kind_ok("mash") is False
+        assert fused_ring_variant("containment") == "matmul"
+        mesh3 = make_mesh(3)
+        assert resolve_ring_comm(mesh3, "auto", kind="containment") == "pallas_dma"
+        assert resolve_ring_comm(mesh3, "auto", kind="mash") == "ppermute"
+    finally:
+        reset_selftest_for_tests()
 
 
 def test_ring_comm_gauge_reports_ppermute(rng):

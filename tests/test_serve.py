@@ -176,6 +176,47 @@ def test_classify_batch_independent_equals_oneshot(serve_index):
     assert lib.tree_digest(loc, exclude_dirs=()) == digest  # zero writes
 
 
+def test_device_resident_sketch_matrix_uploads_once(serve_index, monkeypatch):
+    """The serve fast path keeps the resident sketch matrix
+    device-resident ACROSS batches: exactly one upload per generation
+    (counter-pinned — no per-batch re-upload), verdicts byte-identical
+    to one-shot classify, a hot-swapped generation costs exactly one
+    more upload, and pinning the knob off reproduces the same verdicts
+    through the classic per-batch repack."""
+    from drep_tpu.index import resident_device
+    from drep_tpu.utils.profiling import counters
+
+    loc, queries = serve_index
+    resident_device.reset_for_tests()
+    resident = load_resident_index(loc)
+    oneshot = {q: index_classify(loc, [q])[0] for q in queries}
+    for _ in range(3):
+        sq = sketch_queries(resident, queries)
+        got = classify_batch(resident, sq, joint=False)
+        for q, v in zip(queries, got):
+            assert v == oneshot[q]
+    assert resident_device.upload_count() == 1, "re-uploaded per batch"
+    assert resident_device.fallback_count() == 0
+    assert counters.gauges.get("serve_resident_uploads") == 1.0
+    # a generation hot-swap installs a FRESH resident object — the
+    # daemon prewarms it: exactly one more upload, batches reuse it
+    fresh = load_resident_index(loc)
+    assert resident_device.prewarm_resident(fresh)
+    assert resident_device.upload_count() == 2
+    sq = sketch_queries(fresh, queries)
+    got = classify_batch(fresh, sq, joint=False)
+    for q, v in zip(queries, got):
+        assert v == oneshot[q]
+    assert resident_device.upload_count() == 2
+    # knob off => classic union repack, byte-identical verdicts
+    monkeypatch.setenv("DREP_TPU_SERVE_DEVICE_RESIDENT", "0")
+    sq = sketch_queries(resident, queries)
+    got = classify_batch(resident, sq, joint=False)
+    for q, v in zip(queries, got):
+        assert v == oneshot[q]
+    assert resident_device.upload_count() == 2
+
+
 # ---- the daemon -----------------------------------------------------------
 
 

@@ -154,7 +154,7 @@ def _streaming(spec, ft_config=None, checkpoint_dir=None):
     ), "edges differ under injection"
 
 
-def _ring(spec, ft_config=None, ring_comm=None):
+def _ring(spec, ft_config=None, ring_comm=None, vmem_mb=None):
     from drep_tpu.parallel.allpairs import sharded_mash_allpairs
     from drep_tpu.parallel.mesh import make_mesh
     from drep_tpu.utils import faults
@@ -162,6 +162,8 @@ def _ring(spec, ft_config=None, ring_comm=None):
     packed = _packed(n=21)
     mesh = make_mesh(3)
     want = sharded_mash_allpairs(packed, k=21, mesh=mesh)
+    if vmem_mb is not None:  # starve the grid: fused cells go single-row
+        os.environ["DREP_TPU_RING_VMEM_MB"] = str(vmem_mb)
     faults.configure(spec)
     try:
         got = sharded_mash_allpairs(
@@ -169,6 +171,8 @@ def _ring(spec, ft_config=None, ring_comm=None):
         )
     finally:
         faults.configure(None)
+        if vmem_mb is not None:
+            os.environ.pop("DREP_TPU_RING_VMEM_MB", None)
     assert got.tobytes() == want.tobytes(), "ring matrix differs under injection"
 
 
@@ -242,6 +246,13 @@ def _cells():
         ("ring_dispatch", "raise", "failed FUSED pallas step -> per-block recovery",
          "survive", lambda: _ring(
              "ring_dispatch:raise:1.0:max=1", ring_comm="pallas_interpret")),
+        # the GRIDDED fused step (ISSUE 16): VMEM budget starved to zero
+        # forces single-row tiles — the maximal grid — and the per-block
+        # recovery story must hold mid-grid exactly as it does monolithic
+        ("ring_dispatch", "raise", "failed GRIDDED fused step -> per-block recovery",
+         "survive", lambda: _ring(
+             "ring_dispatch:raise:1.0:max=1", ring_comm="pallas_interpret",
+             vmem_mb=0)),
         ("secondary_batch", "raise", "one failed batch -> local retry",
          "survive", lambda: _secondary_retry("secondary_batch:raise:1.0:max=1")),
         ("secondary_batch", "raise", "beyond retry budget -> abort",
@@ -586,6 +597,8 @@ POD_CELLS = [
      "survive", "tests/test_multihost.py::test_elastic_ring_survives_sigkilled_member"),
     ("ring_step", "kill", "SIGKILL mid-PALLAS-ring -> survivors fall back, bit-identical",
      "survive", "tests/test_multihost.py::test_elastic_pallas_ring_survives_sigkilled_member"),
+    ("ring_step", "kill", "SIGKILL mid-GRIDDED-ring (starved VMEM) -> bit-identical recovery",
+     "survive", "tests/test_multihost.py::test_elastic_gridded_ring_survives_sigkilled_member"),
     ("barrier", "death", "death BEFORE the stage-open barrier -> admission",
      "survive", "tests/test_multihost.py::test_streaming_prebarrier_death_continues_degraded"),
     ("secondary_batch", "raise", "mid-batch failure on a pod -> local retry",
