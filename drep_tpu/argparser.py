@@ -450,6 +450,91 @@ def build_parser() -> argparse.ArgumentParser:
                    help="memory bound for the bucket join's host expansion "
                         "(0 = one-pass; same semantics as the pipeline flag)")
 
+    r = isub.add_parser(
+        "route",
+        help="fleet front door (stateless router): speaks the serve "
+             "protocol in front of N `index serve` replicas, routes each "
+             "query by its coarse code summary to replicas with cache "
+             "affinity, scatter/gathers multi-partition queries through "
+             "the exact federated merge (verdicts byte-identical to one "
+             "daemon), generation-fences the fan-out, hedges stragglers, "
+             "and degrades to stamped PARTIAL verdicts — never a crash — "
+             "under replica loss or overload",
+    )
+    r.add_argument("index_directory",
+                   help="the FEDERATED root the fleet serves (the router "
+                        "loads only its spine + routing bitmaps)")
+    r.add_argument("--replica", action="append", default=[], metavar="ADDR[=PIDS]",
+                   help="one serve replica: host:port or socket path, "
+                        "optionally '=' a partition assignment as ids/"
+                        "inclusive ranges (0-2,5). No assignment = serves "
+                        "every partition. Repeatable; replicas can also "
+                        "join/leave a running router via the fleet op")
+    r.add_argument("-p", "--processes", type=int, default=1,
+                   help="sketching processes per batch (queries are small; "
+                        "1 keeps the router single-sketcher)")
+    r.add_argument("-d", "--debug", action="store_true")
+    r.add_argument("--io_retries", type=int, default=None,
+                   help="transient shared-filesystem I/O retry budget "
+                        "(utils/durableio.py; same knob as the pipeline)")
+    r.add_argument("--socket", default=None, metavar="PATH",
+                   help="serve on a unix-domain socket at PATH instead of TCP")
+    r.add_argument("--host", default="127.0.0.1",
+                   help="TCP bind host (default 127.0.0.1)")
+    r.add_argument("--port", type=int, default=0,
+                   help="TCP bind port (default 0 = OS-assigned; printed "
+                        "as the JSON ready line)")
+    r.add_argument("--max_inflight", type=int, default=None,
+                   help="bounded admission: max queued classify requests "
+                        "before the router sheds load with a backpressure "
+                        "refusal. Default DREP_TPU_ROUTER_MAX_INFLIGHT")
+    r.add_argument("--max_batch", type=int, default=64,
+                   help="most queries routed as one scatter/forward round "
+                        "(the inherited dynamic batch window). Default 64")
+    r.add_argument("--batch_window_ms", type=float, default=5.0,
+                   help="batch-formation window (default 5ms)")
+    r.add_argument("--poll_generation_s", type=float, default=2.0,
+                   help="meta-manifest re-read cadence for the router's own "
+                        "generation hot-swap (a fenced gather reloads "
+                        "sooner when the fleet is ahead). Default 2s")
+    r.add_argument("--leg_timeout_s", type=float, default=None,
+                   help="per-leg socket deadline for one scatter/forward "
+                        "dispatch. Default DREP_TPU_ROUTER_LEG_TIMEOUT_S")
+    r.add_argument("--hedge_delay_s", type=float, default=None,
+                   help="straggler hedge: duplicate an unanswered leg to a "
+                        "second capable replica after this long (first "
+                        "answer wins). Default DREP_TPU_ROUTER_HEDGE_DELAY_S")
+    r.add_argument("--probe_interval_s", type=float, default=1.0,
+                   help="replica /healthz poll cadence feeding the "
+                        "healthy->suspect->ejected table. Default 1s")
+    r.add_argument("--probe_backoff_s", type=float, default=None,
+                   help="first reprobe delay after an ejection (doubles to "
+                        "DREP_TPU_SERVE_PROBE_MAX_S). Default "
+                        "DREP_TPU_ROUTER_PROBE_BACKOFF_S")
+    r.add_argument("--resident_mb", type=int, default=None,
+                   help="byte budget (MiB) for the router's OWN lazily "
+                        "loaded component sketches (the merge's secondary "
+                        "recluster stage; the heavy rect compares run on "
+                        "the replicas). Default DREP_TPU_SERVE_RESIDENT_MB")
+    r.add_argument("--log_dir", default=None,
+                   help="home for the router's logs/metrics/events — "
+                        "NEVER the index directory (read-only contract)")
+    r.add_argument("--events", default=None, choices=["off", "on"],
+                   help="structured event tracing (replica_suspect/"
+                        "ejected/recovered, fleet_join/leave, fenced "
+                        "generation_swap instants) into --log_dir")
+    r.add_argument("--primary_prune", default="off", choices=["off", "lsh"],
+                   help="LSH candidate pruning, forwarded to every scatter "
+                        "leg so the whole fleet prunes identically")
+    r.add_argument("--prune_bands", type=int, default=0,
+                   help="LSH band count (same semantics as the pipeline flag)")
+    r.add_argument("--prune_min_shared", type=int, default=0,
+                   help="candidate-threshold floor (same semantics as the "
+                        "pipeline flag)")
+    r.add_argument("--prune_join_chunk", type=int, default=0,
+                   help="bucket-join memory bound (same semantics as the "
+                        "pipeline flag)")
+
     cmp_p = sub.add_parser("compare", help="cluster genomes without dereplicating")
     add_common(cmp_p, with_filter=False, with_scoring=False)
 

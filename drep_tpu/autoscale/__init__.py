@@ -16,10 +16,24 @@ that missing layer, shaped like a k8s operator:
   drains via SIGTERM. Workers need NO changes to be governed, and the
   controller's death is harmless — workers never depend on it.
 
+The fleet follow-on (ISSUE 17) reuses the SAME pure policy one layer
+up: :mod:`drep_tpu.autoscale.fleet` maps a serve router's per-replica
+queue depths onto per-partition-range synthetic snapshots and actuates
+replica spawn/drain through the router's ``fleet`` join/leave op —
+``tools/pod_autoscale.py --router`` is the CLI.
+
 CLI entrypoint: ``tools/pod_autoscale.py``.
 """
 
 from drep_tpu.autoscale.controller import AutoscaleController
+from drep_tpu.autoscale.fleet import FleetAutoscaleController, decide_fleet
 from drep_tpu.autoscale.policy import Decision, Targets, decide
 
-__all__ = ["AutoscaleController", "Decision", "Targets", "decide"]
+__all__ = [
+    "AutoscaleController",
+    "Decision",
+    "FleetAutoscaleController",
+    "Targets",
+    "decide",
+    "decide_fleet",
+]

@@ -1,0 +1,330 @@
+"""Fleet autoscaling (ISSUE 17): the SAME pure policy, one layer up.
+
+The elastic-pod controller (drep_tpu/autoscale/controller.py) governs
+BATCH work: snapshots come from shard mtimes, the deadline is a
+finish-by instant, capacity is pod joiners. The fleet front door
+(serve/router.py) poses the same question for SERVING work — do the
+replicas covering each partition range have enough capacity to keep
+queueing delay under the operator's target? — and this module answers
+it by *mapping* the serving telemetry onto the exact inputs
+:func:`drep_tpu.autoscale.policy.decide` already takes, rather than
+writing a second policy:
+
+- one router ``status`` snapshot is split into one synthetic pod
+  snapshot PER PARTITION RANGE (replicas sharing an assignment govern
+  together; unscoped replicas form the ``all`` range);
+- ``eta_s`` becomes the queueing-delay projection
+  ``queue_total * svc_s / n_live`` — the documented proxy slot the
+  policy already reasons about (work drains ~linearly with replicas,
+  exactly the ideal-scaling assumption the batch side states);
+- ``deadline_at`` is rebuilt EVERY tick as
+  ``observed_at + queue_deadline_s``: a rolling service-level target
+  rather than a finish-by instant. The policy never knows the
+  difference — hysteresis, cooldown, clamps and reason slugs all carry
+  over verbatim, and the per-range decision history gates the same
+  cooldown.
+
+Actuation mirrors the batch controller's contract one layer up: a
+scale-up spawns a replica process (the operator's ``--spawn`` command,
+stamped ``DREP_TPU_AUTOSCALE_SPAWNED=1``), reads its ready line for the
+bound address, and announces it to the router via the ``fleet`` join
+op; a scale-down SIGTERMs the most recently spawned still-live replica
+of that range (the daemon's graceful drain) after a ``fleet`` leave so
+the router stops routing to it first. The controller only ever retires
+capacity it added, and its death is harmless — the router keeps serving
+whatever fleet exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shlex
+import signal
+import subprocess
+import time
+from dataclasses import replace
+
+from drep_tpu.autoscale.controller import _append_decision
+from drep_tpu.autoscale.policy import Decision, Targets, decide
+from drep_tpu.utils import telemetry
+from drep_tpu.utils.logger import get_logger
+
+__all__ = ["range_key", "fleet_snapshots", "decide_fleet", "FleetAutoscaleController"]
+
+# replica states that count as serving capacity for a range: suspect
+# replicas are still routable (one probe failure, reprobe pending) —
+# only ejected/draining/left capacity is gone from the policy's view
+_LIVE_STATES = ("healthy", "suspect")
+
+
+def range_key(assigned) -> str:
+    """Canonical partition-range id: ``"all"`` for an unscoped replica,
+    else the sorted partition ids joined with ``,`` (stable across
+    list/set/tuple inputs — the decision log and cooldown history key
+    on it)."""
+    if assigned is None:
+        return "all"
+    return ",".join(str(int(p)) for p in sorted(assigned)) or "all"
+
+
+def fleet_snapshots(status: dict, observed_at: float, svc_s: float) -> dict[str, dict]:
+    """Map one router ``status`` dict onto per-range synthetic pod
+    snapshots :func:`decide` accepts verbatim. Pure: the clock rides in
+    as `observed_at` (the controller stamps it when it took the
+    snapshot), never read here.
+
+    ``eta_s`` is the queueing-delay proxy ``queue_total * svc_s /
+    n_live``; with no live replicas it is None (the policy holds with
+    ``no-live-members``, which is the right verdict — there is nothing
+    to SIGTERM and a spawn can't be attributed to a range nobody
+    serves... except via the operator re-running with --replica)."""
+    replicas = ((status.get("replicas") or {}).get("replicas")) or {}
+    ranges: dict[str, dict] = {}
+    for addr, rep in replicas.items():
+        key = range_key(rep.get("assigned"))
+        r = ranges.setdefault(key, {"live": [], "queue_total": 0, "draining": []})
+        state = rep.get("state")
+        if state in _LIVE_STATES and not rep.get("draining"):
+            r["live"].append(addr)
+            r["queue_total"] += int(rep.get("queue_depth") or 0)
+        elif rep.get("draining"):
+            r["draining"].append(addr)
+    out: dict[str, dict] = {}
+    for key, r in sorted(ranges.items()):
+        n_live = len(r["live"])
+        eta = (r["queue_total"] * float(svc_s) / n_live) if n_live else None
+        out[key] = {
+            "observed_at": observed_at,
+            "live": sorted(r["live"]),
+            # a draining replica is capacity leaving, not arriving — it
+            # must NOT count as a pending join (that would suppress a
+            # needed spawn under the pending-covers rule)
+            "pending_joins": [],
+            "shards_published": 0,
+            "shards_total": None,  # serving never "finishes"
+            "eta_s": round(eta, 6) if eta is not None else None,
+            "queue_total": r["queue_total"],
+        }
+    return out
+
+
+def decide_fleet(
+    status: dict,
+    observed_at: float,
+    targets: Targets,
+    queue_deadline_s: float,
+    svc_s: float,
+    history: dict[str, list[dict]],
+) -> dict[str, Decision]:
+    """One pure fleet verdict: per partition range, the UNCHANGED batch
+    policy over the mapped snapshot, against a rolling deadline
+    ``observed_at + queue_deadline_s``. `history` is keyed by range (a
+    scale-up for partitions 0-2 must not cooldown-gate range 3-5)."""
+    decisions: dict[str, Decision] = {}
+    rolling = replace(targets, deadline_at=observed_at + float(queue_deadline_s))
+    for key, snap in fleet_snapshots(status, observed_at, svc_s).items():
+        decisions[key] = decide(snap, rolling, history.get(key, []))
+    return decisions
+
+
+class FleetAutoscaleController:
+    """Watch one router, govern its replica fleet per partition range.
+
+    `router_client` is a connected :class:`drep_tpu.serve.ServeClient`
+    factory argument — anything with ``.status()`` and ``.request()``
+    (tests pass fakes). `spawn_cmd` is the full ``index serve`` command
+    line for ONE replica (``{partitions}`` in it is substituted with the
+    range's comma list, or removed for the ``all`` range); None =
+    recommend-only. The decision log is the same crash-safe JSONL idiom
+    as the batch controller, one record per range per tick."""
+
+    def __init__(
+        self,
+        router_client,
+        targets: Targets,
+        queue_deadline_s: float,
+        svc_s: float,
+        spawn_cmd: str | None = None,
+        interval_s: float = 2.0,
+        decision_log: str | None = None,
+        spawn_env: dict | None = None,
+    ) -> None:
+        self.client = router_client
+        self.targets = targets
+        self.queue_deadline_s = float(queue_deadline_s)
+        self.svc_s = float(svc_s)
+        self.spawn_cmd = spawn_cmd
+        self.interval_s = float(interval_s)
+        self.decision_log = decision_log
+        self._spawn_env = spawn_env
+        self.history: dict[str, list[dict]] = {}
+        # per-range spawn ledger: (Popen, address) pairs, most recent
+        # last — scale-down retires from the tail, batch-controller style
+        self.spawned: dict[str, list[tuple[subprocess.Popen, str]]] = {}
+        self.decisions = 0
+        self._log = get_logger()
+
+    # -- actuation --------------------------------------------------------
+    def _spawn_replica(self, key: str, count: int) -> str:
+        if not self.spawn_cmd:
+            return "skipped: no --spawn command (recommend-only mode)"
+        count = min(count, self.targets.max_spawn)
+        if count <= 0:
+            return "skipped: max_spawn is 0"
+        cmd = self.spawn_cmd
+        if "{partitions}" in cmd:
+            cmd = cmd.replace("{partitions}", "" if key == "all" else key)
+        env = dict(self._spawn_env if self._spawn_env is not None else os.environ)
+        env["DREP_TPU_AUTOSCALE_SPAWNED"] = "1"
+        argv = [a for a in shlex.split(cmd) if a]
+        joined = []
+        for _ in range(count):
+            proc = subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE, text=True
+            )
+            addr = self._await_ready(proc)
+            if addr is None:
+                return f"FAILED: spawned pid {proc.pid} produced no ready line"
+            self.spawned.setdefault(key, []).append((proc, addr))
+            pids = None if key == "all" else [int(p) for p in key.split(",")]
+            try:
+                self.client.request(
+                    {"op": "fleet", "action": "join", "address": addr,
+                     "partitions": pids}
+                )
+            except Exception as e:  # noqa: BLE001 — replica is up; join is advisory
+                return f"spawned {addr} but fleet join failed: {e!r}"
+            joined.append(addr)
+        return f"spawned+joined {joined} for range {key}"
+
+    def _await_ready(self, proc: subprocess.Popen, timeout_s: float = 120.0) -> str | None:
+        """Parse the daemon's ready line (one JSON object with
+        ``serving``) from its stdout — the same contract the chaos
+        harness and bench drivers rely on."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline() if proc.stdout else ""
+            if not line:
+                if proc.poll() is not None:
+                    return None
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(msg, dict) and msg.get("serving"):
+                return str(msg["serving"])
+        return None
+
+    def _drain_replica(self, key: str, count: int) -> str:
+        alive = [(p, a) for p, a in self.spawned.get(key, ()) if p.poll() is None]
+        if not alive:
+            return "skipped: no controller-spawned capacity left to drain"
+        victims = alive[-count:] if count else alive[-1:]
+        out = []
+        for proc, addr in victims:
+            # leave FIRST so the router stops routing new legs at it,
+            # then SIGTERM for the daemon's graceful drain of in-flight
+            try:
+                self.client.request(
+                    {"op": "fleet", "action": "leave", "address": addr}
+                )
+            except Exception:  # noqa: BLE001 — drain proceeds regardless
+                pass
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            out.append(addr)
+        return f"left+SIGTERMed {out} for range {key}"
+
+    def _actuate(self, key: str, decision: Decision) -> str:
+        try:
+            if decision.verdict == "scale_up":
+                return self._spawn_replica(key, decision.delta)
+            if decision.verdict == "scale_down":
+                return self._drain_replica(key, -decision.delta)
+        except Exception as e:  # noqa: BLE001 — same contract as the batch
+            # controller: a broken spawn must not die before the record
+            self._log.warning("fleet autoscale: actuation failed: %r", e)
+            return f"FAILED: {e!r}"
+        return ""
+
+    # -- the loop ---------------------------------------------------------
+    def poll_once(self) -> dict[str, Decision]:
+        """One tick: router status -> per-range decide -> actuate ->
+        record. Read-only against the router (one status op)."""
+        # drep-lint: allow[clock-mono] — the rolling deadline is an absolute wall-clock instant in the snapshot's own clock family, exactly like the batch controller's --deadline resolution
+        observed_at = time.time()
+        try:
+            status = self.client.status()
+        except Exception as e:  # noqa: BLE001 — a dead router is a report
+            status = {"error": f"router unreachable: {e!r}"}
+        if "error" in status:
+            decisions = {"all": decide(status, self.targets, [])}
+        else:
+            decisions = decide_fleet(
+                status, observed_at, self.targets,
+                self.queue_deadline_s, self.svc_s, self.history,
+            )
+        self.decisions += 1
+        for key, decision in decisions.items():
+            actuation = self._actuate(key, decision)
+            if decision.verdict != "hold" and not actuation.startswith("skipped"):
+                self.history.setdefault(key, []).append(
+                    {"at": observed_at, "verdict": decision.verdict,
+                     "delta": decision.delta}
+                )
+            record = {
+                "at": observed_at,
+                "range": key,
+                "verdict": decision.verdict,
+                "delta": decision.delta,
+                "reason": decision.reason,
+                "inputs": decision.inputs,
+                "actuation": actuation,
+            }
+            if self.decision_log:
+                try:
+                    _append_decision(self.decision_log, record)
+                except OSError as e:
+                    self._log.warning("fleet autoscale: decision log unwritable: %s", e)
+            telemetry.event(
+                "fleet_autoscale_decision",
+                range=key, verdict=decision.verdict, delta=decision.delta,
+                reason=decision.reason,
+            )
+            if decision.verdict != "hold":
+                self._log.warning(
+                    "fleet autoscale[%s]: %s %+d (%s) — %s",
+                    key, decision.verdict, decision.delta,
+                    decision.reason, actuation,
+                )
+        return decisions
+
+    def run(self, count: int = 0) -> int:
+        """Poll until interrupted (or `count` ticks, for tests).
+        Returns 0 — a dying fleet is a report, not a controller
+        failure."""
+        n = 0
+        try:
+            while True:
+                self.poll_once()
+                n += 1
+                if count and n >= count:
+                    break
+                time.sleep(max(0.05, self.interval_s))
+        except KeyboardInterrupt:
+            pass
+        finally:
+            # spawned replicas are fleet members now: leave them running
+            for key, pairs in self.spawned.items():
+                for proc, addr in pairs:
+                    if proc.poll() is None:
+                        self._log.info(
+                            "fleet autoscale: leaving spawned replica %s "
+                            "(pid %d, range %s) running — the fleet owns "
+                            "its lifecycle", addr, proc.pid, key,
+                        )
+        return 0

@@ -61,6 +61,7 @@ class Controller:
         from drep_tpu.workflows import (
             index_build_wrapper,
             index_classify_wrapper,
+            index_route_wrapper,
             index_serve_wrapper,
             index_update_wrapper,
         )
@@ -76,6 +77,9 @@ class Controller:
             # blocks until drained (SIGTERM/SIGINT); exit 0 is the drain
             # contract, same as the elastic pod's graceful preemption
             return index_serve_wrapper(index_loc, genomes, **kwargs)
+        if sub == "route":
+            # the fleet front door: same drain contract as serve
+            return index_route_wrapper(index_loc, genomes, **kwargs)
         if sub == "classify":
             import json
             import sys

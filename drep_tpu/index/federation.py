@@ -1568,6 +1568,7 @@ def classify_batch_federated(
     processes: int = 1,
     prune_cfg: dict | None = None,
     joint: bool = True,
+    partition_compare=None,
 ) -> list[dict]:
     """Streaming per-partition classify (ISSUE 14 tentpole): route, run
     one rect compare per (consulted partition x batch), merge the
@@ -1578,7 +1579,15 @@ def classify_batch_federated(
     (stamped ``partitions_consulted`` / ``partitions_unavailable``)
     when one is not. No K-pad shape bucketing here: device shapes vary
     with the consulted partition sizes anyway, and each per-partition
-    pack is already block-padded by the streaming executor."""
+    pack is already block-padded by the streaming executor.
+
+    ``partition_compare(pid, names, bottoms) -> (ui, qi, dd) | None``
+    (optional) substitutes the per-partition rect compare — the fleet
+    router (serve/router.py) injects pre-gathered REMOTE leg results
+    here, so a scatter/gathered verdict runs the very same merge +
+    recluster below and stays byte-identical to the local path. ``None``
+    books the partition unavailable, exactly like a local residency
+    failure."""
     from drep_tpu.index.classify import _assemble_verdicts
 
     if not queries.n:
@@ -1598,13 +1607,18 @@ def classify_batch_federated(
     ]
     for pid in sorted(set().union(*cand) if cand else ()):
         cols = [t for t in range(k) if pid in cand[t]]
-        if not fed.ensure_resident(pid, pin={pid}):
-            unavailable.add(pid)
-            continue
-        res = fed.classify_partition(
-            pid, [q_names[t] for t in cols], [q_bottoms[t] for t in cols],
-            prune_cfg,
-        )
+        if partition_compare is not None:
+            res = partition_compare(
+                pid, [q_names[t] for t in cols], [q_bottoms[t] for t in cols]
+            )
+        else:
+            if not fed.ensure_resident(pid, pin={pid}):
+                unavailable.add(pid)
+                continue
+            res = fed.classify_partition(
+                pid, [q_names[t] for t in cols], [q_bottoms[t] for t in cols],
+                prune_cfg,
+            )
         if res is None:
             unavailable.add(pid)
             continue

@@ -1,10 +1,15 @@
-"""Resident serving tier (ISSUE 11): the `index serve` daemon.
+"""Resident serving tier (ISSUE 11): the `index serve` daemon — plus
+the `index route` fleet front door over it (ISSUE 17).
 
 A long-lived classify front door over the genome index — load once,
 dynamically batch concurrent queries into one K x N rect compare,
 hot-swap index generations mid-flight, answer with byte-identical
-one-shot verdicts, and drain gracefully on SIGTERM. See serve/daemon.py
-for the architecture and README "Serving" for the operator story.
+one-shot verdicts, and drain gracefully on SIGTERM. The router
+(serve/router.py) speaks the same protocol in front of N such replicas:
+scatter/gather with generation fencing, hedged legs, and graceful
+degradation to stamped PARTIAL verdicts. See serve/daemon.py +
+serve/router.py for the architecture and README "Serving"/"Fleet" for
+the operator story.
 """
 
 from drep_tpu.serve.batcher import AdmissionQueue, PendingRequest  # noqa: F401
@@ -13,4 +18,9 @@ from drep_tpu.serve.daemon import (  # noqa: F401
     IndexServer,
     ServeConfig,
     install_signal_handlers,
+)
+from drep_tpu.serve.router import (  # noqa: F401
+    ReplicaTable,
+    RouterConfig,
+    RouterServer,
 )

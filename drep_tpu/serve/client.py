@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import socket
 import threading
 import time
@@ -111,8 +112,15 @@ class ServeClient:
     def classify(self, genome: str, retries: int = 0, strict: bool = False) -> dict:
         """Classify one genome; returns the full classify response
         (``verdict``, ``generation``, ``batch_size``, latencies).
-        Honors backpressure up to `retries` times, sleeping the
-        daemon's own ``retry_after_s`` hint between attempts.
+        Honors backpressure up to `retries` times, sleeping a JITTERED
+        multiple (0.5x-1.5x) of the daemon's own ``retry_after_s`` hint
+        between attempts — a herd of clients refused together must not
+        re-arrive in lockstep and re-fill the queue to the exact
+        high-water mark that refused them.
+
+        A timeout mid-retry surfaces the LAST refusal (reason +
+        retry hint), not a bare socket timeout: "backpressure after 3
+        attempts" is actionable, "timed out" is not.
 
         ``strict`` (federated serving): refuse PARTIAL partition
         coverage — a verdict that would be stamped with
@@ -120,17 +128,33 @@ class ServeClient:
         refusal carrying ``retry_after_s`` (the next reload-probe
         instant), which the retry loop here honors like backpressure."""
         attempt = 0
+        last_refusal: dict | None = None
         while True:
             req = {"op": "classify", "genome": genome, "id": uuid.uuid4().hex[:8]}
             if strict:
                 req["strict"] = True
-            resp = self.request(req)
+            try:
+                resp = self.request(req)
+            except (TimeoutError, socket.timeout) as e:
+                if last_refusal is not None:
+                    raise ServeError(
+                        f"classify timed out after {attempt} retried refusal(s); "
+                        f"last refusal: {last_refusal.get('error', '?')}",
+                        reason=last_refusal.get("reason"),
+                        retry_after_s=last_refusal.get("retry_after_s"),
+                    ) from e
+                raise ServeError(
+                    f"classify timed out after {self.timeout_s}s "
+                    f"(no refusal seen — daemon unresponsive?)",
+                    reason="timeout",
+                ) from e
             if resp.get("ok"):
                 return resp
             retry_after = resp.get("retry_after_s")
             if retry_after is not None and attempt < retries:
                 attempt += 1
-                time.sleep(float(retry_after))
+                last_refusal = resp
+                time.sleep(float(retry_after) * (0.5 + random.random()))
                 continue
             raise ServeError(
                 resp.get("error", "classify failed"),

@@ -48,6 +48,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+import numpy as np
+
 from drep_tpu.errors import UserInputError
 from drep_tpu.index import resident_device
 from drep_tpu.index.classify import (
@@ -97,6 +99,8 @@ class _ServeStats:
     batches_total: int = 0
     swaps_total: int = 0
     partial_refusals: int = 0  # strict-mode refusals on PARTIAL coverage
+    legs_total: int = 0  # classify_part legs served (fleet scatter tier)
+    leg_refusals: int = 0  # legs refused (fence/drain/partition loss)
 
 
 class IndexServer:
@@ -120,6 +124,11 @@ class IndexServer:
         self._threads: list[threading.Thread] = []
         self._stop_poll = threading.Event()
         self._lock = threading.Lock()  # resident swap + stats
+        # serializes ALL resident compute: the batch loop's classify and
+        # any classify_part legs served on connection threads (fleet
+        # tier) — FederatedResident's residency bookkeeping (LRU loads,
+        # evictions, quarantine state) is not thread-safe by design
+        self._compute_lock = threading.Lock()
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> str:
@@ -251,13 +260,18 @@ class IndexServer:
         counters.set_gauge("serve_queue_depth", float(self.queue.depth()))
         counters.set_gauge("serve_batch_size", float(len(batch)))
         by_name: dict = {}
-        path_err: dict[str, str] = {}
+        # basename -> (message, reason, retry_after_s): per-path failures
+        # keep their refusal semantics — a router-raised no-capable-replica
+        # error carries reason/retry_after_s attributes, and the client's
+        # backoff loop needs them surfaced, not flattened to classify_failed
+        path_err: dict[str, tuple[str, str, float | None]] = {}
         try:
             with counters.stage("serve_batch"):
                 with telemetry.span(
                     "serve_batch", n=len(batch), unique=len(paths), generation=gen
                 ):
-                    by_name = self._classify_fn(resident, paths)
+                    with self._compute_lock:
+                        by_name = self._classify_fn(resident, paths)
         except Exception as e:  # noqa: BLE001 — a poisoned batch must not kill the daemon
             # isolate the poison: one unreadable/malformed query must not
             # fail its co-batched neighbors (K one-shot classifies would
@@ -271,11 +285,18 @@ class IndexServer:
             for p in paths:
                 try:
                     with counters.stage("serve_batch"):
-                        by_name.update(self._classify_fn(resident, [p]))
+                        with self._compute_lock:
+                            by_name.update(self._classify_fn(resident, [p]))
                 except UserInputError as pe:
-                    path_err[os.path.basename(p)] = str(pe)
+                    path_err[os.path.basename(p)] = (
+                        str(pe), "classify_failed", None
+                    )
                 except Exception as pe:  # noqa: BLE001
-                    path_err[os.path.basename(p)] = f"{type(pe).__name__}: {pe}"
+                    path_err[os.path.basename(p)] = (
+                        f"{type(pe).__name__}: {pe}",
+                        getattr(pe, "reason", None) or "classify_failed",
+                        getattr(pe, "retry_after_s", None),
+                    )
                     get_logger().exception("serve: query %s failed", p)
         batch_ms = (time.monotonic() - t0) * 1000.0
         counters.observe("serve_batch_ms", batch_ms)
@@ -291,9 +312,12 @@ class IndexServer:
             verdict = by_name.get(base)
             if verdict is None:
                 self.stats.errors_total += 1
+                msg, reason, retry = path_err.get(
+                    base,
+                    (f"no verdict produced for {req.genome}", "classify_failed", None),
+                )
                 resp = protocol.error_response(
-                    path_err.get(base, f"no verdict produced for {req.genome}"),
-                    req_id=req.req_id, reason="classify_failed",
+                    msg, req_id=req.req_id, reason=reason, retry_after_s=retry,
                 )
             elif req.strict and verdict.get("partitions_unavailable"):
                 # the --strict contract (ISSUE 14): a PARTIAL verdict —
@@ -571,6 +595,19 @@ class IndexServer:
         if op == "status":
             send({"ok": True, "op": "status", "status": self.snapshot()})
             return
+        if op == "classify_part":
+            # one scatter leg (fleet tier) — served on THIS connection
+            # thread (the router bounds its own wait); the compute lock
+            # inside serializes against the batch loop
+            self._serve_leg(req, send)
+            return
+        if op == "fleet":
+            send(protocol.error_response(
+                "this daemon is a serve replica, not a router — fleet "
+                "membership ops go to the `index route` front door",
+                req_id=req.get("id"), reason="not_a_router",
+            ))
+            return
         with wlock:
             state["inflight"] += 1
         self._admit_classify(req, reply_classify)
@@ -603,6 +640,99 @@ class IndexServer:
             send(protocol.error_response(
                 msg, req_id=req_id, reason=refused, retry_after_s=retry,
             ))
+
+    # ---- fleet scatter legs (ISSUE 17) ----------------------------------
+    def _serve_leg(self, req: dict, send: Callable[[dict], None]) -> None:
+        """One ``classify_part`` leg: the per-partition rect compare of a
+        router's already-sketched query batch. Generation-FENCED — a leg
+        for a generation this replica is not at is refused (carrying the
+        replica's generation), never silently computed: the router's
+        gather must not merge edges whose union-row indices belong to a
+        different generation's spine."""
+        req_id = req.get("id")
+        resident = self._resident  # pinned: swaps replace the object
+        if not hasattr(resident, "classify_partition"):
+            send(protocol.error_response(
+                "this replica serves a monolithic index — classify_part "
+                "needs a federated root", req_id=req_id, reason="not_federated",
+            ))
+            return
+        if self.queue.draining:
+            # replica leave-in-progress: the router reroutes the leg —
+            # the no-dropped-query half of the join/leave contract
+            send(protocol.error_response(
+                "replica is draining", req_id=req_id, reason="draining",
+                retry_after_s=_RETRY_AFTER_FLOOR_S,
+            ))
+            return
+        have = int(resident.generation)
+        want = int(req["generation"])
+        if want != have:
+            with self._lock:
+                self.stats.leg_refusals += 1
+            resp = protocol.error_response(
+                f"replica is at generation {have}, leg wants {want}",
+                req_id=req_id, reason="generation_mismatch",
+                retry_after_s=max(
+                    _RETRY_AFTER_FLOOR_S, float(self.cfg.poll_generation_s)
+                ),
+            )
+            resp["generation"] = have
+            send(resp)
+            return
+        pid = int(req["pid"])
+        if pid not in resident._slots:
+            send(protocol.error_response(
+                f"no partition {pid} at generation {have}",
+                req_id=req_id, reason="bad_request",
+            ))
+            return
+        names = [str(n) for n in req["names"]]
+        bottoms = [np.asarray(b, np.uint64) for b in req["bottoms"]]
+        prune_cfg = req.get("prune", self.cfg.prune_cfg)
+        t0 = time.monotonic()
+        try:
+            with self._compute_lock:
+                if not resident.ensure_resident(pid, pin={pid}):
+                    res = None
+                else:
+                    res = resident.classify_partition(pid, names, bottoms, prune_cfg)
+        except Exception as e:  # noqa: BLE001 — a leg failure must not kill the replica
+            get_logger().exception("serve: classify_part leg pid=%d failed", pid)
+            with self._lock:
+                self.stats.leg_refusals += 1
+            send(protocol.error_response(
+                f"leg failed: {type(e).__name__}: {e}", req_id=req_id,
+                reason="leg_failed", retry_after_s=self._partial_retry_hint(),
+            ))
+            return
+        if res is None:
+            # the PR 14 containment boundary, seen from one layer up:
+            # this replica's copy of the partition is quarantined — the
+            # router reroutes or stamps PARTIAL, with the reload-probe
+            # hint as its cue
+            with self._lock:
+                self.stats.leg_refusals += 1
+            counters.add_fault("serve_leg_unavailable")
+            send(protocol.error_response(
+                f"partition {pid} unavailable on this replica",
+                req_id=req_id, reason="partition_unavailable",
+                retry_after_s=self._partial_retry_hint(),
+            ))
+            return
+        ui, qi, dd = res
+        with self._lock:
+            self.stats.legs_total += 1
+        counters.observe("serve_leg_ms", (time.monotonic() - t0) * 1000.0)
+        send({
+            "ok": True, "op": "classify_part", "id": req_id, "pid": pid,
+            "generation": have,
+            "ui": [int(x) for x in ui],
+            "qi": [int(x) for x in qi],
+            # float32 -> float -> JSON -> float32 is bit-exact (double
+            # holds every float32), so the routed merge stays byte-identical
+            "dist": [float(x) for x in dd],
+        })
 
     # ---- HTTP shim -------------------------------------------------------
     def _handle_http(self, conn: socket.socket, first: bytes, reader) -> None:
@@ -637,7 +767,8 @@ class IndexServer:
         resp = box.get("resp", protocol.error_response("no response"))
         status = 200 if resp.get("ok") else (
             503
-            if resp.get("reason") in ("backpressure", "draining", "partial_coverage")
+            if resp.get("reason")
+            in ("backpressure", "draining", "partial_coverage", "no_replicas")
             else 400
         )
         with contextlib.suppress(OSError):

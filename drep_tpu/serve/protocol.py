@@ -9,6 +9,33 @@ NDJSON requests (the native protocol — what ServeClient speaks)::
     {"op": "status"}        # the daemon's health/metrics snapshot
     {"op": "ping"}          # liveness + current generation
 
+Fleet ops (ISSUE 17 — the router tier). ``classify_part`` is one
+scatter LEG: the router asks a replica for the per-partition rect
+compare of an already-sketched query batch, generation-fenced (the
+replica refuses with ``reason: "generation_mismatch"`` — carrying ITS
+generation — when it is not at the requested one, so a mixed-generation
+gather can never merge silently)::
+
+    {"op": "classify_part", "pid": 2, "generation": 7,
+     "names": ["query:a.fasta", ...], "bottoms": [[int64...], ...],
+     "prune": {...} | null, "id": "optional"}
+    -> {"ok": true, "op": "classify_part", "pid": 2, "generation": 7,
+        "ui": [...], "qi": [...], "dist": [...]}
+
+``bottoms`` are the queries' minhash bottom sketches as JSON integer
+lists (int64 survives JSON exactly); ``ui``/``qi``/``dist`` are the
+retained union-row/query-column/distance edge triple
+(``FederatedResident.classify_partition``'s return, listified —
+float32 -> JSON -> float32 round-trips bit-exact, so routed merges stay
+byte-identical to local ones).
+
+``fleet`` is the router's membership op (replicas joining/leaving a
+running fleet without a dropped query; a plain daemon answers
+``reason: "not_a_router"``)::
+
+    {"op": "fleet", "action": "join"|"leave", "address": "host:port",
+     "partitions": [0, 2] | null}
+
 ``strict`` (optional, federated serving only — ISSUE 14): a verdict
 answered with PARTIAL partition coverage (one or more candidate
 partitions quarantined — the verdict carries ``partitions_unavailable``)
@@ -49,7 +76,7 @@ from typing import Any
 
 MAX_LINE_BYTES = 1 << 20  # a request line is a path + opcode, never MBs
 
-OPS = ("classify", "status", "ping")
+OPS = ("classify", "status", "ping", "classify_part", "fleet")
 
 # HTTP methods the shim answers; anything else on a connection whose
 # first line is not JSON is a protocol error
@@ -87,6 +114,39 @@ def parse_request(line: bytes) -> dict:
             raise ProtocolError('classify needs a "genome" FASTA path')
         if "strict" in req and not isinstance(req["strict"], bool):
             raise ProtocolError('"strict" must be a JSON boolean')
+    elif op == "classify_part":
+        if not isinstance(req.get("pid"), int) or isinstance(req.get("pid"), bool):
+            raise ProtocolError('classify_part needs an integer "pid"')
+        if not isinstance(req.get("generation"), int):
+            raise ProtocolError(
+                'classify_part needs an integer "generation" (the fence)'
+            )
+        names, bottoms = req.get("names"), req.get("bottoms")
+        if not isinstance(names, list) or not names or not all(
+            isinstance(n, str) and n for n in names
+        ):
+            raise ProtocolError('classify_part needs a non-empty "names" list')
+        if not isinstance(bottoms, list) or len(bottoms) != len(names) or not all(
+            isinstance(b, list) and b for b in bottoms
+        ):
+            raise ProtocolError(
+                'classify_part needs "bottoms": one non-empty integer list per name'
+            )
+        if "prune" in req and req["prune"] is not None and not isinstance(
+            req["prune"], dict
+        ):
+            raise ProtocolError('"prune" must be a JSON object or null')
+    elif op == "fleet":
+        if req.get("action") not in ("join", "leave"):
+            raise ProtocolError('fleet "action" must be "join" or "leave"')
+        if not isinstance(req.get("address"), str) or not req["address"]:
+            raise ProtocolError('fleet needs a replica "address"')
+        parts = req.get("partitions")
+        if parts is not None and (
+            not isinstance(parts, list)
+            or not all(isinstance(p, int) and not isinstance(p, bool) for p in parts)
+        ):
+            raise ProtocolError('"partitions" must be an integer list or null')
     return req
 
 

@@ -1,0 +1,1000 @@
+"""The `index route` fleet front door (ISSUE 17 tentpole): a STATELESS
+scatter/gather router over N `index serve` replicas.
+
+One router process speaks the exact serve protocol (serve/protocol.py —
+NDJSON + the HTTP shim, byte-compatible with every existing client) in
+front of a fleet of replicas, each holding a subset of a federated
+root's partitions resident. The router holds the CHEAP half of the same
+root — the union spine and routing bitmaps, zero sketch payloads at
+startup — and farms every per-partition rectangular compare out to the
+fleet:
+
+- **routing**: each query's coarse code summary
+  (`rangepart.code_summary_bitmap`, recall 1.0 by construction) names
+  its candidate partitions; the replica table routes each leg to a
+  replica with cache AFFINITY for that partition (resident beats
+  evicted, shallow queue beats deep).
+- **forward fast path**: a query whose whole candidate set one replica
+  covers is forwarded as a plain `classify` (the replica's batch window
+  coalesces concurrent forwards — the fleet bench's 2x path).
+- **scatter/gather**: multi-partition queries fan out as
+  `classify_part` legs and merge through the EXACT recluster path the
+  replicas themselves run (`classify_batch_federated` with the router's
+  pre-gathered legs injected via ``partition_compare``) — routed
+  verdicts are byte-identical to a single daemon's union classify,
+  oracle-pinned in tests/test_router.py.
+- **generation fencing**: every leg is stamped with the router's
+  federation generation and a replica at any OTHER generation refuses
+  the leg (carrying its own), so a mixed-generation gather can never
+  merge silently. A replica AHEAD of the router triggers one bounded
+  synchronous reload-and-retry of the whole gather; exhaustion degrades
+  honestly.
+- **robustness is the contract**: per-leg timeouts; straggler HEDGING
+  (a duplicate dispatch to a second capable replica after
+  ``hedge_delay_s`` — first answer wins, the loser is discarded without
+  a double merge); leg failure -> reroute -> else a stamped PARTIAL
+  verdict (`--strict` converts it to a ``partial_coverage`` refusal
+  with ``retry_after_s``, exactly the PR 14 semantics one layer down);
+  bounded admission with overload SPILL to PARTIAL answers instead of
+  queueing to death; SIGTERM drain; replica join/leave mid-traffic
+  (the ``fleet`` op) without a dropped query.
+- **replica containment** mirrors PR 14's partition machine one layer
+  up: /healthz probes drive healthy -> suspect (immediate reprobe) ->
+  ejected (bounded exponential reprobe backoff,
+  DREP_TPU_ROUTER_PROBE_BACKOFF_S doubling to
+  DREP_TPU_SERVE_PROBE_MAX_S); a recovered probe rejoins the replica
+  seamlessly.
+
+The router is STATELESS by construction — no durable state, nothing
+written anywhere (it inherits the daemon's pure-reader contract and the
+reader-purity lint walks it): kill it and restart it and the fleet
+re-forms from the replica specs + probes.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as queue_mod
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from drep_tpu.errors import UserInputError
+from drep_tpu.serve import protocol
+from drep_tpu.serve.client import ServeClient
+from drep_tpu.serve.daemon import _RETRY_AFTER_FLOOR_S, IndexServer, ServeConfig
+from drep_tpu.utils import faults, telemetry
+from drep_tpu.utils.logger import get_logger
+from drep_tpu.utils.profiling import counters
+
+REPLICA_HEALTHY = "healthy"
+REPLICA_SUSPECT = "suspect"
+REPLICA_EJECTED = "ejected"
+
+# entries the router's sketch cache keeps (a sketch is ~KBs; the cap is
+# a leak bound, not a memory budget)
+_SKETCH_CACHE_CAP = 4096
+
+
+class FleetUnavailableError(RuntimeError):
+    """No usable replica in the fleet — surfaced to clients as a
+    ``no_replicas`` refusal with the soonest-reprobe retry hint (the
+    daemon's per-path error isolation forwards ``reason`` /
+    ``retry_after_s`` attributes verbatim)."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.reason = "no_replicas"
+        self.retry_after_s = retry_after_s
+
+
+def parse_replica_spec(spec: str) -> tuple[str, frozenset | None]:
+    """``ADDR`` or ``ADDR=PIDS`` where PIDS is a comma list of ids and
+    inclusive ranges (``0-2,5``). No assignment = the replica serves
+    every partition."""
+    addr, sep, rest = spec.partition("=")
+    addr = addr.strip()
+    if not addr:
+        raise UserInputError(f"bad replica spec {spec!r}: empty address")
+    if not sep:
+        return addr, None
+    pids: set[int] = set()
+    for part in filter(None, (p.strip() for p in rest.split(","))):
+        lo, dash, hi = part.partition("-")
+        try:
+            if dash:
+                pids.update(range(int(lo), int(hi) + 1))
+            else:
+                pids.add(int(part))
+        except ValueError as e:
+            raise UserInputError(
+                f"bad replica spec {spec!r}: partition list must be ids/"
+                f"ranges like 0-2,5 (got {part!r})"
+            ) from e
+    if not pids:
+        raise UserInputError(
+            f"bad replica spec {spec!r}: '=' given but no partitions named"
+        )
+    return addr, frozenset(pids)
+
+
+@dataclass
+class RouterConfig(ServeConfig):
+    """ServeConfig + the fleet surface. ``replicas`` are
+    :func:`parse_replica_spec` strings; None knobs resolve from the
+    router section of the env registry (utils/envknobs.py)."""
+
+    replicas: list[str] = field(default_factory=list)
+    leg_timeout_s: float | None = None
+    hedge_delay_s: float | None = None
+    probe_interval_s: float = 1.0
+    probe_backoff_s: float | None = None
+    probe_max_s: float | None = None
+    max_inflight: int | None = None  # wins over max_queue when set
+
+
+@dataclass
+class ReplicaSlot:
+    """One replica's containment record — the partition slot machine of
+    PR 14, promoted to a whole process."""
+
+    address: str
+    assigned: frozenset | None = None  # None = serves all partitions
+    state: str = REPLICA_HEALTHY
+    failures: int = 0
+    probes: int = 0
+    recoveries: int = 0
+    backoff_s: float = 0.0
+    next_probe: float = 0.0  # monotonic: earliest reprobe when ejected
+    last_ok: float | None = None
+    last_err: str | None = None
+    generation: int | None = None
+    n_genomes: int | None = None
+    queue_depth: int = 0
+    inflight: int = 0  # router-side legs/forwards currently on the wire
+    draining: bool = False
+    resident: frozenset = frozenset()  # pids with sketches resident
+    left: bool = False  # fleet leave: no NEW legs, record kept
+
+
+class ReplicaTable:
+    """The router's only mutable state: per-replica health + affinity,
+    fed by the /healthz poller and by leg outcomes. Thread-safe (probe
+    thread, leg threads, and fleet-op handler threads all book here)."""
+
+    def __init__(self, specs: list[str], probe_backoff_s: float, probe_max_s: float):
+        self._lock = threading.Lock()
+        self._slots: dict[str, ReplicaSlot] = {}
+        self.probe_backoff_s = float(probe_backoff_s)
+        self.probe_max_s = float(probe_max_s)
+        for spec in specs:
+            addr, assigned = parse_replica_spec(spec)
+            self.join(addr, assigned)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots.values() if not s.left)
+
+    # ---- membership (fleet op + CLI specs) ------------------------------
+    def join(self, address: str, assigned: frozenset | None = None) -> ReplicaSlot:
+        with self._lock:
+            slot = self._slots.get(address)
+            if slot is None:
+                slot = ReplicaSlot(address=address, assigned=assigned)
+                self._slots[address] = slot
+            else:
+                # rejoin: routable again immediately; probes re-earn trust
+                slot.left = False
+                slot.state = REPLICA_HEALTHY
+                slot.failures = 0
+                slot.backoff_s = 0.0
+                slot.next_probe = 0.0
+                if assigned is not None:
+                    slot.assigned = assigned
+            return slot
+
+    # ---- in-flight accounting --------------------------------------------
+    def lease(self, address: str) -> None:
+        """Book one router-side dispatch onto a replica. The /healthz
+        ``queue_depth`` refreshes only at probe cadence — within a
+        probe interval the lease count is the ONLY load signal, and
+        without it every equally-good target ties and the address
+        tiebreak funnels a whole batch at one replica."""
+        with self._lock:
+            slot = self._slots.get(address)
+            if slot is not None:
+                slot.inflight += 1
+
+    def release(self, address: str) -> None:
+        with self._lock:
+            slot = self._slots.get(address)
+            if slot is not None and slot.inflight > 0:
+                slot.inflight -= 1
+
+    def leave(self, address: str) -> bool:
+        """No new legs route here; in-flight legs finish on their open
+        sockets — the no-dropped-query half of the leave contract."""
+        with self._lock:
+            slot = self._slots.get(address)
+            if slot is None:
+                return False
+            slot.left = True
+            return True
+
+    # ---- outcome booking -------------------------------------------------
+    def book_failure(self, address: str, err: BaseException | str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            slot = self._slots.get(address)
+            if slot is None or slot.left:
+                return
+            slot.failures += 1
+            slot.last_err = f"{err}"
+            if slot.state == REPLICA_HEALTHY:
+                slot.state = REPLICA_SUSPECT
+                slot.next_probe = now  # one immediate reprobe: a blip is
+                # not an ejection (the partition machine's grace, one up)
+                state = REPLICA_SUSPECT
+            elif slot.state == REPLICA_SUSPECT:
+                slot.state = REPLICA_EJECTED
+                slot.backoff_s = self.probe_backoff_s
+                slot.next_probe = now + slot.backoff_s
+                state = REPLICA_EJECTED
+            else:
+                slot.backoff_s = min(
+                    self.probe_max_s, max(self.probe_backoff_s, slot.backoff_s * 2)
+                )
+                slot.next_probe = now + slot.backoff_s
+                state = REPLICA_EJECTED
+        counters.add_fault(f"router_replica_{state}")
+        telemetry.event(
+            f"replica_{state}", address=address, error=f"{err}"[:200]
+        )
+
+    def book_success(self, address: str, status: dict | None = None) -> None:
+        with self._lock:
+            slot = self._slots.get(address)
+            if slot is None:
+                return
+            recovered = slot.state != REPLICA_HEALTHY
+            if recovered:
+                slot.recoveries += 1
+            slot.state = REPLICA_HEALTHY
+            slot.failures = 0
+            slot.backoff_s = 0.0
+            slot.last_ok = time.monotonic()
+            slot.last_err = None
+            if status:
+                slot.probes += 1
+                slot.generation = status.get("generation")
+                slot.n_genomes = status.get("n_genomes")
+                slot.queue_depth = int(status.get("queue_depth") or 0)
+                slot.draining = bool(status.get("draining"))
+                per = (status.get("partitions") or {}).get("partitions") or {}
+                try:
+                    slot.resident = frozenset(
+                        int(p) for p, info in per.items() if info.get("resident")
+                    )
+                except (TypeError, ValueError):
+                    slot.resident = frozenset()
+        if recovered:
+            counters.add_fault("router_replica_recovered")
+            telemetry.event("replica_recovered", address=address)
+
+    # ---- routing views ---------------------------------------------------
+    def _routable(self) -> list[ReplicaSlot]:
+        return [
+            s for s in self._slots.values()
+            if not s.left and not s.draining and s.state != REPLICA_EJECTED
+        ]
+
+    def eligible(self, pid: int) -> list[ReplicaSlot]:
+        """Replicas capable of partition ``pid``, best first: sketch
+        affinity, then health, then shallow queues (deterministic
+        address tiebreak)."""
+        with self._lock:
+            slots = [
+                s for s in self._routable()
+                if s.assigned is None or pid in s.assigned
+            ]
+            slots.sort(key=lambda s: (
+                0 if pid in s.resident else 1,
+                0 if s.state == REPLICA_HEALTHY else 1,
+                s.queue_depth + s.inflight, s.address,
+            ))
+            return slots
+
+    def cover_targets(self, pids: set[int]) -> list[ReplicaSlot]:
+        """Replicas whose assignment covers EVERY pid in ``pids`` (the
+        forward fast path), best first by affinity overlap."""
+        with self._lock:
+            slots = [
+                s for s in self._routable()
+                if s.assigned is None or pids <= s.assigned
+            ]
+            slots.sort(key=lambda s: (
+                -len(pids & s.resident),
+                0 if s.state == REPLICA_HEALTHY else 1,
+                s.queue_depth + s.inflight, s.address,
+            ))
+            return slots
+
+    def usable(self) -> bool:
+        with self._lock:
+            return bool(self._routable())
+
+    def probe_due(self, now: float) -> list[tuple[str, str]]:
+        """(address, state) of every replica the poller should probe
+        this tick: healthy/suspect always, ejected only past their
+        backoff deadline, left never."""
+        with self._lock:
+            return [
+                (s.address, s.state) for s in self._slots.values()
+                if not s.left
+                and (s.state != REPLICA_EJECTED or now >= s.next_probe)
+            ]
+
+    def retry_hint_s(self) -> float:
+        """The soonest instant anything could change — the refusal hint
+        when no replica is usable."""
+        now = time.monotonic()
+        with self._lock:
+            waits = [
+                max(_RETRY_AFTER_FLOOR_S, s.next_probe - now)
+                for s in self._slots.values()
+                if not s.left and s.state == REPLICA_EJECTED
+            ]
+        return min(waits) if waits else self.probe_backoff_s
+
+    def health_map(self) -> dict:
+        with self._lock:
+            replicas = {
+                s.address: {
+                    "state": "left" if s.left else s.state,
+                    "assigned": sorted(s.assigned) if s.assigned is not None else None,
+                    "generation": s.generation,
+                    "n_genomes": s.n_genomes,
+                    "queue_depth": s.queue_depth,
+                    "inflight": s.inflight,
+                    "draining": s.draining,
+                    "resident": sorted(s.resident),
+                    "failures": s.failures,
+                    "recoveries": s.recoveries,
+                    "probes": s.probes,
+                    "last_error": s.last_err,
+                }
+                for s in sorted(self._slots.values(), key=lambda s: s.address)
+            }
+            suspect = sorted(
+                s.address for s in self._slots.values()
+                if not s.left and s.state == REPLICA_SUSPECT
+            )
+            ejected = sorted(
+                s.address for s in self._slots.values()
+                if not s.left and s.state == REPLICA_EJECTED
+            )
+        return {"replicas": replicas, "suspect": suspect, "ejected": ejected}
+
+
+class RouterServer(IndexServer):
+    """IndexServer whose classify core routes to a fleet instead of
+    rect-comparing locally. Everything else — bounded admission, dynamic
+    batching, the strict/PARTIAL refusal branch, generation hot-swap
+    polling, SIGTERM drain, /healthz — is inherited unchanged, so the
+    two tiers cannot drift."""
+
+    def __init__(self, cfg: RouterConfig, classify_fn=None):
+        from drep_tpu.utils import envknobs
+
+        self.leg_timeout_s = (
+            envknobs.env_float("DREP_TPU_ROUTER_LEG_TIMEOUT_S")
+            if cfg.leg_timeout_s is None else float(cfg.leg_timeout_s)
+        )
+        self.hedge_delay_s = (
+            envknobs.env_float("DREP_TPU_ROUTER_HEDGE_DELAY_S")
+            if cfg.hedge_delay_s is None else float(cfg.hedge_delay_s)
+        )
+        probe_backoff = (
+            envknobs.env_float("DREP_TPU_ROUTER_PROBE_BACKOFF_S")
+            if cfg.probe_backoff_s is None else float(cfg.probe_backoff_s)
+        )
+        probe_max = (
+            envknobs.env_float("DREP_TPU_SERVE_PROBE_MAX_S")
+            if cfg.probe_max_s is None else float(cfg.probe_max_s)
+        )
+        if cfg.max_inflight is None:
+            cfg.max_inflight = envknobs.env_int("DREP_TPU_ROUTER_MAX_INFLIGHT")
+        cfg.max_queue = int(cfg.max_inflight)
+        super().__init__(cfg, classify_fn=classify_fn)
+        self.table = ReplicaTable(list(cfg.replicas), probe_backoff, probe_max)
+        self.router_stats = {
+            "forwarded": 0,  # queries answered via the forward fast path
+            "scattered": 0,  # queries answered via scatter/gather merge
+            "legs_total": 0,
+            "leg_failures": 0,
+            "reroutes": 0,
+            "hedges": 0,
+            "hedge_wins": 0,
+            "fence_retries": 0,  # gathers retried after a generation fence
+            "fence_reloads": 0,  # synchronous reloads the fence forced
+            "overload_spills": 0,  # legs abandoned on fleet-wide backpressure
+            "partial_verdicts": 0,
+        }
+        self._swap_lock = threading.Lock()  # fence reload vs poller swap
+        self._sketch_lock = threading.Lock()
+        self._sketch_cache: OrderedDict[tuple, dict] = OrderedDict()
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> str:
+        address = super().start()
+        if not hasattr(self._resident, "route_candidates"):
+            self.close()
+            raise UserInputError(
+                f"index route needs a FEDERATED root (got a monolithic "
+                f"store at {self.cfg.index_loc}) — the router scatters "
+                f"per-partition legs; a monolithic index has nothing to "
+                f"scatter. Serve it with `index serve` instead."
+            )
+        prober = threading.Thread(
+            target=self._probe_loop, daemon=True, name="drep-route-probe"
+        )
+        self._threads.append(prober)
+        prober.start()
+        telemetry.event(
+            "route_start", address=address, replicas=len(self.table),
+            generation=int(self._resident.generation),
+        )
+        return address
+
+    # ---- replica health polling -----------------------------------------
+    def _probe_once(self) -> None:
+        for addr, _state in self.table.probe_due(time.monotonic()):
+            try:
+                faults.fire("replica_health")
+                with ServeClient(
+                    addr, timeout_s=min(5.0, self.leg_timeout_s)
+                ) as c:
+                    status = c.status()
+                self.table.book_success(addr, status)
+            except Exception as e:  # noqa: BLE001 — a probe failure is DATA
+                # (it advances the slot machine), never a router crash
+                self.table.book_failure(addr, e)
+
+    def _probe_loop(self) -> None:
+        cfg: RouterConfig = self.cfg  # type: ignore[assignment]
+        interval = max(0.05, float(cfg.probe_interval_s))
+        while True:
+            self._probe_once()
+            if self._stop_poll.wait(interval):
+                return
+
+    # ---- fleet membership op --------------------------------------------
+    def _handle_line(self, line, send, reply_classify, state, wlock) -> None:
+        try:
+            req = protocol.parse_request(line)
+        except protocol.ProtocolError:
+            # let the base handler produce the canonical protocol error
+            return super()._handle_line(line, send, reply_classify, state, wlock)
+        if req["op"] == "fleet":
+            self._handle_fleet(req, send)
+            return
+        return super()._handle_line(line, send, reply_classify, state, wlock)
+
+    def _handle_fleet(self, req: dict, send) -> None:
+        action, addr = req["action"], req["address"]
+        parts = req.get("partitions")
+        assigned = (
+            frozenset(int(p) for p in parts) if parts is not None else None
+        )
+        if action == "join":
+            self.table.join(addr, assigned)
+            known = True
+        else:
+            known = self.table.leave(addr)
+        get_logger().info(
+            "route: fleet %s %s%s (%d replica(s) routable)",
+            action, addr,
+            f" partitions={sorted(assigned)}" if assigned is not None else "",
+            len(self.table),
+        )
+        telemetry.event(
+            "fleet_" + action, address=addr,
+            partitions=sorted(assigned) if assigned is not None else None,
+        )
+        send({
+            "ok": True, "op": "fleet", "action": action, "address": addr,
+            "known": known, "replicas": len(self.table),
+            "id": req.get("id"),
+        })
+
+    # ---- status ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        out = super().snapshot()
+        out["role"] = "router"
+        out["replicas"] = self.table.health_map()
+        with self._lock:
+            out["router"] = dict(self.router_stats)
+        return out
+
+    # ---- generation fence ------------------------------------------------
+    def _fence_reload(self):
+        """Synchronous reload when a gather proves the fleet is AHEAD of
+        this router's resident generation (the poller would catch up
+        within poll_generation_s; the fence cannot wait). Returns the
+        freshest resident."""
+        from drep_tpu.index import resident_device
+        from drep_tpu.index.classify import load_resident_index
+
+        with self._swap_lock:
+            current = self._resident
+            try:
+                fresh = load_resident_index(
+                    self.cfg.index_loc, resident_mb=self.cfg.resident_mb
+                )
+            except Exception as e:  # noqa: BLE001 — keep the current generation
+                get_logger().warning("route: fence reload failed (%s)", e)
+                return current
+            if current is not None and int(fresh.generation) <= int(
+                current.generation
+            ):
+                return current
+            resident_device.prewarm_resident(fresh)
+            old = int(current.generation) if current is not None else -1
+            self._resident = fresh
+            with self._lock:
+                self.stats.swaps_total += 1
+                self.router_stats["fence_reloads"] += 1
+            counters.set_gauge("serve_generation", float(fresh.generation))
+            telemetry.event(
+                "generation_swap", old=old, new=int(fresh.generation),
+                n=fresh.n, fenced=True,
+            )
+            get_logger().info(
+                "route: generation fence reload %d -> %d", old, fresh.generation
+            )
+            return fresh
+
+    # ---- the routed classify core ---------------------------------------
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.router_stats[key] += n
+
+    def _classify_paths(self, resident, paths: list[str]) -> dict:
+        """The router's replacement for the daemon's local classify
+        core: sketch (cached), route, forward/scatter, merge. Returns
+        verdicts keyed by display name — the inherited batch loop does
+        admission, batching, strict conversion, and reply plumbing."""
+        queries = self._sketch_batch(resident, paths)
+        out: dict[str, dict] = {v["genome"]: v for v in queries.dropped}
+        if not queries.n:
+            return out
+        if not self.table.usable():
+            raise FleetUnavailableError(
+                "no usable replica in the fleet (all ejected or left)",
+                retry_after_s=self.table.retry_hint_s(),
+            )
+        q_names = list(queries.admitted["genome"])
+        disp = [
+            n[len("query:"):] if n.startswith("query:") else n for n in q_names
+        ]
+        q_bottoms = [
+            np.asarray(queries.results[g]["bottom"], np.uint64) for g in q_names
+        ]
+        cand = resident.route_candidates(q_bottoms)
+        path_of = {os.path.basename(p): p for p in paths}
+
+        # partition the batch: forward what one replica fully covers,
+        # scatter the rest. Queries assigned earlier in THIS batch count
+        # as load on their target (the `local` ledger): the table's
+        # queue_depth only refreshes at probe cadence, and without the
+        # ledger every query of a batch would tie-break onto one
+        # replica's address while its twin idles
+        forward: dict[str, list[int]] = {}
+        scatter_ts: list[int] = []
+        local: dict[str, int] = {}
+        for t in range(len(q_names)):
+            targets = self.table.cover_targets(cand[t]) if cand[t] else []
+            if targets:
+                best = min(
+                    enumerate(targets),
+                    key=lambda it: (
+                        it[1].queue_depth + it[1].inflight
+                        + local.get(it[1].address, 0),
+                        it[0],  # affinity order breaks load ties
+                    ),
+                )[1]
+                local[best.address] = local.get(best.address, 0) + 1
+                forward.setdefault(best.address, []).append(t)
+            else:
+                scatter_ts.append(t)
+
+        fwd_results: dict[int, dict] = {}
+        threads = []
+        for addr, ts in forward.items():
+            th = threading.Thread(
+                target=self._forward_group,
+                args=(addr, ts, [path_of[disp[t]] for t in ts],
+                      set(cand[ts[0]]) if len(ts) == 1 else
+                      set().union(*(cand[t] for t in ts)), fwd_results),
+                daemon=True, name="drep-route-fwd",
+            )
+            threads.append(th)
+            th.start()
+        deadline = time.monotonic() + self._leg_budget_s() + 1.0
+        for th in threads:
+            th.join(max(0.0, deadline - time.monotonic()))
+
+        gen = int(resident.generation)
+        for addr, ts in forward.items():
+            for t in ts:
+                resp = fwd_results.get(t)
+                if resp is not None and resp.get("ok") and resp.get("verdict"):
+                    if resp.get("generation") != gen:
+                        # a forwarded verdict is COMPLETE at whichever
+                        # generation stamped it — honest to return, worth
+                        # counting (scatter legs, by contrast, hard-fence)
+                        self._bump("fence_retries")
+                    out[disp[t]] = resp["verdict"]
+                    self._bump("forwarded")
+                else:
+                    scatter_ts.append(t)  # reroute through the merge path
+
+        if scatter_ts:
+            sub = self._subset_queries(queries, sorted(scatter_ts))
+            for v in self._classify_scatter(resident, sub):
+                out[v["genome"]] = v
+                self._bump("scattered")
+                if v.get("partitions_unavailable"):
+                    self._bump("partial_verdicts")
+        return out
+
+    def _subset_queries(self, queries, ts: list[int]):
+        from drep_tpu.index.classify import SketchedQueries
+
+        return SketchedQueries(
+            admitted=queries.admitted.iloc[ts].reset_index(drop=True),
+            results=queries.results, dropped=[],
+        )
+
+    def _classify_scatter(self, fed, queries) -> list[dict]:
+        """Scatter legs, gather, and run the EXACT federated merge with
+        the remote results injected — one bounded generation-fence
+        retry when the fleet proves to be ahead."""
+        from drep_tpu.index.federation import classify_batch_federated
+
+        for attempt in (0, 1):
+            gen = int(fed.generation)
+            q_names = list(queries.admitted["genome"])
+            q_bottoms = [
+                np.asarray(queries.results[g]["bottom"], np.uint64)
+                for g in q_names
+            ]
+            cand = fed.route_candidates(q_bottoms)
+            legs, ahead = self._gather_legs(fed, gen, cand, q_names, q_bottoms)
+            if ahead and attempt == 0:
+                self._bump("fence_retries")
+                fresh = self._fence_reload()
+                if fresh is not None and int(fresh.generation) > gen:
+                    fed = fresh
+                    continue  # re-route + re-scatter on the new generation
+            # drep-lint: allow[reader-purity] — the routed merge is the same storeless federated classify the daemon waives (classify.py): joint=False runs every rect compare with no checkpoint_dir, partition legs are remote, residency loads are checked reads; byte-for-byte pinned by the router oracle tests
+            return classify_batch_federated(
+                fed, queries, processes=self.cfg.processes,
+                prune_cfg=self.cfg.prune_cfg, joint=False,
+                partition_compare=lambda pid, _names, _bottoms: legs.get(pid),
+            )
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _leg_budget_s(self) -> float:
+        return 2.0 * self.leg_timeout_s + self.hedge_delay_s
+
+    def _gather_legs(self, fed, gen, cand, q_names, q_bottoms):
+        """Dispatch one classify_part leg per candidate partition, all
+        concurrent, each internally rerouted/hedged/deadlined. Returns
+        ({pid: (ui, qi, dd)}, fleet_is_ahead)."""
+        pids = sorted(set().union(*cand)) if cand else []
+        legs: dict[int, tuple] = {}
+        ahead = threading.Event()
+        threads = []
+        for pid in pids:
+            cols = [t for t in range(len(q_names)) if pid in cand[t]]
+            names = [q_names[t] for t in cols]
+            bottoms = [[int(x) for x in q_bottoms[t]] for t in cols]
+            th = threading.Thread(
+                target=self._run_leg, args=(pid, gen, names, bottoms, legs, ahead),
+                daemon=True, name=f"drep-route-leg-{pid}",
+            )
+            threads.append(th)
+            th.start()
+        # backstop join deadline: each leg bounds itself, but a hang
+        # fault fired at the router_leg site (chaos) must be contained
+        # HERE — an expired leg merges as unavailable, never a wedge
+        deadline = time.monotonic() + self._leg_budget_s() + 1.0
+        for th in threads:
+            th.join(max(0.0, deadline - time.monotonic()))
+        return legs, ahead.is_set()
+
+    def _run_leg(self, pid, gen, names, bottoms, legs, ahead) -> None:
+        try:
+            faults.fire("router_leg")
+            res = self._leg_dispatch(pid, gen, names, bottoms, ahead)
+        except Exception as e:  # noqa: BLE001 — a leg NEVER raises out of
+            # the router: failure degrades to a stamped PARTIAL
+            get_logger().warning("route: leg pid=%d failed: %s", pid, e)
+            res = None
+        if res is None:
+            self._bump("leg_failures")
+        else:
+            legs[pid] = res
+
+    def _leg_dispatch(self, pid, gen, names, bottoms, ahead):
+        """One leg's full lifecycle: affinity-ordered targets, per-attempt
+        socket deadline, straggler hedge to a second capable replica
+        (first answer wins, the loser's socket is abandoned — a
+        once-latch on the return path makes a double merge impossible),
+        reroute on failure/refusal, overall deadline. Returns
+        (ui, qi, dd) arrays or None."""
+        deadline = time.monotonic() + self._leg_budget_s()
+        req = {
+            "op": "classify_part", "pid": int(pid), "generation": int(gen),
+            "names": names, "bottoms": bottoms, "prune": self.cfg.prune_cfg,
+        }
+        results: queue_mod.Queue = queue_mod.Queue()
+
+        def attempt(addr: str) -> None:
+            self.table.lease(addr)
+            try:
+                with ServeClient(addr, timeout_s=self.leg_timeout_s) as c:
+                    results.put((addr, c.request(req), None))
+            except Exception as e:  # noqa: BLE001 — routed to the loop below
+                results.put((addr, None, e))
+            finally:
+                self.table.release(addr)
+
+        def launch(addr: str) -> None:
+            threading.Thread(
+                target=attempt, args=(addr,), daemon=True,
+                name="drep-route-attempt",
+            ).start()
+
+        tried: list[str] = []
+        hedge_addrs: set[str] = set()
+        pending = 0
+        saw_busy = False
+
+        def next_target() -> str | None:
+            for slot in self.table.eligible(pid):
+                if slot.address not in tried:
+                    return slot.address
+            return None
+
+        self._bump("legs_total")
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                break
+            if pending == 0:
+                addr = next_target()
+                if addr is None:
+                    break  # every capable replica tried and failed
+                if tried:
+                    self._bump("reroutes")
+                tried.append(addr)
+                launch(addr)
+                pending += 1
+                wait_until = min(deadline, now + self.hedge_delay_s)
+            elif pending == 1 and not hedge_addrs:
+                # the hedge window elapsed with the primary still out:
+                # duplicate to a second capable replica, first answer wins
+                addr = next_target()
+                if addr is not None:
+                    tried.append(addr)
+                    hedge_addrs.add(addr)
+                    self._bump("hedges")
+                    counters.add_fault("router_leg_hedged")
+                    launch(addr)
+                    pending += 1
+                wait_until = deadline
+            else:
+                wait_until = deadline
+            try:
+                addr, resp, err = results.get(
+                    timeout=max(0.0, wait_until - time.monotonic())
+                )
+            except queue_mod.Empty:
+                continue  # loop re-decides: hedge, reroute, or expire
+            pending -= 1
+            if err is not None or resp is None:
+                self.table.book_failure(addr, err or "empty leg response")
+                continue
+            if resp.get("ok"):
+                self.table.book_success(addr)
+                if addr in hedge_addrs:
+                    self._bump("hedge_wins")
+                return (
+                    np.asarray(resp.get("ui", ()), np.int64),
+                    np.asarray(resp.get("qi", ()), np.int64),
+                    np.asarray(resp.get("dist", ()), np.float32),
+                )
+            reason = resp.get("reason")
+            if reason == "generation_mismatch":
+                rgen = resp.get("generation")
+                if rgen is not None and int(rgen) > gen:
+                    ahead.set()  # the batch-level fence retry takes over
+                    return None
+                continue  # replica BEHIND: another target may be current
+            if reason in ("backpressure", "draining"):
+                saw_busy = True  # overload: spill to other replicas,
+                continue  # never queue the leg behind a saturated one
+            if reason == "partition_unavailable":
+                # the replica itself quarantined this partition (PR 14) —
+                # its OTHER partitions are fine, so no failure booking
+                continue
+            self.table.book_failure(addr, resp.get("error") or reason or "leg error")
+        if saw_busy:
+            self._bump("overload_spills")
+            counters.add_fault("router_overload_spill")
+        return None
+
+    # ---- forward fast path ----------------------------------------------
+    def _forward_group(self, addr, ts, paths, pids, results) -> None:
+        """Forward whole queries (one pipelined connection — the
+        replica's batch window coalesces them) with the same
+        reroute + hedge envelope as a scatter leg. Failures leave the
+        queries' slots empty; the caller falls back to the scatter
+        merge, which degrades per-partition instead of per-query."""
+        try:
+            faults.fire("router_leg")
+        except Exception as e:  # noqa: BLE001 — injected: same contract
+            get_logger().warning("route: forward to %s failed: %s", addr, e)
+            return
+        deadline = time.monotonic() + self._leg_budget_s()
+        rq: queue_mod.Queue = queue_mod.Queue()
+
+        def attempt(a: str) -> None:
+            self.table.lease(a)
+            try:
+                with ServeClient(a, timeout_s=self.leg_timeout_s) as c:
+                    rq.put((a, c.classify_many(paths), None))
+            except Exception as e:  # noqa: BLE001
+                rq.put((a, None, e))
+            finally:
+                self.table.release(a)
+
+        tried = [addr]
+        hedge_addrs: set[str] = set()
+        pending = 1
+        threading.Thread(
+            target=attempt, args=(addr,), daemon=True, name="drep-route-fwd-try"
+        ).start()
+
+        def next_target() -> str | None:
+            for slot in self.table.cover_targets(pids):
+                if slot.address not in tried:
+                    return slot.address
+            return None
+
+        while True:
+            now = time.monotonic()
+            if now >= deadline:
+                return
+            if pending == 0:
+                nxt = next_target()
+                if nxt is None:
+                    return
+                self._bump("reroutes")
+                tried.append(nxt)
+                threading.Thread(
+                    target=attempt, args=(nxt,), daemon=True,
+                    name="drep-route-fwd-try",
+                ).start()
+                pending += 1
+                wait_until = min(deadline, now + self.hedge_delay_s)
+            elif pending == 1 and not hedge_addrs:
+                nxt = next_target()
+                if nxt is not None:
+                    tried.append(nxt)
+                    hedge_addrs.add(nxt)
+                    self._bump("hedges")
+                    counters.add_fault("router_leg_hedged")
+                    threading.Thread(
+                        target=attempt, args=(nxt,), daemon=True,
+                        name="drep-route-fwd-try",
+                    ).start()
+                    pending += 1
+                wait_until = deadline
+            else:
+                wait_until = deadline
+            try:
+                a, resps, err = rq.get(
+                    timeout=max(0.0, wait_until - time.monotonic())
+                )
+            except queue_mod.Empty:
+                continue
+            pending -= 1
+            if err is not None or resps is None:
+                self.table.book_failure(a, err or "empty forward response")
+                self._bump("leg_failures")
+                continue
+            self.table.book_success(a)
+            if a in hedge_addrs:
+                self._bump("hedge_wins")
+            # once-latch: the FIRST complete group wins; a loser arriving
+            # later hits the results-already-set check and is discarded
+            for t, resp in zip(ts, resps):
+                if t not in results:
+                    results[t] = resp
+            return
+
+    # ---- sketch cache ----------------------------------------------------
+    def _sketch_key(self, path: str) -> tuple | None:
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (os.path.abspath(path), st.st_size, st.st_mtime_ns)
+
+    def _sketch_batch(self, resident, paths: list[str]):
+        """sketch_queries with a per-file LRU keyed by (path, size,
+        mtime): a loadgen's hot set sketches once at the router, so the
+        forward fast path adds routing — not re-sketching — on top of
+        the replica's work. Byte-identical to the uncached path (the
+        admission rule is re-applied per batch from the pinned params;
+        only the sketch payload is reused)."""
+        import pandas as pd
+
+        from drep_tpu.index.classify import SketchedQueries, sketch_queries
+
+        basenames = [os.path.basename(p) for p in paths]
+        if len(set(basenames)) != len(basenames):
+            # the batcher never co-batches basename colliders; stay
+            # correct anyway if a caller bypasses it
+            return sketch_queries(resident, paths, processes=self.cfg.processes)
+        cached: dict[str, dict] = {}
+        misses: list[str] = []
+        keys = {p: self._sketch_key(p) for p in paths}
+        with self._sketch_lock:
+            for p in paths:
+                ent = self._sketch_cache.get(keys[p]) if keys[p] else None
+                if ent is None:
+                    misses.append(p)
+                else:
+                    self._sketch_cache.move_to_end(keys[p])
+                    cached[p] = ent
+        if misses:
+            sq = sketch_queries(resident, misses, processes=self.cfg.processes)
+            with self._sketch_lock:
+                for p in misses:
+                    r = sq.results.get(f"query:{os.path.basename(p)}")
+                    if r is None:
+                        continue  # pragma: no cover — sketch_paths raises instead
+                    cached[p] = r
+                    if keys[p] is not None:
+                        self._sketch_cache[keys[p]] = r
+                while len(self._sketch_cache) > _SKETCH_CACHE_CAP:
+                    self._sketch_cache.popitem(last=False)
+        min_len = int(resident.params.get("filter_length", 0))
+        gen = int(resident.generation)
+        rows: dict[str, list] = {"genome": [], "location": []}
+        results: dict[str, dict] = {}
+        dropped: list[dict] = []
+        for p in paths:
+            base = os.path.basename(p)
+            qn = f"query:{base}"
+            r = cached[p]
+            results[qn] = r
+            if int(r["length"]) >= min_len:
+                rows["genome"].append(qn)
+                rows["location"].append(os.path.abspath(p))
+            else:
+                dropped.append({
+                    "genome": base, "filtered": True,
+                    "reason": f"below the index's filter length {min_len}",
+                    "generation": gen,
+                })
+        return SketchedQueries(
+            admitted=pd.DataFrame(rows), results=results, dropped=dropped,
+        )

@@ -209,6 +209,35 @@ _declare(
     "DREP_TPU_SERVE_PROBE_MAX_S", "float", 60.0,
     "Cap on the partition reload-probe backoff (s).",
 )
+# -- fleet router (ISSUE 17) -------------------------------------------------
+_declare(
+    "DREP_TPU_ROUTER_LEG_TIMEOUT_S", "float", 30.0,
+    "Fleet router (serve/router.py): per-leg socket deadline for one "
+    "scatter/forward dispatch to a replica. A leg past it is abandoned "
+    "(the attempt reroutes; exhaustion degrades to a PARTIAL verdict). "
+    "The CLI `index route --leg_timeout_s` overrides.",
+)
+_declare(
+    "DREP_TPU_ROUTER_HEDGE_DELAY_S", "float", 2.0,
+    "Fleet router: straggler hedge — when a leg's first attempt has not "
+    "answered after this long, a duplicate dispatch goes to a second "
+    "capable replica and the first answer wins (the loser is discarded, "
+    "never double-merged). The CLI `index route --hedge_delay_s` overrides.",
+)
+_declare(
+    "DREP_TPU_ROUTER_PROBE_BACKOFF_S", "float", 1.0,
+    "Fleet router: first reprobe delay after a replica is EJECTED by the "
+    "health poller (healthy->suspect->ejected); doubles per failed "
+    "reprobe up to DREP_TPU_SERVE_PROBE_MAX_S — the PR 14 partition "
+    "containment ladder, one layer up.",
+)
+_declare(
+    "DREP_TPU_ROUTER_MAX_INFLIGHT", "int", 256,
+    "Fleet router: bounded admission — max queued classify requests "
+    "before the router sheds load with a backpressure refusal "
+    "(retry_after_s) instead of queueing to death. The CLI "
+    "`index route --max_inflight` overrides.",
+)
 # -- autoscaling controller --------------------------------------------------
 _declare(
     "DREP_TPU_AUTOSCALE_INTERVAL_S", "float", 5.0,

@@ -92,6 +92,16 @@ SITES = (
     # point, drep_tpu/autoscale/controller.py (fires BEFORE the snapshot
     # + decide; raise/hang/kill take the controller down — which must be
     # harmless: workers never depend on it — and sleep paces the loop)
+    "router_leg",  # the fleet router's per-leg dispatch point,
+    # drep_tpu/serve/router.py (fires as a scatter leg leaves for a
+    # replica: raise -> the leg books a failure and reroutes/degrades to
+    # PARTIAL, hang -> the per-leg deadline contains it, sleep -> paces
+    # a scatter so chaos can kill the replica mid-gather)
+    "replica_health",  # the router's per-replica health probe,
+    # drep_tpu/serve/router.py (fires inside one /healthz poll: raise ->
+    # the probe books a failure and the healthy->suspect->ejected
+    # machine advances — a probe fault must eject the replica, never
+    # the router)
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):

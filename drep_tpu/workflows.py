@@ -299,6 +299,94 @@ def index_serve_wrapper(index_loc: str, genomes: list[str] | None = None, **kwar
         telemetry.close()
 
 
+def index_route_wrapper(index_loc: str, genomes: list[str] | None = None, **kwargs) -> int:
+    """`index route`: the fleet front door (drep_tpu/serve/router.py) —
+    a stateless scatter/gather router over N `index serve` replicas.
+    Blocks until drained; returns the (0) exit status.
+
+    Same reader-purity inversion as `index serve`: the router never
+    writes under the index tree — logs/metrics/events go to --log_dir
+    or nowhere. An empty --replica list is legal (replicas may join
+    later via the ``fleet`` op); queries before any join are refused
+    with reason ``no_replicas``."""
+    import os
+
+    from drep_tpu.serve import RouterConfig, RouterServer, install_signal_handlers
+    from drep_tpu.utils import telemetry
+    from drep_tpu.utils.profiling import counters, start_metrics_flush, stop_metrics_flush
+    from drep_tpu.utils.xla_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    log_dir = kwargs.get("log_dir") or None
+    if telemetry.resolve_enabled(kwargs.get("events")) and not log_dir:
+        raise UserInputError(
+            "--events on needs --log_dir (the router never writes under "
+            "the index directory, so traces have nowhere to go)"
+        )
+    if log_dir:
+        log_dir = os.path.abspath(log_dir)
+        idx_abs = os.path.abspath(index_loc)
+        if log_dir == idx_abs or log_dir.startswith(idx_abs + os.sep):
+            raise UserInputError(
+                f"--log_dir {log_dir} is inside the index directory — the "
+                f"router is read-only by contract; point it elsewhere"
+            )
+        os.makedirs(log_dir, exist_ok=True)
+    import logging
+
+    console_lvl = next(
+        (h.level for h in get_logger().handlers
+         if isinstance(h, logging.StreamHandler)),
+        logging.INFO,
+    )
+    setup_logger(log_dir, verbosity=console_lvl or logging.INFO)
+    telemetry.configure(log_dir=log_dir, enabled=kwargs.get("events"))
+    if log_dir:
+        start_metrics_flush(log_dir)
+    else:
+        stop_metrics_flush()
+    counters.reset()
+    replicas = list(kwargs.get("replica") or [])
+    if not replicas:
+        get_logger().warning(
+            "index route starting with an empty replica table — queries "
+            "will be refused (no_replicas) until a `fleet` join arrives"
+        )
+    cfg = RouterConfig(
+        index_loc=index_loc,
+        host=kwargs.get("host", "127.0.0.1") or "127.0.0.1",
+        port=int(kwargs.get("port", 0) or 0),
+        socket_path=kwargs.get("socket") or None,
+        max_batch=int(kwargs.get("max_batch", 64) or 64),
+        batch_window_ms=float(kwargs.get("batch_window_ms", 5.0) or 0.0),
+        poll_generation_s=float(kwargs.get("poll_generation_s", 2.0) or 2.0),
+        processes=int(kwargs.get("processes", 1) or 1),
+        prune_cfg={
+            "primary_prune": kwargs.get("primary_prune", "off") or "off",
+            "prune_bands": int(kwargs.get("prune_bands", 0) or 0),
+            "prune_min_shared": int(kwargs.get("prune_min_shared", 0) or 0),
+            "prune_join_chunk": int(kwargs.get("prune_join_chunk", 0) or 0),
+        },
+        log_dir=log_dir,
+        resident_mb=kwargs.get("resident_mb"),
+        replicas=replicas,
+        max_inflight=kwargs.get("max_inflight"),
+        leg_timeout_s=kwargs.get("leg_timeout_s"),
+        hedge_delay_s=kwargs.get("hedge_delay_s"),
+        probe_interval_s=float(kwargs.get("probe_interval_s", 1.0) or 1.0),
+        probe_backoff_s=kwargs.get("probe_backoff_s"),
+    )
+    server = RouterServer(cfg)
+    install_signal_handlers(server)
+    try:
+        return server.run()
+    finally:
+        stop_metrics_flush(final=bool(log_dir))
+        if log_dir:
+            counters.write(log_dir)
+        telemetry.close()
+
+
 def dereplicate_wrapper(wd_loc: str, genomes: list[str] | None = None, **kwargs) -> pd.DataFrame:
     """`dereplicate`: filter + cluster + choose + evaluate + analyze.
     Returns Wdb (the winners)."""

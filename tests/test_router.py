@@ -1,0 +1,721 @@
+"""Fleet front door (ISSUE 17, drep_tpu/serve/router.py): the router
+tier's acceptance contract.
+
+- THE oracle pin: fleet-routed verdicts — scatter/gather through scoped
+  replicas AND the forward fast path through unscoped ones — are
+  byte-identical FULL DICTS to a single `index serve` daemon's answers
+  over the same federated root (coverage stamps, generation and all);
+- replica containment one layer up from PR 14: a replica death
+  mid-traffic never raises out of the router — affected queries degrade
+  to stamped PARTIAL verdicts, strict clients are refused with
+  ``partial_coverage`` + retry_after_s, and a ``fleet`` join restores
+  byte-identical full coverage without a restart;
+- straggler hedging: a slow primary's forward is duplicated to a second
+  capable replica after ``hedge_delay_s``; the first answer wins and the
+  loser is discarded (no double merge — every query answers exactly
+  once);
+- overload spill: a draining replica's refusals spill the legs to an
+  honest PARTIAL instead of queueing behind it;
+- the replica table's healthy -> suspect -> ejected machine with
+  bounded-backoff reprobes, the ``fleet`` membership op, the
+  ``no_replicas`` refusal, and the ``classify_part``/``fleet`` wire
+  validation;
+- the router_leg / replica_health fault sites parse (and reject
+  nonsense specs), the router's env knobs are declared, and the
+  client's backpressure retry is jittered and surfaces the last refusal.
+
+Subprocess chaos cells (SIGKILL mid-scatter, generation-torn fan-out,
+overload spill under a saturated replica) live in
+tests/test_router_chaos.py (slow+chaos — chaos_matrix --router runs
+them by id). The P in {2, 5} oracle sweep is marked slow (two more
+federation builds; the tier-1 budget is knife-edge and P=3 covers both
+code paths).
+"""
+
+import contextlib
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.errors import UserInputError  # noqa: E402
+from drep_tpu.index import (  # noqa: E402
+    build_federated,
+    build_from_paths,
+    classify_batch,
+    load_resident_index,
+    sketch_queries,
+)
+from drep_tpu.serve import (  # noqa: E402
+    IndexServer,
+    ServeClient,
+    ServeConfig,
+    ServeError,
+    protocol,
+)
+from drep_tpu.serve.router import (  # noqa: E402
+    REPLICA_EJECTED,
+    REPLICA_HEALTHY,
+    REPLICA_SUSPECT,
+    ReplicaTable,
+    RouterConfig,
+    RouterServer,
+    parse_replica_spec,
+)
+
+# the test_fed_serve layout: P=3, groups split across partitions
+GROUPS = [3, 2, 2]
+SEED = 3
+
+
+# ---- units: replica specs + the replica table ------------------------------
+
+
+def test_parse_replica_spec():
+    assert parse_replica_spec("h:9001") == ("h:9001", None)
+    assert parse_replica_spec(" h:9001 = 0-2,5 ") == ("h:9001", frozenset({0, 1, 2, 5}))
+    assert parse_replica_spec("/tmp/r.sock=2") == ("/tmp/r.sock", frozenset({2}))
+    for bad in ("=0,1", "h:1=", "h:1=x", "h:1=0-z"):
+        with pytest.raises(UserInputError):
+            parse_replica_spec(bad)
+
+
+def test_replica_table_state_machine():
+    """healthy -> suspect (immediate reprobe) -> ejected (bounded
+    doubling backoff); one good probe resets everything and books a
+    recovery — the PR 14 partition machine, promoted to a process."""
+    t = ReplicaTable(["a:1"], probe_backoff_s=0.05, probe_max_s=0.2)
+    assert len(t) == 1 and t.usable()
+    slot = t.join("a:1")  # idempotent
+    assert slot.state == REPLICA_HEALTHY
+
+    t.book_failure("a:1", "boom")
+    assert slot.state == REPLICA_SUSPECT
+    # suspect is still routable (a blip is not an ejection) and its
+    # reprobe is immediate
+    assert t.usable()
+    assert [a for a, _s in t.probe_due(time.monotonic())] == ["a:1"]
+
+    t.book_failure("a:1", "boom again")
+    assert slot.state == REPLICA_EJECTED and not t.usable()
+    assert slot.backoff_s == 0.05
+    # not due until the backoff elapses; further failures double it to the cap
+    assert t.probe_due(slot.next_probe - 0.01) == []
+    assert t.probe_due(slot.next_probe) == [("a:1", REPLICA_EJECTED)]
+    t.book_failure("a:1", "still down")
+    assert slot.backoff_s == 0.1
+    t.book_failure("a:1", "still down")
+    t.book_failure("a:1", "still down")
+    assert slot.backoff_s == 0.2  # capped at probe_max_s
+    assert t.retry_hint_s() > 0
+
+    t.book_success("a:1", {"generation": 3, "n_genomes": 7, "queue_depth": 2,
+                           "draining": False, "partitions": {"partitions": {
+                               "0": {"resident": True}, "1": {"resident": False}}}})
+    assert slot.state == REPLICA_HEALTHY and slot.failures == 0
+    assert slot.recoveries == 1 and slot.backoff_s == 0.0
+    assert slot.generation == 3 and slot.queue_depth == 2
+    assert slot.resident == frozenset({0})
+
+    # leave: no new legs (not routable, not probed), record kept; a
+    # rejoin is routable again immediately
+    assert t.leave("a:1") and len(t) == 0 and not t.usable()
+    assert t.probe_due(time.monotonic()) == []
+    assert t.eligible(0) == []
+    assert not t.leave("ghost:9")
+    t.join("a:1")
+    assert t.usable() and t.eligible(0)[0].address == "a:1"
+
+    # lease/release: the in-flight load signal, floored at zero
+    t.lease("a:1")
+    t.lease("a:1")
+    assert t.health_map()["replicas"]["a:1"]["inflight"] == 2
+    t.release("a:1")
+    t.release("a:1")
+    t.release("a:1")
+    assert t.health_map()["replicas"]["a:1"]["inflight"] == 0
+
+
+def test_replica_table_routing_views():
+    """eligible() scopes by assignment and orders by sketch affinity
+    then load (queue_depth + leased in-flight); cover_targets() needs
+    the WHOLE candidate set covered — the forward fast path's filter."""
+    t = ReplicaTable(["a:1=0,1", "b:1=2", "c:1"], probe_backoff_s=0.1,
+                     probe_max_s=1.0)
+    assert {s.address for s in t.eligible(0)} == {"a:1", "c:1"}
+    assert {s.address for s in t.eligible(2)} == {"b:1", "c:1"}
+    # resident affinity beats load; load beats address
+    t.book_success("b:1", {"generation": 0, "queue_depth": 5, "draining": False,
+                           "partitions": {"partitions": {"2": {"resident": True}}}})
+    assert t.eligible(2)[0].address == "b:1"
+    # leased in-flight counts as load within a probe interval
+    for _ in range(3):
+        t.lease("c:1")
+    assert [s.address for s in t.cover_targets({0, 1})] == ["a:1", "c:1"]
+    assert {s.address for s in t.cover_targets({0, 2})} == {"c:1"}
+    assert [s.address for s in t.cover_targets({0, 1, 2})] == ["c:1"]
+    # a draining replica takes no new legs
+    t.book_success("a:1", {"generation": 0, "queue_depth": 0, "draining": True,
+                           "partitions": {}})
+    assert t.eligible(0)[0].address == "c:1"
+    assert [s.address for s in t.cover_targets({0, 1})] == ["c:1"]
+
+
+def test_fleet_wire_validation():
+    """classify_part / fleet requests are validated at the protocol
+    layer — a malformed leg must bounce before it touches the index."""
+    req = protocol.parse_request(
+        b'{"op": "classify_part", "pid": 2, "generation": 7,'
+        b' "names": ["query:a"], "bottoms": [[1, 2]], "prune": null}'
+    )
+    assert req["pid"] == 2 and req["bottoms"] == [[1, 2]]
+    fl = protocol.parse_request(
+        b'{"op": "fleet", "action": "join", "address": "h:1", "partitions": [0, 2]}'
+    )
+    assert fl["action"] == "join" and fl["partitions"] == [0, 2]
+    for bad in (
+        b'{"op": "classify_part", "pid": true, "generation": 0, "names": ["a"], "bottoms": [[1]]}',
+        b'{"op": "classify_part", "pid": 0, "names": ["a"], "bottoms": [[1]]}',
+        b'{"op": "classify_part", "pid": 0, "generation": 0, "names": [], "bottoms": []}',
+        b'{"op": "classify_part", "pid": 0, "generation": 0, "names": ["a"], "bottoms": [[1], [2]]}',
+        b'{"op": "classify_part", "pid": 0, "generation": 0, "names": ["a"], "bottoms": [[1]], "prune": "lsh"}',
+        b'{"op": "fleet", "action": "evict", "address": "h:1"}',
+        b'{"op": "fleet", "action": "join", "address": ""}',
+        b'{"op": "fleet", "action": "join", "address": "h:1", "partitions": [true]}',
+    ):
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_request(bad)
+
+
+def test_router_fault_sites_and_knobs():
+    """router_leg / replica_health exist in the fault registry with sane
+    spec validation, and the router's env knobs are declared (the
+    drep-lint coverage contract)."""
+    from drep_tpu.utils import envknobs, faults
+
+    faults.configure("router_leg:raise:0.5:seed=1")
+    faults.configure("router_leg:hang:secs=0.01")
+    faults.configure("replica_health:raise:1.0:max=2")
+    faults.configure("router_leg:sleep:secs=0.01,replica_health:raise")
+    for bad in (
+        "router_leg:torn",  # torn is shard_write-only
+        "replica_health:drain",  # drain fires at the death sites only
+        "router_leg:io_error",  # io modes live on the io site
+        "replica_health:raise:path=part_000",  # no path at compute sites
+    ):
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure(bad)
+    faults.configure(None)
+    for name, kind in (
+        ("DREP_TPU_ROUTER_LEG_TIMEOUT_S", "float"),
+        ("DREP_TPU_ROUTER_HEDGE_DELAY_S", "float"),
+        ("DREP_TPU_ROUTER_PROBE_BACKOFF_S", "float"),
+        ("DREP_TPU_ROUTER_MAX_INFLIGHT", "int"),
+    ):
+        assert envknobs.knob(name).kind == kind
+    assert envknobs.env_float("DREP_TPU_ROUTER_LEG_TIMEOUT_S") == 30.0
+    assert envknobs.env_float("DREP_TPU_ROUTER_HEDGE_DELAY_S") == 2.0
+    assert envknobs.env_int("DREP_TPU_ROUTER_MAX_INFLIGHT") == 256
+
+
+# ---- units: the client's refusal retry loop --------------------------------
+
+
+class _StubDaemon:
+    """A line server speaking just enough protocol to script refusal
+    sequences — no index, no JAX."""
+
+    def __init__(self, script):
+        # script: list of dicts to answer successive requests with; a
+        # None entry means "read the request, answer nothing" (the
+        # unresponsive-daemon case the surfaced-timeout contract covers)
+        self.script = list(script)
+        self.requests: list[dict] = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.address = "127.0.0.1:%d" % self._srv.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        conn, _ = self._srv.accept()
+        self._conn = conn  # held open: "silent" must not read as EOF
+        reader = conn.makefile("rb")
+        while True:
+            line = reader.readline()
+            if not line:
+                return
+            self.requests.append(json.loads(line))
+            resp = self.script.pop(0) if self.script else None
+            if resp is None:
+                continue  # go silent: the client's socket timeout fires
+            conn.sendall(json.dumps(resp).encode() + b"\n")
+
+    def close(self):
+        self._srv.close()
+        conn = getattr(self, "_conn", None)
+        if conn is not None:
+            conn.close()
+
+
+def test_client_retry_honors_hint_with_jitter(monkeypatch):
+    """The satellite contract: the client's backoff sleeps a JITTERED
+    multiple (0.5x-1.5x) of the daemon's own retry_after_s hint — a
+    refused herd must not re-arrive in lockstep."""
+    hint = 0.8
+    refusal = {"ok": False, "error": "full", "reason": "backpressure",
+               "retry_after_s": hint}
+    stub = _StubDaemon([refusal, refusal, {"ok": True, "verdict": {"genome": "q"},
+                                           "generation": 0}])
+    slept: list[float] = []
+    import types
+
+    import drep_tpu.serve.client as client_mod
+
+    # shim the client module's `time` binding only — a global
+    # time.sleep patch would reach every daemon thread in the process
+    monkeypatch.setattr(
+        client_mod, "time", types.SimpleNamespace(sleep=slept.append)
+    )
+    try:
+        with ServeClient(stub.address, timeout_s=30) as c:
+            resp = c.classify("/q.fa", retries=3)
+        assert resp["ok"]
+        assert len(slept) == 2
+        for s in slept:
+            assert 0.5 * hint <= s <= 1.5 * hint
+    finally:
+        stub.close()
+
+
+def test_client_timeout_surfaces_last_refusal():
+    """A timeout mid-retry surfaces the LAST refusal (reason + hint),
+    not a bare socket timeout — 'backpressure after N attempts' is
+    actionable, 'timed out' is not."""
+    refusal = {"ok": False, "error": "queue full", "reason": "backpressure",
+               "retry_after_s": 0.01}
+    stub = _StubDaemon([refusal, None])
+    try:
+        with pytest.raises(ServeError) as ei:
+            with ServeClient(stub.address, timeout_s=0.5) as c:
+                c.classify("/q.fa", retries=3)
+        assert ei.value.reason == "backpressure"
+        assert ei.value.retry_after_s == 0.01
+        assert "1 retried refusal" in str(ei.value)
+    finally:
+        stub.close()
+
+
+# ---- units: fleet autoscaling maps onto the UNCHANGED policy ---------------
+
+
+def _router_status(replicas: dict) -> dict:
+    return {"replicas": {"replicas": replicas, "suspect": [], "ejected": []}}
+
+
+def _rep(assigned, state="healthy", queue_depth=0, draining=False):
+    return {"state": state, "assigned": assigned, "queue_depth": queue_depth,
+            "draining": draining}
+
+
+def test_decide_fleet_maps_serving_onto_policy():
+    """The fleet follow-on: per-partition-range synthetic snapshots +
+    a ROLLING deadline feed the exact batch decide() — scale-up on a
+    queueing-delay miss, per-range cooldown isolation, draining and
+    ejected capacity excluded."""
+    from drep_tpu.autoscale.fleet import decide_fleet, fleet_snapshots, range_key
+    from drep_tpu.autoscale.policy import Targets
+
+    assert range_key(None) == "all"
+    assert range_key([2, 0, 1]) == "0,1,2"
+    assert range_key(frozenset({1})) == "1"
+
+    status = _router_status({
+        "a:1": _rep([0, 1], queue_depth=10),
+        "b:1": _rep([0, 1], state="suspect", queue_depth=10),
+        "c:1": _rep([2], queue_depth=0),
+        "d:1": _rep([2], draining=True),  # capacity leaving, not arriving
+        "e:1": _rep([2], state="ejected", queue_depth=99),
+        "f:1": _rep(None, state="left"),
+    })
+    now = 1000.0
+    snaps = fleet_snapshots(status, observed_at=now, svc_s=1.0)
+    assert set(snaps) == {"0,1", "2", "all"}
+    assert snaps["0,1"]["live"] == ["a:1", "b:1"]  # suspect still serves
+    assert snaps["0,1"]["queue_total"] == 20
+    assert snaps["0,1"]["eta_s"] == 10.0  # 20 queued * 1 s/q / 2 replicas
+    assert snaps["0,1"]["shards_total"] is None  # serving never finishes
+    assert snaps["0,1"]["pending_joins"] == []
+    assert snaps["2"]["live"] == ["c:1"] and snaps["2"]["eta_s"] == 0.0
+    assert snaps["all"]["live"] == [] and snaps["all"]["eta_s"] is None
+
+    targets = Targets(deadline_at=None, max_procs=4, cooldown_s=30.0,
+                      hysteresis=0.1, max_spawn=2)
+    decisions = decide_fleet(status, now, targets, queue_deadline_s=5.0,
+                             svc_s=1.0, history={})
+    # range 0,1: 10s projected queueing delay misses the 5s target
+    assert decisions["0,1"].verdict == "scale_up" and decisions["0,1"].delta >= 1
+    assert decisions["2"].verdict == "hold"  # delay comfortably met
+    assert decisions["all"].verdict == "hold"
+    assert decisions["all"].reason == "no-live-members"
+
+    # cooldown history is KEYED BY RANGE: a fresh scale-up for 0,1
+    # gates 0,1 only — range 2 still decides on its own merits
+    hist = {"0,1": [{"at": now - 1.0, "verdict": "scale_up", "delta": 1}]}
+    gated = decide_fleet(status, now, targets, queue_deadline_s=5.0,
+                         svc_s=1.0, history=hist)
+    assert gated["0,1"].verdict == "hold" and gated["0,1"].reason == "cooldown"
+    assert gated["2"].verdict == "hold" and gated["2"].reason != "cooldown"
+
+    # a dead-router snapshot holds with the policy's own error verdict
+    from drep_tpu.autoscale.policy import decide
+
+    assert decide({"error": "router unreachable"}, targets, []).reason == "snapshot-error"
+
+
+# ---- in-process fleet integration ------------------------------------------
+
+
+def _strip(verdict: dict) -> dict:
+    out = dict(verdict)
+    out.pop("partitions_consulted", None)
+    out.pop("partitions_unavailable", None)
+    out.pop("partial", None)
+    return out
+
+
+def _start_replica(loc, classify_fn=None, **over):
+    over.setdefault("batch_window_ms", 20.0)
+    over.setdefault("max_batch", 16)
+    over.setdefault("poll_generation_s", 60.0)
+    cfg = ServeConfig(index_loc=loc, **over)
+    srv = IndexServer(cfg, classify_fn=classify_fn)
+    addr = srv.start()
+    t = threading.Thread(target=srv.serve_batches, daemon=True)
+    t.start()
+    return srv, addr, t
+
+
+def _start_router(loc, replicas, **over):
+    over.setdefault("batch_window_ms", 20.0)
+    over.setdefault("max_batch", 16)
+    over.setdefault("poll_generation_s", 60.0)
+    # compile of a replica's first-ever classify takes longer than the
+    # default hedge window — keep hedging/timeouts out of the way unless
+    # a test is ABOUT them
+    over.setdefault("leg_timeout_s", 120.0)
+    over.setdefault("hedge_delay_s", 60.0)
+    over.setdefault("probe_interval_s", 0.2)
+    over.setdefault("probe_backoff_s", 0.2)
+    over.setdefault("probe_max_s", 0.5)
+    cfg = RouterConfig(index_loc=loc, replicas=list(replicas), **over)
+    srv = RouterServer(cfg)
+    addr = srv.start()
+    t = threading.Thread(target=srv.serve_batches, daemon=True)
+    t.start()
+    return srv, addr, t
+
+
+def _stop(srv, t):
+    try:
+        srv.request_drain()
+    finally:
+        srv.queue.drain()
+        t.join(timeout=60)
+        srv.close()
+
+
+def _abrupt_kill(srv):
+    """In-process stand-in for SIGKILL. ``close()`` alone is not
+    abrupt enough: the accept thread blocked in ``accept()`` keeps the
+    listening socket's open file description ALIVE in the kernel, so
+    new connections still land and get served. ``shutdown()`` wakes the
+    blocked accept, the loop exits, and the port genuinely refuses."""
+    with contextlib.suppress(OSError):
+        srv._listener.shutdown(socket.SHUT_RDWR)
+    srv.close()
+    srv.queue.drain()  # let the orphaned batch loop exit for cleanup
+
+
+@pytest.fixture(scope="module")
+def fleet_store(tmp_path_factory):
+    """One shared P=3 federation + a 4-query hot set spanning groups
+    (incl. a novel genome), plus the single-daemon ORACLE: the exact
+    responses a plain `index serve` daemon gives for the same queries —
+    the byte-identity baseline every routed test compares against."""
+    td = tmp_path_factory.mktemp("fleet")
+    paths = lib.write_genome_set(str(td / "g"), GROUPS, seed=SEED)
+    loc = str(td / "fed")
+    build_federated(loc, paths, 3, length=0)
+    novel = lib.write_genome_set(str(td / "q"), [1], seed=97, prefix="novel")
+    queries = [paths[0], paths[1], paths[3]] + novel
+    srv, addr, t = _start_replica(loc)
+    try:
+        with ServeClient(addr, timeout_s=600) as c:
+            resps = c.classify_many(queries)
+        assert all(r.get("ok") for r in resps), resps
+        oracle = {q: r["verdict"] for q, r in zip(queries, resps)}
+    finally:
+        _stop(srv, t)
+    return loc, paths, queries, oracle
+
+
+def test_scatter_oracle_and_replica_loss_containment(fleet_store):
+    """THE tentpole pin, scatter path: a scoped split (no replica covers
+    every candidate partition) forces full scatter/gather, and the
+    routed verdicts are byte-identical FULL DICTS to the single-daemon
+    oracle. Then the sole replica for one partition dies mid-traffic:
+    nothing raises out of the router — affected queries degrade to
+    stamped PARTIAL verdicts, strict clients are refused with
+    retry_after_s, and a `fleet` join of a replacement restores
+    byte-identical full coverage."""
+    loc, _paths, queries, oracle = fleet_store
+    r1, a1, t1 = _start_replica(loc)
+    r2, a2, t2 = _start_replica(loc)
+    rt, ra, trt = _start_router(loc, [f"{a1}=0,1", f"{a2}=2"])
+    r3 = t3 = None
+    try:
+        with ServeClient(ra, timeout_s=600) as c:
+            resps = c.classify_many(queries)
+            for q, r in zip(queries, resps):
+                assert r.get("ok"), r
+                assert r["verdict"] == oracle[q], q  # stamps and all
+            snap = rt.snapshot()
+            assert snap["role"] == "router"
+            stats = snap["router"]
+            assert stats["scattered"] >= 1 and stats["leg_failures"] == 0
+            assert stats["legs_total"] >= 3  # one leg per candidate partition
+
+            # kill the sole partition-2 replica ABRUPTLY (no drain, no
+            # leave): the next gather's pid-2 leg fails, the router
+            # contains it as an honest PARTIAL
+            _abrupt_kill(r2)
+            r = c.classify(queries[0])
+            assert r["ok"], r  # replica death NEVER raises out of the router
+            assert r["verdict"]["partial"] is True
+            assert 2 in r["verdict"]["partitions_unavailable"]
+            assert 2 not in r["verdict"]["partitions_consulted"]
+            with pytest.raises(ServeError) as ei:
+                c.classify(queries[0], strict=True)
+            assert ei.value.reason == "partial_coverage"
+            assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+            assert rt.snapshot()["router"]["partial_verdicts"] >= 1
+
+            # a replacement joins mid-traffic via the fleet op: full
+            # coverage returns, byte-identical to the oracle again
+            r3, a3, t3 = _start_replica(loc)
+            jr = c.request({"op": "fleet", "action": "join", "address": a3,
+                            "partitions": [2]})
+            assert jr["ok"] and jr["replicas"] == 3
+            r = c.classify(queries[0])
+            assert r["ok"] and r["verdict"] == oracle[queries[0]]
+            health = rt.snapshot()["replicas"]["replicas"]
+            assert health[a3]["state"] == "healthy"
+            assert health[a2]["state"] in (REPLICA_SUSPECT, REPLICA_EJECTED)
+    finally:
+        for srv, t in ((rt, trt), (r1, t1), (r3, t3)):
+            if srv is not None:
+                _stop(srv, t)
+        r2.queue.drain()
+        t2.join(timeout=60)
+
+
+def test_forward_fast_path_oracle_and_sketch_cache(fleet_store):
+    """The forward fast path: unscoped replicas cover every candidate
+    set, so whole queries forward as plain classifies (zero scatter) —
+    verdicts byte-identical to the single-daemon oracle. A second round
+    over the same hot set answers from the router's sketch cache,
+    byte-identical again; the fleet op's leave keeps serving on the
+    remaining replica and a plain daemon refuses the op outright."""
+    loc, _paths, queries, oracle = fleet_store
+    r1, a1, t1 = _start_replica(loc)
+    r2, a2, t2 = _start_replica(loc)
+    rt, ra, trt = _start_router(loc, [a1, a2])
+    try:
+        with ServeClient(ra, timeout_s=600) as c:
+            for _round in (1, 2):  # round 2 rides the sketch cache
+                resps = c.classify_many(queries)
+                for q, r in zip(queries, resps):
+                    assert r.get("ok"), r
+                    assert r["verdict"] == oracle[q], (q, _round)
+            stats = rt.snapshot()["router"]
+            assert stats["forwarded"] == 2 * len(queries)
+            assert stats["scattered"] == 0
+            assert len(rt._sketch_cache) == len(queries)
+
+            # leave one replica mid-traffic: no dropped query, the
+            # survivor answers alone
+            lr = c.request({"op": "fleet", "action": "leave", "address": a1})
+            assert lr["ok"] and lr["known"] and lr["replicas"] == 1
+            assert not c.request({"op": "fleet", "action": "leave",
+                                  "address": "ghost:1"})["known"]
+            r = c.classify(queries[0])
+            assert r["ok"] and r["verdict"] == oracle[queries[0]]
+        # a plain daemon is not a router: the fleet op refuses honestly
+        with ServeClient(a2, timeout_s=30) as rc:
+            resp = rc.request({"op": "fleet", "action": "join",
+                               "address": "h:1", "partitions": None})
+            assert not resp["ok"] and resp["reason"] == "not_a_router"
+    finally:
+        for srv, t in ((rt, trt), (r1, t1), (r2, t2)):
+            _stop(srv, t)
+
+
+def test_hedged_forward_race_first_answer_wins(fleet_store):
+    """Straggler hedging: the primary replica stalls, the hedge window
+    elapses, a duplicate goes to the second capable replica and ITS
+    answer wins — the loser is discarded without a double merge (every
+    query answers exactly once). Stub classify cores make the stall
+    deterministic; the router still sketches and routes for real."""
+    loc, _paths, queries, _oracle = fleet_store
+    flags = {"a": threading.Event(), "b": threading.Event()}
+
+    def mk_stub(key, tag):
+        def classify(resident, paths):
+            if flags[key].is_set():
+                time.sleep(2.0)
+            return {os.path.basename(p): {"genome": os.path.basename(p),
+                                          "stub": tag,
+                                          "generation": int(resident.generation)}
+                    for p in paths}
+        return classify
+
+    ra_srv, aa, ta = _start_replica(loc, classify_fn=mk_stub("a", "A"))
+    rb_srv, ab, tb = _start_replica(loc, classify_fn=mk_stub("b", "B"))
+    # the router breaks load ties by affinity order (address ascending
+    # here): stall whichever replica it will pick FIRST
+    slow_addr = min(aa, ab)
+    flags["a" if slow_addr == aa else "b"].set()
+    fast_tag = "B" if slow_addr == aa else "A"
+    rt, ra, trt = _start_router(loc, [aa, ab], hedge_delay_s=0.3,
+                                leg_timeout_s=60.0)
+    try:
+        with ServeClient(ra, timeout_s=600) as c:
+            resp = c.classify(queries[0])
+            assert resp["ok"]
+            assert resp["verdict"]["stub"] == fast_tag  # the hedge won
+            stats = rt.snapshot()["router"]
+            assert stats["hedges"] >= 1 and stats["hedge_wins"] >= 1
+            assert stats["forwarded"] == 1 and stats["scattered"] == 0
+            # no double merge: a second query still answers exactly once
+            resps = c.classify_many(queries[:2])
+            assert len(resps) == 2 and all(r["ok"] for r in resps)
+    finally:
+        for srv, t in ((rt, trt), (ra_srv, ta), (rb_srv, tb)):
+            _stop(srv, t)
+
+
+def test_overload_spill_on_draining_replica(fleet_store):
+    """Overload spill: every leg of a gather hits the sole replica's
+    draining refusals — the router NEVER queues behind it; the legs
+    spill to an honest all-partitions-unavailable PARTIAL (strict:
+    refused) and the spill is counted."""
+    loc, _paths, queries, _oracle = fleet_store
+    r1, a1, t1 = _start_replica(loc)
+    # probe interval long enough that the router never LEARNS of the
+    # drain through /healthz — the refusals themselves must spill
+    rt, ra, trt = _start_router(loc, [a1], probe_interval_s=60.0)
+    try:
+        # queue-level drain ONLY: request_drain() would also close the
+        # listener, turning the refusals this test is about into plain
+        # connection failures — here the replica still answers, and
+        # every answer is a draining refusal the legs must spill on
+        r1.queue.drain()
+        with ServeClient(ra, timeout_s=600) as c:
+            r = c.classify(queries[0])
+            assert r["ok"], r
+            assert r["verdict"]["partial"] is True
+            assert r["verdict"]["partitions_consulted"] == []
+            assert set(r["verdict"]["partitions_unavailable"]) == {0, 1, 2}
+            with pytest.raises(ServeError) as ei:
+                c.classify(queries[0], strict=True)
+            assert ei.value.reason == "partial_coverage"
+            stats = rt.snapshot()["router"]
+            assert stats["overload_spills"] >= 1
+            assert stats["partial_verdicts"] >= 1
+    finally:
+        _stop(rt, trt)
+        r1.queue.drain()
+        t1.join(timeout=60)
+        r1.close()
+
+
+def test_no_usable_replica_refusal(fleet_store):
+    """With every replica ejected the router refuses honestly —
+    reason=no_replicas with the soonest-reprobe retry hint — instead of
+    hanging or crashing."""
+    loc, _paths, queries, _oracle = fleet_store
+    # nothing listens on the discard port: every probe fails fast
+    rt, ra, trt = _start_router(loc, ["127.0.0.1:9"], probe_interval_s=0.05,
+                                probe_backoff_s=0.1, probe_max_s=0.2)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            health = rt.snapshot()["replicas"]["replicas"]
+            if health["127.0.0.1:9"]["state"] == REPLICA_EJECTED:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"replica never ejected: {health}")
+        with ServeClient(ra, timeout_s=600) as c:
+            with pytest.raises(ServeError) as ei:
+                c.classify(queries[0])
+        assert ei.value.reason == "no_replicas"
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+    finally:
+        _stop(rt, trt)
+
+
+def test_router_requires_federated_root(tmp_path):
+    """`index route` over a monolithic store refuses with an actionable
+    message — the router scatters per-partition legs; a monolithic index
+    has nothing to scatter."""
+    paths = lib.write_genome_set(str(tmp_path / "g"), [2], seed=11)
+    loc = str(tmp_path / "mono")
+    build_from_paths(loc, paths, length=0)
+    cfg = RouterConfig(index_loc=loc, replicas=["127.0.0.1:9"])
+    with pytest.raises(UserInputError, match="FEDERATED"):
+        RouterServer(cfg).start()
+
+
+@pytest.mark.slow  # two more federation builds + oracles; P=3 above is
+# the tier-1 representative (the budget sits at the 870s knife edge).
+# With P=3 there, the acceptance's {2,3,5} x prune on/off grid closes.
+@pytest.mark.parametrize("partitions", [2, 5])
+def test_router_oracle_more_partition_counts(tmp_path, fleet_store, partitions):
+    loc0, paths, queries, _oracle = fleet_store
+    loc = str(tmp_path / "fed")
+    build_federated(loc, paths, partitions, length=0)
+    fed = load_resident_index(loc)
+    half = partitions // 2
+    lo = ",".join(str(p) for p in range(half + 1))
+    hi = ",".join(str(p) for p in range(half, partitions))
+    r1, a1, t1 = _start_replica(loc)
+    r2, a2, t2 = _start_replica(loc)
+    rt = ra = trt = None
+    try:
+        for prune in (None, {"primary_prune": "lsh"}):
+            want = classify_batch(
+                fed, sketch_queries(fed, queries), prune_cfg=prune, joint=False
+            )
+            if rt is not None:
+                _stop(rt, trt)
+            rt, ra, trt = _start_router(
+                loc, [f"{a1}={lo}", f"{a2}={hi}"], prune_cfg=prune
+            )
+            with ServeClient(ra, timeout_s=600) as c:
+                resps = c.classify_many(queries)
+            for w, r in zip(want, resps):
+                assert r.get("ok"), r
+                assert r["verdict"] == w, (partitions, prune, w["genome"])
+    finally:
+        for srv, t in ((rt, trt), (r1, t1), (r2, t2)):
+            if srv is not None:
+                _stop(srv, t)

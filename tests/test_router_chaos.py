@@ -1,0 +1,349 @@
+"""Fleet-tier chaos cells for the scatter/gather router (ISSUE 17,
+`tools/chaos_matrix.py --router`).
+
+Each cell runs the REAL `index route` daemon as a subprocess in front
+of real `index serve` replica subprocesses over a federated root, and
+pins the acceptance contract of the fleet front door:
+
+- SIGKILL a replica under live routed traffic -> the router stays up,
+  queries needing the dead replica's partitions degrade to stamped
+  PARTIAL verdicts (strict clients refused with retry_after_s), and a
+  replacement replica joining via the ``fleet`` op restores verdicts
+  byte-identical to the single-process oracle — no router restart.
+- A generation swap landing under the fleet mid-traffic -> scatter legs
+  refuse the stale fan-out (generation fence), the router reloads its
+  spine synchronously, and the re-scattered gather converges on the new
+  generation's oracle — never a silent mixed-generation merge.
+- A saturated replica entering SIGTERM drain -> the router spills the
+  overload as an honest PARTIAL (overload_spills booked) instead of
+  queueing behind the drain, while the replica finishes its in-flight
+  query and exits 0 — no dropped work anywhere.
+
+Marked slow+chaos: each cell pays several subprocesses (full JAX
+imports) and the tier-1 budget sits at the 870s knife edge —
+chaos_matrix runs them by test id, like the PR 13/14 cells.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import (  # noqa: E402
+    build_federated, index_classify, index_update, load_resident_index,
+)
+from drep_tpu.serve import ServeClient, ServeError  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+P = 3
+
+
+def _strip(verdict: dict) -> dict:
+    out = dict(verdict)
+    out.pop("partitions_consulted", None)
+    out.pop("partitions_unavailable", None)
+    out.pop("partial", None)
+    return out
+
+
+def _build(tmp_path):
+    """The test_fed_serve layout: P=3, groups split across partitions."""
+    paths = lib.write_genome_set(str(tmp_path / "g"), [3, 2, 2], seed=3)
+    loc = str(tmp_path / "fed")
+    build_federated(loc, paths, P, length=0)
+    fed = load_resident_index(loc)
+    victim_pid = int(fed.part_of[fed.names.index(os.path.basename(paths[0]))])
+    return loc, paths, victim_pid
+
+
+def _env(extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               DREP_TPU_SERVE_PROBE_BACKOFF_S="0.2",
+               DREP_TPU_SERVE_PROBE_MAX_S="0.5",
+               DREP_TPU_ROUTER_PROBE_BACKOFF_S="0.2")
+    env.update(extra or {})
+    return env
+
+
+def _spawn(argv, extra_env=None):
+    """Spawn one daemon (`index serve` or `index route`) and parse its
+    machine-readable ready line."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "drep_tpu"] + argv,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=_env(extra_env),
+    )
+    line = proc.stdout.readline()
+    assert line, "daemon died before its ready line"
+    return proc, json.loads(line)
+
+
+def _spawn_replica(loc, extra=(), extra_env=None):
+    return _spawn(
+        ["index", "serve", loc, "--batch_window_ms", "20"] + list(extra),
+        extra_env,
+    )
+
+
+def _spawn_router(loc, log_dir, replicas, extra=()):
+    argv = ["index", "route", loc, "--batch_window_ms", "20",
+            "--events", "on", "--log_dir", log_dir]
+    for spec in replicas:
+        argv += ["--replica", spec]
+    return _spawn(argv + list(extra))
+
+
+def _events(log_dir):
+    out = []
+    for fn in sorted(os.listdir(log_dir)):
+        if fn.startswith("events.p") and fn.endswith(".jsonl"):
+            with open(os.path.join(log_dir, fn)) as f:
+                for ln in f:
+                    if ln.strip():
+                        try:
+                            out.append(json.loads(ln))
+                        except ValueError:
+                            pass  # torn final line: expected crash evidence
+    return out
+
+
+def _classify_until(c, path, pred, deadline_s=120, strict=False):
+    """Poll a classify until `pred(resp)` holds (probe backoffs make the
+    exact containment/recovery instant timing-dependent)."""
+    deadline = time.monotonic() + deadline_s
+    resp = None
+    while time.monotonic() < deadline:
+        resp = c.classify(path, strict=strict)
+        if pred(resp):
+            return resp
+        time.sleep(0.2)
+    raise AssertionError(f"condition never held; last response: {resp}")
+
+
+def _reap(*procs):
+    for proc in procs:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+
+def test_sigkill_replica_mid_scatter_partial_contained(tmp_path):
+    """SIGKILL the replica holding one partition under routed traffic:
+    the router survives, stamps honest PARTIAL verdicts scoped to the
+    dead replica's partitions (strict -> partial_coverage refusal), and
+    a replacement joining via the `fleet` op restores byte-identical
+    full-coverage verdicts — the router is never restarted."""
+    loc, paths, victim_pid = _build(tmp_path)
+    complement = [p for p in range(P) if p != victim_pid]
+    oracle = index_classify(loc, [paths[0]])[0]
+    log_dir = str(tmp_path / "route_log")
+    os.makedirs(log_dir)
+
+    r_victim, rv_ready = _spawn_replica(loc)
+    r_other, ro_ready = _spawn_replica(loc)
+    router, rt_ready = _spawn_router(
+        loc, log_dir,
+        [f"{rv_ready['serving']}={victim_pid}",
+         f"{ro_ready['serving']}={','.join(str(p) for p in complement)}"],
+        ["--probe_interval_s", "0.3",
+         "--leg_timeout_s", "30", "--hedge_delay_s", "30"],
+    )
+    r_victim2 = None
+    try:
+        with ServeClient(rt_ready["serving"], timeout_s=600) as c:
+            # healthy fleet: routed verdict == the single-process oracle
+            r = c.classify(paths[0])
+            assert r["ok"] and not r["verdict"].get("partial")
+            assert _strip(r["verdict"]) == oracle
+
+            r_victim.kill()  # SIGKILL: no drain, no goodbye
+            r_victim.wait(timeout=60)
+            rp = _classify_until(
+                c, paths[0],
+                lambda r: r["ok"]
+                and victim_pid in (r["verdict"].get("partitions_unavailable") or []),
+            )
+            v = rp["verdict"]
+            assert v["partial"] is True
+            assert victim_pid not in v["partitions_consulted"]
+            assert set(v["partitions_consulted"]) <= set(complement)
+            assert router.poll() is None, "router died on replica loss"
+            with pytest.raises(ServeError) as ei:
+                c.classify(paths[0], strict=True)
+            assert ei.value.reason == "partial_coverage"
+            assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+
+            # replacement replica joins mid-traffic: coverage restored
+            r_victim2, rv2_ready = _spawn_replica(loc)
+            jr = c.request({
+                "op": "fleet", "action": "join",
+                "address": rv2_ready["serving"],
+                "partitions": [victim_pid],
+            })
+            assert jr["ok"] and jr["known"]
+            r2 = _classify_until(
+                c, paths[0],
+                lambda r: r["ok"]
+                and not r["verdict"].get("partitions_unavailable"),
+            )
+            assert _strip(r2["verdict"]) == oracle
+            st = c.status()
+            assert st["router"]["leg_failures"] >= 1
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=120) == 0
+        for proc in (r_other, r_victim2):
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=120) == 0
+    finally:
+        _reap(router, r_victim, r_other, r_victim2)
+    evs = [e["ev"] for e in _events(log_dir)]
+    assert "replica_suspect" in evs
+    assert "fleet_join" in evs
+
+
+def test_generation_torn_fanout_fence_converges(tmp_path):
+    """A generation swap lands under the fleet while the router still
+    holds the old spine: scatter legs refuse the stale fan-out, the
+    generation fence reloads the router's resident synchronously, and
+    the re-scattered gather converges on the NEW generation's oracle —
+    never a silent merge of mixed-generation edges."""
+    loc, paths, _victim_pid = _build(tmp_path)
+    log_dir = str(tmp_path / "route_log")
+    os.makedirs(log_dir)
+
+    # scoped split: no replica covers every partition, so the query
+    # fans out as scatter legs (the fenced path under test)
+    r_lo, lo_ready = _spawn_replica(loc, ["--poll_generation_s", "0.2"])
+    r_hi, hi_ready = _spawn_replica(loc, ["--poll_generation_s", "0.2"])
+    router, rt_ready = _spawn_router(
+        loc, log_dir,
+        [f"{lo_ready['serving']}=0,1", f"{hi_ready['serving']}=2"],
+        ["--poll_generation_s", "600",  # only the fence can move it
+         "--probe_interval_s", "0.3",
+         "--leg_timeout_s", "60", "--hedge_delay_s", "60"],
+    )
+    try:
+        with ServeClient(rt_ready["serving"], timeout_s=600) as c:
+            r0 = c.classify(paths[0])
+            assert r0["ok"] and r0["verdict"]["generation"] == 0
+
+            # publish generation 1 beside the live fleet, then wait for
+            # every replica's own poller to hot-swap onto it
+            new = lib.write_genome_set(
+                str(tmp_path / "g2"), [2], seed=31, prefix="n"
+            )
+            index_update(loc, new)
+            for ready in (lo_ready, hi_ready):
+                with ServeClient(ready["serving"], timeout_s=120) as rc:
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        if int(rc.status()["generation"]) >= 1:
+                            break
+                        time.sleep(0.2)
+                    else:
+                        raise AssertionError("replica never swapped to gen 1")
+            oracle_post = index_classify(loc, [paths[0]])[0]
+
+            # the router is still at gen 0: its next scatter must fence
+            r1 = c.classify(paths[0])
+            assert r1["ok"], r1
+            assert r1["verdict"]["generation"] == 1
+            assert not r1["verdict"].get("partitions_unavailable")
+            assert _strip(r1["verdict"]) == oracle_post
+            st = c.status()
+            assert int(st["generation"]) == 1
+            assert st["router"]["fence_reloads"] >= 1
+            assert st["router"]["fence_retries"] >= 1
+        for proc in (router, r_lo, r_hi):
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=120) == 0
+    finally:
+        _reap(router, r_lo, r_hi)
+    swaps = [e for e in _events(log_dir) if e["ev"] == "generation_swap"]
+    assert any((e.get("args") or {}).get("fenced") for e in swaps), swaps
+
+
+def test_overload_spill_under_saturated_replica(tmp_path):
+    """SIGTERM-drain the fleet's only replica while it grinds a slow
+    in-flight query (paced by an injected partition_classify sleep): the
+    router answers IMMEDIATELY with an honest all-partitions PARTIAL
+    instead of queueing behind the multi-second drain, strict clients
+    get an honest refusal, the replica still finishes the admitted query
+    and exits 0 — no dropped work on either side of the front door.
+
+    Which refusal class the legs see is a kernel-level race on the
+    drain's listener teardown: a leg landing on the last accepted
+    connection gets a ``draining`` refusal (booked as an overload
+    spill), one landing after gets connection-refused (booked as a leg
+    failure, ejecting the replica, so a later strict classify refuses
+    ``no_replicas`` instead of ``partial_coverage``). Both are
+    contained; the deterministic spill count is pinned in-process by
+    tests/test_router.py::test_overload_spill_on_draining_replica."""
+    loc, paths, _victim_pid = _build(tmp_path)
+    log_dir = str(tmp_path / "route_log")
+    os.makedirs(log_dir)
+
+    r1, r1_ready = _spawn_replica(
+        loc, extra_env={"DREP_TPU_FAULTS": "partition_classify:sleep:secs=6"}
+    )
+    router, rt_ready = _spawn_router(
+        loc, log_dir, [r1_ready["serving"]],
+        ["--probe_interval_s", "30",  # the refusals themselves must spill
+         "--leg_timeout_s", "30", "--hedge_delay_s", "30"],
+    )
+    bg: dict = {}
+    try:
+        with ServeClient(rt_ready["serving"], timeout_s=600) as c:
+            # warm the router's sketch cache + compiles while the fleet
+            # is healthy, so the drain-window classify below is instant
+            warm = c.classify(paths[0])
+            assert warm["ok"] and not warm["verdict"].get("partial")
+
+            def _occupy():
+                with ServeClient(r1_ready["serving"], timeout_s=600) as rc:
+                    bg["resp"] = rc.classify(paths[1])
+
+            t = threading.Thread(target=_occupy, daemon=True)
+            t.start()
+            time.sleep(2.0)  # the slow query is admitted + grinding
+            r1.send_signal(signal.SIGTERM)  # drain: in-flight finishes
+
+            # the drain window is long (3 x 6s injected sleeps): the
+            # router must answer NOW, not queue behind the drain
+            t0 = time.monotonic()
+            r = c.classify(paths[0])
+            assert time.monotonic() - t0 < 10.0, "queued behind the drain"
+            assert r["ok"], r
+            v = r["verdict"]
+            assert v["partial"] is True
+            assert v["partitions_consulted"] == []
+            assert set(v["partitions_unavailable"]) == set(range(P))
+            with pytest.raises(ServeError) as ei:
+                c.classify(paths[0], strict=True)
+            assert ei.value.reason in ("partial_coverage", "no_replicas")
+            assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+            st = c.status()
+            booked = (st["router"]["overload_spills"]
+                      + st["router"]["leg_failures"])
+            assert booked >= 1
+            assert router.poll() is None
+
+            t.join(timeout=300)
+            assert not t.is_alive(), "occupying classify never returned"
+            assert bg["resp"]["ok"], bg["resp"]  # admitted work not dropped
+        assert r1.wait(timeout=300) == 0
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=120) == 0
+    finally:
+        _reap(router, r1)

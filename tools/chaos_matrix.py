@@ -77,6 +77,17 @@ Two tiers:
   ``epoch_history`` exactly. Delegate to tests/test_trace_report.py,
   CPU-only.
 
+- router cells (``--router``): the fleet front door (ISSUE 17,
+  drep_tpu/serve/router.py) — SIGKILL a replica mid-scatter (the router
+  survives, affected queries return stamped PARTIAL verdicts while
+  unaffected legs stay byte-identical, a rejoined replica restores full
+  coverage), a generation-TORN fan-out (replicas hot-swap to a new
+  index generation while the router still routes the old one — the
+  generation fence retries the gather once over a fenced reload and
+  converges), and overload spill (a saturated replica's backpressure
+  refusals spill the leg to honest PARTIAL degradation instead of
+  queueing behind it). Delegate to tests/test_router_chaos.py, CPU-only.
+
 - autoscaling cells (``--autoscale``): the deadline-driven controller
   (ISSUE 15, drep_tpu/autoscale/ + tools/pod_autoscale.py) — a real pod
   under ``--deadline`` pressure gains a CONTROLLER-spawned joiner
@@ -98,6 +109,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --serve-federated # + partition containment
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --autoscale # + controller cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --router  # + fleet front-door cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
 
@@ -565,6 +577,29 @@ FED_SERVE_CELLS = [
 ]
 
 
+# router cells (--router, ISSUE 17): the fleet front door's containment
+# story. Every cell needs subprocess replicas behind a subprocess router
+# with live clients — delegate to their pytest chaos tests. CPU-only,
+# tens of seconds each.
+ROUTER_CELLS = [
+    ("router_leg", "kill",
+     "SIGKILL replica mid-scatter -> router up, PARTIAL stamped, unaffected "
+     "legs byte-identical; rejoin restores full coverage",
+     "survive",
+     "tests/test_router_chaos.py::test_sigkill_replica_mid_scatter_partial_contained"),
+    ("router_leg", "torn",
+     "generation-TORN fan-out (replicas swap ahead of the router) -> "
+     "fenced gather retry converges on the new generation",
+     "survive",
+     "tests/test_router_chaos.py::test_generation_torn_fanout_fence_converges"),
+    ("router_leg", "overload",
+     "saturated replica's backpressure -> leg spills to PARTIAL, never "
+     "queues behind it",
+     "survive",
+     "tests/test_router_chaos.py::test_overload_spill_under_saturated_replica"),
+]
+
+
 # serve cells (--serve, ISSUE 11): the resident serving tier's crash
 # story. SIGKILL needs a subprocess daemon + live clients — delegate to
 # the pytest chaos cell. CPU-only, tens of seconds.
@@ -619,6 +654,7 @@ def main() -> int:
     elastic_cells = "--elastic" in sys.argv
     serve_cells = "--serve" in sys.argv
     fed_serve_cells = "--serve-federated" in sys.argv
+    router_cells = "--router" in sys.argv
     events_cells = "--events" in sys.argv
     autoscale_cells = "--autoscale" in sys.argv
     from drep_tpu.parallel import faulttol
@@ -666,6 +702,7 @@ def main() -> int:
     _pytest_cells(ELASTIC_CELLS, "--elastic", elastic_cells)
     _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
     _pytest_cells(FED_SERVE_CELLS, "--serve-federated", fed_serve_cells)
+    _pytest_cells(ROUTER_CELLS, "--router", router_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)
     _pytest_cells(AUTOSCALE_CELLS, "--autoscale", autoscale_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)

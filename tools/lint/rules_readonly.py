@@ -49,6 +49,15 @@ ENTRYPOINTS = (
     ("drep_tpu/autoscale/controller.py", "AutoscaleController.poll_once"),
     ("drep_tpu/autoscale/controller.py", "AutoscaleController.run"),
     ("tools/pod_autoscale.py", "main"),
+    # the fleet front door (ISSUE 17) inherits the daemon's reader
+    # contract and adds the routed classify core: the router reads the
+    # federated spine + routing bitmaps and talks to replicas over
+    # sockets — it never writes a byte under the index tree
+    ("drep_tpu/serve/router.py", "RouterServer.start"),
+    ("drep_tpu/serve/router.py", "RouterServer._probe_once"),
+    ("drep_tpu/serve/router.py", "RouterServer._classify_paths"),
+    ("drep_tpu/serve/router.py", "RouterServer._fence_reload"),
+    ("drep_tpu/serve/router.py", "RouterServer.snapshot"),
 )
 
 # modules the walk does not enter — each writes only under an explicit

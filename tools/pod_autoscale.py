@@ -23,6 +23,16 @@ checkpoint dir and — with ``--log_dir`` + ``DREP_TPU_EVENTS=on`` — as an
 ``autoscale_decision`` telemetry instant tools/trace_report.py merges
 next to the membership timeline. Knobs: DREP_TPU_AUTOSCALE_INTERVAL_S /
 _COOLDOWN_S / _MAX_SPAWN (drep_tpu/utils/envknobs.py).
+
+FLEET MODE (ISSUE 17): point it at a serve ROUTER instead of a
+checkpoint dir and the SAME policy governs the replica fleet per
+partition range — queue depths map onto the ETA slot, a rolling
+``--queue_deadline_s`` service target replaces the finish-by instant,
+and actuation goes through the router's ``fleet`` join/leave op::
+
+    python tools/pod_autoscale.py --router 127.0.0.1:7788 \\
+        --queue_deadline_s 5 --svc_s 0.2 --max_procs 4 \\
+        --spawn "python -m drep_tpu index serve IDX --port 0"
 """
 
 from __future__ import annotations
@@ -45,9 +55,22 @@ from drep_tpu.utils import envknobs, telemetry  # noqa: E402
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("checkpoint_dir",
+    ap.add_argument("checkpoint_dir", nargs="?", default=None,
                     help="the pod's shared checkpoint dir "
-                         "(e.g. <wd>/data/streaming_primary)")
+                         "(e.g. <wd>/data/streaming_primary); omit in "
+                         "--router fleet mode")
+    ap.add_argument("--router", default=None, metavar="ADDR",
+                    help="fleet mode: govern the replica fleet behind the "
+                         "`index route` front door at ADDR (host:port or "
+                         "socket path) instead of a batch pod")
+    ap.add_argument("--queue_deadline_s", type=float, default=5.0,
+                    help="fleet mode: rolling queueing-delay target per "
+                         "partition range — the policy scales up a range "
+                         "whose projected drain time exceeds it")
+    ap.add_argument("--svc_s", type=float, default=0.2,
+                    help="fleet mode: assumed per-query service time used "
+                         "in the drain-time projection "
+                         "(queue_total * svc_s / n_live)")
     ap.add_argument("--deadline", type=float, default=None, metavar="SECONDS",
                     help="finish-by target, seconds from controller start; "
                          "the policy scales up when the publish-rate ETA "
@@ -96,6 +119,40 @@ def main(argv: list[str] | None = None) -> int:
         if args.max_spawn is None
         else args.max_spawn
     )
+    if args.router and args.checkpoint_dir:
+        ap.error("--router (fleet mode) and checkpoint_dir are exclusive")
+    if not args.router and not args.checkpoint_dir:
+        ap.error("need a checkpoint_dir (batch mode) or --router (fleet mode)")
+
+    if args.router:
+        from drep_tpu.autoscale.fleet import FleetAutoscaleController  # noqa: E402
+        from drep_tpu.serve import ServeClient  # noqa: E402
+
+        # fleet mode: deadline_at is rebuilt per tick from
+        # --queue_deadline_s (a rolling service target), so the Targets
+        # base carries everything BUT the deadline; cost_proc_s maps
+        # unchanged (proc-seconds of projected queue drain)
+        targets = Targets(
+            deadline_at=None,
+            cost_proc_s=args.cost,
+            min_procs=args.min_procs,
+            max_procs=args.max_procs,
+            cooldown_s=cooldown,
+            hysteresis=args.hysteresis,
+            max_spawn=max_spawn,
+        )
+        controller = FleetAutoscaleController(
+            ServeClient(args.router), targets,
+            queue_deadline_s=args.queue_deadline_s, svc_s=args.svc_s,
+            spawn_cmd=args.spawn,
+            interval_s=args.interval if args.interval is not None else 2.0,
+            decision_log=args.decision_log,
+        )
+        try:
+            return controller.run(count=args.count)
+        finally:
+            telemetry.close()
+
     targets = Targets(
         deadline_at=(
             # drep-lint: allow[clock-mono] — the deadline is compared against snapshot observed_at stamps (wall/server clock), like the protocol's note mtimes

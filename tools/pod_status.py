@@ -438,6 +438,43 @@ def render_serve(status: dict) -> str:
             f"{pod.get('shards_total') or '?'} shards @ "
             f"{pod.get('checkpoint_dir')}"
         )
+    # fleet front door (ISSUE 17): a router's snapshot carries its
+    # replica table — render it in the same one-line-per-member idiom
+    # as the partition health map above
+    fleet = status.get("replicas")
+    if fleet:
+        rt = status.get("router") or {}
+        lines.append(
+            f"  router: {rt.get('forwarded', 0)} forwarded / "
+            f"{rt.get('scattered', 0)} scattered "
+            f"({rt.get('legs_total', 0)} legs, {rt.get('hedges', 0)} hedged, "
+            f"{rt.get('reroutes', 0)} rerouted, "
+            f"{rt.get('fence_retries', 0)} fence retr(ies), "
+            f"{rt.get('partial_verdicts', 0)} PARTIAL, "
+            f"{rt.get('overload_spills', 0)} overload spill(s))"
+        )
+        for addr, e in sorted(fleet.get("replicas", {}).items()):
+            assigned = e.get("assigned")
+            scope = (
+                "all partitions" if assigned is None
+                else "partitions " + ",".join(str(p) for p in assigned)
+            )
+            detail = (
+                f"{scope}, gen {e.get('generation')}, "
+                f"queue {e.get('queue_depth')}"
+                + (", draining" if e.get("draining") else "")
+                + f", {e.get('failures', 0)} failure(s), "
+                f"{e.get('recoveries', 0)} recover(ies)"
+            )
+            lines.append(f"  {addr:<24} {e.get('state', '?'):<9} {detail}")
+            if e.get("last_error"):
+                lines.append(f"            last error: {str(e['last_error'])[:160]}")
+        for bucket in ("suspect", "ejected"):
+            if fleet.get(bucket):
+                lines.append(
+                    f"  {bucket.upper()} replica(s): "
+                    + ", ".join(fleet[bucket])
+                )
     return "\n".join(lines) + "\n"
 
 

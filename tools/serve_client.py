@@ -28,6 +28,17 @@ The record (``--out``, default SERVE_BENCH.json) is stamped
 characterize the batching/admission layers and are REFUSED as hardware
 claims by tools/missing_stages.py exactly like every other proxy
 record. Guards exit 1 on miss (``--no_guard`` records without judging).
+
+Fleet mode (``--bench --fleet``, ISSUE 17) is the ROUTER's perf guard:
+it builds a synthetic FEDERATED index, then measures the same
+closed-loop loadgen at ``--clients`` (default 64) concurrency against
+(a) ONE serve daemon and (b) TWO unscoped replicas behind an
+`index route` front door. The guard requires fleet qps >=
+``--fleet_speedup`` (default 2.0) x the single daemon — the claim that
+the router turns replica processes into throughput instead of just a
+hop. The record (FLEET_BENCH.json) carries the router's own stats
+(forwarded/scattered/hedges/reroutes) and the same
+``proxy_metrics: true`` honesty stamp.
 """
 
 from __future__ import annotations
@@ -198,6 +209,128 @@ def _loadgen(
     }
 
 
+def _spawn_router(index_loc: str, replicas: list[str], max_batch: int):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    argv = [sys.executable, "-m", "drep_tpu", "index", "route", index_loc,
+            "--max_batch", str(max_batch), "--batch_window_ms", "10",
+            "--probe_interval_s", "0.5"]
+    for addr in replicas:
+        argv += ["--replica", addr]
+    proc = subprocess.Popen(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env,
+    )
+    line = proc.stdout.readline()
+    if not line:
+        proc.kill()
+        raise RuntimeError("router died before its ready line")
+    return proc, json.loads(line)["serving"]
+
+
+def run_fleet_bench(args) -> int:
+    """The router perf guard: one daemon vs two replicas behind the
+    front door, same federated index, same loadgen."""
+    import numpy as np  # noqa: F401 — _plant_genomes needs it anyway
+
+    tmp = tempfile.mkdtemp(prefix="drep_fleet_bench_")
+    print(f"fleet bench: planting {args.n_genomes} synthetic genomes...",
+          file=sys.stderr)
+    planted = _plant_genomes(os.path.join(tmp, "g"), args.n_genomes)
+    from drep_tpu.index import build_federated
+
+    index_loc = os.path.join(tmp, "idx")
+    build_federated(index_loc, planted, args.partitions, length=0)
+    # a WIDE disjoint hot set: the single daemon's identical-request
+    # coalescing must not trivialize the workload, or the ratio would
+    # measure framing overhead instead of compute parallelism
+    genomes = _plant_genomes(os.path.join(tmp, "q"), args.n_queries, seed=1)
+
+    record: dict = {
+        "kind": "fleet_bench",
+        "proxy_metrics": True,  # loadgen numbers are NEVER hardware claims
+        "n_indexed": len(planted),
+        "n_partitions": args.partitions,
+        "n_query_hot_set": len(genomes),
+        "n_replicas": 2,
+        "configs": {},
+    }
+    try:
+        import jax
+
+        record["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        record["backend"] = "unknown"
+
+    procs: list = []
+    try:
+        # -- single daemon reference --------------------------------------
+        proc, addr = _spawn_daemon(index_loc, args.max_batch)
+        procs.append(proc)
+        single = _loadgen(
+            addr, genomes, clients=args.clients,
+            requests_per_client=args.requests_per_client,
+            pipeline=args.pipeline,
+        )
+        record["configs"]["single"] = single
+        print(f"fleet bench: single daemon: {single['qps']} qps "
+              f"(p50 {single['latency_ms']['p50']}ms)", file=sys.stderr)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(60)
+
+        # -- two replicas behind the router -------------------------------
+        r1, a1 = _spawn_daemon(index_loc, args.max_batch)
+        r2, a2 = _spawn_daemon(index_loc, args.max_batch)
+        procs += [r1, r2]
+        router, raddr = _spawn_router(index_loc, [a1, a2], args.max_batch)
+        procs.append(router)
+        fleet = _loadgen(
+            raddr, genomes, clients=args.clients,
+            requests_per_client=args.requests_per_client,
+            pipeline=args.pipeline,
+        )
+        with ServeClient(raddr, timeout_s=60) as c:
+            st = c.status()
+            fleet["router"] = st.get("router")
+            fleet["replica_states"] = {
+                a: e.get("state")
+                for a, e in (st.get("replicas") or {}).get("replicas", {}).items()
+            }
+        record["configs"]["fleet"] = fleet
+        print(f"fleet bench: 2-replica fleet: {fleet['qps']} qps "
+              f"(p50 {fleet['latency_ms']['p50']}ms; "
+              f"router {fleet.get('router')})", file=sys.stderr)
+        for p in (router, r1, r2):
+            p.send_signal(signal.SIGTERM)
+        for p in (router, r1, r2):
+            p.wait(60)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    qps_single = record["configs"]["single"]["qps"]
+    qps_fleet = record["configs"]["fleet"]["qps"]
+    record["fleet_speedup_x"] = round(qps_fleet / max(qps_single, 1e-9), 2)
+    record["guards"] = {
+        "fleet_speedup_min": args.fleet_speedup,
+        "fleet_speedup_ok": record["fleet_speedup_x"] >= args.fleet_speedup,
+        "fleet_errors_ok": record["configs"]["fleet"]["errors"] == 0,
+    }
+    out = args.out if args.out != "SERVE_BENCH.json" else "FLEET_BENCH.json"
+    atomic_write_bytes(out, json.dumps(record, indent=1, sort_keys=True).encode())
+    print(json.dumps({k: record[k] for k in
+                      ("fleet_speedup_x", "guards", "backend", "proxy_metrics")}))
+    print(f"fleet bench: record -> {out}", file=sys.stderr)
+    if args.no_guard:
+        return 0
+    ok = all(v for k, v in record["guards"].items() if k.endswith("_ok"))
+    if not ok:
+        print(f"fleet bench: GUARD FAILED: {record['guards']}", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def run_bench(args) -> int:
     tmp = tempfile.mkdtemp(prefix="drep_serve_bench_")
     if args.index:
@@ -323,6 +456,17 @@ def main(argv: list[str] | None = None) -> int:
                          "instead of a degraded answer")
     ap.add_argument("--bench", action="store_true",
                     help="spawn daemons + loadgen: the serving perf guard")
+    ap.add_argument("--fleet", action="store_true",
+                    help="with --bench: the ROUTER perf guard — 2 replicas "
+                         "behind `index route` vs 1 daemon over the same "
+                         "federated index (FLEET_BENCH.json)")
+    ap.add_argument("--partitions", type=int, default=2,
+                    help="federated partition count for --fleet (default 2)")
+    ap.add_argument("--max_batch", type=int, default=64,
+                    help="daemon/router max_batch for --fleet (default 64)")
+    ap.add_argument("--fleet_speedup", type=float, default=2.0,
+                    help="guard: fleet / single-daemon qps floor at "
+                         "--clients concurrency (default 2.0)")
     ap.add_argument("--index", default=None,
                     help="bench against this existing index (default: "
                          "build a synthetic one)")
@@ -348,6 +492,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--out", default="SERVE_BENCH.json")
     args = ap.parse_args(argv)
 
+    if args.bench and args.fleet:
+        if args.clients == 16:
+            args.clients = 64  # the fleet claim is pinned at 64 concurrent
+        if args.n_queries == 4:
+            args.n_queries = 32  # wide hot set: no identical-request
+            # coalescing shortcut — the ratio must measure parallel compute
+        return run_fleet_bench(args)
     if args.bench:
         return run_bench(args)
     if not args.address:
