@@ -373,6 +373,60 @@ def build_parser() -> argparse.ArgumentParser:
                    help="memory bound for the bucket join's host expansion "
                         "(0 = one-pass; same semantics as the pipeline flag)")
 
+    def add_maint_io(p: argparse.ArgumentParser):
+        p.add_argument("index_directory", help="the long-lived genome index")
+        p.add_argument("-p", "--processes", type=int, default=6)
+        p.add_argument("-d", "--debug", action="store_true")
+        p.add_argument("--io_retries", type=int, default=None,
+                       help="transient shared-filesystem I/O retry budget "
+                            "(utils/durableio.py; same knob as the pipeline)")
+        p.add_argument("--fsync", action="store_true",
+                       help="fsync every durable publish (DREP_TPU_FSYNC=1 "
+                            "equivalent)")
+
+    sp = isub.add_parser(
+        "split",
+        help="index lifecycle: bisect a FEDERATED partition's range at "
+             "its sketch-code median into two child partition stores — a "
+             "staged meta-manifest transaction (children materialize "
+             "under pending/, commit is one atomic federation.json "
+             "publish, the parent is gc'd only after); crash-safe at "
+             "every phase, and an ordinary hot-swap to live readers",
+    )
+    add_maint_io(sp)
+    sp.add_argument("--pid", type=int, required=True,
+                    help="the partition id to split (pids are renumbered "
+                         "densely by range order at commit)")
+
+    mg = isub.add_parser(
+        "merge",
+        help="index lifecycle: fold two ADJACENT federated partitions "
+             "into one (the split's inverse — same staged transaction, "
+             "same crash-safety contract)",
+    )
+    add_maint_io(mg)
+    mg.add_argument("--pids", type=int, nargs=2, required=True,
+                    metavar=("PID_A", "PID_B"),
+                    help="the two adjacent partition ids to fold")
+
+    cp = isub.add_parser(
+        "compact",
+        help="index lifecycle: LSM-style generation compaction — fold a "
+             "store's N sketch/edge/state shard generations into ONE "
+             "freshly-written generation and gc the superseded shards "
+             "(federated roots compact per partition and commit through "
+             "the meta-manifest; verdicts/updates are byte-identical to "
+             "the uncompacted store — the pinned oracle)",
+    )
+    add_maint_io(cp)
+    cp.add_argument("--pid", type=int, default=None,
+                    help="compact only this federated partition (default: "
+                         "every partition past --min_generations)")
+    cp.add_argument("--min_generations", type=int, default=None,
+                    help="without --pid: compact partitions holding at "
+                         "least this many shard generations (default: "
+                         "DREP_TPU_COMPACT_MIN_SHARDS)")
+
     s = isub.add_parser(
         "serve",
         help="resident serving tier: a long-lived daemon that loads the "

@@ -44,7 +44,10 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
-__all__ = ["Targets", "Decision", "decide"]
+__all__ = [
+    "Targets", "Decision", "decide",
+    "MaintenanceTargets", "maintenance_decide",
+]
 
 
 @dataclass(frozen=True)
@@ -204,3 +207,97 @@ def decide(snapshot: dict, targets: Targets, history: list[dict]) -> Decision:
     return _hold(
         "deadline-met" if remaining is not None else "within-cost", inputs
     )
+
+
+# ---------------------------------------------------------------------------
+# maintenance scheduler (ISSUE 18): split/compaction in idle windows
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MaintenanceTargets:
+    """The operator's index-maintenance envelope (same contract as
+    ``Targets``: resolved once, outside the pure function — the env-knob
+    reader lives in ``index.maintenance.maintenance_targets_from_env``).
+
+    ``split_max_genomes`` is the skew budget: a partition past it is
+    proposed for `index split` (0 = never — splits stay operator-
+    initiated). ``compact_min_shards`` is the generation-sprawl budget:
+    a partition holding at least this many sketch/edge shard-family
+    generations is proposed for `index compact`. ``idle_qps`` bounds
+    when maintenance may run at all — a loaded serving tier holds
+    (maintenance commits are ordinary hot-swaps, but the child-store
+    rebuild competes for the same cores). ``cooldown_s`` spaces
+    successive maintenance proposals the way scaling cooldown spaces
+    spawns: one transaction must land and age before the next."""
+
+    compact_min_shards: int = 4
+    split_max_genomes: int = 0
+    idle_qps: float = 1.0
+    cooldown_s: float = 300.0
+
+
+def maintenance_decide(
+    snapshot: dict, targets: MaintenanceTargets, history: list[dict]
+) -> Decision:
+    """One pure maintenance verdict over one read-only index snapshot
+    (``index.maintenance.maintenance_snapshot``): ``split`` the most
+    skewed over-budget partition, ``compact`` the most sprawled one, or
+    ``hold``. Split outranks compaction — skew is the load/residency
+    hazard the ROADMAP names first, and a split folds the parent's
+    generations into its children anyway (a split IS a compaction of
+    the hot range). The chosen pid rides ``inputs["pid"]``; verdict
+    ``delta`` is 0 (maintenance moves data, not capacity)."""
+    if "error" in snapshot:
+        return _hold("snapshot-error", {"error": snapshot["error"]})
+    now = float(snapshot["observed_at"])
+    parts = list(snapshot.get("partitions", ()))
+    qps = snapshot.get("qps")
+    inputs: dict = {
+        "n_partitions": len(parts),
+        "generation": snapshot.get("generation"),
+        "qps": qps,
+    }
+    if not parts:
+        return _hold("not-federated", inputs)
+    if snapshot.get("maintenance_pending"):
+        # an interrupted transaction converges through roll_forward on
+        # the next maintenance pass — never propose new work over it
+        return _hold("maintenance-pending", inputs)
+    if qps is not None and float(qps) > targets.idle_qps:
+        return _hold("busy-traffic", inputs)
+    for past in reversed(history):
+        if past.get("verdict") in ("split", "compact"):
+            age = now - float(past.get("at", now))
+            if age < targets.cooldown_s:
+                inputs["cooldown_remaining_s"] = round(
+                    targets.cooldown_s - age, 3
+                )
+                return _hold("cooldown", inputs)
+            break
+    if any(int(p.get("generations", 0)) < 0 for p in parts):
+        # an unreadable partition manifest: maintenance would rewrite
+        # the range map over a store it cannot see — hold for the heal
+        return _hold("partition-unreadable", inputs)
+
+    if targets.split_max_genomes > 0:
+        fat = max(parts, key=lambda p: int(p["n_genomes"]))
+        if int(fat["n_genomes"]) > targets.split_max_genomes:
+            inputs["pid"] = int(fat["pid"])
+            inputs["n_genomes"] = int(fat["n_genomes"])
+            return Decision(
+                verdict="split", delta=0,
+                reason="partition-over-split-budget", inputs=inputs,
+            )
+
+    floor = max(2, int(targets.compact_min_shards))
+    sprawled = max(parts, key=lambda p: int(p.get("generations", 0)))
+    if int(sprawled.get("generations", 0)) >= floor:
+        inputs["pid"] = int(sprawled["pid"])
+        inputs["generations"] = int(sprawled["generations"])
+        return Decision(
+            verdict="compact", delta=0,
+            reason="shards-over-budget", inputs=inputs,
+        )
+
+    return _hold("healthy", inputs)

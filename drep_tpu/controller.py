@@ -61,6 +61,7 @@ class Controller:
         from drep_tpu.workflows import (
             index_build_wrapper,
             index_classify_wrapper,
+            index_maintenance_wrapper,
             index_route_wrapper,
             index_serve_wrapper,
             index_update_wrapper,
@@ -80,6 +81,10 @@ class Controller:
         if sub == "route":
             # the fleet front door: same drain contract as serve
             return index_route_wrapper(index_loc, genomes, **kwargs)
+        if sub in ("split", "merge", "compact"):
+            # the transactional index lifecycle (index/maintenance.py):
+            # crash-safe at every phase, resumable by any later pass
+            return index_maintenance_wrapper(index_loc, op=sub, **kwargs)
         if sub == "classify":
             import json
             import sys

@@ -40,5 +40,12 @@ from drep_tpu.index.classify import (  # noqa: F401
     load_resident_index,
     sketch_queries,
 )
+from drep_tpu.index.maintenance import (  # noqa: F401
+    compact_store,
+    fed_compact,
+    fed_merge,
+    fed_split,
+    roll_forward,
+)
 from drep_tpu.index.store import IndexStore, LoadedIndex, load_index  # noqa: F401
 from drep_tpu.index.update import index_update  # noqa: F401

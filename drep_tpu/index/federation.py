@@ -469,7 +469,9 @@ def load_federated(location: str, heal: bool = False) -> LoadedIndex:
         if n_p <= 0:
             loaded[pid] = None
             continue
-        pdir = store.partition_dir(pid)
+        # honor the meta's recorded dir: after a split/merge the dense
+        # pid renumbering decouples pid from the part_### store name
+        pdir = store.abspath(e["dir"])
         try:
             pidx = load_index(pdir, heal=heal)
         except Exception as err:  # noqa: BLE001 — a bare OSError (and even
@@ -486,7 +488,7 @@ def load_federated(location: str, heal: bool = False) -> LoadedIndex:
             )
             refusal.fed_partition = pid  # type: ignore[attr-defined]
             raise refusal from err
-        healed.extend(f"{fedmeta.partition_dir_name(pid)}/{h}" for h in pidx.healed)
+        healed.extend(f"{e['dir']}/{h}" for h in pidx.healed)
         g_meta = int(e["generation"])
         if pidx.generation < g_meta:
             raise UserInputError(
@@ -2029,6 +2031,12 @@ def fed_update(
 
     logger = get_logger()
     store = FederationStore(location)
+    # converge any interrupted split/merge/compaction FIRST: an update
+    # must never land on a half-committed range map (lazy import — the
+    # maintenance module builds on this one)
+    from drep_tpu.index import maintenance as fedmaint
+
+    fedmaint.roll_forward(location)
     m = store.read_meta()
     params = m["params"]
     gen = int(m["generation"])
@@ -2141,6 +2149,9 @@ def fed_update(
     bounds = [tuple(e["range"]) for e in m["partitions"]]
     meta_gen = {int(e["pid"]): int(e["generation"]) for e in m["partitions"]}
     meta_n = {int(e["pid"]): int(e["n_genomes"]) for e in m["partitions"]}
+    # pid -> store dir from the meta (post-split/merge renumbering
+    # decouples the dense pid from the part_### name)
+    meta_dir = {int(e["pid"]): store.abspath(e["dir"]) for e in m["partitions"]}
     routed = _routed_batches(batch, results, bounds)
     prune_flags = {
         "primary_prune": primary_prune if primary_prune != "off" else "",
@@ -2158,7 +2169,7 @@ def fed_update(
         pid = int(e["pid"])
         if pid in routed:
             continue
-        if _partition_generation(store.partition_dir(pid)) > int(e["generation"]):
+        if _partition_generation(meta_dir[pid]) > int(e["generation"]):
             raise UserInputError(
                 f"federated index: partition {pid} is ahead of the "
                 f"meta-manifest from an interrupted earlier update, and "
@@ -2169,7 +2180,7 @@ def fed_update(
     dirty: list[tuple[int, str, str]] = []  # (pid, part_dir, build|update)
     done: set[int] = set()
     for pid in sorted(routed):
-        pdir = store.partition_dir(pid)
+        pdir = meta_dir.get(pid, store.partition_dir(pid))
         want = list(routed[pid]["genome"])
         actual_gen = _partition_generation(pdir)
         base_n = meta_n[pid]
@@ -2280,7 +2291,7 @@ def fed_update(
         if pid in failed:
             unadmitted.extend(routed[pid]["genome"])
             continue
-        pdir = store.partition_dir(pid)
+        pdir = meta_dir[pid]
         pidx = load_index(pdir)
         base_n = meta_n[pid]
         tail = list(range(base_n, pidx.n))
@@ -2361,7 +2372,7 @@ def fed_update(
                 "generation": new_gen[int(e["pid"])],
                 "n_genomes": new_n[int(e["pid"])],
                 "manifest_crc": (
-                    fedmeta.manifest_crc(store.partition_dir(int(e["pid"])))
+                    fedmeta.manifest_crc(store.abspath(e["dir"]))
                     if new_n[int(e["pid"])] > 0
                     else None
                 ),

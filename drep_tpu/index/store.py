@@ -216,13 +216,19 @@ class IndexStore:
 
     # ---- shard serialization --------------------------------------------
     def write_sketch_shard(self, rel: str, names, locations, gdb_rows: pd.DataFrame,
-                           bottom, scaled, admitted_gen: int) -> None:
+                           bottom, scaled, admitted_gen) -> None:
         from drep_tpu.utils.ckptmeta import atomic_savez
 
+        # admitted_gen: one int for an ordinary per-generation append
+        # shard, or a per-genome array for a folded shard (compaction /
+        # split children span many admitting generations in one payload)
+        adm = np.asarray(admitted_gen, np.int64)
+        if adm.ndim == 0:
+            adm = np.full(len(names), adm, np.int64)
         payload: dict[str, np.ndarray] = {
             "names": np.array(names, dtype=str),
             "locations": np.array(locations, dtype=str),
-            "admitted_generation": np.full(len(names), admitted_gen, np.int64),
+            "admitted_generation": adm,
         }
         for c in _STAT_COLS:
             payload[c] = gdb_rows[c].to_numpy().astype(np.int64)
@@ -505,7 +511,7 @@ def load_index(location: str, heal: bool = False) -> LoadedIndex:
         store.write_sketch_shard(
             entry["file"], idx.names[lo:hi], idx.locations[lo:hi],
             idx.gdb.iloc[lo:hi], idx.bottom[lo:hi], idx.scaled[lo:hi],
-            int(idx.admitted[lo]),
+            idx.admitted[lo:hi],  # folded shards span many admit gens
         )
 
     # 3. edge shards ------------------------------------------------------

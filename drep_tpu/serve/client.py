@@ -109,6 +109,19 @@ class ServeClient:
                              reason=resp.get("reason"))
         return resp["status"]
 
+    def prewarm(self, partitions: list[int]) -> dict:
+        """Sketch prefetch hint: ask a federated replica to make these
+        partitions' sketch payloads resident now (so its first scatter
+        leg carries no cold-load spike). Returns the daemon's
+        ``{warmed, failed, generation}`` report."""
+        resp = self.request(
+            {"op": "prewarm", "partitions": [int(p) for p in partitions]}
+        )
+        if not resp.get("ok"):
+            raise ServeError(resp.get("error", "prewarm failed"),
+                             reason=resp.get("reason"))
+        return resp
+
     def classify(self, genome: str, retries: int = 0, strict: bool = False) -> dict:
         """Classify one genome; returns the full classify response
         (``verdict``, ``generation``, ``batch_size``, latencies).

@@ -601,6 +601,9 @@ class IndexServer:
             # inside serializes against the batch loop
             self._serve_leg(req, send)
             return
+        if op == "prewarm":
+            self._serve_prewarm(req, send)
+            return
         if op == "fleet":
             send(protocol.error_response(
                 "this daemon is a serve replica, not a router — fleet "
@@ -640,6 +643,44 @@ class IndexServer:
             send(protocol.error_response(
                 msg, req_id=req_id, reason=refused, retry_after_s=retry,
             ))
+
+    def _serve_prewarm(self, req: dict, send: Callable[[dict], None]) -> None:
+        """Sketch prefetch hint (ISSUE 18 satellite): make the named
+        partitions' sketch payloads resident NOW — the router sends this
+        at `fleet join` with the replica's assigned partitions, so the
+        first scatter leg carries no cold-load spike. Best-effort: an
+        unknown or unloadable partition books into "failed" (the
+        ordinary quarantine machinery owns it); the reply is never an
+        error and a prewarm must never take a replica down."""
+        req_id = req.get("id")
+        resident = self._resident  # pinned: swaps replace the object
+        if not hasattr(resident, "ensure_resident"):
+            send(protocol.error_response(
+                "this replica serves a monolithic index — prewarm hints "
+                "need a federated root", req_id=req_id, reason="not_federated",
+            ))
+            return
+        warmed: list[int] = []
+        failed: list[int] = []
+        for pid in req["partitions"]:
+            pid = int(pid)
+            if pid not in resident._slots:
+                failed.append(pid)
+                continue
+            try:
+                with self._compute_lock:
+                    ok = resident.ensure_resident(pid)
+            except Exception:  # noqa: BLE001 — a hint must not kill the replica
+                ok = False
+            (warmed if ok else failed).append(pid)
+        resp: dict = {
+            "ok": True, "op": "prewarm",
+            "generation": int(resident.generation),
+            "warmed": warmed, "failed": failed,
+        }
+        if req_id is not None:
+            resp["id"] = req_id
+        send(resp)
 
     # ---- fleet scatter legs (ISSUE 17) ----------------------------------
     def _serve_leg(self, req: dict, send: Callable[[dict], None]) -> None:

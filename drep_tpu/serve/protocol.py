@@ -76,7 +76,7 @@ from typing import Any
 
 MAX_LINE_BYTES = 1 << 20  # a request line is a path + opcode, never MBs
 
-OPS = ("classify", "status", "ping", "classify_part", "fleet")
+OPS = ("classify", "status", "ping", "classify_part", "fleet", "prewarm")
 
 # HTTP methods the shim answers; anything else on a connection whose
 # first line is not JSON is a protocol error
@@ -147,6 +147,17 @@ def parse_request(line: bytes) -> dict:
             or not all(isinstance(p, int) and not isinstance(p, bool) for p in parts)
         ):
             raise ProtocolError('"partitions" must be an integer list or null')
+    elif op == "prewarm":
+        # sketch prefetch hint (ISSUE 18 satellite): load these
+        # partitions' sketch payloads into the LRU NOW, before the
+        # replica takes scatter legs — so its first leg carries no
+        # cold-load spike
+        parts = req.get("partitions")
+        if (
+            not isinstance(parts, list) or not parts
+            or not all(isinstance(p, int) and not isinstance(p, bool) for p in parts)
+        ):
+            raise ProtocolError('prewarm needs a non-empty integer "partitions" list')
     return req
 
 

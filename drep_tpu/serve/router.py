@@ -492,6 +492,12 @@ class RouterServer(IndexServer):
         if action == "join":
             self.table.join(addr, assigned)
             known = True
+            # sketch prefetch hint (ISSUE 18 satellite): tell the joiner
+            # which partitions it was assigned so it warms those sketch
+            # payloads BEFORE its first scatter leg — synchronous (the
+            # join ack IS "ready for legs") but contained: a failed hint
+            # only logs; the ordinary lazy load still covers every leg
+            self._prewarm_joiner(addr, assigned)
         else:
             known = self.table.leave(addr)
         get_logger().info(
@@ -509,6 +515,42 @@ class RouterServer(IndexServer):
             "known": known, "replicas": len(self.table),
             "id": req.get("id"),
         })
+
+    def _prewarm_joiner(self, addr: str, assigned: frozenset | None) -> None:
+        """Dispatch one bounded prewarm turn to a joining replica with
+        its assigned partition ids (all routable pids when the joiner is
+        unscoped). Best-effort by contract: any failure logs and the
+        join proceeds — the hint only removes the first-leg cold-load
+        spike, it never gates membership."""
+        from drep_tpu.serve.client import ServeClient
+
+        resident = self._resident
+        if assigned is not None:
+            pids = sorted(assigned)
+        elif hasattr(resident, "_slots"):
+            pids = sorted(getattr(resident, "_slots"))
+        else:
+            pids = []
+        if not pids:
+            return
+        try:
+            with ServeClient(addr, timeout_s=self.leg_timeout_s) as client:
+                report = client.prewarm(pids)
+        except Exception as e:  # noqa: BLE001 — a hint must never fail the join
+            get_logger().warning(
+                "route: prewarm hint to joining replica %s failed (%s) — "
+                "its first legs lazy-load instead", addr, e,
+            )
+            return
+        get_logger().info(
+            "route: prewarmed joining replica %s — partitions %s resident"
+            "%s", addr, report.get("warmed"),
+            f", {report['failed']} failed" if report.get("failed") else "",
+        )
+        telemetry.event(
+            "fleet_prewarm", address=addr, warmed=report.get("warmed"),
+            failed=report.get("failed"),
+        )
 
     # ---- status ----------------------------------------------------------
     def snapshot(self) -> dict:

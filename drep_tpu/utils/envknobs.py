@@ -185,6 +185,32 @@ _declare(
     "width per range shard (pow2; rangepart.partition_by_range). Execution "
     "knob only — the candidate set is identical for every value.",
 )
+# -- index maintenance (split/merge/compaction, ISSUE 18) --------------------
+_declare(
+    "DREP_TPU_SPLIT_GC_GRACE_S", "float", 0.0,
+    "Partition split/merge: delay (s) between the federation.json commit "
+    "and the parent-store gc, so live serve replicas on the old meta "
+    "hot-swap before the parents vanish (index/maintenance.py). A "
+    "straggler past it is contained by the ordinary partition quarantine.",
+)
+_declare(
+    "DREP_TPU_COMPACT_GC_GRACE_S", "float", 0.0,
+    "Generation compaction: delay (s) between the meta publish and the "
+    "superseded-shard gc (index/maintenance.py) — same hot-swap grace as "
+    "DREP_TPU_SPLIT_GC_GRACE_S.",
+)
+_declare(
+    "DREP_TPU_COMPACT_MIN_SHARDS", "int", 4,
+    "Maintenance scheduler: propose compaction for a partition holding at "
+    "least this many sketch/edge shard-family generations "
+    "(autoscale/policy.py maintenance_decide; `index compact` without "
+    "--pid uses it as its default threshold via --min_generations).",
+)
+_declare(
+    "DREP_TPU_SPLIT_MAX_GENOMES", "int", 0,
+    "Maintenance scheduler: propose splitting a partition past this many "
+    "genomes (skew containment); 0 disables split proposals.",
+)
 # -- partition-scoped federated serving --------------------------------------
 _declare(
     "DREP_TPU_SERVE_DEVICE_RESIDENT", "bool", True,

@@ -102,6 +102,16 @@ SITES = (
     # the probe books a failure and the healthy->suspect->ejected
     # machine advances — a probe fault must eject the replica, never
     # the router)
+    "partition_split",  # the split/merge meta-manifest transaction's
+    # phase boundaries, drep_tpu/index/maintenance.py (fires after
+    # STAGE, before COMMIT, and before GC — kill with skip=0/1/2
+    # targets each phase; a killed transaction must either leave the
+    # old meta fully live or be rolled forward by the next pass)
+    "compaction",  # the generation-compaction transaction's phase
+    # boundaries, drep_tpu/index/maintenance.py (same skip discipline:
+    # staged / pre-commit / pre-gc — a kill between a partition's
+    # manifest publish and the meta publish must be adopted by
+    # roll_forward, and the gc must resume idempotently)
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):

@@ -201,6 +201,35 @@ def index_update_wrapper(
     )
 
 
+def index_maintenance_wrapper(index_loc: str, *, op: str, **kwargs) -> dict:
+    """`index split|merge|compact`: the transactional index lifecycle
+    (index/maintenance.py). Each verb first converges any interrupted
+    earlier transaction (roll_forward), then runs its own staged
+    transaction — crash-safe at every phase by construction."""
+    from drep_tpu.index import fed_compact, fed_merge, fed_split
+    from drep_tpu.utils import envknobs
+
+    _init_index(index_loc)
+    processes = kwargs.get("processes", 1) or 1
+    if op == "split":
+        summary = fed_split(index_loc, int(kwargs["pid"]), processes=processes)
+    elif op == "merge":
+        pid_a, pid_b = kwargs["pids"]
+        summary = fed_merge(
+            index_loc, int(pid_a), int(pid_b), processes=processes
+        )
+    else:
+        min_gens = kwargs.get("min_generations")
+        if min_gens is None:
+            min_gens = envknobs.env_int("DREP_TPU_COMPACT_MIN_SHARDS")
+        summary = fed_compact(
+            index_loc, pid=kwargs.get("pid"), processes=processes,
+            min_generations=int(min_gens),
+        )
+    get_logger().info("index %s summary: %s", op, summary)
+    return summary
+
+
 def index_classify_wrapper(
     index_loc: str, genomes: list[str] | None = None, **kwargs
 ) -> list[dict]:

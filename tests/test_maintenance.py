@@ -1,0 +1,452 @@
+"""Transactional index lifecycle (ISSUE 18): split/merge/compaction
+correctness, the maintenance scheduler policy, and the scrubber's
+maintenance classes — the in-process half of the PR 18 contract (the
+SIGKILL convergence cells live in tests/test_maintenance_chaos.py).
+
+Pinned here:
+
+- `fed_split` bisects one partition at its sketch-code median: the
+  range map stays a contiguous cover, pids renumber DENSE by range
+  order, and the union's membership, clustering, winners, per-genome
+  admitted generations and classify verdicts are all preserved —
+  further updates converge with an unsplit control.
+- `fed_merge` folds two adjacent partitions (the inverse transaction)
+  and refuses non-adjacent pids, duplicate pids and 2-partition
+  federations.
+- `fed_compact` / `compact_store` fold N shard generations into one:
+  the compacted store classifies AND updates byte-equivalent to its
+  uncompacted twin (the incremental==from-scratch oracle re-used as
+  the compaction oracle), superseded shards are gc'd, and a rerun is
+  an idempotent no-op.
+- `maintenance_decide` is pure: every reason slug is pinned over
+  synthetic snapshots.
+- tools/scrub_store.py classifies orphaned staging and superseded
+  families as NON-damage ("staged" / "superseded"), and --delete
+  converges them to a clean tree.
+"""
+
+import os
+import shutil
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.errors import UserInputError  # noqa: E402
+from drep_tpu.index import (  # noqa: E402
+    build_federated, compact_store, fed_compact, fed_merge, fed_split,
+    index_classify, index_update, load_index,
+)
+from drep_tpu.index import maintenance as maint  # noqa: E402
+from drep_tpu.index import meta as fedmeta  # noqa: E402
+from drep_tpu.index.federation import load_federated  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RANGE_HI = 2**64
+
+
+def _load_scrub():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "scrub_store", os.path.join(REPO, "tools", "scrub_store.py")
+    )
+    ss = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ss)
+    return ss
+
+
+def _build_fed(tmp_path, partitions=2, groups=(3, 2, 2), seed=72, updates=0):
+    """A federated root (+ optional admitted generations on top)."""
+    paths = lib.write_genome_set(str(tmp_path / "g"), list(groups), seed=seed)
+    loc = str(tmp_path / "fed")
+    build_federated(loc, paths, partitions, length=0)
+    for u in range(updates):
+        batch = lib.write_genome_set(
+            str(tmp_path / f"u{u}"), [1, 1], seed=seed + 10 + u, prefix=f"u{u}_"
+        )
+        index_update(loc, batch)
+    return loc, paths
+
+
+def _splittable_pid(loc: str) -> int:
+    """The first partition whose members span >= 2 distinct sketch range
+    codes (the split refusal's complement) — deterministic from bytes."""
+    union = load_federated(loc, heal=False)
+    m = fedmeta.read_meta(loc)
+    for e in m["partitions"]:
+        if int(e["n_genomes"]) < 2:
+            continue
+        rows = maint._member_rows(union, int(e["pid"]))
+        codes = {fedmeta.route_code(union.bottom[int(u)]) for u in rows}
+        if len(codes) >= 2:
+            return int(e["pid"])
+    raise AssertionError("no splittable partition in this fixture")
+
+
+def _assert_range_cover(m: dict) -> None:
+    """The partition ranges are a contiguous cover of [0, 2^64) and the
+    pids are DENSE in range order (the routing bitmaps are pid-indexed)."""
+    entries = sorted(m["partitions"], key=lambda e: int(e["range"][0]))
+    assert [int(e["pid"]) for e in entries] == list(range(len(entries)))
+    assert int(entries[0]["range"][0]) == 0
+    assert int(entries[-1]["range"][1]) == RANGE_HI
+    for a, b in zip(entries, entries[1:]):
+        assert int(a["range"][1]) == int(b["range"][0])
+
+
+def _semantic(idx) -> dict:
+    """The partitioning-independent identity of a loaded union."""
+    return {
+        "names": sorted(idx.names),
+        "primary": lib.primary_partition(idx),
+        "secondary": lib.secondary_partition(idx),
+        "winners": lib.winners_by_members(idx),
+        "admitted": dict(zip(idx.names, np.asarray(idx.admitted).tolist())),
+        "n_edges": len(idx.edges[0]),
+    }
+
+
+_VOLATILE = ("generation", "primary_cluster", "secondary_cluster",
+             "partitions_consulted", "partitions_unavailable", "partial")
+
+
+def _stable_verdict(v: dict) -> dict:
+    out = {k: val for k, val in v.items() if k not in _VOLATILE}
+    out["cluster_members"] = sorted(v["cluster_members"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# split
+# ---------------------------------------------------------------------------
+
+
+def test_split_preserves_union_and_verdicts(tmp_path):
+    loc, paths = _build_fed(tmp_path, partitions=2)
+    pid = _splittable_pid(loc)
+    before = _semantic(load_federated(loc, heal=False))
+    v_before = [_stable_verdict(v) for v in index_classify(loc, [paths[0]])]
+    m0 = fedmeta.read_meta(loc)
+
+    res = fed_split(loc, pid)
+    assert res["op"] == "split" and res["generation"] == int(m0["generation"]) + 1
+    assert res["n_partitions"] == int(m0["n_partitions"]) + 1
+    assert len(res["children"]) == 2
+    assert sum(c["n_genomes"] for c in res["children"]) > 0
+
+    m1 = fedmeta.read_meta(loc)
+    assert int(m1["n_partitions"]) == int(m0["n_partitions"]) + 1
+    _assert_range_cover(m1)
+    # the transaction record and the parent store are gone (gc ran)
+    assert not os.path.exists(maint.maint_path(loc))
+    parent_dir = next(
+        e["dir"] for e in m0["partitions"] if int(e["pid"]) == pid
+    )
+    live_dirs = {e["dir"] for e in m1["partitions"]}
+    if parent_dir not in live_dirs:
+        assert not os.path.isdir(os.path.join(loc, parent_dir))
+    # membership, clustering, winners, admitted: untouched by the move
+    assert _semantic(load_federated(loc, heal=False)) == before
+    assert [_stable_verdict(v) for v in index_classify(loc, [paths[0]])] == v_before
+
+
+def test_split_then_update_converges_with_unsplit_control(tmp_path):
+    loc, _paths = _build_fed(tmp_path, partitions=2)
+    control = str(tmp_path / "control")
+    shutil.copytree(loc, control)
+    fed_split(loc, _splittable_pid(loc))
+    batch = lib.write_genome_set(str(tmp_path / "b"), [1, 1], seed=90, prefix="n")
+    s_loc = index_update(loc, batch)
+    s_ctl = index_update(control, batch)
+    assert not s_loc["partitions_failed"] and not s_ctl["partitions_failed"]
+    got, want = _semantic(load_index(loc)), _semantic(load_index(control))
+    # the split itself bumped the federation generation, so ABSOLUTE
+    # admit generations shift by one against the unsplit control — the
+    # admission ORDER is the invariant
+    ga, wa = got.pop("admitted"), want.pop("admitted")
+    assert got == want
+    assert {g for g, a in ga.items() if a == max(ga.values())} == \
+        {g for g, a in wa.items() if a == max(wa.values())} == \
+        {os.path.basename(p) for p in batch}
+
+
+def test_split_refusals(tmp_path):
+    loc, _paths = _build_fed(tmp_path, partitions=2)
+    with pytest.raises(UserInputError, match="no partition 9"):
+        fed_split(loc, 9)
+
+
+# ---------------------------------------------------------------------------
+# merge
+# ---------------------------------------------------------------------------
+
+
+def test_merge_folds_adjacent_and_refuses_bad_pairs(tmp_path):
+    loc, paths = _build_fed(tmp_path, partitions=3)
+    before = _semantic(load_federated(loc, heal=False))
+    v_before = [_stable_verdict(v) for v in index_classify(loc, [paths[0]])]
+    m0 = fedmeta.read_meta(loc)
+
+    with pytest.raises(UserInputError, match="DISTINCT"):
+        fed_merge(loc, 1, 1)
+    with pytest.raises(UserInputError, match="not adjacent"):
+        fed_merge(loc, 0, 2)
+
+    res = fed_merge(loc, 0, 1)
+    assert res["op"] == "merge" and res["n_partitions"] == 2
+    assert len(res["children"]) == 1
+    m1 = fedmeta.read_meta(loc)
+    assert int(m1["generation"]) == int(m0["generation"]) + 1
+    _assert_range_cover(m1)
+    child = next(e for e in m1["partitions"] if e["dir"] == res["children"][0]["dir"])
+    assert int(child["range"][0]) == 0  # pid 0+1 ranges folded from the left
+    assert _semantic(load_federated(loc, heal=False)) == before
+    assert [_stable_verdict(v) for v in index_classify(loc, [paths[0]])] == v_before
+
+    # the floor: a 2-partition federation refuses to shrink to 1
+    with pytest.raises(UserInputError, match="at least 2"):
+        fed_merge(loc, 0, 1)
+
+
+# ---------------------------------------------------------------------------
+# compaction
+# ---------------------------------------------------------------------------
+
+
+def test_fed_compact_oracle_against_uncompacted_twin(tmp_path):
+    loc, paths = _build_fed(tmp_path, partitions=2, updates=2)
+    twin = str(tmp_path / "twin")
+    shutil.copytree(loc, twin)
+    m0 = fedmeta.read_meta(loc)
+
+    res = fed_compact(loc, min_generations=2)
+    assert res["op"] == "compact" and res["compacted"]
+    assert res["generation"] == int(m0["generation"]) + 1
+    m1 = fedmeta.read_meta(loc)
+    assert int(m1["generation"]) == int(m0["generation"]) + 1
+    # every compacted partition folded to ONE generation per family,
+    # superseded shards gc'd off disk
+    from drep_tpu.index.store import IndexStore
+
+    for e in m1["partitions"]:
+        if e["dir"] not in res["compacted"]:
+            continue
+        pm = IndexStore(os.path.join(loc, e["dir"])).read_manifest()
+        assert len(pm["sketch_shards"]) == 1
+        assert len(pm["edge_shards"]) == 1
+        sk_dir = os.path.join(loc, e["dir"], "sketches")
+        assert len([f for f in os.listdir(sk_dir) if f.endswith(".npz")]) == 1
+
+    # the compaction oracle: same union, same verdicts, and further
+    # updates converge with the uncompacted twin
+    assert _semantic(load_index(loc)) == _semantic(load_index(twin))
+    novel = lib.write_genome_set(str(tmp_path / "q"), [1], seed=97, prefix="q")
+    got = [_stable_verdict(v) for v in index_classify(loc, [paths[0]] + novel)]
+    want = [_stable_verdict(v) for v in index_classify(twin, [paths[0]] + novel)]
+    assert got == want
+
+    # idempotent: a rerun finds single-generation stores and skips
+    res2 = fed_compact(loc, min_generations=2)
+    assert res2["compacted"] == [] and res2["skipped"]
+
+    index_update(loc, novel)
+    index_update(twin, novel)
+    got = _semantic(load_index(loc))
+    want = _semantic(load_index(twin))
+    # compaction bumped the federation generation, so the twin's post-
+    # compaction admits land one generation apart — order is the invariant
+    ga, wa = got.pop("admitted"), want.pop("admitted")
+    assert got == want
+    assert {g for g, a in ga.items() if a == max(ga.values())} == \
+        {g for g, a in wa.items() if a == max(wa.values())} == \
+        {os.path.basename(p) for p in novel}
+
+
+def test_fed_compact_scoped_and_thresholds(tmp_path):
+    from drep_tpu.index.store import IndexStore
+
+    loc, _paths = _build_fed(tmp_path, partitions=2, updates=2)
+    m = fedmeta.read_meta(loc)
+    multi = [
+        int(e["pid"]) for e in m["partitions"]
+        if int(e["n_genomes"]) > 0
+        and maint._family_generations(
+            IndexStore(os.path.join(loc, e["dir"])).read_manifest()
+        ) >= 2
+    ]
+    assert multi, "fixture grew no multi-generation partition"
+    # a sky-high floor compacts nothing
+    res = fed_compact(loc, min_generations=99)
+    assert res["compacted"] == []
+    # pid-scoped: exactly that partition folds
+    res = fed_compact(loc, pid=multi[0])
+    assert len(res["compacted"]) == 1
+    with pytest.raises(UserInputError, match="no partition 42"):
+        fed_compact(loc, pid=42)
+
+
+def test_compact_plain_store_oracle(tmp_path):
+    paths = lib.write_genome_set(str(tmp_path / "g"), [2, 2], seed=11)
+    from drep_tpu.index import build_from_paths
+
+    loc = str(tmp_path / "idx")
+    build_from_paths(loc, paths, length=0)
+    batch = lib.write_genome_set(str(tmp_path / "b"), [1], seed=12, prefix="n")
+    index_update(loc, batch)
+    twin = str(tmp_path / "twin")
+    shutil.copytree(loc, twin)
+
+    res = compact_store(loc)
+    assert res["compacted"] and res["generation"] == 2
+    assert _semantic(load_index(loc)) == _semantic(load_index(twin))
+    got = [_stable_verdict(v) for v in index_classify(loc, [paths[0]])]
+    want = [_stable_verdict(v) for v in index_classify(twin, [paths[0]])]
+    assert got == want
+    # already-compact: the rerun only sweeps
+    res2 = compact_store(loc)
+    assert res2["compacted"] == [] and res2["skipped"]
+
+
+# ---------------------------------------------------------------------------
+# maintenance scheduler (pure policy + snapshot + env targets)
+# ---------------------------------------------------------------------------
+
+
+def _snap(**kw):
+    base = {
+        "observed_at": 1000.0,
+        "generation": 3,
+        "maintenance_pending": False,
+        "partitions": [
+            {"pid": 0, "n_genomes": 5, "generations": 2},
+            {"pid": 1, "n_genomes": 9, "generations": 3},
+        ],
+    }
+    base.update(kw)
+    return base
+
+
+def test_maintenance_decide_slugs_pinned():
+    from drep_tpu.autoscale.policy import MaintenanceTargets, maintenance_decide
+
+    t = MaintenanceTargets(compact_min_shards=4, split_max_genomes=0,
+                           idle_qps=1.0, cooldown_s=300.0)
+    d = maintenance_decide({"error": "boom", "observed_at": 0.0}, t, [])
+    assert (d.verdict, d.reason) == ("hold", "snapshot-error")
+    d = maintenance_decide(_snap(partitions=[]), t, [])
+    assert d.reason == "not-federated"
+    d = maintenance_decide(_snap(maintenance_pending=True), t, [])
+    assert d.reason == "maintenance-pending"
+    d = maintenance_decide(_snap(qps=5.0), t, [])
+    assert d.reason == "busy-traffic"
+    d = maintenance_decide(_snap(), t, [{"verdict": "compact", "at": 900.0}])
+    assert d.reason == "cooldown"
+    d = maintenance_decide(
+        _snap(partitions=[{"pid": 0, "n_genomes": 5, "generations": -1}]), t, []
+    )
+    assert d.reason == "partition-unreadable"
+    # below both budgets: healthy hold
+    d = maintenance_decide(_snap(), t, [])
+    assert (d.verdict, d.reason) == ("hold", "healthy")
+
+    # compaction budget crossed: the MOST sprawled partition is chosen
+    t2 = MaintenanceTargets(compact_min_shards=3)
+    d = maintenance_decide(_snap(), t2, [])
+    assert (d.verdict, d.reason) == ("compact", "shards-over-budget")
+    assert d.delta == 0 and d.inputs["pid"] == 1
+
+    # split outranks compaction, and picks the FATTEST partition
+    t3 = MaintenanceTargets(compact_min_shards=3, split_max_genomes=8)
+    d = maintenance_decide(_snap(), t3, [])
+    assert (d.verdict, d.reason) == ("split", "partition-over-split-budget")
+    assert d.delta == 0 and d.inputs["pid"] == 1 and d.inputs["n_genomes"] == 9
+
+    # an aged-out cooldown no longer gates
+    d = maintenance_decide(_snap(), t2, [{"verdict": "compact", "at": 100.0}])
+    assert d.verdict == "compact"
+
+
+def test_maintenance_snapshot_read_only_and_pending_flag(tmp_path):
+    loc, _paths = _build_fed(tmp_path, partitions=2, updates=1)
+    digest = lib.tree_digest(loc, exclude_dirs=("log",))
+    snap = maint.maintenance_snapshot(loc)
+    assert lib.tree_digest(loc, exclude_dirs=("log",)) == digest
+    assert snap["maintenance_pending"] is False
+    assert len(snap["partitions"]) == snap["n_partitions"] == 2
+    assert all(p["generations"] >= 1 for p in snap["partitions"]
+               if p["n_genomes"] > 0)
+    maint._write_staging(loc, {"op": "compact", "gen_new": 99, "parents": []})
+    assert maint.maintenance_snapshot(loc)["maintenance_pending"] is True
+    # a plain directory is an honest error, not a crash
+    assert "error" in maint.maintenance_snapshot(str(tmp_path))
+
+
+def test_maintenance_targets_from_env(monkeypatch):
+    monkeypatch.setenv("DREP_TPU_COMPACT_MIN_SHARDS", "7")
+    monkeypatch.setenv("DREP_TPU_SPLIT_MAX_GENOMES", "123")
+    t = maint.maintenance_targets_from_env()
+    assert t.compact_min_shards == 7 and t.split_max_genomes == 123
+
+
+# ---------------------------------------------------------------------------
+# scrubber maintenance classes
+# ---------------------------------------------------------------------------
+
+
+def test_scrub_classifies_staged_and_superseded_not_damage(tmp_path):
+    ss = _load_scrub()
+    loc, _paths = _build_fed(tmp_path, partitions=2, updates=1)
+    assert not ss.scrub([loc])["damaged"]
+
+    # orphaned staging: a transaction record + a staged child payload
+    maint._write_staging(loc, {"op": "split", "gen_new": 9, "parents": []})
+    staged_child = os.path.join(loc, "pending", "part_009", "sketches")
+    os.makedirs(staged_child)
+    with open(os.path.join(staged_child, "sketch_g000000.npz"), "wb") as f:
+        f.write(b"half-built child payload")
+    # superseded families: an unreferenced partition dir and an
+    # unreferenced shard generation inside a live partition
+    ghost = os.path.join(loc, "part_099")
+    os.makedirs(ghost)
+    with open(os.path.join(ghost, "manifest.json"), "w") as f:
+        f.write("{}")
+    m = fedmeta.read_meta(loc)
+    live = next(e["dir"] for e in m["partitions"] if int(e["n_genomes"]) > 0)
+    orphan_shard = os.path.join(loc, live, "sketches", "sketch_g000099.npz")
+    with open(orphan_shard, "wb") as f:
+        f.write(b"superseded generation payload")
+
+    report = ss.scrub([loc])
+    assert not report["damaged"], report["damaged"]  # NON-damage classes
+    assert len(report["staged"]) >= 2
+    assert len(report["superseded"]) >= 2
+    assert any("part_099" in p for p in report["superseded"])
+    assert any(p.endswith("sketch_g000099.npz") for p in report["superseded"])
+
+    # --delete converges: maintenance leftovers removed, live tree clean
+    ss.scrub([loc], delete=True)
+    assert not os.path.exists(orphan_shard)
+    assert not os.path.exists(os.path.join(ghost, "manifest.json"))
+    assert not os.path.exists(maint.maint_path(loc))
+    report2 = ss.scrub([loc])
+    assert not report2["damaged"]
+    assert not report2["staged"] and not report2["superseded"]
+    assert load_index(loc).names  # the live store still loads
+
+
+def test_roll_forward_noop_on_clean_store(tmp_path):
+    loc, _paths = _build_fed(tmp_path, partitions=2)
+    digest = lib.tree_digest(loc, exclude_dirs=("log",))
+    assert maint.roll_forward(loc) is None
+    assert lib.tree_digest(loc, exclude_dirs=("log",)) == digest
+    # a corrupt transaction record is discarded with a warning, not fatal
+    os.makedirs(os.path.join(loc, "pending"), exist_ok=True)
+    with open(maint.maint_path(loc), "w") as f:
+        f.write("{torn json")
+    assert maint.read_staging(loc) is None
+    assert not os.path.exists(maint.maint_path(loc))

@@ -1,0 +1,344 @@
+"""SIGKILL convergence cells for the transactional index lifecycle
+(ISSUE 18, `tools/chaos_matrix.py --maintenance`).
+
+The acceptance contract: `index split`, `index merge` and
+`index compact` are staged meta-manifest transactions — a SIGKILL at
+ANY phase boundary (the ``partition_split`` / ``compaction`` fault
+sites fire at skip=0 STAGED, skip=1 PRE-COMMIT, skip=2 PRE-GC) leaves
+the old meta fully live (pre-commit) or is rolled forward (post-
+commit), and a rerun of the same verb converges byte-identical to an
+uninterrupted control (modulo npz zip timestamps). The kill cells run
+the REAL CLI as a subprocess victim, exactly like the PR 13 federation
+chaos cells.
+
+Also pinned here:
+
+- compaction gc HONESTY: a corrupt SUPERSEDED shard left by a kill
+  between the meta publish and the gc is removed WITHOUT being read
+  (no heal event, no verification error), the rerun never re-counts
+  the fold's ``healed`` tally, and the gc resume is idempotent.
+- LIVE-TRAFFIC safety: a serve replica + fleet router ride through a
+  split under continuous routed classify traffic with zero daemon
+  exceptions — the commit is an ordinary hot-swap generation bump, and
+  post-split verdicts match the post-split oracle.
+
+Marked slow+chaos: the kill cells each pay a subprocess JAX import and
+the tier-1 budget sits at the 870s knife edge — chaos_matrix runs them
+by test id, like the router cells.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import (  # noqa: E402
+    build_federated, fed_compact, fed_merge, fed_split, index_classify,
+    index_update,
+)
+from drep_tpu.index import maintenance as maint  # noqa: E402
+from drep_tpu.index import meta as fedmeta  # noqa: E402
+from drep_tpu.index.federation import load_federated  # noqa: E402
+from drep_tpu.utils import faults  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+
+def _setup(tmp_path, partitions=2, seed=72):
+    """A federated root with one admitted generation on top (so splits
+    fold real multi-generation parents and compaction has work), plus
+    an identical CONTROL copy for the uninterrupted twin."""
+    base = lib.write_genome_set(str(tmp_path / "base"), [3, 2, 2], seed=seed)
+    batch = lib.write_genome_set(
+        str(tmp_path / "batch"), [1, 1], seed=seed + 1, prefix="n"
+    )
+    loc = str(tmp_path / "fed")
+    build_federated(loc, base, partitions, length=0)
+    index_update(loc, batch)
+    control = str(tmp_path / "control")
+    shutil.copytree(loc, control)
+    return loc, control, base
+
+
+def _splittable_pid(loc: str) -> int:
+    union = load_federated(loc, heal=False)
+    m = fedmeta.read_meta(loc)
+    for e in m["partitions"]:
+        if int(e["n_genomes"]) < 2:
+            continue
+        rows = maint._member_rows(union, int(e["pid"]))
+        codes = {fedmeta.route_code(union.bottom[int(u)]) for u in rows}
+        if len(codes) >= 2:
+            return int(e["pid"])
+    raise AssertionError("no splittable partition in this fixture")
+
+
+def _cli(loc: str, argv: list[str], fault_spec: str | None = None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if fault_spec:
+        env["DREP_TPU_FAULTS"] = fault_spec
+    return subprocess.run(
+        [sys.executable, "-m", "drep_tpu", "index", *argv, "-p", "1"],
+        capture_output=True, text=True, cwd=REPO, timeout=300, env=env,
+    )
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL at each phase boundary: rerun converges byte-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("skip", [0, 1, 2], ids=["staged", "precommit", "pregc"])
+def test_sigkill_split_rerun_converges(tmp_path, skip):
+    """partition_split:kill at skip=0/1/2: pre-commit kills leave the
+    old meta exactly live (readers see generation 1); the rerun — same
+    verb, no faults — converges byte-identical to the uninterrupted
+    control. The post-commit kill (skip=2) is rolled forward and the
+    rerun reports the committed transaction instead of re-splitting
+    the renumbered pid."""
+    loc, control, _base = _setup(tmp_path)
+    pid = _splittable_pid(loc)
+    fed_split(control, pid)  # the uninterrupted twin
+    res = _cli(loc, ["split", loc, "--pid", str(pid)],
+               f"partition_split:kill:1.0:skip={skip}")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    m = fedmeta.read_meta(loc)
+    if skip < 2:
+        assert int(m["generation"]) == 1  # old meta fully live
+        assert int(m["n_partitions"]) == 2
+    else:
+        assert int(m["generation"]) == 2  # committed, gc still owed
+    res2 = fed_split(loc, pid)
+    if skip == 2:
+        assert res2.get("already_committed"), res2
+    else:
+        assert res2["generation"] == 2 and res2["n_partitions"] == 3
+    lib.assert_stores_equal(loc, control)
+
+
+@pytest.mark.parametrize("skip", [0, 1, 2], ids=["staged", "precommit", "pregc"])
+def test_sigkill_merge_rerun_converges(tmp_path, skip):
+    """The same three kill points through `index merge` (split's
+    inverse rides the same transaction body and the same
+    partition_split fault site)."""
+    loc, control, _base = _setup(tmp_path, partitions=3)
+    fed_merge(control, 0, 1)
+    res = _cli(loc, ["merge", loc, "--pids", "0", "1"],
+               f"partition_split:kill:1.0:skip={skip}")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    m = fedmeta.read_meta(loc)
+    assert int(m["generation"]) == (1 if skip < 2 else 2)
+    res2 = fed_merge(loc, 0, 1)
+    if skip == 2:
+        assert res2.get("already_committed"), res2
+    else:
+        assert res2["generation"] == 2 and res2["n_partitions"] == 2
+    lib.assert_stores_equal(loc, control)
+
+
+@pytest.mark.parametrize("skip", [0, 1, 2], ids=["staged", "precommit", "pregc"])
+def test_sigkill_compact_rerun_converges(tmp_path, skip):
+    """compaction:kill at skip=0/1/2. skip=1 is the nastiest state: the
+    per-partition manifests are already published (ahead-by-one with an
+    UNCHANGED genome count — the unambiguous compaction interrupt) but
+    the meta is not — roll_forward completes the commit instead of
+    unwinding it, and the rerun converges on the control."""
+    loc, control, _base = _setup(tmp_path)
+    fed_compact(control, min_generations=2)
+    res = _cli(loc, ["compact", loc, "--min_generations", "2"],
+               f"compaction:kill:1.0:skip={skip}")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    res2 = fed_compact(loc, min_generations=2)
+    assert res2["compacted"] == [] and res2.get("already_committed"), res2
+    m = fedmeta.read_meta(loc)
+    assert int(m["generation"]) == 2
+    lib.assert_stores_equal(loc, control)
+
+
+def test_recordless_compaction_interrupt_adopted(tmp_path):
+    """Belt-and-braces for the adoption path: even with the transaction
+    record DELETED after a pre-commit kill (a lost pending/ dir), the
+    ahead-by-one-unchanged-n partitions are recognized as an interrupted
+    compaction and the meta is republished — `index update` (which
+    roll_forwards first) then admits on top of the adopted generation."""
+    loc, control, _base = _setup(tmp_path)
+    fed_compact(control, min_generations=2)
+    res = _cli(loc, ["compact", loc, "--min_generations", "2"],
+               "compaction:kill:1.0:skip=1")
+    assert res.returncode == -signal.SIGKILL, res.stderr[-2000:]
+    os.remove(maint.maint_path(loc))  # the record is gone for good
+    rf = maint.roll_forward(loc)
+    assert rf and rf["op"] == "compact" and rf["rolled"] == "forward"
+    assert int(fedmeta.read_meta(loc)["generation"]) == 2
+    lib.assert_stores_equal(loc, control)
+
+
+# ---------------------------------------------------------------------------
+# compaction gc honesty
+# ---------------------------------------------------------------------------
+
+
+def test_compaction_gc_honesty_no_reread_no_double_heal(tmp_path):
+    """A corrupt LIVE shard is healed exactly once by the fold; a kill
+    between the meta publish and the gc leaves superseded shards on
+    disk, and the resume removes them WITHOUT reading (a corrupt
+    superseded shard is deleted, never verified or healed or double-
+    counted), idempotently."""
+    from drep_tpu.utils.durableio import _flip_bit
+
+    loc, control, _base = _setup(tmp_path)
+    # the same deterministic pre-fold damage on both twins
+    victims = sorted(
+        os.path.relpath(os.path.join(dp, f), loc)
+        for dp, _d, fs in os.walk(loc)
+        for f in fs if f == "sketch_g000000.npz" and "part_" in dp
+    )
+    _flip_bit(os.path.join(loc, victims[0]))
+    _flip_bit(os.path.join(control, victims[0]))
+
+    s_ctl = fed_compact(control, min_generations=2)
+    assert s_ctl["healed"] == 1, s_ctl  # the fold healed it, once
+
+    faults.configure("compaction:raise:1.0:skip=2")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            fed_compact(loc, min_generations=2)
+    finally:
+        faults.configure(None)
+    # committed but not gc'd: the superseded generations are still here
+    assert int(fedmeta.read_meta(loc)["generation"]) == 2
+    superseded = [
+        os.path.join(dp, f)
+        for dp, _d, fs in os.walk(loc)
+        for f in fs
+        if f.startswith(("sketch_g", "edges_g", "state_g"))
+        and not f.endswith("_g000002.npz") and "part_" in dp
+    ]
+    assert superseded, "pre-gc kill left no superseded shards"
+    _flip_bit(superseded[0])  # gc must delete this WITHOUT reading it
+
+    res = fed_compact(loc, min_generations=2)  # the resume
+    assert res["compacted"] == [] and res.get("already_committed"), res
+    assert "healed" not in res  # the fold's heal tally is never re-counted
+    for path in superseded:
+        assert not os.path.exists(path)
+    # idempotent: another roll_forward moves nothing
+    digest = lib.tree_digest(loc, exclude_dirs=("log",))
+    assert maint.roll_forward(loc) is None
+    assert lib.tree_digest(loc, exclude_dirs=("log",)) == digest
+    lib.assert_stores_equal(loc, control)
+    # the surviving store is clean: a heal pass finds nothing to heal
+    summary = index_update(loc, None)
+    assert summary["healed"] == []
+
+
+# ---------------------------------------------------------------------------
+# live-traffic safety: a split lands under a serving fleet
+# ---------------------------------------------------------------------------
+
+
+def _spawn(argv, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "drep_tpu"] + argv,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env,
+    )
+    line = proc.stdout.readline()
+    assert line, "daemon died before its ready line"
+    return proc, json.loads(line)
+
+
+def test_split_under_live_router_traffic(tmp_path, monkeypatch):
+    """A split commits under a replica + router serving continuous
+    classify traffic: every response stays ok (worst case a stamped
+    PARTIAL during the swap window — never an exception or a dropped
+    query), both daemons outlive the transaction, and post-split
+    verdicts match the post-split oracle at the new generation."""
+    from drep_tpu.serve import ServeClient
+
+    base = lib.write_genome_set(str(tmp_path / "base"), [3, 2, 2], seed=72)
+    loc = str(tmp_path / "fed")
+    build_federated(loc, base, 2, length=0)
+    pid = _splittable_pid(loc)
+    # the gc grace keeps the parent store alive through the replica's
+    # hot-swap window (the live-traffic knob under test)
+    monkeypatch.setenv("DREP_TPU_SPLIT_GC_GRACE_S", "2.0")
+
+    replica, rep_ready = _spawn(
+        ["index", "serve", loc, "--batch_window_ms", "20",
+         "--poll_generation_s", "0.2"])
+    router, rt_ready = _spawn(
+        ["index", "route", loc, "--batch_window_ms", "20",
+         "--poll_generation_s", "0.2", "--probe_interval_s", "0.3",
+         "--replica", rep_ready["serving"]])
+    stop = threading.Event()
+    responses: list[dict] = []
+    failures: list[BaseException] = []
+
+    def _traffic():
+        try:
+            with ServeClient(rt_ready["serving"], timeout_s=600) as c:
+                while not stop.is_set():
+                    responses.append(c.classify(base[0], retries=10))
+                    time.sleep(0.05)
+        except BaseException as e:  # noqa: BLE001 — the test owns the verdict
+            failures.append(e)
+
+    t = threading.Thread(target=_traffic, daemon=True)
+    try:
+        t.start()
+        deadline = time.monotonic() + 60
+        while not responses and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert responses, "no traffic flowed before the split"
+
+        res = fed_split(loc, pid)  # the maintenance commit, mid-traffic
+        assert res["generation"] == 1 and res["n_partitions"] == 3
+
+        with ServeClient(rt_ready["serving"], timeout_s=600) as probe:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if int(probe.status()["generation"]) >= 1:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("router never swapped to the split meta")
+            time.sleep(1.0)  # a few more routed queries on the new meta
+            stop.set()
+            t.join(timeout=120)
+            assert not t.is_alive(), "traffic thread wedged"
+            assert not failures, failures  # zero exceptions anywhere
+            assert responses and all(r["ok"] for r in responses)
+            assert replica.poll() is None and router.poll() is None
+
+            oracle = index_classify(loc, [base[0]])[0]
+            final = probe.classify(base[0])
+            assert final["ok"] and not final["verdict"].get("partial")
+            v = dict(final["verdict"])
+            for k in ("partitions_consulted", "partitions_unavailable", "partial"):
+                v.pop(k, None)
+            assert v == oracle
+        for proc in (router, replica):
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=120) == 0
+    finally:
+        stop.set()
+        for proc in (router, replica):
+            if proc.poll() is None:
+                proc.kill()

@@ -18,6 +18,15 @@ pins the acceptance contract of the fleet front door:
   overload as an honest PARTIAL (overload_spills booked) instead of
   queueing behind the drain, while the replica finishes its in-flight
   query and exits 0 — no dropped work anywhere.
+- HA handoff (ISSUE 18 satellite): two routers front the SAME fleet;
+  SIGKILL one mid-scatter -> its in-flight client gets a clean
+  disconnection (never a hang), the survivor keeps serving full-
+  coverage verdicts byte-identical to the oracle with no restart, and
+  the replicas never notice.
+- Prefetch hints (ISSUE 18 satellite): a `fleet join` carrying assigned
+  partitions is prewarm-dispatched BEFORE the ack — the joining
+  replica's assigned partitions are resident (loads==1) before its
+  first scatter leg, and that first leg adds no cold load.
 
 Marked slow+chaos: each cell pays several subprocesses (full JAX
 imports) and the tier-1 budget sits at the 870s knife edge —
@@ -347,3 +356,141 @@ def test_overload_spill_under_saturated_replica(tmp_path):
         assert router.wait(timeout=120) == 0
     finally:
         _reap(router, r1)
+
+
+def test_router_ha_handoff_survivor_serves_through_sigkill(tmp_path):
+    """Two routers front the SAME fleet (the routers are stateless —
+    the fleet table is per-router config): SIGKILL one while its
+    scatter legs grind a slow partition (an injected partition_classify
+    sleep paces the fan-out so the kill lands mid-scatter). The client
+    on the dead router gets a clean disconnection — never a hang, never
+    a torn line — while the SURVIVOR keeps serving byte-identical
+    full-coverage verdicts with no restart, and both replicas ride
+    through untouched."""
+    loc, paths, victim_pid = _build(tmp_path)
+    complement = [p for p in range(P) if p != victim_pid]
+    oracle = index_classify(loc, [paths[0]])[0]
+    log1, log2 = str(tmp_path / "rt1_log"), str(tmp_path / "rt2_log")
+    os.makedirs(log1)
+    os.makedirs(log2)
+
+    # the slow partition makes every scatter hold legs open ~2s, so the
+    # kill below reliably lands mid-scatter
+    r_slow, rs_ready = _spawn_replica(
+        loc, extra_env={"DREP_TPU_FAULTS": "partition_classify:sleep:secs=2"}
+    )
+    r_fast, rf_ready = _spawn_replica(loc)
+    specs = [f"{rs_ready['serving']}={victim_pid}",
+             f"{rf_ready['serving']}={','.join(str(p) for p in complement)}"]
+    flags = ["--probe_interval_s", "0.3",
+             "--leg_timeout_s", "60", "--hedge_delay_s", "60"]
+    router1, rt1_ready = _spawn_router(loc, log1, specs, flags)
+    router2, rt2_ready = _spawn_router(loc, log2, specs, flags)
+    bg: dict = {}
+    try:
+        with ServeClient(rt2_ready["serving"], timeout_s=600) as c2:
+            # both fronts healthy: routed verdicts == the oracle
+            warm = c2.classify(paths[0])
+            assert warm["ok"] and not warm["verdict"].get("partial")
+            assert _strip(warm["verdict"]) == oracle
+
+            def _doomed():
+                try:
+                    with ServeClient(rt1_ready["serving"], timeout_s=600) as c1:
+                        assert c1.classify(paths[0])["ok"]  # warm router1 too
+                        bg["resp"] = c1.classify(paths[0])
+                except ServeError as e:
+                    bg["error"] = e
+
+            t = threading.Thread(target=_doomed, daemon=True)
+            t.start()
+            time.sleep(3.0)  # past the warm classify, into the doomed scatter
+            router1.kill()  # SIGKILL: mid-scatter, no goodbye
+            router1.wait(timeout=60)
+            t.join(timeout=60)
+            assert not t.is_alive(), "client on the dead router hung"
+            # the in-flight query died CLEANLY: a disconnection error,
+            # or (kill raced the gather's send) a complete final reply
+            assert "error" in bg or bg["resp"]["ok"], bg
+
+            # the survivor serves on — full coverage, no restart
+            r2 = c2.classify(paths[0])
+            assert r2["ok"] and not r2["verdict"].get("partial")
+            assert _strip(r2["verdict"]) == oracle
+            assert router2.poll() is None
+            assert r_slow.poll() is None and r_fast.poll() is None
+        router2.send_signal(signal.SIGTERM)
+        assert router2.wait(timeout=120) == 0
+        for proc in (r_slow, r_fast):
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=300) == 0
+    finally:
+        _reap(router1, router2, r_slow, r_fast)
+
+
+def test_fleet_join_prewarm_no_cold_load_spike(tmp_path):
+    """`fleet join` with assigned partitions prewarm-dispatches BEFORE
+    the ack: the joiner's assigned partitions are already resident
+    (loads==1) when the join reply lands, the router books a
+    fleet_prewarm event, and the first scatter leg against the joiner
+    adds NO cold load (loads stays 1) while restoring full-coverage
+    verdicts byte-identical to the oracle."""
+    loc, paths, victim_pid = _build(tmp_path)
+    complement = [p for p in range(P) if p != victim_pid]
+    oracle = index_classify(loc, [paths[0]])[0]
+    log_dir = str(tmp_path / "route_log")
+    os.makedirs(log_dir)
+
+    r1, r1_ready = _spawn_replica(loc)
+    router, rt_ready = _spawn_router(
+        loc, log_dir,
+        [f"{r1_ready['serving']}={','.join(str(p) for p in complement)}"],
+        ["--probe_interval_s", "0.3",
+         "--leg_timeout_s", "30", "--hedge_delay_s", "30"],
+    )
+    r2 = None
+    try:
+        with ServeClient(rt_ready["serving"], timeout_s=600) as c:
+            # pre-join: the victim partition has no replica — PARTIAL
+            pre = c.classify(paths[0])
+            assert pre["ok"] and pre["verdict"]["partial"] is True
+            assert victim_pid in pre["verdict"]["partitions_unavailable"]
+
+            r2, r2_ready = _spawn_replica(loc)
+            with ServeClient(r2_ready["serving"], timeout_s=120) as direct:
+                cold = direct.status()["partitions"]["partitions"]
+                assert not cold[str(victim_pid)]["resident"]
+                assert cold[str(victim_pid)]["loads"] == 0
+
+                jr = c.request({
+                    "op": "fleet", "action": "join",
+                    "address": r2_ready["serving"],
+                    "partitions": [victim_pid],
+                })
+                assert jr["ok"] and jr["known"]
+                # the ack already implies the prewarm ran: assigned
+                # partition resident, exactly one load, no leg yet
+                warm = direct.status()["partitions"]["partitions"]
+                assert warm[str(victim_pid)]["resident"] is True
+                assert warm[str(victim_pid)]["loads"] == 1
+                for p in complement:
+                    assert warm[str(p)]["loads"] == 0  # hint-scoped, not a flood
+
+                post = _classify_until(
+                    c, paths[0],
+                    lambda r: r["ok"]
+                    and not r["verdict"].get("partitions_unavailable"),
+                )
+                assert _strip(post["verdict"]) == oracle
+                # the first leg paid NO cold load: the prewarm already did
+                after = direct.status()["partitions"]["partitions"]
+                assert after[str(victim_pid)]["loads"] == 1
+                assert after[str(victim_pid)]["resident"] is True
+        for proc in (router, r1, r2):
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=120) == 0
+    finally:
+        _reap(router, r1, r2)
+    evs = [e["ev"] for e in _events(log_dir)]
+    assert "fleet_prewarm" in evs
+    assert "fleet_join" in evs

@@ -88,6 +88,20 @@ Two tiers:
   refusals spill the leg to honest PARTIAL degradation instead of
   queueing behind it). Delegate to tests/test_router_chaos.py, CPU-only.
 
+- maintenance cells (``--maintenance``): the transactional index
+  lifecycle (ISSUE 18, drep_tpu/index/maintenance.py) — SIGKILL the
+  real `index split` / `index merge` / `index compact` CLI at EVERY
+  phase boundary of the staged meta-manifest transaction (STAGED /
+  PRE-COMMIT / PRE-GC, via the deterministic ``partition_split`` and
+  ``compaction`` fault sites): pre-commit kills leave the old meta
+  fully live, post-commit kills roll forward, and a rerun converges
+  byte-identical to an uninterrupted control. Plus the gc-honesty cell
+  (a corrupt superseded shard is deleted without being read and the
+  fold's heal tally is never double-counted), the record-less
+  compaction adoption cell, and the live-traffic cell (a split commits
+  under a replica+router as an ordinary hot-swap with zero daemon
+  exceptions). Delegate to tests/test_maintenance_chaos.py, CPU-only.
+
 - autoscaling cells (``--autoscale``): the deadline-driven controller
   (ISSUE 15, drep_tpu/autoscale/ + tools/pod_autoscale.py) — a real pod
   under ``--deadline`` pressure gains a CONTROLLER-spawned joiner
@@ -110,6 +124,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --autoscale # + controller cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --router  # + fleet front-door cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --maintenance # + index lifecycle cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
 
@@ -597,6 +612,80 @@ ROUTER_CELLS = [
      "queues behind it",
      "survive",
      "tests/test_router_chaos.py::test_overload_spill_under_saturated_replica"),
+    ("router_front", "kill",
+     "SIGKILL one of two routers fronting the same fleet mid-scatter -> "
+     "clean client disconnection, survivor serves oracle verdicts, "
+     "replicas untouched",
+     "survive",
+     "tests/test_router_chaos.py::test_router_ha_handoff_survivor_serves_through_sigkill"),
+    ("fleet_join", "prewarm",
+     "join with assigned partitions -> prewarm lands before the ack "
+     "(loads==1), first scatter leg adds no cold load",
+     "survive",
+     "tests/test_router_chaos.py::test_fleet_join_prewarm_no_cold_load_spike"),
+]
+
+
+# maintenance cells (--maintenance, ISSUE 18): the transactional index
+# lifecycle — split/merge/compaction as staged meta-manifest
+# transactions. Every kill cell runs the real CLI as a subprocess
+# victim with a deterministic fault spec (partition_split / compaction
+# fired at skip=0 STAGED, skip=1 PRE-COMMIT, skip=2 PRE-GC) and pins
+# rerun convergence byte-identical to an uninterrupted control.
+# CPU-only, seconds to tens of seconds each.
+MAINTENANCE_CELLS = [
+    ("partition_split", "kill",
+     "SIGKILL `index split` STAGED -> old meta live, rerun converges",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_split_rerun_converges[staged]"),
+    ("partition_split", "kill",
+     "SIGKILL `index split` PRE-COMMIT -> old meta live, rerun converges",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_split_rerun_converges[precommit]"),
+    ("partition_split", "kill",
+     "SIGKILL `index split` PRE-GC -> committed, roll-forward finishes gc",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_split_rerun_converges[pregc]"),
+    ("partition_split", "kill",
+     "SIGKILL `index merge` STAGED -> old meta live, rerun converges",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_merge_rerun_converges[staged]"),
+    ("partition_split", "kill",
+     "SIGKILL `index merge` PRE-COMMIT -> old meta live, rerun converges",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_merge_rerun_converges[precommit]"),
+    ("partition_split", "kill",
+     "SIGKILL `index merge` PRE-GC -> committed, roll-forward finishes gc",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_merge_rerun_converges[pregc]"),
+    ("compaction", "kill",
+     "SIGKILL `index compact` STAGED -> folded shards invisible, rerun converges",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_compact_rerun_converges[staged]"),
+    ("compaction", "kill",
+     "SIGKILL `index compact` PRE-COMMIT (manifests ahead-by-one) -> "
+     "roll-forward completes the commit",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_compact_rerun_converges[precommit]"),
+    ("compaction", "kill",
+     "SIGKILL `index compact` PRE-GC -> committed, gc resumes idempotently",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_sigkill_compact_rerun_converges[pregc]"),
+    ("compaction", "kill",
+     "transaction record LOST after pre-commit kill -> ahead-by-one "
+     "unchanged-n partitions adopted, meta republished",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_recordless_compaction_interrupt_adopted"),
+    ("compaction", "corrupt",
+     "corrupt superseded shard after pre-gc kill -> gc deletes without "
+     "reading, heal tally never double-counted",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_compaction_gc_honesty_no_reread_no_double_heal"),
+    ("partition_split", "live",
+     "split commits under replica+router traffic -> ordinary hot-swap, "
+     "zero daemon exceptions, post-split oracle verdicts",
+     "survive",
+     "tests/test_maintenance_chaos.py::test_split_under_live_router_traffic"),
 ]
 
 
@@ -657,6 +746,7 @@ def main() -> int:
     router_cells = "--router" in sys.argv
     events_cells = "--events" in sys.argv
     autoscale_cells = "--autoscale" in sys.argv
+    maintenance_cells = "--maintenance" in sys.argv
     from drep_tpu.parallel import faulttol
     from drep_tpu.utils.profiling import counters
 
@@ -703,6 +793,7 @@ def main() -> int:
     _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
     _pytest_cells(FED_SERVE_CELLS, "--serve-federated", fed_serve_cells)
     _pytest_cells(ROUTER_CELLS, "--router", router_cells)
+    _pytest_cells(MAINTENANCE_CELLS, "--maintenance", maintenance_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)
     _pytest_cells(AUTOSCALE_CELLS, "--autoscale", autoscale_cells)
     _pytest_cells(POD_CELLS, "--pod", pod)

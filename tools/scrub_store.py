@@ -33,6 +33,15 @@ Verified payload families (everything else is left alone):
   (``cross_g*.npz`` cross-partition edges, ``fedstate_g*.npz`` union
   state); damage under a partition is reported WITH the partition id,
   so an `index update` heal pass can be pointed at the right store.
+- index-maintenance lifecycle leftovers (drep_tpu/index/maintenance.py)
+  report as their own NON-damage classes, like torn tails: ``STAGED``
+  (a federated root's ``pending/`` transaction record + child stores —
+  an in-flight or interrupted split/merge/compact) and ``SUPERSEDED``
+  (payloads a committed transaction no longer references but has not
+  yet gc'd: old parent partition stores, unreferenced cross/fedstate/
+  routing files, a compacted store's pre-fold shard generations).
+  ``--delete`` removes them, pre-empting the convergence the next
+  maintenance pass would perform anyway.
 - ``events.p*.jsonl`` telemetry logs (utils/telemetry.py) — every
   complete line must parse as JSON (mid-file rot is DAMAGE); a torn
   FINAL line is a killed writer's expected crash evidence, reported as
@@ -82,6 +91,88 @@ _EVENTS_RE = re.compile(r"^events\.p\d+\.jsonl$")
 # right store
 _PARTITION_RE = re.compile(r"(?:^|[\\/])(part_\d{3})[\\/]")
 
+_PART_DIR_RE = re.compile(r"^part_\d{3}$")
+
+
+def _maintenance_map(root: str) -> dict[str, str]:
+    """Classify index-maintenance leftovers (ISSUE 18) under `root`:
+    path -> "staged" (artifacts of an in-flight/interrupted split/merge/
+    compact transaction under a federated root's ``pending/``) or
+    "superseded" (payloads a COMMITTED maintenance transaction no longer
+    references but has not yet gc'd: old parent partition stores,
+    unreferenced cross/fedstate/routing family files, and a compacted
+    store's pre-fold shard generations). Both are expected lifecycle
+    states, NOT damage — the next maintenance pass (`index split|merge|
+    compact`, or any federated `index update`) converges them; --delete
+    just gets there first. Reads metas UNVERIFIED (a rotted meta still
+    reports as damage through the ordinary walk — this pre-pass only
+    decides which intact files are maintenance leftovers)."""
+    out: dict[str, str] = {}
+
+    def _tag_tree(top: str, cls: str) -> None:
+        for dp, _dd, ff in os.walk(top):
+            for f in ff:
+                out[os.path.join(dp, f)] = cls
+
+    for dirpath, dirs, files in os.walk(root):
+        if "federation.json" in files:
+            try:
+                with open(os.path.join(dirpath, "federation.json"), "rb") as f:
+                    meta = json.load(f)
+                entries = list(meta.get("partitions", ()))
+            except (OSError, ValueError):
+                continue
+            _tag_tree(os.path.join(dirpath, "pending"), "staged")
+            live_dirs = {str(e.get("dir")) for e in entries}
+            for d in dirs:
+                if _PART_DIR_RE.match(d) and d not in live_dirs:
+                    _tag_tree(os.path.join(dirpath, d), "superseded")
+            keep = {
+                os.path.basename(str(e.get("file")))
+                for e in meta.get("cross_shards", ())
+            }
+            for sub, prefix, keep_set in (
+                ("cross", "cross_g", keep),
+                ("state", "fedstate_g",
+                 {os.path.basename(str(meta.get("state") or ""))}),
+                ("routing", "summary_g",
+                 {os.path.basename(str(meta.get("routing") or ""))}),
+            ):
+                fam = os.path.join(dirpath, sub)
+                if not os.path.isdir(fam):
+                    continue
+                for f in os.listdir(fam):
+                    if (f.startswith(prefix) and f.endswith(".npz")
+                            and f not in keep_set):
+                        out[os.path.join(fam, f)] = "superseded"
+        elif "manifest.json" in files:
+            # an index store (plain, or one federated partition): shard
+            # generations the CURRENT manifest no longer references are
+            # a compaction's not-yet-gc'd leftovers
+            try:
+                with open(os.path.join(dirpath, "manifest.json"), "rb") as f:
+                    pm = json.load(f)
+            except (OSError, ValueError):
+                continue
+            keep = {
+                os.path.basename(str(e.get("file")))
+                for fam in ("sketch_shards", "edge_shards")
+                for e in pm.get(fam, ())
+            }
+            keep.add(os.path.basename(str(pm.get("state") or "")))
+            for sub, prefix in (
+                ("sketches", "sketch_g"), ("edges", "edges_g"),
+                ("state", "state_g"),
+            ):
+                fam_dir = os.path.join(dirpath, sub)
+                if not os.path.isdir(fam_dir):
+                    continue
+                for f in os.listdir(fam_dir):
+                    if (f.startswith(prefix) and f.endswith(".npz")
+                            and f not in keep):
+                        out[os.path.join(fam_dir, f)] = "superseded"
+    return out
+
 
 def _is_json_note(name: str) -> bool:
     # every checked-JSON family the pipeline publishes: store meta, the
@@ -125,6 +216,12 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
     damaged: list[tuple[str, str]] = []
     artifacts: list[str] = []
     torn_tails: list[str] = []
+    staged: list[str] = []
+    superseded: list[str] = []
+    maint_map: dict[str, str] = {}
+    for root in roots:
+        if os.path.isdir(root):
+            maint_map.update(_maintenance_map(root))
 
     def check_events(path: str) -> None:
         """Line-wise validation of a telemetry event log: every COMPLETE
@@ -150,6 +247,13 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
 
     def check(path: str, name: str) -> None:
         nonlocal verified, legacy
+        cls = maint_map.get(path)
+        if cls is not None:
+            # maintenance lifecycle leftovers (ISSUE 18): staged txn
+            # artifacts / committed-but-not-yet-gc'd payloads — expected
+            # states the next maintenance pass converges, NOT damage
+            (staged if cls == "staged" else superseded).append(path)
+            return
         if ".tmp-" in name:
             # an orphaned atomic-write tmp (SIGKILL mid-publish — the
             # cleanup `finally` never ran): garbage no reader ever
@@ -232,6 +336,31 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
     for path in torn_tails:
         print(f"TORN-TAIL {path}: event log ends mid-line (expected crash "
               f"evidence from a killed writer, not damage)", file=out)
+    for path in staged:
+        action = ""
+        if delete:
+            try:
+                # drep-lint: allow[reader-purity] — --delete repair mode: staged maintenance-transaction artifacts; removing them just pre-empts the rollback/roll-forward the next maintenance pass performs
+                os.remove(path)
+                action = " [deleted — next maintenance pass restages]"
+            except OSError as e:
+                action = f" [delete failed: {e}]"
+        print(f"STAGED {path}: in-flight index-maintenance staging "
+              f"(pending split/merge/compact transaction — converged or "
+              f"discarded by the next maintenance pass, not damage)"
+              f"{action}", file=out)
+    for path in superseded:
+        action = ""
+        if delete:
+            try:
+                # drep-lint: allow[reader-purity] — --delete repair mode: payloads a COMMITTED maintenance transaction superseded; the next maintenance pass gc's them identically
+                os.remove(path)
+                action = " [deleted — completes the interrupted gc]"
+            except OSError as e:
+                action = f" [delete failed: {e}]"
+        print(f"SUPERSEDED {path}: superseded by a committed index-"
+              f"maintenance transaction, gc pending (the next maintenance "
+              f"pass removes it, not damage){action}", file=out)
     if by_partition:
         print(
             "scrub: federated damage by partition: "
@@ -243,11 +372,15 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
         f"(readable, no in-band checksum), {len(damaged)} damaged"
         + (" (deleted)" if delete and damaged else "")
         + (f", {len(artifacts)} crash artifact(s)" if artifacts else "")
-        + (f", {len(torn_tails)} torn event-log tail(s)" if torn_tails else ""),
+        + (f", {len(torn_tails)} torn event-log tail(s)" if torn_tails else "")
+        + (f", {len(staged)} staged maintenance artifact(s)" if staged else "")
+        + (f", {len(superseded)} superseded (gc-pending) payload(s)"
+           if superseded else ""),
         file=out,
     )
     return {"verified": verified, "legacy": legacy, "damaged": damaged,
             "artifacts": artifacts, "torn_tails": torn_tails,
+            "staged": staged, "superseded": superseded,
             "by_partition": by_partition}
 
 
