@@ -1571,6 +1571,7 @@ def classify_batch_federated(
     prune_cfg: dict | None = None,
     joint: bool = True,
     partition_compare=None,
+    consult_check=None,
 ) -> list[dict]:
     """Streaming per-partition classify (ISSUE 14 tentpole): route, run
     one rect compare per (consulted partition x batch), merge the
@@ -1589,7 +1590,14 @@ def classify_batch_federated(
     here, so a scatter/gathered verdict runs the very same merge +
     recluster below and stays byte-identical to the local path. ``None``
     books the partition unavailable, exactly like a local residency
-    failure."""
+    failure.
+
+    ``consult_check() -> bool`` (optional) gates each partition consult
+    up front: False books the partition unavailable WITHOUT running its
+    compare. The fleet router passes its batch's remaining deadline
+    budget here (ISSUE 19), so a gather whose clients have already
+    walked away degrades to an immediate honest PARTIAL instead of
+    burning device time per partition on an answer nobody reads."""
     from drep_tpu.index.classify import _assemble_verdicts
 
     if not queries.n:
@@ -1608,6 +1616,13 @@ def classify_batch_federated(
         (np.empty(0, np.int64), np.empty(0, np.float32)) for _ in range(k)
     ]
     for pid in sorted(set().union(*cand) if cand else ()):
+        if consult_check is not None and not consult_check():
+            # the batch's deadline budget expired mid-merge: every
+            # remaining partition books unavailable — the verdict goes
+            # out PARTIAL (stamped, honest) and the batch thread frees
+            # for work someone is still waiting on
+            unavailable.add(pid)
+            continue
         cols = [t for t in range(k) if pid in cand[t]]
         if partition_compare is not None:
             res = partition_compare(

@@ -24,3 +24,4 @@ from drep_tpu.serve.router import (  # noqa: F401
     RouterConfig,
     RouterServer,
 )
+from drep_tpu.serve.wirechaos import WireChaos  # noqa: F401

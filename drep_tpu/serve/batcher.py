@@ -19,6 +19,18 @@ One correctness wrinkle rides here: queries are namespaced by basename
 (``query:<basename>`` — index/classify.py), so two DIFFERENT paths with
 the SAME basename cannot share a batch. ``next_batch`` defers the
 collider to the next batch instead of failing either request.
+
+Deadline budgets (ISSUE 19): every admitted request carries an absolute
+monotonic ``deadline`` (stamped by the daemon from the request's
+``deadline_ms`` or the registered default). ``next_batch`` SHEDS an
+entry whose budget has already expired — the client has (or is about
+to) walk away, so dispatching it would spend a device slot on an answer
+nobody reads — via the ``on_shed`` callback (the daemon answers with a
+``deadline_exceeded`` refusal carrying the histogram-derived ETA as its
+retry hint). The shed happens strictly BEFORE batch membership, so a
+shed request never reaches the rect compare. ``cancel`` removes a
+still-queued entry by request id — the cooperative-abandonment half of
+the same contract.
 """
 
 from __future__ import annotations
@@ -42,10 +54,37 @@ class PendingRequest:
     # converted into a partial_coverage refusal with retry_after_s
     strict: bool = False
     enqueued_at: float = field(default_factory=time.monotonic)
+    # absolute monotonic deadline (ISSUE 19); None = unbounded (the
+    # daemon stamps the registered default, so None only means the
+    # default knob itself is 0)
+    deadline: float | None = None
+
+    def expired(self, now: float | None = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) >= self.deadline
 
     @property
     def basename(self) -> str:
         return os.path.basename(self.genome)
+
+
+def queue_eta_s(
+    depth: int, max_batch: int, window_s: float, batch_ms_hist=None,
+) -> float:
+    """Expected seconds until a request admitted NOW is dispatched: the
+    batches already ahead of it (queue depth / batch capacity, plus the
+    batch it joins) times the recent median batch wall
+    (utils/profiling.Histogram over ``serve_batch_ms``). Before any
+    batch has run, the window itself is the only honest estimate. Pure
+    arithmetic — the admission check refuses up front when this already
+    exceeds a request's budget, and the shed refusal's retry hint
+    derives from it (the histogram-ETA rule, pinned by tests)."""
+    batches_ahead = int(depth) // max(1, int(max_batch)) + 1
+    per_batch_s = max(0.0, float(window_s))
+    if batch_ms_hist is not None and getattr(batch_ms_hist, "count", 0) > 0:
+        per_batch_s += batch_ms_hist.percentile(0.5) / 1000.0
+    return batches_ahead * per_batch_s
 
 
 class AdmissionQueue:
@@ -53,11 +92,17 @@ class AdmissionQueue:
     latch. Thread-safe: connection handlers submit, the single batch
     loop consumes."""
 
-    def __init__(self, max_queue: int = 256):
+    def __init__(
+        self, max_queue: int = 256,
+        on_shed: Callable[[PendingRequest], None] | None = None,
+    ):
         self.max_queue = int(max_queue)
         self._items: deque[PendingRequest] = deque()
         self._cond = threading.Condition()
         self._draining = False
+        # called (outside batch membership, inside the lock's shadow) for
+        # every entry shed because its deadline expired in queue
+        self._on_shed = on_shed
 
     # ---- admission (handler threads) ------------------------------------
     def submit(self, req: PendingRequest) -> str | None:
@@ -79,6 +124,21 @@ class AdmissionQueue:
     @property
     def draining(self) -> bool:
         return self._draining
+
+    def cancel(self, req_id) -> PendingRequest | None:
+        """Remove a still-QUEUED request by id (cooperative abandonment).
+        Returns the removed entry (the caller still owes its connection a
+        terminal ``cancelled`` reply — the in-flight accounting must
+        balance) or None when no queued entry matches (already batched,
+        already answered, or never seen)."""
+        if req_id is None:
+            return None
+        with self._cond:
+            for req in self._items:
+                if req.req_id == req_id:
+                    self._items.remove(req)
+                    return req
+        return None
 
     # ---- drain (signal handler / tests) ----------------------------------
     def drain(self) -> None:
@@ -112,8 +172,16 @@ class AdmissionQueue:
             batch: list[PendingRequest] = []
             seen: dict[str, str] = {}  # basename -> path already in batch
             deferred: list[PendingRequest] = []
+            shed: list[PendingRequest] = []
+            now = time.monotonic()
             while self._items and len(batch) < max_batch:
                 req = self._items.popleft()
+                if req.expired(now):
+                    # budget burned in queue: shedding here — BEFORE batch
+                    # membership — is what guarantees an expired request
+                    # never reaches the rect compare
+                    shed.append(req)
+                    continue
                 if seen.get(req.basename, req.genome) != req.genome:
                     # same basename, DIFFERENT path: the query: namespace
                     # can hold only one per batch — defer, never fail.
@@ -127,4 +195,13 @@ class AdmissionQueue:
                 self._items.appendleft(req)
             if deferred:
                 self._cond.notify()
-            return batch
+        # refusals go out OUTSIDE the lock: a slow client socket must
+        # not stall admissions behind the shed bookkeeping
+        if self._on_shed is not None:
+            for req in shed:
+                self._on_shed(req)
+        if not batch and (shed or deferred):
+            # everything popped was shed/deferred: recurse rather than
+            # hand the loop an empty batch (it would treat [] as work)
+            return self.next_batch(max_batch, window_s)
+        return batch

@@ -14,7 +14,6 @@ alone.
 
 from __future__ import annotations
 
-import json
 import os
 import random
 import socket
@@ -22,6 +21,8 @@ import threading
 import time
 import uuid
 from typing import Any
+
+from drep_tpu.serve import protocol
 
 
 class ServeError(RuntimeError):
@@ -58,6 +59,13 @@ class ServeClient:
         self.address = address
         self.timeout_s = timeout_s
         self._lock = threading.Lock()
+        # wire-damage accounting (ISSUE 19): corrupt frames discarded,
+        # duplicate replies deduped, retries spent on wire damage — the
+        # loadgen folds these into its honest proxy_metrics record
+        self.wire_stats = {"corrupt": 0, "dup": 0, "wire_retries": 0}
+        # replies read while waiting for a DIFFERENT id (reordered or
+        # raced frames): parked here, consumed by the next matching read
+        self._stash: dict[Any, dict] = {}
         family, target = _parse_address(address)
         self._sock = socket.socket(family, socket.SOCK_STREAM)
         self._sock.settimeout(timeout_s)
@@ -80,23 +88,71 @@ class ServeClient:
 
     # ---- wire ------------------------------------------------------------
     def _send(self, obj: dict) -> None:
-        data = json.dumps(obj, separators=(",", ":")).encode() + b"\n"
-        self._sock.sendall(data)
+        # seal: the per-line CRC rides every request frame (gated by
+        # DREP_TPU_WIRE_CRC inside seal) so the daemon detects a garbled
+        # request instead of mis-parsing it
+        self._sock.sendall(protocol.seal(obj))
 
     def _recv(self) -> dict:
+        """One frame off the wire: crc verify+strip, JSON decode.
+        Raises protocol.WireCorruption (counted) on a garbled frame —
+        the line was consumed whole, so the stream stays aligned and the
+        caller can retry."""
         line = self._reader.readline()
         if not line:
             raise ServeError(
                 f"connection to {self.address} closed by the daemon",
                 reason="disconnected",
             )
-        return json.loads(line.decode("utf-8"))
+        try:
+            return protocol.unseal(line)
+        except protocol.WireCorruption:
+            self.wire_stats["corrupt"] += 1
+            raise
+
+    def _recv_for(self, rid, expect_op: str | None = None) -> dict:
+        """The reply matching request id `rid` — the request-id echo is
+        what lets duplicated/reordered replies be DETECTED and
+        classified, never merged: a frame whose id is already accounted
+        for is a dup (dropped, counted), a frame for a different id is
+        parked in the stash for its own reader. ``rid=None`` accepts the
+        first frame (ops that send no id)."""
+        if rid is not None and expect_op is None and rid in self._stash:
+            return self._stash.pop(rid)
+        # bounded: a dup storm must end in an honest error, not a spin
+        for _ in range(64):
+            resp = self._recv()
+            got = resp.get("id")
+            if rid is None:
+                return resp
+            if got == rid and (
+                expect_op is None or resp.get("op") == expect_op
+            ):
+                return resp
+            if got is None:
+                if expect_op is None:
+                    # a legacy daemon that does not echo ids: the first
+                    # frame IS the reply (dedup needs an echo to exist)
+                    return resp
+                self.wire_stats["dup"] += 1  # id-less stray mid-cancel
+                continue
+            if got == rid or got in self._stash:
+                # a dup of an already-parked reply, or a same-id frame
+                # of the wrong op: drop exactly-once
+                self.wire_stats["dup"] += 1
+                continue
+            self._stash[got] = resp
+        raise ServeError(
+            f"no reply for request {rid!r} within 64 frames "
+            f"(duplicate/reordered reply storm?)", reason="wire_corrupt",
+        )
 
     def request(self, obj: dict) -> dict:
-        """One request/response turn."""
+        """One request/response turn (matched by request-id echo when
+        the request carries an ``id``)."""
         with self._lock:
             self._send(obj)
-            return self._recv()
+            return self._recv_for(obj.get("id"))
 
     # ---- ops -------------------------------------------------------------
     def ping(self) -> dict:
@@ -122,7 +178,23 @@ class ServeClient:
                              reason=resp.get("reason"))
         return resp
 
-    def classify(self, genome: str, retries: int = 0, strict: bool = False) -> dict:
+    def cancel(self, req_id: str) -> bool:
+        """Cooperatively abandon a prior request by id. Returns True
+        when the daemon dropped it still-queued (its slot freed without
+        a dispatch), False when it was already in flight (the result is
+        discarded server-side) or already answered. The ack is matched
+        by op+id, so a racing classify reply for the same id is not
+        mistaken for it."""
+        with self._lock:
+            self._send({"op": "cancel", "id": req_id})
+            resp = self._recv_for(req_id, expect_op="cancel")
+        self._stash.pop(req_id, None)  # drop any parked reply for it
+        return bool(resp.get("cancelled"))
+
+    def classify(
+        self, genome: str, retries: int = 0, strict: bool = False,
+        deadline_ms: float | None = None,
+    ) -> dict:
         """Classify one genome; returns the full classify response
         (``verdict``, ``generation``, ``batch_size``, latencies).
         Honors backpressure up to `retries` times, sleeping a JITTERED
@@ -139,46 +211,123 @@ class ServeClient:
         coverage — a verdict that would be stamped with
         ``partitions_unavailable`` comes back as a ``partial_coverage``
         refusal carrying ``retry_after_s`` (the next reload-probe
-        instant), which the retry loop here honors like backpressure."""
+        instant), which the retry loop here honors like backpressure.
+
+        ``deadline_ms`` (ISSUE 19): the end-to-end budget, sent on the
+        wire (the daemon sheds the request if it expires in queue) AND
+        enforced locally — the socket wait is bounded by the REMAINING
+        budget, so a stalled wire ends in a clean stamped
+        ``deadline_exceeded`` refusal, never a hang. Retries spend the
+        same budget (the re-sent request carries the decremented
+        remainder). A reply garbled in transit (CRC mismatch) or a
+        request the daemon received garbled (``reason: "wire_corrupt"``)
+        is retried immediately within the same ``retries`` budget — the
+        verdict that finally lands is byte-identical to a clean wire's."""
+        deadline = (
+            None if deadline_ms is None
+            else time.monotonic() + float(deadline_ms) / 1000.0
+        )
+
+        def remaining_s() -> float | None:
+            return None if deadline is None else deadline - time.monotonic()
+
+        def deadline_refusal(cause: Exception | None = None) -> ServeError:
+            err = ServeError(
+                f"deadline budget ({deadline_ms:.0f} ms) exhausted "
+                f"client-side", reason="deadline_exceeded",
+                retry_after_s=float(deadline_ms) / 1000.0,
+            )
+            err.__cause__ = cause
+            return err
+
         attempt = 0
         last_refusal: dict | None = None
-        while True:
-            req = {"op": "classify", "genome": genome, "id": uuid.uuid4().hex[:8]}
-            if strict:
-                req["strict"] = True
-            try:
-                resp = self.request(req)
-            except (TimeoutError, socket.timeout) as e:
-                if last_refusal is not None:
+        try:
+            while True:
+                req = {"op": "classify", "genome": genome,
+                       "id": uuid.uuid4().hex[:8]}
+                if strict:
+                    req["strict"] = True
+                left = remaining_s()
+                if left is not None:
+                    if left <= 0:
+                        raise deadline_refusal()
+                    req["deadline_ms"] = round(left * 1000.0, 3)
+                    # bound the wire wait by the remaining budget: a
+                    # stall past it surfaces as the stamped refusal
+                    self._sock.settimeout(min(self.timeout_s, left))
+                try:
+                    resp = self.request(req)
+                except protocol.WireCorruption as e:
+                    if attempt < retries:
+                        attempt += 1
+                        self.wire_stats["wire_retries"] += 1
+                        continue
                     raise ServeError(
-                        f"classify timed out after {attempt} retried refusal(s); "
-                        f"last refusal: {last_refusal.get('error', '?')}",
-                        reason=last_refusal.get("reason"),
-                        retry_after_s=last_refusal.get("retry_after_s"),
+                        f"reply corrupted in transit and retries "
+                        f"exhausted after {attempt} attempt(s): {e}",
+                        reason="wire_corrupt",
                     ) from e
+                except (TimeoutError, socket.timeout) as e:
+                    if deadline is not None and remaining_s() <= 0:
+                        raise deadline_refusal(e) from e
+                    if last_refusal is not None:
+                        raise ServeError(
+                            f"classify timed out after {attempt} retried refusal(s); "
+                            f"last refusal: {last_refusal.get('error', '?')}",
+                            reason=last_refusal.get("reason"),
+                            retry_after_s=last_refusal.get("retry_after_s"),
+                        ) from e
+                    raise ServeError(
+                        f"classify timed out after {self.timeout_s}s "
+                        f"(no refusal seen — daemon unresponsive?)",
+                        reason="timeout",
+                    ) from e
+                if resp.get("ok"):
+                    return resp
+                if resp.get("reason") == "wire_corrupt" and attempt < retries:
+                    # the DAEMON saw our request garbled: re-send now —
+                    # nothing was admitted, so this cannot double-classify
+                    attempt += 1
+                    self.wire_stats["wire_retries"] += 1
+                    continue
+                retry_after = resp.get("retry_after_s")
+                if retry_after is not None and attempt < retries:
+                    attempt += 1
+                    last_refusal = resp
+                    sleep_s = float(retry_after) * (0.5 + random.random())
+                    left = remaining_s()
+                    if left is not None and sleep_s >= left:
+                        # honoring the hint would burn the whole budget:
+                        # surface the refusal instead of missing silently
+                        raise ServeError(
+                            resp.get("error", "classify failed"),
+                            reason=resp.get("reason"),
+                            retry_after_s=retry_after,
+                        )
+                    time.sleep(sleep_s)
+                    continue
                 raise ServeError(
-                    f"classify timed out after {self.timeout_s}s "
-                    f"(no refusal seen — daemon unresponsive?)",
-                    reason="timeout",
-                ) from e
-            if resp.get("ok"):
-                return resp
-            retry_after = resp.get("retry_after_s")
-            if retry_after is not None and attempt < retries:
-                attempt += 1
-                last_refusal = resp
-                time.sleep(float(retry_after) * (0.5 + random.random()))
-                continue
-            raise ServeError(
-                resp.get("error", "classify failed"),
-                reason=resp.get("reason"), retry_after_s=retry_after,
-            )
+                    resp.get("error", "classify failed"),
+                    reason=resp.get("reason"), retry_after_s=retry_after,
+                )
+        finally:
+            if deadline is not None:
+                self._sock.settimeout(self.timeout_s)
 
-    def classify_many(self, genomes: list[str], strict: bool = False) -> list[dict]:
+    def classify_many(
+        self, genomes: list[str], strict: bool = False,
+        deadline_ms: float | None = None,
+    ) -> list[dict]:
         """PIPELINED classify: all requests go out before any reply is
         read, so the daemon's batch window sees them together (the
-        coalescing path). Replies are matched by request id; returns
-        responses in input order (errors inline, not raised)."""
+        coalescing path). Replies are matched by request id — a
+        DUPLICATED reply is dropped exactly-once (first frame wins,
+        counted in ``wire_stats``), a garbled frame is discarded and its
+        request reported as a ``wire_corrupt`` error inline. Returns
+        responses in input order (errors inline, not raised) — except a
+        disconnection on an UNDAMAGED stream, which raises
+        ``disconnected`` like classify does: the daemon died."""
         with self._lock:
             ids = []
             for g in genomes:
@@ -187,9 +336,43 @@ class ServeClient:
                 req = {"op": "classify", "genome": g, "id": rid}
                 if strict:
                     req["strict"] = True
+                if deadline_ms is not None:
+                    req["deadline_ms"] = float(deadline_ms)
                 self._send(req)
-            by_id: dict[str, dict] = {}
-            for _ in genomes:
-                resp = self._recv()
-                by_id[resp.get("id", "?")] = resp
-        return [by_id.get(rid, {"ok": False, "error": "no reply"}) for rid in ids]
+            want = set(ids)
+            by_id: dict[str, dict] = {
+                rid: self._stash.pop(rid) for rid in ids if rid in self._stash
+            }
+            frames = corrupts = dups = 0
+            while want - set(by_id):
+                if corrupts and frames >= len(want) + dups:
+                    break  # a corrupt frame ATE a reply: stop honestly
+                try:
+                    resp = self._recv()
+                except protocol.WireCorruption:
+                    corrupts += 1
+                    frames += 1
+                    continue
+                except (TimeoutError, socket.timeout):
+                    break  # stalled: report the holes inline
+                except ServeError:
+                    if not corrupts:
+                        raise  # clean-stream disconnect: the daemon died
+                    break  # EOF after damage (short read): holes inline
+                frames += 1
+                rid = resp.get("id")
+                if rid not in want or rid in by_id:
+                    # duplicated reply (or a stray for nobody): first
+                    # frame won, this one is dropped — exactly-once
+                    self.wire_stats["dup"] += 1
+                    dups += 1
+                    continue
+                by_id[rid] = resp
+        return [
+            by_id.get(rid, {
+                "ok": False,
+                "error": "no reply (frame lost or corrupted in transit)",
+                "reason": "wire_corrupt" if corrupts else "no_reply",
+            })
+            for rid in ids
+        ]

@@ -39,6 +39,7 @@ The server is equally usable as a library (tests run it in-process):
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -58,8 +59,8 @@ from drep_tpu.index.classify import (
     sketch_queries,
 )
 from drep_tpu.serve import protocol
-from drep_tpu.serve.batcher import AdmissionQueue, PendingRequest
-from drep_tpu.utils import telemetry
+from drep_tpu.serve.batcher import AdmissionQueue, PendingRequest, queue_eta_s
+from drep_tpu.utils import envknobs, telemetry
 from drep_tpu.utils.logger import get_logger
 from drep_tpu.utils.profiling import counters
 
@@ -101,6 +102,8 @@ class _ServeStats:
     partial_refusals: int = 0  # strict-mode refusals on PARTIAL coverage
     legs_total: int = 0  # classify_part legs served (fleet scatter tier)
     leg_refusals: int = 0  # legs refused (fence/drain/partition loss)
+    deadline_shed: int = 0  # queued entries shed on an expired budget
+    cancels: int = 0  # requests/legs abandoned via the cancel op
 
 
 class IndexServer:
@@ -116,8 +119,24 @@ class IndexServer:
         classify_fn: Callable[[Any, list[str]], dict] | None = None,
     ):
         self.cfg = cfg
-        self.queue = AdmissionQueue(cfg.max_queue)
+        self.queue = AdmissionQueue(cfg.max_queue, on_shed=self._shed_expired)
         self.stats = _ServeStats()
+        # default end-to-end budget stamped onto requests that carry no
+        # deadline_ms of their own (legacy clients are bounded too);
+        # <= 0 disables the default
+        self._deadline_default_ms = envknobs.env_float(
+            "DREP_TPU_SERVE_DEADLINE_DEFAULT_MS"
+        )
+        # request ids cancelled while in flight (already batched, or a
+        # classify_part leg not yet served): the result is discarded at
+        # reply time. Bounded — a stream of cancels for ids this daemon
+        # never saw must not grow memory.
+        self._cancelled: "collections.OrderedDict[str, None]" = (
+            collections.OrderedDict()
+        )
+        # tightest remaining deadline of the batch currently dispatching
+        # (set by _serve_one_batch, read by the router's leg fan-out)
+        self._batch_deadline: float | None = None
         self._classify_fn = classify_fn or self._classify_paths
         self._resident = None
         self._listener: socket.socket | None = None
@@ -265,6 +284,11 @@ class IndexServer:
         # error carries reason/retry_after_s attributes, and the client's
         # backoff loop needs them surfaced, not flattened to classify_failed
         path_err: dict[str, tuple[str, str, float | None]] = {}
+        # the batch's tightest remaining budget, visible to the classify
+        # core for the duration of the dispatch — the router's leg fan-out
+        # reads it to DECREMENT budgets per hop (elapsed subtracted)
+        deadlines = [req.deadline for req in batch if req.deadline is not None]
+        self._batch_deadline = min(deadlines) if deadlines else None
         try:
             with counters.stage("serve_batch"):
                 with telemetry.span(
@@ -310,6 +334,19 @@ class IndexServer:
             queue_ms = queue_ms_of[id(req)]
             base = os.path.basename(req.genome)
             verdict = by_name.get(base)
+            if self._is_cancelled(req.req_id):
+                # cancelled while in flight: the compute already ran for
+                # its co-batched neighbors; the abandoning client gets
+                # the terminal refusal (accounting balances), never a
+                # verdict it stopped waiting for
+                with self._lock:
+                    self.stats.cancels += 1
+                counters.add_fault("serve_cancelled")
+                req.reply(protocol.error_response(
+                    "request cancelled by the client", req_id=req.req_id,
+                    reason="cancelled",
+                ))
+                continue
             if verdict is None:
                 self.stats.errors_total += 1
                 msg, reason, retry = path_err.get(
@@ -427,6 +464,8 @@ class IndexServer:
             "latency_ms": hists,
         }
         out["partial_refusals"] = self.stats.partial_refusals
+        out["deadline_shed"] = self.stats.deadline_shed
+        out["cancels"] = self.stats.cancels
         # streaming federated resident (ISSUE 14): the partition health
         # map — resident/evicted/suspect/quarantined, last probe,
         # residency bytes — rides the same snapshot /healthz serves, and
@@ -529,7 +568,10 @@ class IndexServer:
         state = {"inflight": 0, "eof": False}
 
         def send(obj: dict) -> None:
-            data = protocol.encode(obj)
+            # seal: the per-line CRC rides every reply frame (gated by
+            # DREP_TPU_WIRE_CRC inside seal) so a garbled wire is
+            # detected by the client, never merged into a verdict
+            data = protocol.seal(obj)
             with wlock:
                 with contextlib.suppress(OSError):
                     conn.sendall(data)
@@ -583,7 +625,14 @@ class IndexServer:
         reply_classify: Callable[[dict], None], state: dict, wlock,
     ) -> None:
         try:
-            req = protocol.parse_request(line)
+            req = protocol.parse_request(protocol.check_crc(line))
+        except protocol.WireCorruption as e:
+            # a request garbled in transit: no id survives to echo, so
+            # the refusal is connection-scoped — the client's retry loop
+            # re-sends with a fresh frame
+            counters.add_fault("serve_wire_corrupt")
+            send(protocol.error_response(str(e), reason="wire_corrupt"))
+            return
         except protocol.ProtocolError as e:
             send(protocol.error_response(str(e), reason="protocol"))
             return
@@ -604,6 +653,9 @@ class IndexServer:
         if op == "prewarm":
             self._serve_prewarm(req, send)
             return
+        if op == "cancel":
+            self._cancel(req, send)
+            return
         if op == "fleet":
             send(protocol.error_response(
                 "this daemon is a serve replica, not a router — fleet "
@@ -615,6 +667,72 @@ class IndexServer:
             state["inflight"] += 1
         self._admit_classify(req, reply_classify)
 
+    # ---- deadline budgets + cancellation (ISSUE 19) ----------------------
+    def _budget_ms(self, req: dict) -> float | None:
+        """The request's end-to-end budget: its own ``deadline_ms``, else
+        the registered default (legacy clients are bounded too)."""
+        d = req.get("deadline_ms")
+        if d is not None:
+            return float(d)
+        return self._deadline_default_ms if self._deadline_default_ms > 0 else None
+
+    def _eta_s(self) -> float:
+        """Histogram-derived dispatch ETA for a request admitted now —
+        the admission check's refusal threshold AND the retry hint a
+        deadline refusal carries."""
+        return queue_eta_s(
+            self.queue.depth(), self.cfg.max_batch,
+            max(0.0, float(self.cfg.batch_window_ms)) / 1000.0,
+            counters.hists.get("serve_batch_ms"),
+        )
+
+    def _shed_expired(self, req: PendingRequest) -> None:
+        """AdmissionQueue's on_shed: a queued entry whose budget expired
+        before dispatch. Answer honestly (stamped refusal + ETA retry
+        hint) — the device never sees the request."""
+        with self._lock:
+            self.stats.deadline_shed += 1
+        counters.add_fault("serve_deadline_shed")
+        req.reply(protocol.error_response(
+            "deadline budget expired while queued "
+            f"(waited {(time.monotonic() - req.enqueued_at) * 1000.0:.0f} ms)",
+            req_id=req.req_id, reason="deadline_exceeded",
+            retry_after_s=max(_RETRY_AFTER_FLOOR_S, self._eta_s()),
+        ))
+
+    def _cancel(self, req: dict, send: Callable[[dict], None]) -> None:
+        """The cancel op: drop a still-queued request (its connection
+        gets the terminal ``cancelled`` refusal so in-flight accounting
+        balances), or flag an in-flight id so its result is discarded at
+        reply time. The ack states which happened."""
+        rid = req["id"]
+        queued = self.queue.cancel(rid)
+        if queued is not None:
+            with self._lock:
+                self.stats.cancels += 1
+            counters.add_fault("serve_cancelled")
+            queued.reply(protocol.error_response(
+                "request cancelled by the client", req_id=rid,
+                reason="cancelled",
+            ))
+        else:
+            with self._lock:
+                self._cancelled[rid] = None
+                while len(self._cancelled) > 1024:
+                    self._cancelled.popitem(last=False)
+        send({"ok": True, "op": "cancel", "id": rid,
+              "cancelled": queued is not None})
+
+    def _is_cancelled(self, rid) -> bool:
+        """Consume (test-and-clear) an in-flight cancellation flag."""
+        if rid is None:
+            return False
+        with self._lock:
+            if rid in self._cancelled:
+                del self._cancelled[rid]
+                return True
+        return False
+
     def _admit_classify(self, req: dict, send: Callable[[dict], None]) -> None:
         genome = os.path.abspath(req["genome"])
         req_id = req.get("id")
@@ -623,9 +741,30 @@ class IndexServer:
                 f"no such genome file: {genome}", req_id=req_id, reason="bad_request",
             ))
             return
+        budget_ms = self._budget_ms(req)
+        deadline = None
+        if budget_ms is not None:
+            budget_s = budget_ms / 1000.0
+            eta_s = self._eta_s()
+            if eta_s > budget_s:
+                # the queue's dispatch ETA already exceeds the budget:
+                # refusing NOW is strictly kinder than admitting a
+                # request we would shed anyway after it aged in queue
+                with self._lock:
+                    self.stats.deadline_shed += 1
+                    self.stats.rejected_total += 1
+                counters.add_fault("serve_deadline_shed")
+                send(protocol.error_response(
+                    f"queue ETA {eta_s * 1000.0:.0f} ms exceeds the "
+                    f"{budget_ms:.0f} ms deadline budget",
+                    req_id=req_id, reason="deadline_exceeded",
+                    retry_after_s=max(_RETRY_AFTER_FLOOR_S, eta_s),
+                ))
+                return
+            deadline = time.monotonic() + budget_s
         pending = PendingRequest(
             genome=genome, reply=send, req_id=req_id,
-            strict=bool(req.get("strict", False)),
+            strict=bool(req.get("strict", False)), deadline=deadline,
         )
         refused = self.queue.submit(pending)
         if refused is not None:
@@ -732,12 +871,55 @@ class IndexServer:
         bottoms = [np.asarray(b, np.uint64) for b in req["bottoms"]]
         prune_cfg = req.get("prune", self.cfg.prune_cfg)
         t0 = time.monotonic()
+
+        def _cancelled_refusal() -> None:
+            # the hedge-cancel payoff: a losing leg queued behind the
+            # compute lock discovers the cancel BEFORE spending a device
+            # slot on an answer the router already has
+            with self._lock:
+                self.stats.cancels += 1
+            counters.add_fault("serve_leg_cancelled")
+            send(protocol.error_response(
+                "leg cancelled by the router", req_id=req_id,
+                reason="cancelled",
+            ))
+
+        if self._is_cancelled(req_id):
+            _cancelled_refusal()
+            return
+        # remaining per-hop budget (the router DECREMENTS before
+        # forwarding): bound the compute-lock wait by it, so a leg that
+        # cannot start in time refuses cleanly instead of computing an
+        # answer nobody is still waiting for
+        leg_deadline = (
+            None if req.get("deadline_ms") is None
+            else t0 + float(req["deadline_ms"]) / 1000.0
+        )
         try:
-            with self._compute_lock:
+            if not self._compute_lock.acquire(
+                timeout=-1 if leg_deadline is None
+                else max(0.0, leg_deadline - time.monotonic())
+            ):
+                with self._lock:
+                    self.stats.deadline_shed += 1
+                    self.stats.leg_refusals += 1
+                counters.add_fault("serve_deadline_shed")
+                send(protocol.error_response(
+                    "leg deadline budget expired waiting for the compute "
+                    "slot", req_id=req_id, reason="deadline_exceeded",
+                    retry_after_s=self._partial_retry_hint(),
+                ))
+                return
+            try:
+                if self._is_cancelled(req_id):
+                    _cancelled_refusal()
+                    return
                 if not resident.ensure_resident(pid, pin={pid}):
                     res = None
                 else:
                     res = resident.classify_partition(pid, names, bottoms, prune_cfg)
+            finally:
+                self._compute_lock.release()
         except Exception as e:  # noqa: BLE001 — a leg failure must not kill the replica
             get_logger().exception("serve: classify_part leg pid=%d failed", pid)
             with self._lock:
@@ -809,7 +991,8 @@ class IndexServer:
         status = 200 if resp.get("ok") else (
             503
             if resp.get("reason")
-            in ("backpressure", "draining", "partial_coverage", "no_replicas")
+            in ("backpressure", "draining", "partial_coverage", "no_replicas",
+                "deadline_exceeded")
             else 400
         )
         with contextlib.suppress(OSError):

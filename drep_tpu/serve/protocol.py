@@ -5,9 +5,33 @@ listener (auto-detected per connection from the first bytes).
 NDJSON requests (the native protocol — what ServeClient speaks)::
 
     {"op": "classify", "genome": "/abs/path.fasta", "id": "optional",
-     "strict": false}
+     "strict": false, "deadline_ms": 5000}
     {"op": "status"}        # the daemon's health/metrics snapshot
     {"op": "ping"}          # liveness + current generation
+    {"op": "cancel", "id": "<request id>"}   # abandon a pending request
+
+``deadline_ms`` (optional, ISSUE 19) is the request's END-TO-END budget:
+the daemon stamps an absolute (monotonic) deadline at admission and a
+queued request whose budget expires before dispatch is SHED with a
+``reason: "deadline_exceeded"`` refusal instead of wasting a device
+slot. Requests without it get the registered default budget
+(``DREP_TPU_SERVE_DEADLINE_DEFAULT_MS``) — legacy clients are bounded
+too. The router DECREMENTS the budget per hop (elapsed time subtracted)
+before forwarding it on legs. ``cancel`` names a prior request's ``id``:
+a still-queued request is dropped (answered with ``reason:
+"cancelled"``), an in-flight one is flagged so its compute result is
+discarded; the ack carries ``{"cancelled": true|false}``.
+
+Wire integrity (ISSUE 19, the PR 5 in-band-checksum idiom on the wire):
+when ``DREP_TPU_WIRE_CRC`` is on (default), :func:`seal` appends a
+``"crc"`` key — CRC-32 of the frame's serialized bytes — as the LAST
+key of every NDJSON line. Receivers verify+strip it when present
+(:func:`check_crc` / :func:`unseal`), raising :class:`WireCorruption`
+on mismatch, so a garbled frame is DETECTED and classified — retried by
+the client, never merged into a verdict. Frames without a crc pass
+through (mixed fleets interoperate; the knob is an escape hatch).
+Replies echo the request ``id`` verbatim, which is what lets a client
+discard duplicated or reordered replies exactly-once.
 
 Fleet ops (ISSUE 17 — the router tier). ``classify_part`` is one
 scatter LEG: the router asks a replica for the per-partition rect
@@ -72,11 +96,19 @@ none of them can drift.
 from __future__ import annotations
 
 import json
+import re
+import zlib
 from typing import Any
 
 MAX_LINE_BYTES = 1 << 20  # a request line is a path + opcode, never MBs
 
-OPS = ("classify", "status", "ping", "classify_part", "fleet", "prewarm")
+OPS = ("classify", "status", "ping", "classify_part", "fleet", "prewarm",
+       "cancel")
+
+# the in-band frame checksum, always spliced as the LAST key so the
+# receiver can strip it textually and verify the exact bytes the sender
+# summed (no float re-serialization ambiguity)
+_CRC_TAIL_RE = re.compile(rb',"crc":(\d+)\}$')
 
 # HTTP methods the shim answers; anything else on a connection whose
 # first line is not JSON is a protocol error
@@ -89,9 +121,63 @@ class ProtocolError(ValueError):
     crash)."""
 
 
+class WireCorruption(ProtocolError):
+    """A frame whose in-band CRC (or JSON shape) does not survive the
+    wire — detected, classified, never merged. The client's cue to
+    discard the frame and retry."""
+
+
 def encode(obj: dict) -> bytes:
     """One response/request line (newline-terminated, compact)."""
     return json.dumps(obj, separators=(",", ":"), default=str).encode() + b"\n"
+
+
+def seal(obj: dict) -> bytes:
+    """Encode one frame WITH the in-band crc (gated by
+    ``DREP_TPU_WIRE_CRC``): CRC-32 of the serialized payload bytes,
+    spliced textually as the last key — the wire-level twin of
+    durableio's npz/JSON checksum embed (PR 5)."""
+    from drep_tpu.utils import envknobs
+
+    body = json.dumps(obj, separators=(",", ":"), default=str).encode()
+    if not envknobs.env_bool("DREP_TPU_WIRE_CRC"):
+        return body + b"\n"
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return b'%s,"crc":%d}\n' % (body[:-1], crc)
+
+
+def check_crc(line: bytes) -> bytes:
+    """Verify+strip the in-band crc suffix of one frame, when present.
+    Returns the bare frame bytes. Raises :class:`WireCorruption` on a
+    mismatch; frames WITHOUT a crc pass through untouched (mixed fleets
+    and the ``DREP_TPU_WIRE_CRC=0`` escape hatch interoperate)."""
+    bare = line.rstrip(b"\r\n")
+    m = _CRC_TAIL_RE.search(bare)
+    if m is None:
+        return bare
+    body = bare[: m.start()] + b"}"
+    if (zlib.crc32(body) & 0xFFFFFFFF) != int(m.group(1)):
+        raise WireCorruption(
+            "frame CRC mismatch — the line was corrupted in transit "
+            "(garbled reply discarded, never merged)"
+        )
+    return body
+
+
+def unseal(line: bytes) -> dict:
+    """One received frame -> dict: crc verify+strip, then JSON decode.
+    Any failure to decode classifies as :class:`WireCorruption` — from
+    the receiver's seat an unparseable frame IS wire damage."""
+    body = check_crc(line)
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireCorruption(f"frame is not valid JSON: {e}") from e
+    if not isinstance(obj, dict):
+        raise WireCorruption(
+            f"frame must be a JSON object, got {type(obj).__name__}"
+        )
+    return obj
 
 
 def parse_request(line: bytes) -> dict:
@@ -114,6 +200,15 @@ def parse_request(line: bytes) -> dict:
             raise ProtocolError('classify needs a "genome" FASTA path')
         if "strict" in req and not isinstance(req["strict"], bool):
             raise ProtocolError('"strict" must be a JSON boolean')
+        _check_deadline(req)
+    elif op == "cancel":
+        # cooperative abandonment: the id names a prior request on any
+        # connection — a queued one is dropped, an in-flight one has its
+        # result discarded; either way the device stops working for a
+        # client that has already walked away
+        rid = req.get("id")
+        if not isinstance(rid, str) or not rid:
+            raise ProtocolError('cancel needs the "id" of a prior request')
     elif op == "classify_part":
         if not isinstance(req.get("pid"), int) or isinstance(req.get("pid"), bool):
             raise ProtocolError('classify_part needs an integer "pid"')
@@ -136,6 +231,7 @@ def parse_request(line: bytes) -> dict:
             req["prune"], dict
         ):
             raise ProtocolError('"prune" must be a JSON object or null')
+        _check_deadline(req)
     elif op == "fleet":
         if req.get("action") not in ("join", "leave"):
             raise ProtocolError('fleet "action" must be "join" or "leave"')
@@ -159,6 +255,20 @@ def parse_request(line: bytes) -> dict:
         ):
             raise ProtocolError('prewarm needs a non-empty integer "partitions" list')
     return req
+
+
+def _check_deadline(req: dict) -> None:
+    """Shared ``deadline_ms`` validation: a positive JSON number. The
+    bool guard matters — ``True`` is an int to Python and a 1 ms budget
+    would shed every request it touched."""
+    if "deadline_ms" not in req or req["deadline_ms"] is None:
+        return
+    d = req["deadline_ms"]
+    if isinstance(d, bool) or not isinstance(d, (int, float)) or d <= 0:
+        raise ProtocolError(
+            '"deadline_ms" must be a positive number (milliseconds of '
+            "end-to-end budget)"
+        )
 
 
 def error_response(
@@ -262,5 +372,8 @@ def http_to_request(method: str, path: str, body: bytes) -> dict:
             if not isinstance(doc["strict"], bool):
                 raise ProtocolError('"strict" must be a JSON boolean')
             out["strict"] = doc["strict"]
+        if "deadline_ms" in doc:
+            out["deadline_ms"] = doc["deadline_ms"]
+            _check_deadline(out)
         return out
     raise ProtocolError(f"no route {method} {route} (try GET /healthz or POST /classify)")

@@ -43,7 +43,22 @@ fleet:
   ejected (bounded exponential reprobe backoff,
   DREP_TPU_ROUTER_PROBE_BACKOFF_S doubling to
   DREP_TPU_SERVE_PROBE_MAX_S); a recovered probe rejoins the replica
-  seamlessly.
+  seamlessly. Layered ON that table (ISSUE 19), a per-replica
+  error-rate CIRCUIT BREAKER: leg errors inside a sliding window trip
+  closed -> open (no legs route there), and after a cooldown exactly
+  ONE half-open probe leg decides closed (success) or reopen
+  (failure) — catching the flapping replica whose interleaved
+  successes keep resetting the health machine's failure streak
+  (DREP_TPU_ROUTER_BREAKER_ERRS / DREP_TPU_ROUTER_BREAKER_WINDOW_S /
+  DREP_TPU_ROUTER_BREAKER_HALFOPEN_S).
+- **deadline propagation** (ISSUE 19): when a batch carries a budget
+  (the tightest remaining deadline among its requests, stashed by the
+  daemon's batch loop), every leg is stamped with the DECREMENTED
+  remainder at its own launch instant — elapsed time at this hop is
+  subtracted, never re-granted — hedges launch only within the
+  remaining budget, and the losing hedge leg is cooperatively
+  CANCELLED (the serve protocol's ``cancel`` op) so it stops consuming
+  its replica's queue the moment the winner answers.
 
 The router is STATELESS by construction — no durable state, nothing
 written anywhere (it inherits the daemon's pure-reader contract and the
@@ -53,6 +68,7 @@ re-forms from the replica specs + probes.
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue as queue_mod
 import threading
@@ -74,9 +90,45 @@ REPLICA_HEALTHY = "healthy"
 REPLICA_SUSPECT = "suspect"
 REPLICA_EJECTED = "ejected"
 
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
 # entries the router's sketch cache keeps (a sketch is ~KBs; the cap is
 # a leak bound, not a memory budget)
 _SKETCH_CACHE_CAP = 4096
+
+# leg request ids (the cancel handle for a losing hedge leg) — unique
+# per router process; itertools.count.__next__ is atomic under the GIL
+_LEG_SEQ = itertools.count()
+
+
+def decrement_budget_ms(
+    budget_ms: float | None, elapsed_s: float
+) -> float | None:
+    """The per-hop budget decrement rule (ISSUE 19): what remains of a
+    request's end-to-end budget after ``elapsed_s`` burned at this hop,
+    clamped at zero — a leg is never granted MORE time than its parent
+    has left, and an exhausted budget propagates as 0.0 (an immediate
+    shed at the replica), never as a negative grant. None (no budget)
+    stays None: unbounded in, unbounded out."""
+    if budget_ms is None:
+        return None
+    return max(0.0, float(budget_ms) - float(elapsed_s) * 1000.0)
+
+
+def remaining_budget_ms(
+    deadline: float | None, now: float | None = None
+) -> float | None:
+    """:func:`decrement_budget_ms` phrased against an ABSOLUTE monotonic
+    deadline — the form the dispatch paths carry (the deadline does the
+    elapsed-subtraction implicitly, so a leg launched late inherits
+    exactly what is left, not the original grant)."""
+    if deadline is None:
+        return None
+    if now is None:
+        now = time.monotonic()
+    return max(0.0, (deadline - now) * 1000.0)
 
 
 class FleetUnavailableError(RuntimeError):
@@ -158,6 +210,13 @@ class ReplicaSlot:
     draining: bool = False
     resident: frozenset = frozenset()  # pids with sketches resident
     left: bool = False  # fleet leave: no NEW legs, record kept
+    # error-rate circuit breaker (ISSUE 19), layered on the health
+    # machine above: recent error instants (monotonic, pruned to the
+    # breaker window), the breaker state, and the instant it opened
+    err_times: list = field(default_factory=list)
+    breaker: str = BREAKER_CLOSED
+    breaker_opened: float = 0.0
+    breaker_trips: int = 0
 
 
 class ReplicaTable:
@@ -165,11 +224,18 @@ class ReplicaTable:
     fed by the /healthz poller and by leg outcomes. Thread-safe (probe
     thread, leg threads, and fleet-op handler threads all book here)."""
 
-    def __init__(self, specs: list[str], probe_backoff_s: float, probe_max_s: float):
+    def __init__(
+        self, specs: list[str], probe_backoff_s: float, probe_max_s: float,
+        breaker_errs: int = 5, breaker_window_s: float = 30.0,
+        breaker_halfopen_s: float = 5.0,
+    ):
         self._lock = threading.Lock()
         self._slots: dict[str, ReplicaSlot] = {}
         self.probe_backoff_s = float(probe_backoff_s)
         self.probe_max_s = float(probe_max_s)
+        self.breaker_errs = int(breaker_errs)
+        self.breaker_window_s = float(breaker_window_s)
+        self.breaker_halfopen_s = float(breaker_halfopen_s)
         for spec in specs:
             addr, assigned = parse_replica_spec(spec)
             self.join(addr, assigned)
@@ -192,6 +258,8 @@ class ReplicaTable:
                 slot.failures = 0
                 slot.backoff_s = 0.0
                 slot.next_probe = 0.0
+                slot.err_times.clear()
+                slot.breaker = BREAKER_CLOSED
                 if assigned is not None:
                     slot.assigned = assigned
             return slot
@@ -225,14 +293,43 @@ class ReplicaTable:
             return True
 
     # ---- outcome booking -------------------------------------------------
+    def _book_breaker_error(self, slot: ReplicaSlot, now: float) -> bool:
+        """Book one error into the breaker window (lock held). Errors
+        accumulate WHETHER OR NOT successes interleave — a flapping
+        replica (ok, error, ok, error, ...) never resets this window the
+        way each success resets the health machine's failure streak,
+        which is exactly the pathology the breaker exists to catch.
+        Returns True when this error tripped (or re-tripped) the
+        breaker open."""
+        slot.err_times.append(now)
+        cutoff = now - self.breaker_window_s
+        slot.err_times[:] = [t for t in slot.err_times if t > cutoff]
+        if slot.breaker == BREAKER_HALF_OPEN:
+            # the half-open probe leg itself failed: reopen for a full
+            # cooldown — trust is re-earned one probe at a time
+            slot.breaker = BREAKER_OPEN
+            slot.breaker_opened = now
+            return True
+        if (
+            slot.breaker == BREAKER_CLOSED
+            and len(slot.err_times) >= self.breaker_errs
+        ):
+            slot.breaker = BREAKER_OPEN
+            slot.breaker_opened = now
+            slot.breaker_trips += 1
+            return True
+        return False
+
     def book_failure(self, address: str, err: BaseException | str) -> None:
         now = time.monotonic()
+        tripped = False
         with self._lock:
             slot = self._slots.get(address)
             if slot is None or slot.left:
                 return
             slot.failures += 1
             slot.last_err = f"{err}"
+            tripped = self._book_breaker_error(slot, now)
             if slot.state == REPLICA_HEALTHY:
                 slot.state = REPLICA_SUSPECT
                 slot.next_probe = now  # one immediate reprobe: a blip is
@@ -253,12 +350,26 @@ class ReplicaTable:
         telemetry.event(
             f"replica_{state}", address=address, error=f"{err}"[:200]
         )
+        if tripped:
+            counters.add_fault("router_breaker_open")
+            telemetry.event("replica_breaker_open", address=address)
 
     def book_success(self, address: str, status: dict | None = None) -> None:
+        breaker_closed = False
         with self._lock:
             slot = self._slots.get(address)
             if slot is None:
                 return
+            if status is None and slot.breaker != BREAKER_CLOSED:
+                # a real LEG answered (the half-open probe, or a leg that
+                # raced the trip): close the breaker and forget the error
+                # window. /healthz probes (status != None) deliberately
+                # do NOT close it — a replica can answer /healthz fine
+                # while erroring on every leg, and the breaker gates on
+                # the leg error rate, not liveness.
+                slot.breaker = BREAKER_CLOSED
+                slot.err_times.clear()
+                breaker_closed = True
             recovered = slot.state != REPLICA_HEALTHY
             if recovered:
                 slot.recoveries += 1
@@ -283,12 +394,31 @@ class ReplicaTable:
         if recovered:
             counters.add_fault("router_replica_recovered")
             telemetry.event("replica_recovered", address=address)
+        if breaker_closed:
+            counters.add_fault("router_breaker_closed")
+            telemetry.event("replica_breaker_closed", address=address)
 
     # ---- routing views ---------------------------------------------------
+    def _breaker_allows(self, s: ReplicaSlot, now: float) -> bool:
+        """The breaker gate (lock held). Open blocks every leg until the
+        half-open instant, when exactly ONE bounded probe leg may pass:
+        the transition to half-open happens here, and the in-flight
+        lease count bounds the probe — a second leg arriving while the
+        probe is out sees ``inflight > 0`` and routes elsewhere. The
+        probe's outcome (book_success / book_failure) closes or reopens
+        the breaker."""
+        if s.breaker == BREAKER_OPEN:
+            if now < s.breaker_opened + self.breaker_halfopen_s:
+                return False
+            s.breaker = BREAKER_HALF_OPEN
+        return not (s.breaker == BREAKER_HALF_OPEN and s.inflight > 0)
+
     def _routable(self) -> list[ReplicaSlot]:
+        now = time.monotonic()
         return [
             s for s in self._slots.values()
             if not s.left and not s.draining and s.state != REPLICA_EJECTED
+            and self._breaker_allows(s, now)
         ]
 
     def eligible(self, pid: int) -> list[ReplicaSlot]:
@@ -365,6 +495,9 @@ class ReplicaTable:
                     "recoveries": s.recoveries,
                     "probes": s.probes,
                     "last_error": s.last_err,
+                    "breaker": s.breaker,
+                    "breaker_trips": s.breaker_trips,
+                    "breaker_errors": len(s.err_times),
                 }
                 for s in sorted(self._slots.values(), key=lambda s: s.address)
             }
@@ -376,7 +509,14 @@ class ReplicaTable:
                 s.address for s in self._slots.values()
                 if not s.left and s.state == REPLICA_EJECTED
             )
-        return {"replicas": replicas, "suspect": suspect, "ejected": ejected}
+            breaker_open = sorted(
+                s.address for s in self._slots.values()
+                if not s.left and s.breaker != BREAKER_CLOSED
+            )
+        return {
+            "replicas": replicas, "suspect": suspect, "ejected": ejected,
+            "breaker_open": breaker_open,
+        }
 
 
 class RouterServer(IndexServer):
@@ -409,7 +549,16 @@ class RouterServer(IndexServer):
             cfg.max_inflight = envknobs.env_int("DREP_TPU_ROUTER_MAX_INFLIGHT")
         cfg.max_queue = int(cfg.max_inflight)
         super().__init__(cfg, classify_fn=classify_fn)
-        self.table = ReplicaTable(list(cfg.replicas), probe_backoff, probe_max)
+        self.table = ReplicaTable(
+            list(cfg.replicas), probe_backoff, probe_max,
+            breaker_errs=envknobs.env_int("DREP_TPU_ROUTER_BREAKER_ERRS"),
+            breaker_window_s=envknobs.env_float(
+                "DREP_TPU_ROUTER_BREAKER_WINDOW_S"
+            ),
+            breaker_halfopen_s=envknobs.env_float(
+                "DREP_TPU_ROUTER_BREAKER_HALFOPEN_S"
+            ),
+        )
         self.router_stats = {
             "forwarded": 0,  # queries answered via the forward fast path
             "scattered": 0,  # queries answered via scatter/gather merge
@@ -418,6 +567,7 @@ class RouterServer(IndexServer):
             "reroutes": 0,
             "hedges": 0,
             "hedge_wins": 0,
+            "hedge_cancels": 0,  # losing hedge legs cooperatively cancelled
             "fence_retries": 0,  # gathers retried after a generation fence
             "fence_reloads": 0,  # synchronous reloads the fence forced
             "overload_spills": 0,  # legs abandoned on fleet-wide backpressure
@@ -608,7 +758,11 @@ class RouterServer(IndexServer):
         """The router's replacement for the daemon's local classify
         core: sketch (cached), route, forward/scatter, merge. Returns
         verdicts keyed by display name — the inherited batch loop does
-        admission, batching, strict conversion, and reply plumbing."""
+        admission, batching, strict conversion, and reply plumbing.
+        ``self._batch_deadline`` (stashed by that loop: the tightest
+        remaining deadline among the batch's requests) bounds every
+        downstream leg — the per-hop budget decrement."""
+        budget_deadline = self._batch_deadline
         queries = self._sketch_batch(resident, paths)
         out: dict[str, dict] = {v["genome"]: v for v in queries.dropped}
         if not queries.n:
@@ -660,12 +814,15 @@ class RouterServer(IndexServer):
                 target=self._forward_group,
                 args=(addr, ts, [path_of[disp[t]] for t in ts],
                       set(cand[ts[0]]) if len(ts) == 1 else
-                      set().union(*(cand[t] for t in ts)), fwd_results),
+                      set().union(*(cand[t] for t in ts)), fwd_results,
+                      budget_deadline),
                 daemon=True, name="drep-route-fwd",
             )
             threads.append(th)
             th.start()
         deadline = time.monotonic() + self._leg_budget_s() + 1.0
+        if budget_deadline is not None:
+            deadline = min(deadline, budget_deadline + 1.0)
         for th in threads:
             th.join(max(0.0, deadline - time.monotonic()))
 
@@ -686,7 +843,7 @@ class RouterServer(IndexServer):
 
         if scatter_ts:
             sub = self._subset_queries(queries, sorted(scatter_ts))
-            for v in self._classify_scatter(resident, sub):
+            for v in self._classify_scatter(resident, sub, budget_deadline):
                 out[v["genome"]] = v
                 self._bump("scattered")
                 if v.get("partitions_unavailable"):
@@ -701,10 +858,13 @@ class RouterServer(IndexServer):
             results=queries.results, dropped=[],
         )
 
-    def _classify_scatter(self, fed, queries) -> list[dict]:
+    def _classify_scatter(self, fed, queries, budget_deadline=None) -> list[dict]:
         """Scatter legs, gather, and run the EXACT federated merge with
         the remote results injected — one bounded generation-fence
-        retry when the fleet proves to be ahead."""
+        retry when the fleet proves to be ahead. ``budget_deadline``
+        (absolute monotonic, or None) bounds every leg AND the merge's
+        per-partition consults: once it passes, remaining partitions
+        book unavailable and the verdict goes out honestly PARTIAL."""
         from drep_tpu.index.federation import classify_batch_federated
 
         for attempt in (0, 1):
@@ -715,7 +875,9 @@ class RouterServer(IndexServer):
                 for g in q_names
             ]
             cand = fed.route_candidates(q_bottoms)
-            legs, ahead = self._gather_legs(fed, gen, cand, q_names, q_bottoms)
+            legs, ahead = self._gather_legs(
+                fed, gen, cand, q_names, q_bottoms, budget_deadline
+            )
             if ahead and attempt == 0:
                 self._bump("fence_retries")
                 fresh = self._fence_reload()
@@ -727,15 +889,20 @@ class RouterServer(IndexServer):
                 fed, queries, processes=self.cfg.processes,
                 prune_cfg=self.cfg.prune_cfg, joint=False,
                 partition_compare=lambda pid, _names, _bottoms: legs.get(pid),
+                consult_check=(
+                    None if budget_deadline is None
+                    else lambda: time.monotonic() < budget_deadline
+                ),
             )
         raise AssertionError("unreachable")  # pragma: no cover
 
     def _leg_budget_s(self) -> float:
         return 2.0 * self.leg_timeout_s + self.hedge_delay_s
 
-    def _gather_legs(self, fed, gen, cand, q_names, q_bottoms):
+    def _gather_legs(self, fed, gen, cand, q_names, q_bottoms, budget_deadline=None):
         """Dispatch one classify_part leg per candidate partition, all
-        concurrent, each internally rerouted/hedged/deadlined. Returns
+        concurrent, each internally rerouted/hedged/deadlined (and
+        budget-bounded when the batch carries a deadline). Returns
         ({pid: (ui, qi, dd)}, fleet_is_ahead)."""
         pids = sorted(set().union(*cand)) if cand else []
         legs: dict[int, tuple] = {}
@@ -746,7 +913,8 @@ class RouterServer(IndexServer):
             names = [q_names[t] for t in cols]
             bottoms = [[int(x) for x in q_bottoms[t]] for t in cols]
             th = threading.Thread(
-                target=self._run_leg, args=(pid, gen, names, bottoms, legs, ahead),
+                target=self._run_leg,
+                args=(pid, gen, names, bottoms, legs, ahead, budget_deadline),
                 daemon=True, name=f"drep-route-leg-{pid}",
             )
             threads.append(th)
@@ -755,14 +923,19 @@ class RouterServer(IndexServer):
         # fault fired at the router_leg site (chaos) must be contained
         # HERE — an expired leg merges as unavailable, never a wedge
         deadline = time.monotonic() + self._leg_budget_s() + 1.0
+        if budget_deadline is not None:
+            deadline = min(deadline, budget_deadline + 1.0)
         for th in threads:
             th.join(max(0.0, deadline - time.monotonic()))
         return legs, ahead.is_set()
 
-    def _run_leg(self, pid, gen, names, bottoms, legs, ahead) -> None:
+    def _run_leg(self, pid, gen, names, bottoms, legs, ahead,
+                 budget_deadline=None) -> None:
         try:
             faults.fire("router_leg")
-            res = self._leg_dispatch(pid, gen, names, bottoms, ahead)
+            res = self._leg_dispatch(
+                pid, gen, names, bottoms, ahead, budget_deadline
+            )
         except Exception as e:  # noqa: BLE001 — a leg NEVER raises out of
             # the router: failure degrades to a stamped PARTIAL
             get_logger().warning("route: leg pid=%d failed: %s", pid, e)
@@ -772,23 +945,41 @@ class RouterServer(IndexServer):
         else:
             legs[pid] = res
 
-    def _leg_dispatch(self, pid, gen, names, bottoms, ahead):
+    def _leg_dispatch(self, pid, gen, names, bottoms, ahead,
+                      budget_deadline=None):
         """One leg's full lifecycle: affinity-ordered targets, per-attempt
         socket deadline, straggler hedge to a second capable replica
         (first answer wins, the loser's socket is abandoned — a
         once-latch on the return path makes a double merge impossible),
         reroute on failure/refusal, overall deadline. Returns
-        (ui, qi, dd) arrays or None."""
+        (ui, qi, dd) arrays or None.
+
+        Deadline propagation (ISSUE 19): with a batch budget, each
+        attempt's request is stamped with the DECREMENTED remainder at
+        its own launch instant (elapsed time at this hop is subtracted,
+        never re-granted — the replica sheds it if the rest expires in
+        its queue), the leg's overall deadline shrinks to the budget,
+        and a hedge launches only while the remaining budget exceeds
+        the hedge delay. When any attempt wins, the still-in-flight
+        losers are cooperatively CANCELLED so they stop consuming their
+        replicas' queues."""
         deadline = time.monotonic() + self._leg_budget_s()
-        req = {
+        if budget_deadline is not None:
+            deadline = min(deadline, budget_deadline)
+        base = {
             "op": "classify_part", "pid": int(pid), "generation": int(gen),
             "names": names, "bottoms": bottoms, "prune": self.cfg.prune_cfg,
         }
         results: queue_mod.Queue = queue_mod.Queue()
+        on_wire: dict[str, str] = {}  # addr -> leg id currently in flight
 
-        def attempt(addr: str) -> None:
+        def attempt(addr: str, leg_id: str) -> None:
             self.table.lease(addr)
             try:
+                req = dict(base, id=leg_id)
+                left = remaining_budget_ms(budget_deadline)
+                if left is not None:
+                    req["deadline_ms"] = left  # the per-hop decrement
                 with ServeClient(addr, timeout_s=self.leg_timeout_s) as c:
                     results.put((addr, c.request(req), None))
             except Exception as e:  # noqa: BLE001 — routed to the loop below
@@ -797,10 +988,18 @@ class RouterServer(IndexServer):
                 self.table.release(addr)
 
         def launch(addr: str) -> None:
+            leg_id = f"leg{next(_LEG_SEQ)}-p{pid}"
+            on_wire[addr] = leg_id
             threading.Thread(
-                target=attempt, args=(addr,), daemon=True,
+                target=attempt, args=(addr, leg_id), daemon=True,
                 name="drep-route-attempt",
             ).start()
+
+        def cancel_stragglers() -> None:
+            # the consumed attempt was already popped from on_wire, so
+            # everything left is a loser still occupying a replica
+            for loser, lid in on_wire.items():
+                self._cancel_leg(loser, lid)
 
         tried: list[str] = []
         hedge_addrs: set[str] = set()
@@ -830,8 +1029,14 @@ class RouterServer(IndexServer):
                 wait_until = min(deadline, now + self.hedge_delay_s)
             elif pending == 1 and not hedge_addrs:
                 # the hedge window elapsed with the primary still out:
-                # duplicate to a second capable replica, first answer wins
-                addr = next_target()
+                # duplicate to a second capable replica, first answer
+                # wins — but only within the remaining budget: a hedge
+                # that cannot answer before the deadline is pure fleet
+                # load, so a nearly-spent budget suppresses it
+                addr = None
+                if (budget_deadline is None
+                        or budget_deadline - now > self.hedge_delay_s):
+                    addr = next_target()
                 if addr is not None:
                     tried.append(addr)
                     hedge_addrs.add(addr)
@@ -849,6 +1054,7 @@ class RouterServer(IndexServer):
             except queue_mod.Empty:
                 continue  # loop re-decides: hedge, reroute, or expire
             pending -= 1
+            on_wire.pop(addr, None)
             if err is not None or resp is None:
                 self.table.book_failure(addr, err or "empty leg response")
                 continue
@@ -856,6 +1062,7 @@ class RouterServer(IndexServer):
                 self.table.book_success(addr)
                 if addr in hedge_addrs:
                     self._bump("hedge_wins")
+                cancel_stragglers()
                 return (
                     np.asarray(resp.get("ui", ()), np.int64),
                     np.asarray(resp.get("qi", ()), np.int64),
@@ -866,6 +1073,7 @@ class RouterServer(IndexServer):
                 rgen = resp.get("generation")
                 if rgen is not None and int(rgen) > gen:
                     ahead.set()  # the batch-level fence retry takes over
+                    cancel_stragglers()  # the whole gather re-scatters
                     return None
                 continue  # replica BEHIND: another target may be current
             if reason in ("backpressure", "draining"):
@@ -881,26 +1089,64 @@ class RouterServer(IndexServer):
             counters.add_fault("router_overload_spill")
         return None
 
+    def _cancel_leg(self, addr: str, leg_id: str) -> None:
+        """Best-effort cooperative cancel of a losing hedge leg on a
+        FRESH short-lived connection (the leg's own socket is blocked in
+        its reply wait — it cannot carry the cancel). The replica either
+        drops the still-queued leg outright (its compute slot freed
+        before any dispatch) or flags the id so the computed result is
+        discarded at reply time; either way the loser stops consuming
+        replica capacity. Fire-and-forget by contract: a failed cancel
+        only means the leg runs to waste, exactly the pre-cancel world."""
+        self._bump("hedge_cancels")
+        counters.add_fault("router_hedge_cancelled")
+
+        def _send() -> None:
+            try:
+                with ServeClient(
+                    addr, timeout_s=min(2.0, self.leg_timeout_s)
+                ) as c:
+                    c.cancel(leg_id)
+            except Exception as e:  # noqa: BLE001 — best-effort by contract
+                get_logger().debug(
+                    "route: hedge cancel of %s at %s failed: %s",
+                    leg_id, addr, e,
+                )
+
+        threading.Thread(
+            target=_send, daemon=True, name="drep-route-cancel"
+        ).start()
+
     # ---- forward fast path ----------------------------------------------
-    def _forward_group(self, addr, ts, paths, pids, results) -> None:
+    def _forward_group(self, addr, ts, paths, pids, results,
+                       budget_deadline=None) -> None:
         """Forward whole queries (one pipelined connection — the
         replica's batch window coalesces them) with the same
         reroute + hedge envelope as a scatter leg. Failures leave the
         queries' slots empty; the caller falls back to the scatter
-        merge, which degrades per-partition instead of per-query."""
+        merge, which degrades per-partition instead of per-query. A
+        batch budget bounds the group like a leg (each attempt carries
+        the decremented remainder; the hedge is budget-gated); no
+        cancel here — classify_many owns its request ids, so the
+        router has no handle on the loser's frames."""
         try:
             faults.fire("router_leg")
         except Exception as e:  # noqa: BLE001 — injected: same contract
             get_logger().warning("route: forward to %s failed: %s", addr, e)
             return
         deadline = time.monotonic() + self._leg_budget_s()
+        if budget_deadline is not None:
+            deadline = min(deadline, budget_deadline)
         rq: queue_mod.Queue = queue_mod.Queue()
 
         def attempt(a: str) -> None:
             self.table.lease(a)
             try:
                 with ServeClient(a, timeout_s=self.leg_timeout_s) as c:
-                    rq.put((a, c.classify_many(paths), None))
+                    rq.put((a, c.classify_many(
+                        paths,
+                        deadline_ms=remaining_budget_ms(budget_deadline),
+                    ), None))
             except Exception as e:  # noqa: BLE001
                 rq.put((a, None, e))
             finally:
@@ -936,7 +1182,10 @@ class RouterServer(IndexServer):
                 pending += 1
                 wait_until = min(deadline, now + self.hedge_delay_s)
             elif pending == 1 and not hedge_addrs:
-                nxt = next_target()
+                nxt = None
+                if (budget_deadline is None
+                        or budget_deadline - now > self.hedge_delay_s):
+                    nxt = next_target()
                 if nxt is not None:
                     tried.append(nxt)
                     hedge_addrs.add(nxt)

@@ -264,6 +264,38 @@ _declare(
     "(retry_after_s) instead of queueing to death. The CLI "
     "`index route --max_inflight` overrides.",
 )
+# -- serve-tier deadlines + wire hardening (ISSUE 19) ------------------------
+_declare(
+    "DREP_TPU_SERVE_DEADLINE_DEFAULT_MS", "float", 30000.0,
+    "Serve tier: default end-to-end deadline budget (ms) stamped onto "
+    "requests that carry no `deadline_ms` of their own (legacy clients). "
+    "A queued request whose budget expires before dispatch is SHED with a "
+    "`deadline_exceeded` refusal instead of wasting a device slot; 0 "
+    "disables the default (legacy requests then wait indefinitely).",
+)
+_declare(
+    "DREP_TPU_WIRE_CRC", "bool", True,
+    "Set 0 to disable the per-line CRC on NDJSON serve frames (the PR 5 "
+    "in-band-checksum idiom extended to the wire). Verification is "
+    "presence-gated on the receiver, so mixed fleets interoperate.",
+)
+_declare(
+    "DREP_TPU_ROUTER_BREAKER_ERRS", "int", 5,
+    "Fleet router circuit breaker: leg errors within "
+    "DREP_TPU_ROUTER_BREAKER_WINDOW_S that trip a replica's breaker OPEN "
+    "(routing skips it without eating a leg timeout). Successes do not "
+    "clear the window — a flapping replica still trips. 0 disables.",
+)
+_declare(
+    "DREP_TPU_ROUTER_BREAKER_WINDOW_S", "float", 30.0,
+    "Fleet router circuit breaker: sliding error-rate window (s).",
+)
+_declare(
+    "DREP_TPU_ROUTER_BREAKER_HALFOPEN_S", "float", 5.0,
+    "Fleet router circuit breaker: seconds an OPEN breaker holds before "
+    "moving to HALF-OPEN and admitting exactly one bounded probe leg "
+    "(success closes + clears the window; failure re-opens).",
+)
 # -- autoscaling controller --------------------------------------------------
 _declare(
     "DREP_TPU_AUTOSCALE_INTERVAL_S", "float", 5.0,

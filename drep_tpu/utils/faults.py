@@ -34,7 +34,9 @@ Spec syntax (``DREP_TPU_FAULTS`` env var, or :func:`configure`)::
   shared by every pod member), ``skip=N`` (ignore the first N matching
   calls — e.g. let a process finish two stripes before killing it),
   ``path=S`` (fire only when the target path contains S — e.g.
-  ``path=.e01`` corrupts only an epoch-1-stamped shard; I/O sites only).
+  ``path=.e01`` corrupts only an epoch-1-stamped shard; on the ``wire``
+  site the "path" is the chaos proxy's peer label, so ``path=replica0``
+  garbles exactly one hop; I/O + wire sites only).
 
 The ``kill`` mode (``process_death`` site, fired per streaming stripe;
 ``ring_step`` site, fired per dense-ring step boundary) SIGKILLs the
@@ -112,6 +114,12 @@ SITES = (
     # staged / pre-commit / pre-gc — a kill between a partition's
     # manifest publish and the meta publish must be adopted by
     # roll_forward, and the gc must resume idempotently)
+    "wire",  # the serve tier's NDJSON wire itself, polled per REPLY line
+    # by the in-process chaos proxy (drep_tpu/serve/wirechaos.py) sitting
+    # between any client/router/replica pair. Modes are wire-only (see
+    # WIRE_MODES); ``path=S`` targets a peer LABEL (the proxy's name for
+    # its upstream, e.g. path=replica0) the way io rules target a shard
+    # path — one spec can garble exactly one hop of a fleet.
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):
@@ -121,7 +129,16 @@ SITES = (
 # StoreFullError); corrupt = flip one bit of the published npz AFTER the
 # atomic rename — the post-write rot the in-band checksum self-heals.
 IO_MODES = ("io_error", "stale_read", "enospc", "corrupt")
-MODES = ("raise", "hang", "sleep", "torn", "kill", "drain") + IO_MODES
+# wire-site modes (polled via wire_fault inside serve/wirechaos.py — the
+# chaos proxy ACTS on the byte stream, nothing raises): reset = abort the
+# connection mid-reply (RST, no FIN); stall = hold the reply `secs`
+# (default 3600 — trips the client's deadline, never a daemon thread);
+# slow = delay each reply line `secs` (default 0.05) then deliver intact;
+# short_read = deliver a truncated reply line then close (EOF mid-frame);
+# garble = flip bytes inside the reply frame (the per-line CRC must catch
+# it); dup = deliver the reply line twice (request-id echo must dedupe).
+WIRE_MODES = ("reset", "stall", "slow", "short_read", "garble", "dup")
+MODES = ("raise", "hang", "sleep", "torn", "kill", "drain") + IO_MODES + WIRE_MODES
 
 
 class InjectedFault(RuntimeError):
@@ -211,6 +228,22 @@ def _parse(spec: str) -> dict[str, list[_Rule]]:
                 f"mode 'drain' fires only at the safe-boundary sites "
                 f"process_death/ring_step (got site {site!r})"
             )
+        if mode in WIRE_MODES and site != "wire":
+            # the proxy is the only consumer: router_leg:garble would
+            # parse, book nothing at fire() (which has no garble arm),
+            # and the chaos run would claim wire coverage it never ran
+            raise FaultSpecError(
+                f"mode {mode!r} is wire-site-only (got site {site!r}); "
+                f"wire faults act inside serve/wirechaos.py via the "
+                f"'wire' site"
+            )
+        if site == "wire" and mode not in WIRE_MODES:
+            # symmetric: wire:raise would parse but the proxy only polls
+            # wire_fault() for the byte-stream modes — nothing would fire
+            raise FaultSpecError(
+                f"the 'wire' site takes only the wire modes "
+                f"{', '.join(WIRE_MODES)} (got {mode!r})"
+            )
         if mode == "torn" and site != "shard_write":
             # tearing is an action the WRITER polls (torn_write), and only
             # the shard_write site is ever polled — a spec like
@@ -244,12 +277,12 @@ def _parse(spec: str) -> dict[str, list[_Rule]]:
                     # 'io', torn_write for 'shard_write'); on any other
                     # site should_fire would see path=None and the rule
                     # would silently never fire — reject the spec instead
-                    if site not in ("io", "shard_write"):
+                    if site not in ("io", "shard_write", "wire"):
                         raise FaultSpecError(
                             f"path= is only meaningful on the io/"
-                            f"shard_write sites (got {site!r}); other "
-                            f"sites never supply a target path, so the "
-                            f"rule would never fire"
+                            f"shard_write/wire sites (got {site!r}); "
+                            f"other sites never supply a target path, so "
+                            f"the rule would never fire"
                         )
                     rule.path_sub = val
                 else:
@@ -369,6 +402,24 @@ def corrupt_write(site: str = "io", path: str | None = None) -> bool:
             _record(rule)
             return True
     return False
+
+
+def wire_fault(peer: str | None = None):
+    """Poll the ``wire`` site for one reply frame about to cross `peer`'s
+    hop (serve/wirechaos.py calls this per reply line). Returns the
+    matching :class:`_Rule` — the proxy ACTS on the byte stream itself
+    (reset/stall/slow/short_read/garble/dup), so like torn_write this is
+    a poll, not an exception. ``path=`` rules target the peer label."""
+    rules = _RULES
+    if rules is None:
+        rules = _rules()
+    if not rules:
+        return None
+    for rule in rules.get("wire", ()):
+        if rule.should_fire(None, path=peer):
+            _record(rule)
+            return rule
+    return None
 
 
 def fire_io(op: str, path: str | None = None) -> None:

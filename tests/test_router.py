@@ -61,13 +61,18 @@ from drep_tpu.serve import (  # noqa: E402
     protocol,
 )
 from drep_tpu.serve.router import (  # noqa: E402
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
     REPLICA_EJECTED,
     REPLICA_HEALTHY,
     REPLICA_SUSPECT,
     ReplicaTable,
     RouterConfig,
     RouterServer,
+    decrement_budget_ms,
     parse_replica_spec,
+    remaining_budget_ms,
 )
 
 # the test_fed_serve layout: P=3, groups split across partitions
@@ -223,6 +228,120 @@ def test_router_fault_sites_and_knobs():
     assert envknobs.env_float("DREP_TPU_ROUTER_LEG_TIMEOUT_S") == 30.0
     assert envknobs.env_float("DREP_TPU_ROUTER_HEDGE_DELAY_S") == 2.0
     assert envknobs.env_int("DREP_TPU_ROUTER_MAX_INFLIGHT") == 256
+
+
+def test_budget_decrement_rule():
+    """The per-hop budget arithmetic (ISSUE 19), pinned as pure units:
+    elapsed time subtracts in milliseconds, exhaustion clamps at zero
+    (a leg is never granted negative time), and no-budget stays
+    unbounded through any number of hops."""
+    assert decrement_budget_ms(None, 5.0) is None
+    assert decrement_budget_ms(1000.0, 0.25) == 750.0
+    assert decrement_budget_ms(100.0, 0.25) == 0.0  # clamped, never negative
+    assert decrement_budget_ms(0.0, 10.0) == 0.0
+    assert remaining_budget_ms(None) is None
+    now = time.monotonic()
+    assert remaining_budget_ms(now + 1.0, now=now) == pytest.approx(1000.0)
+    assert remaining_budget_ms(now - 5.0, now=now) == 0.0
+    # the absolute-deadline form IS the pure rule, phrased against now
+    assert remaining_budget_ms(now + 0.75, now=now) == pytest.approx(
+        decrement_budget_ms(1000.0, 0.25)
+    )
+
+
+def test_replica_breaker_state_machine():
+    """The error-rate circuit breaker (ISSUE 19), layered on the health
+    machine: closed -> open on N errors inside the window EVEN WITH
+    interleaved successes (flapping never resets the error window the
+    way it resets the health streak); open blocks routing until the
+    half-open instant; half-open admits exactly ONE bounded probe leg
+    (the in-flight lease is the bound); a probe failure reopens; a real
+    LEG success closes and clears the window — while a /healthz probe
+    success does not (liveness is not leg health)."""
+    t = ReplicaTable(["a:1"], probe_backoff_s=0.05, probe_max_s=0.2,
+                     breaker_errs=3, breaker_window_s=10.0,
+                     breaker_halfopen_s=0.1)
+    slot = t.join("a:1")
+    ok_status = {"generation": 0, "queue_depth": 0, "draining": False,
+                 "partitions": {}}
+    # flap: error, probe-ok, error, probe-ok, error — the health machine
+    # never ejects (each success resets its streak) but the third error
+    # inside the window trips the breaker OPEN
+    t.book_failure("a:1", "boom")
+    t.book_success("a:1", ok_status)
+    assert slot.breaker == BREAKER_CLOSED
+    t.book_failure("a:1", "boom")
+    t.book_success("a:1", ok_status)
+    t.book_failure("a:1", "boom")
+    assert slot.breaker == BREAKER_OPEN and slot.breaker_trips == 1
+    assert slot.state == REPLICA_SUSPECT  # health machine lags behind
+    # open: not routable even though health still trusts it
+    assert t.eligible(0) == [] and not t.usable()
+    hm = t.health_map()
+    assert hm["replicas"]["a:1"]["breaker"] == BREAKER_OPEN
+    assert hm["replicas"]["a:1"]["breaker_trips"] == 1
+    assert hm["breaker_open"] == ["a:1"]
+    # a /healthz success while open does NOT close the breaker
+    t.book_success("a:1", ok_status)
+    assert slot.breaker == BREAKER_OPEN
+    # past the half-open instant: exactly one bounded probe leg passes
+    time.sleep(0.11)
+    assert [s.address for s in t.eligible(0)] == ["a:1"]
+    assert slot.breaker == BREAKER_HALF_OPEN
+    t.lease("a:1")  # the probe leg is on the wire
+    assert t.eligible(0) == []  # a second leg must route elsewhere
+    # the probe fails: reopen for a full cooldown (a re-trip of the same
+    # incident, not a new trip)
+    t.book_failure("a:1", "probe failed")
+    assert slot.breaker == BREAKER_OPEN and slot.breaker_trips == 1
+    t.release("a:1")
+    # the next half-open probe SUCCEEDS as a real leg (status=None):
+    # closed, error window forgotten
+    time.sleep(0.11)
+    assert [s.address for s in t.eligible(0)] == ["a:1"]
+    t.book_success("a:1")
+    assert slot.breaker == BREAKER_CLOSED and slot.err_times == []
+    assert t.health_map()["replicas"]["a:1"]["breaker_errors"] == 0
+    assert t.health_map()["breaker_open"] == []
+    # a fleet rejoin also resets the breaker (trust re-earned fresh)
+    t.book_failure("a:1", "x")
+    t.book_failure("a:1", "x")
+    t.book_failure("a:1", "x")
+    assert slot.breaker == BREAKER_OPEN
+    t.leave("a:1")
+    t.join("a:1")
+    assert slot.breaker == BREAKER_CLOSED and slot.err_times == []
+
+
+def test_wire_fault_site_and_breaker_knobs():
+    """The `wire` fault site (serve/wirechaos.py's driver) parses every
+    wire mode — and ONLY on the wire site, with ``path=`` peer
+    targeting; the router's breaker env knobs are declared (the
+    drep-lint env-knob contract)."""
+    from drep_tpu.utils import envknobs, faults
+
+    for mode in faults.WIRE_MODES:
+        faults.configure(f"wire:{mode}")
+    faults.configure("wire:garble:0.5:seed=3:path=replica0")
+    faults.configure("wire:stall:secs=0.01,wire:dup:max=2")
+    for bad in (
+        "wire:torn",  # torn is shard_write-only
+        "wire:raise",  # compute-site mode on the wire site
+        "io:garble",  # wire modes live on the wire site only
+        "router_leg:dup",
+    ):
+        with pytest.raises(faults.FaultSpecError):
+            faults.configure(bad)
+    faults.configure(None)
+    for name, kind in (
+        ("DREP_TPU_ROUTER_BREAKER_ERRS", "int"),
+        ("DREP_TPU_ROUTER_BREAKER_WINDOW_S", "float"),
+        ("DREP_TPU_ROUTER_BREAKER_HALFOPEN_S", "float"),
+    ):
+        assert envknobs.knob(name).kind == kind
+    assert envknobs.env_int("DREP_TPU_ROUTER_BREAKER_ERRS") == 5
+    assert envknobs.env_float("DREP_TPU_ROUTER_BREAKER_WINDOW_S") == 30.0
+    assert envknobs.env_float("DREP_TPU_ROUTER_BREAKER_HALFOPEN_S") == 5.0
 
 
 # ---- units: the client's refusal retry loop --------------------------------
@@ -623,6 +742,18 @@ def test_overload_spill_on_draining_replica(fleet_store):
     # drain through /healthz — the refusals themselves must spill
     rt, ra, trt = _start_router(loc, [a1], probe_interval_s=60.0)
     try:
+        # let the STARTUP probe land before draining: if it raced the
+        # drain it would mark the slot draining for the whole 60s
+        # interval and the router would refuse outright instead of
+        # spilling (the race this wait closes is real but not the
+        # contract under test)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if rt.snapshot()["replicas"]["replicas"][a1]["probes"] >= 1:
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("router never probed its replica")
         # queue-level drain ONLY: request_drain() would also close the
         # listener, turning the refusals this test is about into plain
         # connection failures — here the replica still answers, and

@@ -132,6 +132,118 @@ def test_histogram_percentiles_report_and_prom():
     assert not c.hists
 
 
+# ---- deadline budgets + wire CRC units (ISSUE 19) --------------------------
+
+
+def test_wire_crc_seal_check_unseal(monkeypatch):
+    """The per-line CRC frame contract: seal embeds CRC-32 of the bare
+    payload as the last key, check_crc verifies+strips it, a flipped
+    byte classifies as WireCorruption (detected, never merged), and
+    frames WITHOUT a crc pass through untouched — mixed fleets and the
+    DREP_TPU_WIRE_CRC=0 escape hatch interoperate."""
+    obj = {"ok": True, "id": "ab12", "verdict": {"genome": "q.fa"}}
+    line = protocol.seal(obj)
+    assert line.endswith(b"}\n") and b',"crc":' in line
+    assert protocol.unseal(line) == obj
+    # round-trip through check_crc yields the bare (crc-stripped) frame
+    assert json.loads(protocol.check_crc(line)) == obj
+    # one flipped byte inside the body: detected, classified
+    pos = line.index(b"ab12")
+    garbled = line[:pos] + b"xb12" + line[pos + 4:]
+    with pytest.raises(protocol.WireCorruption):
+        protocol.check_crc(garbled)
+    # crc-less frames pass through (the mixed-fleet contract)
+    bare = protocol.encode(obj)
+    assert protocol.unseal(bare) == obj
+    # non-JSON / non-object frames classify as wire damage too
+    for junk in (b"not json\n", b'"just a string"\n'):
+        with pytest.raises(protocol.WireCorruption):
+            protocol.unseal(junk)
+    # the escape hatch: CRC off -> seal degenerates to plain encode
+    monkeypatch.setenv("DREP_TPU_WIRE_CRC", "0")
+    assert protocol.seal(obj) == bare
+
+
+def test_deadline_and_cancel_wire_validation():
+    """deadline_ms is a positive JSON number wherever it rides (the
+    bool guard matters: True is an int to Python and a 1 ms budget
+    would shed everything); cancel needs the id of a prior request."""
+    req = protocol.parse_request(
+        b'{"op": "classify", "genome": "/x.fa", "deadline_ms": 250.5}'
+    )
+    assert req["deadline_ms"] == 250.5
+    assert protocol.parse_request(b'{"op": "cancel", "id": "ab12"}')["id"] == "ab12"
+    for bad in (
+        b'{"op": "classify", "genome": "/x.fa", "deadline_ms": true}',
+        b'{"op": "classify", "genome": "/x.fa", "deadline_ms": 0}',
+        b'{"op": "classify", "genome": "/x.fa", "deadline_ms": -5}',
+        b'{"op": "classify", "genome": "/x.fa", "deadline_ms": "soon"}',
+        b'{"op": "cancel"}',
+        b'{"op": "cancel", "id": ""}',
+        b'{"op": "cancel", "id": 7}',
+    ):
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_request(bad)
+
+
+def test_queue_eta_histogram_rule():
+    """The histogram-ETA shed rule, pinned: batches ahead (depth /
+    capacity, plus the one you join) times window + recent MEDIAN batch
+    wall; before any batch has run the window alone is the estimate."""
+    from drep_tpu.serve.batcher import queue_eta_s
+    from drep_tpu.utils.profiling import Histogram
+
+    assert queue_eta_s(0, 8, 0.05) == pytest.approx(0.05)
+    assert queue_eta_s(16, 8, 0.05) == pytest.approx(3 * 0.05)
+    assert queue_eta_s(0, 1, 0.0) == 0.0
+    h = Histogram(size=32)
+    for ms in (100.0, 200.0, 300.0):
+        h.observe(ms)
+    assert queue_eta_s(0, 8, 0.05, h) == pytest.approx(0.05 + 0.2)
+    assert queue_eta_s(16, 8, 0.05, h) == pytest.approx(3 * (0.05 + 0.2))
+
+
+def test_batcher_sheds_expired_before_membership_and_cancels_queued():
+    """An entry whose budget expired in queue is shed via on_shed
+    strictly BEFORE batch membership (it can never reach the rect
+    compare); cancel removes a still-queued entry by id."""
+    shed: list = []
+    q = AdmissionQueue(max_queue=8, on_shed=shed.append)
+    now = time.monotonic()
+    expired1 = PendingRequest(genome="/a/x.fa", reply=lambda r: None,
+                              req_id="e1", deadline=now - 0.5)
+    expired2 = PendingRequest(genome="/a/y.fa", reply=lambda r: None,
+                              req_id="e2", deadline=now - 0.1)
+    fresh = PendingRequest(genome="/a/z.fa", reply=lambda r: None,
+                           req_id="f1", deadline=now + 60.0)
+    for r in (expired1, expired2, fresh):
+        assert q.submit(r) is None
+    batch = q.next_batch(max_batch=8, window_s=0.0)
+    assert [r.req_id for r in batch] == ["f1"]
+    assert [r.req_id for r in shed] == ["e1", "e2"]
+    # no deadline = unbounded (the daemon stamps the default knob)
+    assert not PendingRequest(genome="/a", reply=lambda r: None).expired()
+    # cancel: removes the queued entry once, unknown/None ids are no-ops
+    victim = PendingRequest(genome="/a/w.fa", reply=lambda r: None, req_id="v")
+    assert q.submit(victim) is None
+    assert q.cancel("v") is victim
+    assert q.cancel("v") is None
+    assert q.cancel("ghost") is None
+    assert q.cancel(None) is None
+    assert q.depth() == 0
+
+
+def test_serve_deadline_and_wire_knobs():
+    """The ISSUE 19 serve knobs are declared (the drep-lint env-knob
+    contract): the legacy-client default budget and the CRC gate."""
+    from drep_tpu.utils import envknobs
+
+    assert envknobs.knob("DREP_TPU_SERVE_DEADLINE_DEFAULT_MS").kind == "float"
+    assert envknobs.env_float("DREP_TPU_SERVE_DEADLINE_DEFAULT_MS") == 30000.0
+    assert envknobs.knob("DREP_TPU_WIRE_CRC").kind == "bool"
+    assert envknobs.env_bool("DREP_TPU_WIRE_CRC") is True
+
+
 # ---- the resident-core refactor -------------------------------------------
 
 
@@ -444,6 +556,90 @@ def test_backpressure_and_drain_refusals(serve_index):
         srv.queue.drain()
         t.join(timeout=30)
         srv.close()
+
+
+def test_daemon_deadline_shed_cancel_and_eta_refusal(serve_index):
+    """ISSUE 19 end-to-end: a request whose budget expires in queue is
+    NEVER dispatched (shed strictly before batch membership, answered
+    with an honest stamped refusal + the histogram-ETA retry hint); a
+    cancel drops a queued entry without a dispatch and its connection
+    gets the terminal ``cancelled`` refusal; and once the batch
+    histogram knows the real batch wall, a budget below the queue ETA
+    is refused AT ADMISSION — no queue time burned."""
+    from drep_tpu.utils.profiling import counters
+
+    loc, _queries = serve_index
+    started = threading.Event()
+    release = threading.Event()
+    dispatched: list[str] = []
+
+    def gated_classify(resident, paths):
+        dispatched.extend(os.path.basename(p) for p in paths)
+        started.set()
+        release.wait(timeout=30)
+        return {
+            os.path.basename(p): {"genome": os.path.basename(p),
+                                  "generation": int(resident.generation)}
+            for p in paths
+        }
+
+    counters.reset()  # fresh serve_batch_ms histogram: ETA = window only
+    cfg = ServeConfig(index_loc=loc, max_queue=8, max_batch=1,
+                      batch_window_ms=0.0, poll_generation_s=60.0)
+    srv = IndexServer(cfg, classify_fn=gated_classify)
+    addr = srv.start()
+    t = threading.Thread(target=srv.serve_batches, daemon=True)
+    t.start()
+    try:
+        blocker = os.path.join(loc, "manifest.json")  # any readable file
+        opener = threading.Thread(
+            target=lambda: ServeClient(addr, timeout_s=60).classify(blocker),
+            daemon=True,
+        )
+        opener.start()
+        assert started.wait(timeout=30)  # the batch loop is provably held
+        with ServeClient(addr, timeout_s=60) as c:
+            c._send({"op": "classify", "genome": blocker, "id": "victim",
+                     "deadline_ms": 100})
+            c._send({"op": "classify", "genome": blocker, "id": "v2"})
+            deadline = time.monotonic() + 30
+            while srv.queue.depth() < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv.queue.depth() == 2
+            with ServeClient(addr, timeout_s=30) as c2:
+                assert c2.cancel("v2") is True  # dropped still-queued
+                assert c2.cancel("ghost") is False  # in-flight flag path
+            gone = c._recv_for("v2")
+            assert not gone["ok"] and gone["reason"] == "cancelled"
+            time.sleep(0.25)  # victim's 100 ms budget burns in queue
+            release.set()  # loop frees, pops victim -> expired -> shed
+            shed = c._recv_for("victim")
+            assert not shed["ok"] and shed["reason"] == "deadline_exceeded"
+            assert shed["retry_after_s"] > 0
+        opener.join(timeout=60)
+        # neither the shed nor the cancelled request ever reached the
+        # classify_fn: only the blocker dispatched, exactly once
+        assert dispatched == ["manifest.json"]
+        assert srv.stats.deadline_shed == 1 and srv.stats.cancels == 1
+        snap = srv.snapshot()
+        assert snap["deadline_shed"] == 1 and snap["cancels"] == 1
+        # the histogram now knows batches take ~250 ms+, so a 10 ms
+        # budget is refused up front with the stamped reason (whether
+        # the refusal lands before or after the client's own local
+        # budget check, the error is the same honest classification)
+        with pytest.raises(ServeError) as ei:
+            with ServeClient(addr, timeout_s=30) as c3:
+                c3.classify(blocker, deadline_ms=10)
+        assert ei.value.reason == "deadline_exceeded"
+        assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+        deadline = time.monotonic() + 10
+        while srv.stats.deadline_shed < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert srv.stats.deadline_shed == 2  # booked at admission
+        assert dispatched == ["manifest.json"]  # still never dispatched
+    finally:
+        release.set()
+        _stop_server(srv, t)
 
 
 def test_poisoned_batch_isolates_the_bad_query(serve_index, tmp_path):

@@ -1,0 +1,265 @@
+"""Wire-level chaos (ISSUE 19): the serve protocol under TCP bytes
+behaving badly — the `wire` fault site driving serve/wirechaos.py's
+in-process chaos proxy.
+
+The acceptance contract, per mode (the chaos_matrix --wire cells run
+these by id):
+
+- ``reset``      — a connection RST mid-reply surfaces as a clean error
+  (never a hang, never a torn merge) and the daemon survives;
+- ``stall``      — a reply stalled past the request's deadline budget
+  ends in a stamped ``deadline_exceeded`` refusal, bounded by the
+  budget, never by the transport timeout;
+- ``garble``     — a corrupted reply frame is DETECTED by the per-line
+  CRC (classified ``wire_corrupt``, counted, never merged) and the
+  retried verdict is byte-identical to a clean wire's;
+- ``dup``        — a duplicated reply frame is dropped exactly-once via
+  the request-id echo (first frame wins, counted);
+- ``short_read`` — a truncated reply + EOF reports an honest
+  ``wire_corrupt`` error, never a partial merge.
+
+Most cells run against a scripted line server speaking real sealed
+frames (no index, no JAX — the damage and the detection are wire-layer
+concerns); one integration cell pins the byte-identical-verdict claim
+against a REAL in-process daemon. ``path=`` targeting (one spec garbles
+exactly one hop of a fleet) is pinned against the proxy's peer label.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import build_from_paths, index_classify  # noqa: E402
+from drep_tpu.serve import (  # noqa: E402
+    IndexServer,
+    ServeClient,
+    ServeConfig,
+    ServeError,
+    WireChaos,
+    protocol,
+)
+from drep_tpu.utils import faults  # noqa: E402
+
+
+class _ScriptedServe:
+    """A line server speaking the serve protocol's sealed frames — no
+    index, no JAX: every classify answers a canned verdict echoing the
+    request id (what the proxy's wire damage is applied to). Records
+    any handler exception: the zero-daemon-exceptions pin."""
+
+    def __init__(self):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.address = "127.0.0.1:%d" % self._srv.getsockname()[1]
+        self.errors: list = []
+        self.requests: list[dict] = []
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            reader = conn.makefile("rb")
+            while True:
+                line = reader.readline()
+                if not line:
+                    return
+                req = protocol.unseal(line)
+                self.requests.append(req)
+                if req.get("op") == "cancel":
+                    conn.sendall(protocol.seal(
+                        {"ok": True, "op": "cancel", "id": req.get("id"),
+                         "cancelled": False}
+                    ))
+                    continue
+                conn.sendall(protocol.seal({
+                    "ok": True, "id": req.get("id"), "generation": 0,
+                    "batch_size": 1,
+                    "verdict": {"genome": os.path.basename(req["genome"]),
+                                "novel": True},
+                }))
+        except OSError:
+            pass
+        except Exception as e:  # noqa: BLE001 — the pin is that this never happens
+            self.errors.append(e)
+        finally:
+            conn.close()
+
+    def close(self):
+        self._srv.close()
+
+
+@pytest.fixture()
+def stub():
+    s = _ScriptedServe()
+    try:
+        yield s
+    finally:
+        s.close()
+        faults.configure(None)
+        assert s.errors == [], s.errors  # wire damage never crashed the server
+
+
+def test_wire_reset_mid_reply_clean_error(stub):
+    """reset: the proxy aborts the client connection (RST, no FIN) on
+    the first reply frame — the client sees a clean classified error,
+    never a hang, and the upstream server is untouched."""
+    faults.configure("wire:reset")
+    with WireChaos(stub.address, peer="replica0") as paddr:
+        t0 = time.monotonic()
+        with pytest.raises((ServeError, OSError)) as ei:
+            with ServeClient(paddr, timeout_s=10) as c:
+                c.classify("/q/a.fa")
+        assert time.monotonic() - t0 < 8.0  # an error, not a hang
+        if isinstance(ei.value, ServeError):
+            assert ei.value.reason == "disconnected"
+    # the server itself is fine: a clean hop still answers
+    faults.configure(None)
+    with ServeClient(stub.address, timeout_s=10) as c:
+        assert c.classify("/q/a.fa")["ok"]
+
+
+def test_wire_stall_past_budget_deadline_refusal(stub):
+    """stall: the reply is held far past the request's budget — the
+    CLIENT's remaining-budget socket bound converts it into a stamped
+    ``deadline_exceeded`` refusal at ~the budget instant, never a hang
+    on the transport timeout."""
+    faults.configure("wire:stall:secs=30")
+    with WireChaos(stub.address) as paddr:
+        t0 = time.monotonic()
+        with pytest.raises(ServeError) as ei:
+            with ServeClient(paddr, timeout_s=60) as c:
+                c.classify("/q/a.fa", deadline_ms=400)
+        elapsed = time.monotonic() - t0
+    assert ei.value.reason == "deadline_exceeded"
+    assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+    assert 0.3 <= elapsed < 5.0, elapsed  # budget-bounded, not 30s/60s
+
+
+def test_wire_garble_detected_and_retried(stub):
+    """garble: a corrupted reply frame fails the per-line CRC —
+    classified WireCorruption, counted, never merged. With a retry
+    budget the re-sent request lands a verdict byte-identical to a
+    clean wire's; without one the error surfaces honestly."""
+    faults.configure("wire:garble:max=1")
+    with WireChaos(stub.address) as paddr:
+        with ServeClient(paddr, timeout_s=10) as c:
+            r = c.classify("/q/a.fa", retries=1)
+            assert r["ok"] and r["verdict"] == {"genome": "a.fa", "novel": True}
+            assert c.wire_stats["corrupt"] == 1
+            assert c.wire_stats["wire_retries"] == 1
+    # retries exhausted: honest classification, never a merge
+    faults.configure("wire:garble")
+    with WireChaos(stub.address) as paddr:
+        with pytest.raises(ServeError) as ei:
+            with ServeClient(paddr, timeout_s=10) as c:
+                c.classify("/q/a.fa")
+        assert ei.value.reason == "wire_corrupt"
+
+
+def test_wire_dup_reply_exactly_once(stub):
+    """dup: every reply frame arrives twice — the request-id echo drops
+    the second copy exactly-once (counted), verdicts unchanged and in
+    input order."""
+    faults.configure("wire:dup")
+    with WireChaos(stub.address) as paddr:
+        with ServeClient(paddr, timeout_s=10) as c:
+            resps = c.classify_many(["/q/a.fa", "/q/b.fa", "/q/c.fa"])
+            assert [r["verdict"]["genome"] for r in resps] == [
+                "a.fa", "b.fa", "c.fa"
+            ]
+            assert all(r["ok"] for r in resps)
+            assert c.wire_stats["dup"] >= 1
+
+
+def test_wire_short_read_honest_error(stub):
+    """short_read: half a reply frame then EOF — the truncated line
+    fails to unseal (WireCorruption), the hole reports honestly as
+    ``wire_corrupt``, and nothing partial is ever merged."""
+    faults.configure("wire:short_read")
+    with WireChaos(stub.address) as paddr:
+        with pytest.raises(ServeError) as ei:
+            with ServeClient(paddr, timeout_s=10) as c:
+                c.classify("/q/a.fa")
+        assert ei.value.reason in ("wire_corrupt", "disconnected")
+        # pipelined: the same damage reports inline, never raises
+        with ServeClient(paddr, timeout_s=10) as c2:
+            resps = c2.classify_many(["/q/a.fa"])
+        assert not resps[0]["ok"]
+        assert resps[0]["reason"] in ("wire_corrupt", "no_reply")
+
+
+def test_wire_path_targets_one_peer(stub):
+    """``path=`` peer targeting: one spec damages exactly one hop of a
+    fleet — a proxy whose peer label does not match passes bytes
+    through verbatim."""
+    faults.configure("wire:garble:path=replica0")
+    with WireChaos(stub.address, peer="replica1") as clean_addr:
+        with ServeClient(clean_addr, timeout_s=10) as c:
+            assert c.classify("/q/a.fa")["ok"]
+            assert c.wire_stats["corrupt"] == 0
+    with WireChaos(stub.address, peer="replica0") as hit_addr:
+        with pytest.raises(ServeError):
+            with ServeClient(hit_addr, timeout_s=10) as c:
+                c.classify("/q/a.fa")
+    faults.configure(None)
+
+
+def test_wire_garble_real_daemon_verdict_byte_identical(tmp_path):
+    """The integration pin: a REAL daemon behind the chaos proxy under
+    garble — the CRC catches the damage, the retry lands, and the final
+    response's verdict is byte-identical to both a clean-wire serve
+    answer and the one-shot classify oracle. The daemon survives the
+    whole exchange."""
+    paths = lib.write_genome_set(str(tmp_path / "g"), [2, 1], seed=19)
+    loc = str(tmp_path / "idx")
+    build_from_paths(loc, paths, length=0)
+    q = paths[0]
+    oracle = index_classify(loc, [q])[0]
+
+    cfg = ServeConfig(index_loc=loc, batch_window_ms=1.0, max_batch=8,
+                      poll_generation_s=60.0)
+    srv = IndexServer(cfg)
+    addr = srv.start()
+    t = threading.Thread(target=srv.serve_batches, daemon=True)
+    t.start()
+    try:
+        with ServeClient(addr, timeout_s=120) as c:
+            clean = c.classify(q)
+        faults.configure("wire:garble:max=1")
+        with WireChaos(addr, peer="replica0") as paddr:
+            with ServeClient(paddr, timeout_s=120) as c:
+                damaged = c.classify(q, retries=1)
+                assert c.wire_stats["corrupt"] == 1
+        faults.configure(None)
+        assert damaged["ok"] and clean["ok"]
+        assert json.dumps(damaged["verdict"], sort_keys=True) == json.dumps(
+            clean["verdict"], sort_keys=True
+        )
+        assert damaged["verdict"] == oracle
+        assert damaged["generation"] == clean["generation"] == 0
+        # the daemon took the garbled hop in stride: still serving
+        with ServeClient(addr, timeout_s=120) as c:
+            assert c.classify(q)["ok"]
+    finally:
+        faults.configure(None)
+        srv.request_drain()
+        t.join(timeout=30)
+        srv.close()

@@ -88,6 +88,17 @@ Two tiers:
   refusals spill the leg to honest PARTIAL degradation instead of
   queueing behind it). Delegate to tests/test_router_chaos.py, CPU-only.
 
+- wire cells (``--wire``): the serve tier's NDJSON wire itself
+  (ISSUE 19, drep_tpu/serve/wirechaos.py driving the ``wire`` fault
+  site) — a connection RESET mid-reply surfaces as an honest
+  ``disconnected`` error (daemon clean, never a hang), a reply STALLED
+  past the request's deadline budget ends in a clean stamped
+  ``deadline_exceeded`` refusal, a GARBLED reply frame is detected by
+  the per-line CRC and the retried verdict is byte-identical to a
+  clean wire's, a DUPLICATED reply is merged exactly-once via the
+  request-id echo, and a SHORT READ (EOF mid-frame) reports honestly.
+  Delegate to tests/test_wire_chaos.py, CPU-only, seconds each.
+
 - maintenance cells (``--maintenance``): the transactional index
   lifecycle (ISSUE 18, drep_tpu/index/maintenance.py) — SIGKILL the
   real `index split` / `index merge` / `index compact` CLI at EVERY
@@ -124,6 +135,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --autoscale # + controller cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --router  # + fleet front-door cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --wire    # + wire-damage cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --maintenance # + index lifecycle cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
 """
@@ -626,6 +638,30 @@ ROUTER_CELLS = [
 ]
 
 
+# wire cells (--wire, ISSUE 19): the NDJSON wire under the chaos proxy.
+# Every cell needs a subprocess daemon behind an in-process WireChaos
+# proxy with a fault spec installed — delegate to their pytest tests.
+# CPU-only, seconds each.
+WIRE_CELLS = [
+    ("wire", "reset",
+     "connection RST mid-reply -> honest disconnected error, daemon clean",
+     "survive", "tests/test_wire_chaos.py::test_wire_reset_mid_reply_clean_error"),
+    ("wire", "stall",
+     "reply stalled past the deadline budget -> clean stamped "
+     "deadline_exceeded refusal, never a hang",
+     "survive", "tests/test_wire_chaos.py::test_wire_stall_past_budget_deadline_refusal"),
+    ("wire", "garble",
+     "garbled reply frame -> CRC detects, retried verdict byte-identical",
+     "survive", "tests/test_wire_chaos.py::test_wire_garble_detected_and_retried"),
+    ("wire", "dup",
+     "duplicated reply frame -> request-id echo merges exactly-once",
+     "survive", "tests/test_wire_chaos.py::test_wire_dup_reply_exactly_once"),
+    ("wire", "short_read",
+     "truncated reply then EOF -> honest error, never a partial merge",
+     "survive", "tests/test_wire_chaos.py::test_wire_short_read_honest_error"),
+]
+
+
 # maintenance cells (--maintenance, ISSUE 18): the transactional index
 # lifecycle — split/merge/compaction as staged meta-manifest
 # transactions. Every kill cell runs the real CLI as a subprocess
@@ -744,6 +780,7 @@ def main() -> int:
     serve_cells = "--serve" in sys.argv
     fed_serve_cells = "--serve-federated" in sys.argv
     router_cells = "--router" in sys.argv
+    wire_cells = "--wire" in sys.argv
     events_cells = "--events" in sys.argv
     autoscale_cells = "--autoscale" in sys.argv
     maintenance_cells = "--maintenance" in sys.argv
@@ -793,6 +830,7 @@ def main() -> int:
     _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
     _pytest_cells(FED_SERVE_CELLS, "--serve-federated", fed_serve_cells)
     _pytest_cells(ROUTER_CELLS, "--router", router_cells)
+    _pytest_cells(WIRE_CELLS, "--wire", wire_cells)
     _pytest_cells(MAINTENANCE_CELLS, "--maintenance", maintenance_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)
     _pytest_cells(AUTOSCALE_CELLS, "--autoscale", autoscale_cells)

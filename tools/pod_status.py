@@ -397,7 +397,11 @@ def render_serve(status: dict) -> str:
         f"requests {status.get('requests_total')}  "
         f"swaps {status.get('generation_swaps')}"
         + (f"  partial refusals {status['partial_refusals']}"
-           if status.get("partial_refusals") else ""),
+           if status.get("partial_refusals") else "")
+        + (f"  deadline shed {status['deadline_shed']}"
+           if status.get("deadline_shed") else "")
+        + (f"  cancels {status['cancels']}"
+           if status.get("cancels") else ""),
     ]
     fed = status.get("partitions")
     if fed:
@@ -451,7 +455,8 @@ def render_serve(status: dict) -> str:
             f"{rt.get('reroutes', 0)} rerouted, "
             f"{rt.get('fence_retries', 0)} fence retr(ies), "
             f"{rt.get('partial_verdicts', 0)} PARTIAL, "
-            f"{rt.get('overload_spills', 0)} overload spill(s))"
+            f"{rt.get('overload_spills', 0)} overload spill(s), "
+            f"{rt.get('hedge_cancels', 0)} hedge cancel(s))"
         )
         for addr, e in sorted(fleet.get("replicas", {}).items()):
             assigned = e.get("assigned")
@@ -466,6 +471,14 @@ def render_serve(status: dict) -> str:
                 + f", {e.get('failures', 0)} failure(s), "
                 f"{e.get('recoveries', 0)} recover(ies)"
             )
+            # error-rate circuit breaker (ISSUE 19): only worth a column
+            # when it is not in the quiet closed state
+            breaker = e.get("breaker")
+            if breaker and breaker != "closed":
+                detail += (
+                    f", breaker {breaker.upper()}"
+                    f" ({e.get('breaker_trips', 0)} trip(s))"
+                )
             lines.append(f"  {addr:<24} {e.get('state', '?'):<9} {detail}")
             if e.get("last_error"):
                 lines.append(f"            last error: {str(e['last_error'])[:160]}")
@@ -475,6 +488,12 @@ def render_serve(status: dict) -> str:
                     f"  {bucket.upper()} replica(s): "
                     + ", ".join(fleet[bucket])
                 )
+        if fleet.get("breaker_open"):
+            lines.append(
+                "  BREAKER-OPEN replica(s): "
+                + ", ".join(fleet["breaker_open"])
+                + "  (error rate tripped; half-open probe will test)"
+            )
     return "\n".join(lines) + "\n"
 
 

@@ -28,6 +28,10 @@ The record (``--out``, default SERVE_BENCH.json) is stamped
 characterize the batching/admission layers and are REFUSED as hardware
 claims by tools/missing_stages.py exactly like every other proxy
 record. Guards exit 1 on miss (``--no_guard`` records without judging).
+``--deadline_ms`` stamps every loadgen request with an end-to-end
+budget (ISSUE 19); the record then carries the honest deadline-miss
+rate, the clients' wire-damage tallies, and the daemon's own
+``deadline_shed``/``cancels`` counters.
 
 Fleet mode (``--bench --fleet``, ISSUE 17) is the ROUTER's perf guard:
 it builds a synthetic FEDERATED index, then measures the same
@@ -138,7 +142,7 @@ def _spawn_daemon(index_loc: str, max_batch: int, extra: list[str] | None = None
 
 def _loadgen(
     address: str, genomes: list[str], clients: int, requests_per_client: int,
-    pipeline: int, warmup: bool = True,
+    pipeline: int, warmup: bool = True, deadline_ms: float | None = None,
 ) -> dict:
     """Closed-loop concurrent loadgen: `clients` threads, each sending
     `requests_per_client` classifies (pipelined `pipeline` at a time —
@@ -149,13 +153,21 @@ def _loadgen(
     measured window sees the daemon's steady state — the same
     compile-warmup exclusion every bench stage in this repo applies
     (the rect compare compiles one kernel per batch-size bucket; a
-    daemon pays that once per process, not per request)."""
+    daemon pays that once per process, not per request).
+
+    ``deadline_ms`` (ISSUE 19) stamps every request with that budget;
+    ``deadline_exceeded`` refusals are counted as MISSES (distinct from
+    errors — a shed is the deadline contract working) and the record
+    carries the honest miss rate plus the clients' wire-damage tallies
+    (corrupt frames, dup replies, wire retries)."""
     if warmup:
         _loadgen(address, genomes, clients, max(1, pipeline), pipeline,
                  warmup=False)
     lat_ms: list[float] = []
     batch_sizes: list[int] = []
     errors = [0]
+    misses = [0]
+    wire = {"corrupt": 0, "dup": 0, "wire_retries": 0}
     lock = threading.Lock()
     barrier = threading.Barrier(clients + 1)
 
@@ -168,15 +180,20 @@ def _loadgen(
                 # same-basename chunks cannot pipeline into one batch;
                 # the client dedups nothing — the daemon's batcher defers
                 t0 = time.perf_counter()
-                resps = c.classify_many(chunk)
+                resps = c.classify_many(chunk, deadline_ms=deadline_ms)
                 dt_ms = (time.perf_counter() - t0) * 1000.0 / len(chunk)
                 with lock:
                     for r in resps:
                         if r.get("ok"):
                             lat_ms.append(dt_ms)
                             batch_sizes.append(int(r.get("batch_size", 1)))
+                        elif r.get("reason") == "deadline_exceeded":
+                            misses[0] += 1
                         else:
                             errors[0] += 1
+            with lock:
+                for k in wire:
+                    wire[k] += c.wire_stats[k]
 
     threads = [
         threading.Thread(target=worker, args=(ci,), daemon=True)
@@ -197,6 +214,7 @@ def _loadgen(
             return 0.0
         return lat_ms[min(done - 1, max(0, round(q * (done - 1))))]
 
+    total = done + misses[0] + errors[0]
     return {
         "clients": clients,
         "requests": done,
@@ -206,6 +224,10 @@ def _loadgen(
         "latency_ms": {"p50": round(pct(0.5), 2), "p99": round(pct(0.99), 2)},
         "mean_batch_size": round(sum(batch_sizes) / max(1, len(batch_sizes)), 2),
         "max_batch_size": max(batch_sizes, default=0),
+        "deadline_ms": deadline_ms,
+        "deadline_misses": misses[0],
+        "deadline_miss_rate": round(misses[0] / max(1, total), 4),
+        "wire": wire,
     }
 
 
@@ -271,8 +293,12 @@ def run_fleet_bench(args) -> int:
         single = _loadgen(
             addr, genomes, clients=args.clients,
             requests_per_client=args.requests_per_client,
-            pipeline=args.pipeline,
+            pipeline=args.pipeline, deadline_ms=args.deadline_ms or None,
         )
+        with ServeClient(addr, timeout_s=60) as c:
+            st = c.status()
+            single["deadline_shed"] = st.get("deadline_shed", 0)
+            single["cancels"] = st.get("cancels", 0)
         record["configs"]["single"] = single
         print(f"fleet bench: single daemon: {single['qps']} qps "
               f"(p50 {single['latency_ms']['p50']}ms)", file=sys.stderr)
@@ -288,13 +314,19 @@ def run_fleet_bench(args) -> int:
         fleet = _loadgen(
             raddr, genomes, clients=args.clients,
             requests_per_client=args.requests_per_client,
-            pipeline=args.pipeline,
+            pipeline=args.pipeline, deadline_ms=args.deadline_ms or None,
         )
         with ServeClient(raddr, timeout_s=60) as c:
             st = c.status()
             fleet["router"] = st.get("router")
+            fleet["deadline_shed"] = st.get("deadline_shed", 0)
+            fleet["cancels"] = st.get("cancels", 0)
             fleet["replica_states"] = {
                 a: e.get("state")
+                for a, e in (st.get("replicas") or {}).get("replicas", {}).items()
+            }
+            fleet["replica_breakers"] = {
+                a: e.get("breaker")
                 for a, e in (st.get("replicas") or {}).get("replicas", {}).items()
             }
         record["configs"]["fleet"] = fleet
@@ -394,7 +426,12 @@ def run_bench(args) -> int:
             cfg = _loadgen(
                 addr, genomes, clients=args.clients, requests_per_client=rpc,
                 pipeline=max(1, min(max_batch, args.pipeline)),
+                deadline_ms=args.deadline_ms or None,
             )
+            with ServeClient(addr, timeout_s=60) as c:
+                st = c.status()
+                cfg["deadline_shed"] = st.get("deadline_shed", 0)
+                cfg["cancels"] = st.get("cancels", 0)
             cfg["first_query_ms"] = round(first_ms, 1)
             cfg["warm_query_ms"] = round(warm_ms, 1)
             cfg["startup_amortization_x"] = round(first_ms / max(warm_ms, 1e-3), 1)
@@ -487,6 +524,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="guard: batched(16) / unbatched qps floor")
     ap.add_argument("--amortization", type=float, default=3.0,
                     help="guard: first-query / warm-query latency floor")
+    ap.add_argument("--deadline_ms", type=float, default=0.0,
+                    help="stamp every loadgen request with this end-to-end "
+                         "deadline budget (ISSUE 19); deadline_exceeded "
+                         "refusals are recorded as an honest miss rate "
+                         "alongside the daemon's shed/cancel counters "
+                         "(0 = unbudgeted, the default)")
     ap.add_argument("--no_guard", action="store_true",
                     help="record without judging (exploration runs)")
     ap.add_argument("--out", default="SERVE_BENCH.json")
