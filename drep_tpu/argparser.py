@@ -565,6 +565,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="first reprobe delay after an ejection (doubles to "
                         "DREP_TPU_SERVE_PROBE_MAX_S). Default "
                         "DREP_TPU_ROUTER_PROBE_BACKOFF_S")
+    r.add_argument("--fleet_manifest", default=None, metavar="PATH",
+                   help="the fleet supervisor's durable fleet.json (or its "
+                        "directory): the router REBUILDS its replica table "
+                        "from it at startup — membership survives a router "
+                        "restart with zero `fleet join` replays — and "
+                        "reports the supervision tree in /healthz. "
+                        "Read-only; only `index supervise` writes it")
     r.add_argument("--resident_mb", type=int, default=None,
                    help="byte budget (MiB) for the router's OWN lazily "
                         "loaded component sketches (the merge's secondary "
@@ -588,6 +595,78 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--prune_join_chunk", type=int, default=0,
                    help="bucket-join memory bound (same semantics as the "
                         "pipeline flag)")
+
+    v = isub.add_parser(
+        "supervise",
+        help="fleet supervisor: owns replica process lifecycle against a "
+             "durable fleet.json manifest — spawn with a startup probe "
+             "deadline, heartbeat liveness over /healthz, restart on "
+             "death with decorrelated backoff, crash-loop QUARANTINE "
+             "after K deaths in a window, graceful drain with SIGKILL "
+             "escalation. Crash-recovers by ADOPTING still-live orphans "
+             "from the manifest (never double-spawns); a restarted "
+             "router rebuilds membership from the same file",
+    )
+    v.add_argument("index_directory",
+                   help="the FEDERATED root the supervised fleet serves "
+                        "(the manifest lives under <root>/fleet unless "
+                        "--fleet_dir says otherwise)")
+    v.add_argument("--fleet_dir", default=None, metavar="DIR",
+                   help="home for fleet.json + its generation snapshots. "
+                        "Default <index_directory>/fleet — the one "
+                        "control-plane subtree tools/scrub_store.py "
+                        "classifies (stale generations and dead-pid "
+                        "slots are stale_membership, never damage)")
+    v.add_argument("--spawn", default=None, metavar="CMD",
+                   help="full `index serve` command line for ONE replica "
+                        "('{partitions}' substituted with a slot's comma "
+                        "list, removed for unscoped slots). Required to "
+                        "actually spawn; without it the supervisor only "
+                        "adopts/retires what the manifest records")
+    v.add_argument("--replica", action="append", default=[],
+                   metavar="N[=PIDS]",
+                   help="initial placement: spawn N unscoped replicas, or "
+                        "'N=0-2,5' to scope each to a partition set. "
+                        "Repeatable; applied once at startup for slots "
+                        "the manifest doesn't already record")
+    v.add_argument("--router", default=None, metavar="ADDR",
+                   help="a running `index route` front door to announce "
+                        "fleet join/leave to (advisory: a dead router "
+                        "rebuilds from the manifest when it returns)")
+    v.add_argument("--heartbeat_s", type=float, default=None,
+                   help="liveness tick cadence (pid poll + /healthz). "
+                        "Default DREP_TPU_SUP_HEARTBEAT_S")
+    v.add_argument("--backoff_max_s", type=float, default=None,
+                   help="decorrelated restart backoff cap. Default "
+                        "DREP_TPU_SUP_BACKOFF_MAX_S")
+    v.add_argument("--crashloop_k", type=int, default=None,
+                   help="deaths inside the window that QUARANTINE a slot "
+                        "(0 disables). Default DREP_TPU_SUP_CRASHLOOP_K")
+    v.add_argument("--crashloop_window_s", type=float, default=None,
+                   help="crash-loop detection window. Default "
+                        "DREP_TPU_SUP_CRASHLOOP_WINDOW_S")
+    v.add_argument("--drain_deadline_s", type=float, default=None,
+                   help="seconds after SIGTERM before a draining replica "
+                        "is SIGKILLed (escalations counted). Default "
+                        "DREP_TPU_SUP_DRAIN_DEADLINE_S")
+    v.add_argument("--startup_deadline_s", type=float, default=None,
+                   help="seconds a fresh spawn gets to print its ready "
+                        "line before it books a death. Default "
+                        "DREP_TPU_SUP_STARTUP_DEADLINE_S")
+    v.add_argument("--ticks", type=int, default=0,
+                   help="exit after this many supervision ticks (0 = run "
+                        "until interrupted; the test harness uses this)")
+    v.add_argument("-d", "--debug", action="store_true")
+    v.add_argument("--io_retries", type=int, default=None,
+                   help="transient shared-filesystem I/O retry budget "
+                        "(utils/durableio.py; same knob as the pipeline)")
+    v.add_argument("--log_dir", default=None,
+                   help="home for the supervisor's logs and event traces "
+                        "— NEVER the index directory")
+    v.add_argument("--events", default=None, choices=["off", "on"],
+                   help="structured event tracing (supervisor_spawn/"
+                        "death/quarantine/escalation instants) into "
+                        "--log_dir")
 
     cmp_p = sub.add_parser("compare", help="cluster genomes without dereplicating")
     add_common(cmp_p, with_filter=False, with_scoring=False)

@@ -24,24 +24,26 @@ writing a second policy:
   over verbatim, and the per-range decision history gates the same
   cooldown.
 
-Actuation mirrors the batch controller's contract one layer up: a
-scale-up spawns a replica process (the operator's ``--spawn`` command,
-stamped ``DREP_TPU_AUTOSCALE_SPAWNED=1``), reads its ready line for the
-bound address, and announces it to the router via the ``fleet`` join
-op; a scale-down SIGTERMs the most recently spawned still-live replica
-of that range (the daemon's graceful drain) after a ``fleet`` leave so
-the router stops routing to it first. The controller only ever retires
-capacity it added, and its death is harmless — the router keeps serving
-whatever fleet exists.
+Actuation flows through the fleet supervisor's placement API
+(drep_tpu/serve/supervisor.py, ISSUE 20) — there is no private
+``Popen`` ledger here anymore. A scale-up is
+:meth:`FleetSupervisor.place` (manifest transaction first, then the
+spawn + ready-line probe + ``fleet`` join); a scale-down is
+:meth:`FleetSupervisor.drain`, which picks the most recently PLACED
+still-live slot of the range FROM THE MANIFEST — correct across any
+number of controller restarts, where the old in-memory ledger forgot
+everything it had spawned (the scale-down attribution gap this closes).
+The controller embeds the supervisor (one ``--fleet_dir`` manifest
+home) and drives its heartbeat tick alongside the policy tick, so
+supervised restarts/backoff/quarantine/drain-escalation all run even
+when the operator launches only ``tools/pod_autoscale.py --router``.
+Controller death stays harmless: replicas outlive it, the manifest
+makes its successor whole, and the router keeps serving whatever fleet
+exists.
 """
 
 from __future__ import annotations
 
-import json
-import os
-import shlex
-import signal
-import subprocess
 import time
 from dataclasses import replace
 
@@ -136,8 +138,15 @@ class FleetAutoscaleController:
     (tests pass fakes). `spawn_cmd` is the full ``index serve`` command
     line for ONE replica (``{partitions}`` in it is substituted with the
     range's comma list, or removed for the ``all`` range); None =
-    recommend-only. The decision log is the same crash-safe JSONL idiom
-    as the batch controller, one record per range per tick."""
+    recommend-only. Actuation goes through a
+    :class:`drep_tpu.serve.supervisor.FleetSupervisor` anchored at
+    `fleet_dir` (the durable ``fleet.json`` home) — pass an existing
+    `supervisor` instead to share one (tests pass fakes with
+    ``.place``/``.drain``/``.tick``). Spawning therefore REQUIRES a
+    manifest home: `spawn_cmd` without `fleet_dir`/`supervisor` is a
+    loud ValueError, not a silent in-memory ledger. The decision log is
+    the same crash-safe JSONL idiom as the batch controller, one record
+    per range per tick."""
 
     def __init__(
         self,
@@ -149,6 +158,8 @@ class FleetAutoscaleController:
         interval_s: float = 2.0,
         decision_log: str | None = None,
         spawn_env: dict | None = None,
+        fleet_dir: str | None = None,
+        supervisor=None,
     ) -> None:
         self.client = router_client
         self.targets = targets
@@ -157,87 +168,63 @@ class FleetAutoscaleController:
         self.spawn_cmd = spawn_cmd
         self.interval_s = float(interval_s)
         self.decision_log = decision_log
-        self._spawn_env = spawn_env
         self.history: dict[str, list[dict]] = {}
-        # per-range spawn ledger: (Popen, address) pairs, most recent
-        # last — scale-down retires from the tail, batch-controller style
-        self.spawned: dict[str, list[tuple[subprocess.Popen, str]]] = {}
         self.decisions = 0
         self._log = get_logger()
+        if supervisor is not None:
+            self.supervisor = supervisor
+        elif fleet_dir:
+            from drep_tpu.serve.supervisor import FleetSupervisor
 
-    # -- actuation --------------------------------------------------------
+            self.supervisor = FleetSupervisor(
+                fleet_dir,
+                spawn_cmd=spawn_cmd,
+                router_address=getattr(router_client, "address", None),
+                spawn_env=spawn_env,
+            )
+            # adoption before any placement: a restarted controller
+            # re-attaches the slots its predecessor placed — the
+            # manifest, not process memory, owns attribution
+            self.supervisor.recover()
+        elif spawn_cmd:
+            raise ValueError(
+                "FleetAutoscaleController: spawn_cmd needs a fleet_dir "
+                "(or an explicit supervisor) — actuation is a manifest "
+                "transaction, never an in-memory Popen ledger"
+            )
+        else:
+            self.supervisor = None  # recommend-only
+
+    # -- actuation (all of it through the supervisor placement API) -------
     def _spawn_replica(self, key: str, count: int) -> str:
-        if not self.spawn_cmd:
+        if self.supervisor is None or not (
+            self.spawn_cmd or getattr(self.supervisor, "spawn_cmd", None)
+        ):
             return "skipped: no --spawn command (recommend-only mode)"
         count = min(count, self.targets.max_spawn)
         if count <= 0:
             return "skipped: max_spawn is 0"
-        cmd = self.spawn_cmd
-        if "{partitions}" in cmd:
-            cmd = cmd.replace("{partitions}", "" if key == "all" else key)
-        env = dict(self._spawn_env if self._spawn_env is not None else os.environ)
-        env["DREP_TPU_AUTOSCALE_SPAWNED"] = "1"
-        argv = [a for a in shlex.split(cmd) if a]
-        joined = []
-        for _ in range(count):
-            proc = subprocess.Popen(
-                argv, env=env, stdout=subprocess.PIPE, text=True
+        parts = None if key == "all" else [int(p) for p in key.split(",")]
+        placed = self.supervisor.place(partitions=parts, count=count)
+        ok = [s.get("address") for s in placed if s.get("state") == "healthy"]
+        pending = [s["slot_id"] for s in placed if s.get("state") != "healthy"]
+        if pending and not ok:
+            return (
+                f"FAILED: slot(s) {pending} died at startup "
+                f"(supervisor retries with backoff)"
             )
-            addr = self._await_ready(proc)
-            if addr is None:
-                return f"FAILED: spawned pid {proc.pid} produced no ready line"
-            self.spawned.setdefault(key, []).append((proc, addr))
-            pids = None if key == "all" else [int(p) for p in key.split(",")]
-            try:
-                self.client.request(
-                    {"op": "fleet", "action": "join", "address": addr,
-                     "partitions": pids}
-                )
-            except Exception as e:  # noqa: BLE001 — replica is up; join is advisory
-                return f"spawned {addr} but fleet join failed: {e!r}"
-            joined.append(addr)
-        return f"spawned+joined {joined} for range {key}"
-
-    def _await_ready(self, proc: subprocess.Popen, timeout_s: float = 120.0) -> str | None:
-        """Parse the daemon's ready line (one JSON object with
-        ``serving``) from its stdout — the same contract the chaos
-        harness and bench drivers rely on."""
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline() if proc.stdout else ""
-            if not line:
-                if proc.poll() is not None:
-                    return None
-                continue
-            try:
-                msg = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(msg, dict) and msg.get("serving"):
-                return str(msg["serving"])
-        return None
+        tail = f" ({len(pending)} pending respawn)" if pending else ""
+        return f"placed {ok} for range {key}{tail}"
 
     def _drain_replica(self, key: str, count: int) -> str:
-        alive = [(p, a) for p, a in self.spawned.get(key, ()) if p.poll() is None]
-        if not alive:
-            return "skipped: no controller-spawned capacity left to drain"
-        victims = alive[-count:] if count else alive[-1:]
-        out = []
-        for proc, addr in victims:
-            # leave FIRST so the router stops routing new legs at it,
-            # then SIGTERM for the daemon's graceful drain of in-flight
-            try:
-                self.client.request(
-                    {"op": "fleet", "action": "leave", "address": addr}
-                )
-            except Exception:  # noqa: BLE001 — drain proceeds regardless
-                pass
-            try:
-                proc.send_signal(signal.SIGTERM)
-            except OSError:
-                pass
-            out.append(addr)
-        return f"left+SIGTERMed {out} for range {key}"
+        if self.supervisor is None:
+            return "skipped: no supervised capacity (recommend-only mode)"
+        parts = None if key == "all" else [int(p) for p in key.split(",")]
+        victims = self.supervisor.drain(partitions=parts, count=count)
+        if not victims:
+            return "skipped: no supervised capacity left to drain"
+        out = [s.get("address") or s["slot_id"] for s in victims]
+        return f"draining {out} for range {key}"
 
     def _actuate(self, key: str, decision: Decision) -> str:
         try:
@@ -253,8 +240,15 @@ class FleetAutoscaleController:
 
     # -- the loop ---------------------------------------------------------
     def poll_once(self) -> dict[str, Decision]:
-        """One tick: router status -> per-range decide -> actuate ->
-        record. Read-only against the router (one status op)."""
+        """One tick: supervision heartbeat -> router status -> per-range
+        decide -> actuate -> record. Read-only against the router (one
+        status op); all process actuation rides the supervisor."""
+        if self.supervisor is not None:
+            try:
+                self.supervisor.tick()
+            except Exception as e:  # noqa: BLE001 — a broken heartbeat is a
+                # report; the policy tick must still run and record
+                self._log.warning("fleet autoscale: supervisor tick failed: %r", e)
         # drep-lint: allow[clock-mono] — the rolling deadline is an absolute wall-clock instant in the snapshot's own clock family, exactly like the batch controller's --deadline resolution
         observed_at = time.time()
         try:
@@ -318,13 +312,16 @@ class FleetAutoscaleController:
         except KeyboardInterrupt:
             pass
         finally:
-            # spawned replicas are fleet members now: leave them running
-            for key, pairs in self.spawned.items():
-                for proc, addr in pairs:
-                    if proc.poll() is None:
+            # placed replicas are fleet members now: leave them running —
+            # the manifest records them, and the next supervisor (or a
+            # restarted controller) adopts them instead of respawning
+            if self.supervisor is not None:
+                for slot in self.supervisor.slots().values():
+                    if slot.get("state") == "healthy":
                         self._log.info(
-                            "fleet autoscale: leaving spawned replica %s "
-                            "(pid %d, range %s) running — the fleet owns "
-                            "its lifecycle", addr, proc.pid, key,
+                            "fleet autoscale: leaving replica %s (pid %s, "
+                            "slot %s) running — fleet.json owns its "
+                            "lifecycle", slot.get("address"),
+                            slot.get("pid"), slot.get("slot_id"),
                         )
         return 0

@@ -64,6 +64,7 @@ class Controller:
             index_maintenance_wrapper,
             index_route_wrapper,
             index_serve_wrapper,
+            index_supervise_wrapper,
             index_update_wrapper,
         )
 
@@ -81,6 +82,10 @@ class Controller:
         if sub == "route":
             # the fleet front door: same drain contract as serve
             return index_route_wrapper(index_loc, genomes, **kwargs)
+        if sub == "supervise":
+            # the fleet supervisor: replica lifecycle against the
+            # durable fleet.json manifest (serve/supervisor.py)
+            return index_supervise_wrapper(index_loc, **kwargs)
         if sub in ("split", "merge", "compact"):
             # the transactional index lifecycle (index/maintenance.py):
             # crash-safe at every phase, resumable by any later pass

@@ -24,4 +24,9 @@ from drep_tpu.serve.router import (  # noqa: F401
     RouterConfig,
     RouterServer,
 )
+from drep_tpu.serve.supervisor import (  # noqa: F401
+    FleetSupervisor,
+    load_manifest,
+    manifest_path,
+)
 from drep_tpu.serve.wirechaos import WireChaos  # noqa: F401

@@ -82,7 +82,7 @@ from drep_tpu.errors import UserInputError
 from drep_tpu.serve import protocol
 from drep_tpu.serve.client import ServeClient
 from drep_tpu.serve.daemon import _RETRY_AFTER_FLOOR_S, IndexServer, ServeConfig
-from drep_tpu.utils import faults, telemetry
+from drep_tpu.utils import durableio, faults, telemetry
 from drep_tpu.utils.logger import get_logger
 from drep_tpu.utils.profiling import counters
 
@@ -186,6 +186,11 @@ class RouterConfig(ServeConfig):
     probe_backoff_s: float | None = None
     probe_max_s: float | None = None
     max_inflight: int | None = None  # wins over max_queue when set
+    # durable membership (ISSUE 20): path to the supervisor's fleet.json.
+    # A restarted router rebuilds its replica table from it instead of
+    # forgetting every `fleet join`; the router only ever READS it (the
+    # supervisor is the sole writer — reader purity holds).
+    fleet_manifest: str | None = None
 
 
 @dataclass
@@ -559,6 +564,10 @@ class RouterServer(IndexServer):
                 "DREP_TPU_ROUTER_BREAKER_HALFOPEN_S"
             ),
         )
+        # durable membership rebuild (ISSUE 20): merge the supervisor's
+        # manifest into the table BEFORE the first leg — a restarted
+        # router recovers its whole fleet with zero join replays
+        self._rebuilt_members = self._rebuild_membership()
         self.router_stats = {
             "forwarded": 0,  # queries answered via the forward fast path
             "scattered": 0,  # queries answered via scatter/gather merge
@@ -702,11 +711,86 @@ class RouterServer(IndexServer):
             failed=report.get("failed"),
         )
 
+    # ---- durable membership (ISSUE 20) -----------------------------------
+    def _rebuild_membership(self) -> list[str]:
+        """Join every routable slot recorded in the supervisor's
+        fleet.json into the replica table. Read-only and best-effort: a
+        missing manifest is an empty fleet, a rotted one is a loud
+        warning (the router still starts with its --replica list — the
+        supervisor's next publish heals the file)."""
+        cfg: RouterConfig = self.cfg  # type: ignore[assignment]
+        if not cfg.fleet_manifest:
+            return []
+        from drep_tpu.serve import supervisor as sup
+
+        path = cfg.fleet_manifest
+        if os.path.isdir(path):
+            path = sup.manifest_path(path)
+        try:
+            doc = sup.load_manifest(os.path.dirname(path)) \
+                if os.path.basename(path) == sup.MANIFEST_NAME \
+                else durableio.read_json_checked(path, what="fleet manifest")
+        except Exception as e:  # noqa: BLE001 — degraded start beats no start
+            get_logger().warning(
+                "route: fleet manifest %s unreadable (%r) — starting "
+                "with explicit replicas only", cfg.fleet_manifest, e,
+            )
+            return []
+        joined = []
+        for slot in (doc.get("slots") or {}).values():
+            addr = slot.get("address")
+            # starting/backoff slots have no routable address yet (or a
+            # stale one); the supervisor re-joins them when they come up
+            if not addr or slot.get("state") not in ("healthy",):
+                continue
+            parts = slot.get("partitions")
+            assigned = (
+                frozenset(int(p) for p in parts) if parts is not None
+                else None
+            )
+            self.table.join(addr, assigned)
+            joined.append(addr)
+        if joined:
+            get_logger().info(
+                "route: rebuilt %d replica(s) from fleet manifest %s",
+                len(joined), cfg.fleet_manifest,
+            )
+        return joined
+
+    def _supervision_view(self) -> dict | None:
+        """The manifest's slot table, for /healthz consumers
+        (tools/pod_status.py renders the supervision tree from it).
+        None when no manifest is configured; an error marker when it is
+        configured but unreadable."""
+        cfg: RouterConfig = self.cfg  # type: ignore[assignment]
+        if not cfg.fleet_manifest:
+            return None
+        from drep_tpu.serve import supervisor as sup
+
+        path = cfg.fleet_manifest
+        if os.path.isdir(path):
+            path = sup.manifest_path(path)
+        try:
+            doc = durableio.read_json_checked(path, what="fleet manifest")
+        except FileNotFoundError:
+            return {"slots": {}, "generation": 0, "supervisor_pid": None}
+        except Exception as e:  # noqa: BLE001 — status must answer regardless
+            return {"error": f"fleet manifest unreadable: {e!r}"}
+        return {
+            "slots": doc.get("slots") or {},
+            "generation": doc.get("generation"),
+            "supervisor_pid": doc.get("supervisor_pid"),
+            "supervisor_alive": sup.pid_alive(doc.get("supervisor_pid")),
+        }
+
     # ---- status ----------------------------------------------------------
     def snapshot(self) -> dict:
         out = super().snapshot()
         out["role"] = "router"
         out["replicas"] = self.table.health_map()
+        sup_view = self._supervision_view()
+        if sup_view is not None:
+            out["supervision"] = sup_view
         with self._lock:
             out["router"] = dict(self.router_stats)
         return out

@@ -1,0 +1,730 @@
+"""Fleet supervisor (ISSUE 20): durable membership + replica lifecycle.
+
+The serve tier's control plane before this module was the weakest
+process in the system: the fleet autoscaler spawned replicas into a raw
+in-memory ``Popen`` ledger (a crashed replica was never restarted, a
+crash-looping one was respawned forever, a dead controller leaked
+orphans), and router membership acquired via ``fleet join`` evaporated
+with the router process. This module is the supervision tree that fixes
+all four, built from the same durability primitives as the data plane:
+
+- **Durable membership.** ``fleet.json`` — one checked-JSON manifest
+  written through the :mod:`drep_tpu.utils.durableio` funnel (atomic
+  publish, torn-write-safe, in-band CRC) — records every slot's
+  address, partition scope, pid, generation, and supervision state.
+  Each publish also retains a ``fleet.gNNNNNN.json`` generation
+  snapshot (GC'd to the newest few; crash leftovers classify as
+  ``stale_membership`` in tools/scrub_store.py, never as damage).
+- **Restart with decorrelated backoff.** A death books a wall-clock
+  instant + reason into the slot and schedules a respawn at
+  ``uniform(base, prev*3)`` clamped to DREP_TPU_SUP_BACKOFF_MAX_S — the
+  decorrelated-jitter discipline that keeps N restarting replicas from
+  thundering in phase.
+- **Crash-loop quarantine.** ≥ DREP_TPU_SUP_CRASHLOOP_K deaths inside
+  DREP_TPU_SUP_CRASHLOOP_WINDOW_S moves the slot to QUARANTINED with a
+  durable reason: no further respawns burn, and routed traffic over the
+  missing coverage degrades to the router's honest stamped PARTIAL
+  verdicts (strict clients are refused). ``unquarantine`` is the
+  explicit operator verb back.
+- **Orphan adoption.** A restarted supervisor never double-spawns: it
+  loads the manifest, re-probes every recorded pid (liveness via
+  ``kill(pid, 0)``, health via the existing ``/healthz`` wire), ADOPTS
+  the still-live ones, and reaps stale pids into the normal death path.
+  A restarted router rebuilds its replica table from the same manifest
+  (RouterConfig.fleet_manifest) — zero ``fleet join`` replays.
+- **Graceful-drain escalation.** Retirement is ``fleet leave`` →
+  SIGTERM → DREP_TPU_SUP_DRAIN_DEADLINE_S → SIGKILL, with escalations
+  counted separately in the slot.
+
+The autoscaler (autoscale/fleet.py) actuates exclusively through the
+placement API here (:meth:`FleetSupervisor.place` /
+:meth:`FleetSupervisor.drain`): spawn/drain by range are manifest
+transactions, so a scale-down picks its victim from durable state —
+correct across any number of controller restarts — closing the
+ROADMAP's fleet follow-on (d).
+
+State machine (one slot)::
+
+    place() ──> STARTING ──ready line──> HEALTHY
+                   │                        │ pid death / probe loss
+                   │ startup deadline       v
+                   └──────death──────> BACKOFF ──retry elapsed──> STARTING
+                                          │ K deaths in window
+                                          v
+                                     QUARANTINED ──unquarantine()──> BACKOFF
+    drain() from HEALTHY/STARTING/BACKOFF ──> DRAINING ──exit──> (slot removed)
+                                                  │ drain deadline
+                                                  └──SIGKILL (escalation)──┘
+
+Kept importable without JAX (stdlib + durableio/envknobs/telemetry) so
+the supervisor, like the router and client, can run on a thin
+control-plane host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shlex
+import signal
+import subprocess
+import time
+from typing import Any, Callable
+
+from drep_tpu.utils import durableio, faults, telemetry
+from drep_tpu.utils.envknobs import env_float, env_int
+from drep_tpu.utils.logger import get_logger
+
+__all__ = [
+    "MANIFEST_NAME",
+    "FleetSupervisor",
+    "is_crash_loop",
+    "load_manifest",
+    "manifest_path",
+    "next_backoff",
+    "pid_alive",
+]
+
+MANIFEST_NAME = "fleet.json"
+# slot states the manifest may carry — anything else classifies as rot
+STATES = ("starting", "healthy", "backoff", "quarantined", "draining")
+# manifest generation snapshots retained after each publish (older ones
+# are GC'd; a crash between publish and GC leaves extras that
+# tools/scrub_store.py classifies as stale_membership, not damage)
+KEEP_GENERATIONS = 2
+# consecutive failed /healthz probes against a LIVE pid before the
+# supervisor declares the replica wedged and escalates to a death
+# (a single miss is routine under load — the router's own
+# suspect/ejected machine handles routing around it meanwhile)
+PROBE_STRIKES = 3
+
+
+# -- pure lifecycle arithmetic (tier-1 unit surface) -------------------------
+
+def next_backoff(prev_s: float, base_s: float, max_s: float,
+                 rng: random.Random) -> float:
+    """Decorrelated-jitter exponential backoff: resample
+    ``uniform(base, max(base, prev*3))`` clamped to ``max_s``. Unlike
+    plain doubling, consecutive draws decorrelate — N replicas killed by
+    one event spread their respawns instead of thundering in phase."""
+    lo = float(base_s)
+    hi = max(lo, float(prev_s) * 3.0)
+    return min(float(max_s), rng.uniform(lo, hi))
+
+
+def is_crash_loop(deaths, now: float, k: int, window_s: float) -> bool:
+    """True when at least ``k`` of the recorded death instants fall
+    inside the trailing ``window_s`` seconds. ``k <= 0`` disables the
+    detector (never quarantine)."""
+    if int(k) <= 0:
+        return False
+    recent = [d for d in deaths if (now - float(d)) <= float(window_s)]
+    return len(recent) >= int(k)
+
+
+def pid_alive(pid) -> bool:
+    """Liveness of an arbitrary (possibly non-child) pid via
+    ``kill(pid, 0)`` — the only probe that works for ADOPTED replicas
+    the supervisor never forked. EPERM counts as alive (the process
+    exists, we just can't signal it)."""
+    try:
+        pid = int(pid)
+    except (TypeError, ValueError):
+        return False
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
+# -- the durable manifest ----------------------------------------------------
+
+def manifest_path(fleet_dir: str) -> str:
+    return os.path.join(fleet_dir, MANIFEST_NAME)
+
+
+def generation_name(gen: int) -> str:
+    return f"fleet.g{int(gen):06d}.json"
+
+
+def _empty_manifest() -> dict[str, Any]:
+    return {"version": 1, "generation": 0, "supervisor_pid": None, "slots": {}}
+
+
+def load_manifest(fleet_dir: str) -> dict[str, Any]:
+    """Read + CRC-verify the membership manifest; a missing file is an
+    empty fleet (first boot), a rotted one raises CorruptPayloadError —
+    the supervisor must never adopt from garbage."""
+    path = manifest_path(fleet_dir)
+    if not os.path.exists(path):
+        return _empty_manifest()
+    doc = durableio.read_json_checked(path, what="fleet manifest")
+    if not isinstance(doc, dict) or not isinstance(doc.get("slots"), dict):
+        raise durableio.CorruptPayloadError(
+            f"fleet manifest {path}: not a slots document"
+        )
+    return doc
+
+
+def _new_slot(slot_id: str, partitions, spawn_cmd: str | None,
+              now: float) -> dict[str, Any]:
+    return {
+        "slot_id": slot_id,
+        "partitions": (
+            None if partitions is None else [int(p) for p in partitions]
+        ),
+        "address": None,
+        "pid": None,
+        "spawn_cmd": spawn_cmd,
+        "state": "starting",
+        "restarts": 0,
+        "escalations": 0,
+        "deaths": [],
+        "last_death_reason": None,
+        "next_retry_at": None,
+        "backoff_s": 0.0,
+        "quarantine_reason": None,
+        "placed_at": now,
+        "drain_started_at": None,
+    }
+
+
+def slot_range_key(slot: dict) -> str:
+    """The same canonical range id autoscale/fleet.py keys decisions on
+    (``"all"`` for unscoped, else sorted comma list)."""
+    parts = slot.get("partitions")
+    if parts is None:
+        return "all"
+    return ",".join(str(int(p)) for p in sorted(parts)) or "all"
+
+
+class FleetSupervisor:
+    """Own replica process lifecycle against one durable manifest.
+
+    `fleet_dir` is the manifest's home (created on demand). `spawn_cmd`
+    is the default ``index serve`` command line for one replica
+    (``{partitions}`` substituted with the slot's comma list, or
+    removed for unscoped slots). `router_address` — when given — gets a
+    ``fleet`` join/leave for every replica the supervisor brings
+    up/retires, via a short-lived :class:`drep_tpu.serve.ServeClient`.
+
+    Test seams: `spawn_fn(argv, env) -> Popen-like` replaces the real
+    fork (fakes need ``.pid``/``.poll()``/``.stdout``/``.send_signal``),
+    `probe_fn(address) -> bool` replaces the /healthz round-trip, and
+    `rng` pins the backoff jitter. All lifecycle instants in the
+    manifest are WALL-CLOCK (they must mean the same thing to the next
+    supervisor process); in-process waits stay monotonic."""
+
+    def __init__(
+        self,
+        fleet_dir: str,
+        *,
+        spawn_cmd: str | None = None,
+        router_address: str | None = None,
+        heartbeat_s: float | None = None,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float | None = None,
+        crashloop_k: int | None = None,
+        crashloop_window_s: float | None = None,
+        drain_deadline_s: float | None = None,
+        startup_deadline_s: float | None = None,
+        spawn_env: dict | None = None,
+        spawn_fn: Callable[..., Any] | None = None,
+        probe_fn: Callable[[str], bool] | None = None,
+        rng: random.Random | None = None,
+    ) -> None:
+        self.fleet_dir = str(fleet_dir)
+        self.spawn_cmd = spawn_cmd
+        self.router_address = router_address
+        self.heartbeat_s = (
+            env_float("DREP_TPU_SUP_HEARTBEAT_S")
+            if heartbeat_s is None else float(heartbeat_s)
+        )
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = (
+            env_float("DREP_TPU_SUP_BACKOFF_MAX_S")
+            if backoff_max_s is None else float(backoff_max_s)
+        )
+        self.crashloop_k = (
+            env_int("DREP_TPU_SUP_CRASHLOOP_K")
+            if crashloop_k is None else int(crashloop_k)
+        )
+        self.crashloop_window_s = (
+            env_float("DREP_TPU_SUP_CRASHLOOP_WINDOW_S")
+            if crashloop_window_s is None else float(crashloop_window_s)
+        )
+        self.drain_deadline_s = (
+            env_float("DREP_TPU_SUP_DRAIN_DEADLINE_S")
+            if drain_deadline_s is None else float(drain_deadline_s)
+        )
+        self.startup_deadline_s = (
+            env_float("DREP_TPU_SUP_STARTUP_DEADLINE_S")
+            if startup_deadline_s is None else float(startup_deadline_s)
+        )
+        self._spawn_env = spawn_env
+        self._spawn_fn = spawn_fn
+        self._probe_fn = probe_fn
+        self._rng = rng if rng is not None else random.Random()
+        self._log = get_logger()
+        # child process handles, slot_id -> Popen-like. ADOPTED slots
+        # have no entry here (their pid is not our child) — liveness for
+        # them is pid_alive(); reaping a Popen child additionally
+        # harvests the exit code for the death reason.
+        self.procs: dict[str, Any] = {}
+        # in-memory consecutive-probe-miss strikes (not durable: a new
+        # supervisor re-probing from zero is the right fresh start)
+        self._strikes: dict[str, int] = {}
+        # drep-lint: allow[reader-purity] — pod_autoscale only constructs a supervisor when --fleet_dir is given (actuation mode); recommend-only runs never reach here
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        self.doc = load_manifest(self.fleet_dir)
+
+    # -- manifest transactions -------------------------------------------
+    def _publish(self) -> None:
+        """Atomically publish the manifest + its generation snapshot,
+        then GC old snapshots. Every state transition funnels through
+        here — the manifest IS the supervisor's memory."""
+        self.doc["generation"] = int(self.doc.get("generation", 0)) + 1
+        self.doc["supervisor_pid"] = os.getpid()
+        # drep-lint: allow[clock-mono] — manifest instants are cross-process facts; a successor supervisor must read them on its own wall clock
+        self.doc["updated_at"] = time.time()
+        gen_path = os.path.join(
+            self.fleet_dir, generation_name(self.doc["generation"])
+        )
+        durableio.atomic_write_json(gen_path, self.doc)
+        durableio.atomic_write_json(manifest_path(self.fleet_dir), self.doc)
+        self._gc_generations()
+
+    def _gc_generations(self) -> None:
+        kept: list[tuple[int, str]] = []
+        try:
+            names = os.listdir(self.fleet_dir)
+        except OSError:
+            return
+        for name in names:
+            if name.startswith("fleet.g") and name.endswith(".json"):
+                try:
+                    kept.append((int(name[len("fleet.g"):-len(".json")]), name))
+                except ValueError:
+                    continue
+        kept.sort()
+        for _, name in kept[:-KEEP_GENERATIONS]:
+            try:
+                os.unlink(os.path.join(self.fleet_dir, name))
+            except OSError:
+                pass  # a leftover is scrub-classified, never damage
+
+    def slots(self) -> dict[str, dict]:
+        """Snapshot of the manifest's slot table (deep-ish copy: callers
+        render/assert, they must not mutate supervision state)."""
+        return json.loads(json.dumps(self.doc.get("slots", {})))
+
+    # -- router fleet ops (advisory: a dead router is not a supervisor
+    # failure; it rebuilds membership from the manifest when it returns)
+    def _fleet_op(self, action: str, address: str, partitions=None) -> None:
+        if not self.router_address:
+            return
+        from drep_tpu.serve.client import ServeClient
+
+        req = {"op": "fleet", "action": action, "address": address}
+        if action == "join":
+            req["partitions"] = partitions
+        try:
+            with ServeClient(self.router_address, timeout_s=10.0) as c:
+                c.request(req)
+        except Exception as e:  # noqa: BLE001 — advisory by contract
+            self._log.warning(
+                "supervisor: fleet %s for %s failed (router %s): %r",
+                action, address, self.router_address, e,
+            )
+
+    def _probe(self, address: str) -> bool:
+        if self._probe_fn is not None:
+            return bool(self._probe_fn(address))
+        from drep_tpu.serve.client import ServeClient
+
+        try:
+            with ServeClient(address, timeout_s=5.0) as c:
+                return bool(c.status())
+        except Exception:  # noqa: BLE001 — an unreachable replica is a fact
+            return False
+
+    # -- deaths, backoff, quarantine -------------------------------------
+    def _book_death(self, slot: dict, reason: str, now: float) -> None:
+        """The one funnel every death takes: record the instant +
+        reason, then either QUARANTINE (K deaths in window) or schedule
+        a decorrelated-backoff respawn."""
+        slot["pid"] = None
+        deaths = list(slot.get("deaths", []))
+        deaths.append(now)
+        # the detector only ever looks `window` back; keep a bounded
+        # tail so a months-old slot doesn't grow an unbounded ledger
+        slot["deaths"] = deaths[-max(10, self.crashloop_k * 3):]
+        slot["last_death_reason"] = reason
+        self._strikes.pop(slot["slot_id"], None)
+        if is_crash_loop(slot["deaths"], now, self.crashloop_k,
+                         self.crashloop_window_s):
+            slot["state"] = "quarantined"
+            slot["quarantine_reason"] = (
+                f"crash loop: {self.crashloop_k} deaths within "
+                f"{self.crashloop_window_s:g}s (last: {reason})"
+            )
+            slot["next_retry_at"] = None
+            self._log.warning(
+                "supervisor: slot %s QUARANTINED — %s",
+                slot["slot_id"], slot["quarantine_reason"],
+            )
+            telemetry.event(
+                "supervisor_quarantine", slot=slot["slot_id"],
+                reason=slot["quarantine_reason"],
+            )
+        else:
+            slot["backoff_s"] = next_backoff(
+                slot.get("backoff_s") or 0.0, self.backoff_base_s,
+                self.backoff_max_s, self._rng,
+            )
+            slot["state"] = "backoff"
+            slot["next_retry_at"] = now + slot["backoff_s"]
+            self._log.warning(
+                "supervisor: slot %s died (%s) — retry in %.2fs",
+                slot["slot_id"], reason, slot["backoff_s"],
+            )
+            telemetry.event(
+                "supervisor_death", slot=slot["slot_id"], reason=reason,
+                backoff_s=round(slot["backoff_s"], 3),
+            )
+
+    def unquarantine(self, slot_id: str) -> dict:
+        """Operator verb out of QUARANTINE: clears the durable reason
+        and the death ledger (a fixed binary deserves a fresh crash-loop
+        window) and schedules an immediate respawn attempt."""
+        slot = self.doc["slots"][slot_id]
+        if slot.get("state") != "quarantined":
+            raise ValueError(
+                f"slot {slot_id} is {slot.get('state')!r}, not quarantined"
+            )
+        slot["state"] = "backoff"
+        slot["quarantine_reason"] = None
+        slot["deaths"] = []
+        slot["backoff_s"] = 0.0
+        # drep-lint: allow[clock-mono] — next_retry_at is a cross-process manifest instant
+        slot["next_retry_at"] = time.time()
+        self._publish()
+        telemetry.event("supervisor_unquarantine", slot=slot_id)
+        return slot
+
+    # -- spawn ------------------------------------------------------------
+    def _slot_cmd(self, slot: dict) -> str | None:
+        cmd = slot.get("spawn_cmd") or self.spawn_cmd
+        if not cmd:
+            return None
+        if "{partitions}" in cmd:
+            key = slot_range_key(slot)
+            cmd = cmd.replace("{partitions}", "" if key == "all" else key)
+        return cmd
+
+    def _spawn_slot(self, slot: dict) -> bool:
+        """Fork the slot's replica, await its JSON ready line under the
+        startup deadline, join it to the router. A startup death books
+        through the normal funnel (feeds backoff + crash-loop). Returns
+        True when the slot reached HEALTHY."""
+        # the manifest already records the intent (state=starting) —
+        # a supervisor killed HERE leaves an adoptable, not-yet-forked
+        # slot its successor respawns exactly once
+        faults.fire("supervisor_spawn")
+        cmd = self._slot_cmd(slot)
+        # drep-lint: allow[clock-mono] — death instants live in the manifest's wall-clock family
+        now = time.time()
+        if not cmd:
+            self._book_death(slot, "no spawn command for slot", now)
+            return False
+        env = dict(self._spawn_env if self._spawn_env is not None else os.environ)
+        env["DREP_TPU_AUTOSCALE_SPAWNED"] = "1"
+        argv = [a for a in shlex.split(cmd) if a]
+        if self._spawn_fn is not None:
+            proc = self._spawn_fn(argv, env)
+        else:
+            proc = subprocess.Popen(
+                argv, env=env, stdout=subprocess.PIPE, text=True
+            )
+        ready = self._await_ready(proc)
+        # drep-lint: allow[clock-mono] — manifest instant (see above)
+        now = time.time()
+        if ready is None:
+            rc = proc.poll()
+            reason = (
+                f"died at startup (exit {rc})" if rc is not None
+                else f"no ready line within {self.startup_deadline_s:g}s"
+            )
+            if rc is None:
+                try:
+                    proc.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+            self._book_death(slot, reason, now)
+            return False
+        slot["address"] = str(ready.get("serving"))
+        slot["pid"] = int(ready.get("pid") or proc.pid)
+        slot["state"] = "healthy"
+        slot["placed_at"] = now
+        self.procs[slot["slot_id"]] = proc
+        self._fleet_op("join", slot["address"], slot.get("partitions"))
+        self._log.info(
+            "supervisor: slot %s serving at %s (pid %d)",
+            slot["slot_id"], slot["address"], slot["pid"],
+        )
+        telemetry.event(
+            "supervisor_spawn", slot=slot["slot_id"],
+            address=slot["address"], pid=slot["pid"],
+        )
+        return True
+
+    def _await_ready(self, proc) -> dict | None:
+        """Parse the daemon's one-JSON-object ready line from its stdout
+        under the startup deadline (the same contract every harness in
+        the repo relies on)."""
+        deadline = time.monotonic() + self.startup_deadline_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline() if proc.stdout else ""
+            if not line:
+                if proc.poll() is not None:
+                    return None
+                time.sleep(0.02)
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(msg, dict) and msg.get("serving"):
+                return msg
+        return None
+
+    # -- the placement API (what autoscale/fleet.py actuates through) ----
+    def _next_slot_id(self) -> str:
+        n = int(self.doc.get("next_slot", 0))
+        self.doc["next_slot"] = n + 1
+        return f"s{n:03d}"
+
+    def place(self, partitions=None, count: int = 1,
+              spawn_cmd: str | None = None) -> list[dict]:
+        """Create + start `count` new slots covering `partitions` (None
+        = unscoped). Each slot's intent is published to the manifest
+        BEFORE its process is forked, so a supervisor death mid-spawn
+        can never leak an untracked replica. Returns the slot records
+        (state tells the caller whether each reached healthy)."""
+        placed = []
+        for _ in range(int(count)):
+            # drep-lint: allow[clock-mono] — placed_at orders drain victims across supervisor restarts
+            now = time.time()
+            slot = _new_slot(self._next_slot_id(), partitions,
+                             spawn_cmd, now)
+            self.doc["slots"][slot["slot_id"]] = slot
+            self._publish()
+            self._spawn_slot(slot)
+            self._publish()
+            placed.append(slot)
+        return placed
+
+    def drain(self, partitions=None, count: int = 1,
+              address: str | None = None) -> list[dict]:
+        """Retire up to `count` slots of the given range (or the one
+        slot serving `address`), most recently placed first — victims
+        are chosen from the MANIFEST, so the choice is correct across
+        any number of supervisor/controller restarts. Graceful: fleet
+        leave → SIGTERM now; the tick loop escalates to SIGKILL after
+        the drain deadline."""
+        key = None if partitions is None and address else (
+            "all" if partitions is None
+            else ",".join(str(int(p)) for p in sorted(partitions))
+        )
+        live = [
+            s for s in self.doc["slots"].values()
+            if s.get("state") in ("healthy", "starting", "backoff")
+            and (address is None or s.get("address") == address)
+            and (key is None or slot_range_key(s) == key)
+        ]
+        live.sort(key=lambda s: float(s.get("placed_at") or 0.0))
+        victims = live[-int(count):] if count else live[-1:]
+        for slot in victims:
+            if slot.get("address"):
+                self._fleet_op("leave", slot["address"])
+            # drep-lint: allow[clock-mono] — drain_started_at must survive into a successor supervisor
+            slot["drain_started_at"] = time.time()
+            slot["state"] = "draining"
+            slot["next_retry_at"] = None
+            if pid_alive(slot.get("pid")):
+                try:
+                    os.kill(int(slot["pid"]), signal.SIGTERM)
+                except OSError:
+                    pass
+            telemetry.event(
+                "supervisor_drain", slot=slot["slot_id"],
+                address=slot.get("address"),
+            )
+        if victims:
+            self._publish()
+        return victims
+
+    # -- crash recovery: adoption ----------------------------------------
+    def recover(self) -> dict[str, list[str]]:
+        """The successor's first move: walk the manifest, ADOPT every
+        still-live replica (pid alive + /healthz answers), reap stale
+        pids into the normal death path, and finish any interrupted
+        drains. Adoption strictly precedes any spawn — a recovered
+        supervisor can never double-spawn a slot whose replica survived
+        it. Returns {adopted, reaped, retired, quarantined} slot ids."""
+        out: dict[str, list[str]] = {
+            "adopted": [], "reaped": [], "retired": [], "quarantined": [],
+        }
+        # drep-lint: allow[clock-mono] — comparisons against manifest wall-clock instants
+        now = time.time()
+        for slot_id in list(self.doc.get("slots", {})):
+            slot = self.doc["slots"][slot_id]
+            state = slot.get("state")
+            if state == "quarantined":
+                out["quarantined"].append(slot_id)  # durable by contract
+                continue
+            if state == "draining":
+                # finish the predecessor's drain: dead -> retire the
+                # slot; alive past the deadline -> escalate
+                if not pid_alive(slot.get("pid")):
+                    del self.doc["slots"][slot_id]
+                    out["retired"].append(slot_id)
+                continue
+            alive = pid_alive(slot.get("pid"))
+            if alive and slot.get("address") and self._probe(slot["address"]):
+                slot["state"] = "healthy"
+                out["adopted"].append(slot_id)
+                self._log.info(
+                    "supervisor: adopted slot %s at %s (pid %s)",
+                    slot_id, slot["address"], slot["pid"],
+                )
+                # re-announce: a router restarted alongside us rebuilds
+                # from the manifest, but join is idempotent and free
+                self._fleet_op("join", slot["address"],
+                               slot.get("partitions"))
+                continue
+            if alive:
+                # pid exists but the wire is dead: reap it for real
+                # before booking the death, or the next spawn races it
+                try:
+                    os.kill(int(slot["pid"]), signal.SIGKILL)
+                except OSError:
+                    pass
+                self._book_death(
+                    slot, "adoption probe failed (pid alive, wire dead)",
+                    now,
+                )
+            elif state in ("healthy", "starting", "backoff"):
+                if state != "backoff":
+                    self._book_death(slot, "stale pid reaped at recovery",
+                                     now)
+                out["reaped"].append(slot_id)
+        self._publish()
+        telemetry.event(
+            "supervisor_recover",
+            **{k: len(v) for k, v in out.items()},
+        )
+        return out
+
+    # -- the heartbeat tick ----------------------------------------------
+    def tick(self) -> None:
+        """One supervision pass over every slot: liveness + /healthz for
+        HEALTHY, retry-elapsed respawn for BACKOFF, deadline escalation
+        + retirement for DRAINING. Publishes the manifest only when
+        something changed."""
+        faults.fire("supervisor_tick")
+        # drep-lint: allow[clock-mono] — all slot instants are manifest wall-clock facts
+        now = time.time()
+        changed = False
+        for slot_id in list(self.doc.get("slots", {})):
+            slot = self.doc["slots"][slot_id]
+            state = slot.get("state")
+            if state == "healthy":
+                proc = self.procs.get(slot_id)
+                rc = proc.poll() if proc is not None else None
+                if rc is not None or not pid_alive(slot.get("pid")):
+                    reason = (
+                        f"exited rc={rc}" if rc is not None
+                        else f"pid {slot.get('pid')} vanished"
+                    )
+                    self.procs.pop(slot_id, None)
+                    self._book_death(slot, reason, now)
+                    changed = True
+                elif slot.get("address") and not self._probe(slot["address"]):
+                    strikes = self._strikes.get(slot_id, 0) + 1
+                    self._strikes[slot_id] = strikes
+                    if strikes >= PROBE_STRIKES:
+                        # wedged, not dead: reclaim the pid then book it
+                        try:
+                            os.kill(int(slot["pid"]), signal.SIGKILL)
+                        except OSError:
+                            pass
+                        self.procs.pop(slot_id, None)
+                        self._book_death(
+                            slot,
+                            f"unresponsive ({strikes} probes missed)", now,
+                        )
+                        changed = True
+                else:
+                    self._strikes.pop(slot_id, None)
+            elif state == "backoff":
+                if slot.get("next_retry_at") is not None \
+                        and now >= float(slot["next_retry_at"]):
+                    slot["state"] = "starting"
+                    slot["restarts"] = int(slot.get("restarts", 0)) + 1
+                    slot["next_retry_at"] = None
+                    self._publish()  # intent before fork, as in place()
+                    self._spawn_slot(slot)
+                    changed = True
+            elif state == "draining":
+                if not pid_alive(slot.get("pid")):
+                    self.procs.pop(slot_id, None)
+                    del self.doc["slots"][slot_id]
+                    changed = True
+                elif slot.get("drain_started_at") is not None and (
+                    now - float(slot["drain_started_at"])
+                    > self.drain_deadline_s
+                ):
+                    try:
+                        os.kill(int(slot["pid"]), signal.SIGKILL)
+                    except OSError:
+                        pass
+                    slot["escalations"] = int(slot.get("escalations", 0)) + 1
+                    slot["drain_started_at"] = now  # one escalation per deadline
+                    self._log.warning(
+                        "supervisor: slot %s blew the %.1fs drain "
+                        "deadline — SIGKILLed (escalation %d)",
+                        slot_id, self.drain_deadline_s, slot["escalations"],
+                    )
+                    telemetry.event(
+                        "supervisor_escalation", slot=slot_id,
+                        escalations=slot["escalations"],
+                    )
+                    changed = True
+        if changed:
+            self._publish()
+
+    def run(self, count: int = 0) -> int:
+        """recover() once, then tick at the heartbeat until interrupted
+        (or `count` ticks, for tests). Returns 0 — replicas outlive
+        their supervisor by design; its death is harmless."""
+        self.recover()
+        n = 0
+        try:
+            while True:
+                self.tick()
+                n += 1
+                if count and n >= count:
+                    break
+                time.sleep(max(0.05, self.heartbeat_s))
+        except KeyboardInterrupt:
+            pass
+        return 0

@@ -322,6 +322,45 @@ _declare(
     "so every pod member books `autoscale_churn` and bench records refuse "
     "the run as measured perf (tools/missing_stages.py). Never set by hand.",
 )
+# -- fleet supervisor --------------------------------------------------------
+_declare(
+    "DREP_TPU_SUP_HEARTBEAT_S", "float", 1.0,
+    "Fleet supervisor (serve/supervisor.py): seconds between liveness "
+    "heartbeats against each healthy slot — a pid poll plus a /healthz "
+    "probe over the existing serve wire. A dead pid or failed probe books "
+    "a death and moves the slot to BACKOFF.",
+)
+_declare(
+    "DREP_TPU_SUP_BACKOFF_MAX_S", "float", 30.0,
+    "Fleet supervisor: cap on the decorrelated-jitter exponential restart "
+    "backoff. Each death resamples delay = uniform(base, prev*3) clamped "
+    "to this, so respawn storms decorrelate instead of thundering.",
+)
+_declare(
+    "DREP_TPU_SUP_CRASHLOOP_K", "int", 3,
+    "Fleet supervisor crash-loop detector: this many deaths inside "
+    "DREP_TPU_SUP_CRASHLOOP_WINDOW_S moves the slot to QUARANTINED — no "
+    "further respawns, durable reason in fleet.json; routed traffic over "
+    "the missing coverage degrades to stamped PARTIAL.",
+)
+_declare(
+    "DREP_TPU_SUP_CRASHLOOP_WINDOW_S", "float", 60.0,
+    "Fleet supervisor crash-loop detector: sliding window (s) the death "
+    "count is evaluated over. Deaths older than the window never count "
+    "toward quarantine.",
+)
+_declare(
+    "DREP_TPU_SUP_DRAIN_DEADLINE_S", "float", 30.0,
+    "Fleet supervisor graceful drain: seconds after SIGTERM a draining "
+    "replica gets to finish in-flight work before escalation to SIGKILL "
+    "(escalations are counted separately in the manifest slot).",
+)
+_declare(
+    "DREP_TPU_SUP_STARTUP_DEADLINE_S", "float", 120.0,
+    "Fleet supervisor startup probe: seconds a freshly spawned replica "
+    "gets to print its JSON ready line before the spawn is declared dead "
+    "(books a death like any other — feeds backoff and crash-loop).",
+)
 # -- ingest ------------------------------------------------------------------
 _declare(
     "DREP_TPU_INGEST_BARRIER_S", "float", 600.0,

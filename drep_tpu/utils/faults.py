@@ -120,6 +120,16 @@ SITES = (
     # WIRE_MODES); ``path=S`` targets a peer LABEL (the proxy's name for
     # its upstream, e.g. path=replica0) the way io rules target a shard
     # path — one spec can garble exactly one hop of a fleet.
+    "supervisor_spawn",  # the fleet supervisor's per-spawn point,
+    # drep_tpu/serve/supervisor.py (fires AFTER the manifest records the
+    # intent but BEFORE the replica process is forked: kill -> the
+    # supervisor dies mid-spawn and its successor must adopt every
+    # still-live replica from fleet.json without double-spawning;
+    # raise -> the spawn books a death and feeds backoff; sleep paces)
+    "supervisor_tick",  # the top of each supervision heartbeat tick,
+    # drep_tpu/serve/supervisor.py (kill/raise/hang take the supervisor
+    # down — which must be harmless: replicas keep serving, the manifest
+    # stays adoptable; sleep paces the loop so chaos can interleave)
 )
 
 # io-site modes (fired via fire_io/corrupt_write inside utils/durableio.py):

@@ -404,11 +404,135 @@ def index_route_wrapper(index_loc: str, genomes: list[str] | None = None, **kwar
         hedge_delay_s=kwargs.get("hedge_delay_s"),
         probe_interval_s=float(kwargs.get("probe_interval_s", 1.0) or 1.0),
         probe_backoff_s=kwargs.get("probe_backoff_s"),
+        fleet_manifest=kwargs.get("fleet_manifest"),
     )
     server = RouterServer(cfg)
     install_signal_handlers(server)
     try:
         return server.run()
+    finally:
+        stop_metrics_flush(final=bool(log_dir))
+        if log_dir:
+            counters.write(log_dir)
+        telemetry.close()
+
+
+def index_supervise_wrapper(index_loc: str, **kwargs) -> int:
+    """`index supervise`: the fleet supervisor
+    (drep_tpu/serve/supervisor.py) — replica process lifecycle against
+    the durable ``fleet.json`` manifest. Adoption first (a restarted
+    supervisor re-attaches every still-live replica it finds in the
+    manifest, never double-spawns), then the requested initial
+    placement for ranges the manifest doesn't already cover, then the
+    heartbeat loop: liveness + /healthz per slot, decorrelated-backoff
+    restarts, crash-loop quarantine, drain escalation.
+
+    Prints one JSON ready line (``{"supervising": ..., "pid": ...}``)
+    once recovery + initial placement are published — the same
+    stdout contract every daemon in the serve tier honors. Exit is
+    harmless by design: replicas outlive their supervisor, and the
+    manifest makes the successor whole. The supervisor needs no JAX —
+    it is pure control plane."""
+    import json as _json
+    import os
+    import time as _time
+
+    from drep_tpu.serve.router import parse_replica_spec
+    from drep_tpu.serve.supervisor import FleetSupervisor, manifest_path
+    from drep_tpu.utils import telemetry
+    from drep_tpu.utils.profiling import counters, start_metrics_flush, stop_metrics_flush
+
+    log_dir = kwargs.get("log_dir") or None
+    if telemetry.resolve_enabled(kwargs.get("events")) and not log_dir:
+        raise UserInputError(
+            "--events on needs --log_dir (the supervisor writes only the "
+            "fleet manifest under the index tree; traces go elsewhere)"
+        )
+    if log_dir:
+        log_dir = os.path.abspath(log_dir)
+        idx_abs = os.path.abspath(index_loc)
+        if log_dir == idx_abs or log_dir.startswith(idx_abs + os.sep):
+            raise UserInputError(
+                f"--log_dir {log_dir} is inside the index directory — "
+                f"the supervisor's one sanctioned write there is the "
+                f"fleet manifest; point logs elsewhere"
+            )
+        os.makedirs(log_dir, exist_ok=True)
+    import logging
+
+    console_lvl = next(
+        (h.level for h in get_logger().handlers
+         if isinstance(h, logging.StreamHandler)),
+        logging.INFO,
+    )
+    setup_logger(log_dir, verbosity=console_lvl or logging.INFO)
+    telemetry.configure(log_dir=log_dir, enabled=kwargs.get("events"))
+    if log_dir:
+        start_metrics_flush(log_dir)
+    else:
+        stop_metrics_flush()
+    counters.reset()
+    fleet_dir = kwargs.get("fleet_dir") or os.path.join(index_loc, "fleet")
+    # initial placement specs: "N" (unscoped) or "N=0-2,5" (scoped)
+    wanted: list[tuple[int, frozenset | None]] = []
+    for spec in kwargs.get("replica") or []:
+        count_s, _, pids_s = str(spec).partition("=")
+        try:
+            count = int(count_s)
+        except ValueError:
+            raise UserInputError(
+                f"bad --replica spec {spec!r}: want N or N=PIDS "
+                f"(e.g. 2 or 1=0-2,5)"
+            ) from None
+        assigned = parse_replica_spec(f"x={pids_s}")[1] if pids_s else None
+        wanted.append((count, assigned))
+    sup = FleetSupervisor(
+        fleet_dir,
+        spawn_cmd=kwargs.get("spawn"),
+        router_address=kwargs.get("router"),
+        heartbeat_s=kwargs.get("heartbeat_s"),
+        backoff_max_s=kwargs.get("backoff_max_s"),
+        crashloop_k=kwargs.get("crashloop_k"),
+        crashloop_window_s=kwargs.get("crashloop_window_s"),
+        drain_deadline_s=kwargs.get("drain_deadline_s"),
+        startup_deadline_s=kwargs.get("startup_deadline_s"),
+    )
+    try:
+        recovered = sup.recover()
+        from drep_tpu.serve.supervisor import slot_range_key
+
+        for count, assigned in wanted:
+            key = ("all" if assigned is None
+                   else ",".join(str(p) for p in sorted(assigned)))
+            have = sum(
+                1 for s in sup.doc["slots"].values()
+                if slot_range_key(s) == key
+                and s.get("state") not in ("draining",)
+            )
+            need = count - have
+            if need > 0:
+                sup.place(partitions=(
+                    sorted(assigned) if assigned is not None else None
+                ), count=need)
+        print(_json.dumps({
+            "supervising": fleet_dir,
+            "manifest": manifest_path(fleet_dir),
+            "pid": os.getpid(),
+            "slots": len(sup.doc["slots"]),
+            "adopted": len(recovered["adopted"]),
+        }), flush=True)
+        ticks = int(kwargs.get("ticks", 0) or 0)
+        n = 0
+        try:
+            while True:
+                sup.tick()
+                n += 1
+                if ticks and n >= ticks:
+                    break
+                _time.sleep(max(0.05, sup.heartbeat_s))
+        except KeyboardInterrupt:
+            pass
+        return 0
     finally:
         stop_metrics_flush(final=bool(log_dir))
         if log_dir:

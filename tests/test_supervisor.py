@@ -1,0 +1,538 @@
+"""Tier-1 units for the fleet supervisor (ISSUE 20,
+drep_tpu/serve/supervisor.py): the pure lifecycle arithmetic
+(decorrelated backoff, crash-loop window counting), the slot state
+machine (quarantine at exactly K deaths, unquarantine, heartbeat
+death + respawn), the durable checked-JSON manifest (round-trip,
+generation snapshots + gc), orphan ADOPTION on recovery (live pid vs
+stale pid — never a double spawn), the router's membership rebuild
+from the same manifest, the drain-after-restart attribution fix in
+autoscale/fleet.py, and tools/scrub_store.py's ``stale_membership``
+classification. Everything here is process-local and fast: real child
+pids come from `sleep`-style python subprocesses, the fork itself is
+replaced by the supervisor's `spawn_fn` seam, and /healthz probes by
+`probe_fn`.
+"""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import types
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from drep_tpu.serve.router import ReplicaTable, RouterConfig, RouterServer  # noqa: E402
+from drep_tpu.serve.supervisor import (  # noqa: E402
+    FleetSupervisor,
+    is_crash_loop,
+    load_manifest,
+    manifest_path,
+    next_backoff,
+    pid_alive,
+)
+from drep_tpu.utils import durableio, envknobs, faults  # noqa: E402
+
+
+# ---- harness: fake replica processes ---------------------------------------
+
+
+class _DeadOnArrival:
+    """A 'replica' that exits before printing its ready line — the
+    crash-loop rig."""
+
+    def __init__(self):
+        self.pid = 999999  # never consulted: poll() answers first
+        self.stdout = None
+        self.signals = []
+
+    def poll(self):
+        return 1
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+
+
+class _LiveReplica:
+    """A real child process (so its pid is genuinely alive and
+    signalable) wearing the daemon's ready-line stdout contract."""
+
+    def __init__(self, address):
+        self._p = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(3600)"]
+        )
+        self.pid = self._p.pid
+        self.address = address
+        self._lines = [json.dumps({"serving": address, "pid": self.pid}) + "\n"]
+        self.stdout = self
+
+    def readline(self):
+        return self._lines.pop(0) if self._lines else ""
+
+    def poll(self):
+        return self._p.poll()
+
+    def send_signal(self, sig):
+        self._p.send_signal(sig)
+
+    def kill(self):
+        if self._p.poll() is None:
+            self._p.kill()
+        self._p.wait(timeout=10)
+
+
+@pytest.fixture()
+def reaper():
+    procs = []
+    yield procs
+    for p in procs:
+        p.kill()
+
+
+def _sup(tmp_path, spawn_fn, **kw):
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("backoff_max_s", 0.0)
+    kw.setdefault("crashloop_k", 3)
+    kw.setdefault("crashloop_window_s", 60.0)
+    kw.setdefault("drain_deadline_s", 30.0)
+    kw.setdefault("startup_deadline_s", 5.0)
+    kw.setdefault("heartbeat_s", 0.05)
+    kw.setdefault("probe_fn", lambda addr: True)
+    kw.setdefault("rng", random.Random(7))
+    return FleetSupervisor(str(tmp_path / "fleet"), spawn_fn=spawn_fn,
+                           spawn_cmd="serve --cmd", **kw)
+
+
+# ---- pure arithmetic -------------------------------------------------------
+
+
+def test_backoff_decorrelated_arithmetic():
+    """uniform(base, max(base, prev*3)) clamped to the cap: first draw
+    is exactly base, later draws land in [base, min(cap, prev*3)], and
+    the cap always wins. Seeded rng pins determinism."""
+    rng = random.Random(42)
+    assert next_backoff(0.0, 0.5, 30.0, rng) == 0.5  # degenerate uniform
+    prev = 0.5
+    for _ in range(50):
+        cur = next_backoff(prev, 0.5, 30.0, rng)
+        assert 0.5 <= cur <= min(30.0, max(0.5, prev * 3))
+        prev = cur
+    assert next_backoff(1e9, 0.5, 30.0, rng) <= 30.0  # cap is absolute
+    # same seed -> same trajectory (the unit the chaos cells pin on)
+    a = random.Random(9)
+    b = random.Random(9)
+    assert [next_backoff(1.0, 0.5, 30.0, a) for _ in range(5)] == \
+           [next_backoff(1.0, 0.5, 30.0, b) for _ in range(5)]
+
+
+def test_crash_loop_window_counting():
+    now = 1000.0
+    assert not is_crash_loop([], now, 3, 60.0)
+    assert not is_crash_loop([990.0, 995.0], now, 3, 60.0)  # K-1 inside
+    assert is_crash_loop([990.0, 995.0, 999.0], now, 3, 60.0)  # exactly K
+    # deaths older than the window never count
+    assert not is_crash_loop([100.0, 200.0, 995.0], now, 3, 60.0)
+    # boundary: a death exactly `window` ago still counts (<=)
+    assert is_crash_loop([940.0, 970.0, 999.0], now, 3, 60.0)
+    assert not is_crash_loop([939.9, 970.0, 999.0], now, 3, 60.0)
+    # K <= 0 disables the detector outright
+    assert not is_crash_loop([999.0] * 50, now, 0, 60.0)
+
+
+def test_pid_alive_probe():
+    assert pid_alive(os.getpid())
+    assert not pid_alive(None) and not pid_alive(-1) and not pid_alive("x")
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait(timeout=10)
+    assert not pid_alive(p.pid)
+
+
+def test_supervisor_knobs_registered():
+    for name, kind in (
+        ("DREP_TPU_SUP_HEARTBEAT_S", "float"),
+        ("DREP_TPU_SUP_BACKOFF_MAX_S", "float"),
+        ("DREP_TPU_SUP_CRASHLOOP_K", "int"),
+        ("DREP_TPU_SUP_CRASHLOOP_WINDOW_S", "float"),
+        ("DREP_TPU_SUP_DRAIN_DEADLINE_S", "float"),
+        ("DREP_TPU_SUP_STARTUP_DEADLINE_S", "float"),
+    ):
+        assert envknobs.knob(name).kind == kind
+    assert envknobs.env_int("DREP_TPU_SUP_CRASHLOOP_K") == 3
+    assert envknobs.env_float("DREP_TPU_SUP_HEARTBEAT_S") == 1.0
+
+
+def test_supervisor_fault_sites_registered():
+    """supervisor_spawn / supervisor_tick parse in a spec (unknown
+    sites raise at parse time by contract) and kill/raise are legal
+    modes at both."""
+    try:
+        for spec in ("supervisor_spawn:kill", "supervisor_tick:raise",
+                     "supervisor_tick:sleep:secs=0.1"):
+            faults.configure(spec)
+            assert faults.active()
+    finally:
+        faults.reset()
+
+
+# ---- quarantine at exactly K + unquarantine --------------------------------
+
+
+def test_quarantine_after_exactly_k_deaths_and_unquarantine(tmp_path):
+    calls = []
+
+    def spawn_fn(argv, env):
+        calls.append(list(argv))
+        return _DeadOnArrival()
+
+    sup = _sup(tmp_path, spawn_fn, crashloop_k=3)
+    (slot,) = sup.place(count=1)
+    sid = slot["slot_id"]
+    # death #1 at placement: backoff, not quarantined
+    assert slot["state"] == "backoff" and len(slot["deaths"]) == 1
+    assert "exit 1" in slot["last_death_reason"]
+    sup.tick()  # death #2 (backoff 0 -> retry due immediately)
+    assert sup.doc["slots"][sid]["state"] == "backoff"
+    assert len(sup.doc["slots"][sid]["deaths"]) == 2
+    sup.tick()  # death #3 -> exactly K -> QUARANTINED
+    slot = sup.doc["slots"][sid]
+    assert slot["state"] == "quarantined"
+    assert "crash loop: 3 deaths" in slot["quarantine_reason"]
+    assert slot["restarts"] == 2 and len(calls) == 3
+    # quarantine is durable and stops burning respawns
+    for _ in range(5):
+        sup.tick()
+    assert len(calls) == 3
+    ondisk = load_manifest(sup.fleet_dir)
+    assert ondisk["slots"][sid]["state"] == "quarantined"
+    assert ondisk["slots"][sid]["quarantine_reason"] == slot["quarantine_reason"]
+    # the operator verb back: fresh death ledger, immediate retry
+    sup.unquarantine(sid)
+    slot = sup.doc["slots"][sid]
+    assert slot["state"] == "backoff" and slot["deaths"] == []
+    assert slot["quarantine_reason"] is None
+    sup.tick()  # respawns (and dies) again — the ledger restarts at 1
+    assert len(calls) == 4
+    assert len(sup.doc["slots"][sid]["deaths"]) == 1
+    with pytest.raises(ValueError):
+        sup.unquarantine(sid)  # only quarantined slots have the verb
+
+
+# ---- manifest round-trip + generation snapshots ----------------------------
+
+
+def test_manifest_roundtrip_checked_and_gc(tmp_path):
+    sup = _sup(tmp_path, lambda argv, env: _DeadOnArrival())
+    sup.place(count=2)
+    doc = load_manifest(sup.fleet_dir)
+    assert doc["generation"] == sup.doc["generation"]
+    assert doc["supervisor_pid"] == os.getpid()
+    assert set(doc["slots"]) == set(sup.doc["slots"])
+    # checked JSON: the raw file carries the in-band crc the reader strips
+    raw = json.load(open(manifest_path(sup.fleet_dir)))
+    assert durableio.JSON_CRC_KEY in raw
+    assert durableio.JSON_CRC_KEY not in doc
+    # generation snapshots are retained and gc'd to the newest few
+    gens = sorted(n for n in os.listdir(sup.fleet_dir)
+                  if n.startswith("fleet.g"))
+    assert 1 <= len(gens) <= 2
+    assert gens[-1] == f"fleet.g{doc['generation']:06d}.json"
+    # a rotted manifest refuses loudly (never adopt from garbage)
+    path = manifest_path(sup.fleet_dir)
+    body = open(path, "rb").read()
+    open(path, "wb").write(body.replace(b'"slots"', b'"slotz"', 1))
+    with pytest.raises(durableio.CorruptPayloadError):
+        load_manifest(sup.fleet_dir)
+
+
+# ---- heartbeat: death detection + respawn ----------------------------------
+
+
+def test_heartbeat_books_death_and_respawns(tmp_path, reaper):
+    def spawn_fn(argv, env):
+        p = _LiveReplica(f"replica:{len(reaper)}")
+        reaper.append(p)
+        return p
+
+    sup = _sup(tmp_path, spawn_fn)
+    (slot,) = sup.place(count=1)
+    sid = slot["slot_id"]
+    assert slot["state"] == "healthy" and pid_alive(slot["pid"])
+    sup.tick()  # healthy stays healthy
+    assert sup.doc["slots"][sid]["state"] == "healthy"
+    reaper[0].kill()  # murder the replica out from under the supervisor
+    sup.tick()  # death booked -> backoff(0) ; next tick respawns
+    st = sup.doc["slots"][sid]["state"]
+    assert st in ("backoff", "healthy")
+    if st == "backoff":
+        sup.tick()
+    slot = sup.doc["slots"][sid]
+    assert slot["state"] == "healthy" and slot["restarts"] == 1
+    assert slot["pid"] == reaper[1].pid  # the NEW process
+    assert len(slot["deaths"]) == 1 and "rc=" in slot["last_death_reason"]
+
+
+# ---- adoption: live pid vs stale pid ---------------------------------------
+
+
+def _dead_pid():
+    """A pid that is REALLY dead (forked then reaped) — never a guess
+    that might collide with a live process."""
+    p = subprocess.Popen([sys.executable, "-c", "pass"])
+    p.wait(timeout=10)
+    return p.pid
+
+
+def _manifest_with(tmp_path, slots):
+    fleet_dir = str(tmp_path / "fleet")
+    os.makedirs(fleet_dir, exist_ok=True)
+    doc = {"version": 1, "generation": 5, "supervisor_pid": _dead_pid(),
+           "next_slot": len(slots), "updated_at": time.time(),
+           "slots": slots}
+    durableio.atomic_write_json(manifest_path(fleet_dir), doc)
+    return fleet_dir
+
+
+def _slot(sid, address, pid, state="healthy", partitions=None, **kw):
+    s = {"slot_id": sid, "partitions": partitions, "address": address,
+         "pid": pid, "spawn_cmd": None, "state": state, "restarts": 0,
+         "escalations": 0, "deaths": [], "last_death_reason": None,
+         "next_retry_at": None, "backoff_s": 0.0, "quarantine_reason": None,
+         "placed_at": time.time(), "drain_started_at": None}
+    s.update(kw)
+    return s
+
+
+def test_recover_adopts_live_and_reaps_stale(tmp_path, reaper):
+    live = _LiveReplica("live:1")
+    reaper.append(live)
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait(timeout=10)
+    fleet_dir = _manifest_with(tmp_path, {
+        "s000": _slot("s000", "live:1", live.pid),
+        "s001": _slot("s001", "stale:1", dead.pid),
+        "s002": _slot("s002", "quar:1", dead.pid, state="quarantined",
+                      quarantine_reason="crash loop: pinned"),
+    })
+    spawned = []
+    sup = FleetSupervisor(
+        fleet_dir, spawn_fn=lambda argv, env: spawned.append(argv),
+        probe_fn=lambda addr: addr == "live:1",
+        backoff_base_s=0.0, backoff_max_s=0.0, heartbeat_s=0.05,
+        crashloop_k=3, crashloop_window_s=60.0,
+    )
+    out = sup.recover()
+    assert out["adopted"] == ["s000"]
+    assert out["reaped"] == ["s001"]
+    assert out["quarantined"] == ["s002"]
+    assert spawned == []  # adoption NEVER spawns — no double-spawn, ever
+    slots = sup.doc["slots"]
+    assert slots["s000"]["state"] == "healthy"
+    assert slots["s000"]["pid"] == live.pid  # same process, re-attached
+    assert slots["s001"]["state"] == "backoff"
+    assert "stale pid" in slots["s001"]["last_death_reason"]
+    assert slots["s002"]["state"] == "quarantined"  # reason is durable
+    # the successor's manifest is already republished under ITS pid
+    assert load_manifest(fleet_dir)["supervisor_pid"] == os.getpid()
+
+
+def test_recover_finishes_interrupted_drain(tmp_path):
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait(timeout=10)
+    fleet_dir = _manifest_with(tmp_path, {
+        "s000": _slot("s000", "gone:1", dead.pid, state="draining",
+                      drain_started_at=time.time() - 100),
+    })
+    sup = FleetSupervisor(fleet_dir, probe_fn=lambda a: True)
+    out = sup.recover()
+    assert out["retired"] == ["s000"]
+    assert sup.doc["slots"] == {}
+
+
+# ---- drain-after-restart attribution (the autoscale/fleet.py fix) ----------
+
+
+def test_drain_after_restart_targets_manifest_not_memory(tmp_path, reaper):
+    """The old in-memory Popen ledger forgot everything across a
+    controller restart, so scale-down had nothing to SIGTERM. Victims
+    now come from the manifest: a FRESH supervisor (restart) adopts
+    both replicas and drains the most recently PLACED one."""
+    def spawn_fn(argv, env):
+        p = _LiveReplica(f"r:{len(reaper)}")
+        reaper.append(p)
+        return p
+
+    sup_a = _sup(tmp_path, spawn_fn)
+    sup_a.place(count=1)
+    time.sleep(0.02)  # strictly later placed_at for the second slot
+    sup_a.place(count=1)
+    del sup_a  # the first supervisor/controller "crashes"
+
+    sup_b = _sup(tmp_path, spawn_fn)  # restart: same fleet_dir
+    assert sup_b.recover()["adopted"] == ["s000", "s001"]
+
+    from drep_tpu.autoscale.fleet import FleetAutoscaleController
+    from drep_tpu.autoscale.policy import Targets
+
+    ctl = FleetAutoscaleController(
+        types.SimpleNamespace(status=lambda: {}, request=lambda o: {}),
+        Targets(deadline_at=None), queue_deadline_s=5.0, svc_s=0.1,
+        supervisor=sup_b,
+    )
+    msg = ctl._drain_replica("all", 1)
+    assert "draining ['r:1']" in msg  # most recently placed, via manifest
+    slots = sup_b.doc["slots"]
+    assert slots["s001"]["state"] == "draining"
+    assert slots["s000"]["state"] == "healthy"  # survivor untouched
+    # the SIGTERMed replica exits; the tick retires its slot
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and reaper[1].poll() is None:
+        time.sleep(0.05)
+    assert reaper[1].poll() is not None
+    sup_b.tick()
+    assert "s001" not in sup_b.doc["slots"]
+    # draining again picks the LAST live slot; a third drain has nothing
+    assert "draining ['r:0']" in ctl._drain_replica("all", 1)
+    assert ctl._drain_replica("all", 1).startswith("skipped")
+
+
+def test_fleet_controller_requires_manifest_home_for_spawns(tmp_path):
+    """spawn_cmd without a fleet_dir/supervisor must refuse loudly —
+    the silent in-memory ledger is exactly the bug this PR removes —
+    and no-spawn construction stays recommend-only."""
+    from drep_tpu.autoscale.fleet import FleetAutoscaleController
+    from drep_tpu.autoscale.policy import Targets
+
+    client = types.SimpleNamespace(status=lambda: {}, request=lambda o: {})
+    with pytest.raises(ValueError, match="fleet_dir"):
+        FleetAutoscaleController(client, Targets(deadline_at=None),
+                                 queue_deadline_s=5.0, svc_s=0.1,
+                                 spawn_cmd="index serve x")
+    ctl = FleetAutoscaleController(client, Targets(deadline_at=None),
+                                   queue_deadline_s=5.0, svc_s=0.1)
+    assert ctl.supervisor is None
+    assert ctl._spawn_replica("all", 1).startswith("skipped")
+    assert ctl._drain_replica("all", 1).startswith("skipped")
+
+
+# ---- router table rebuild from the manifest --------------------------------
+
+
+def _router_shim(tmp_path, slots):
+    fleet_dir = _manifest_with(tmp_path, slots)
+    cfg = RouterConfig(index_loc=str(tmp_path / "idx"),
+                       fleet_manifest=fleet_dir)
+    shim = types.SimpleNamespace(
+        cfg=cfg, table=ReplicaTable([], probe_backoff_s=0.1, probe_max_s=1.0)
+    )
+    return shim, fleet_dir
+
+
+def test_router_rebuilds_table_from_manifest(tmp_path, reaper):
+    live = _LiveReplica("live:9")
+    reaper.append(live)
+    shim, fleet_dir = _router_shim(tmp_path, {
+        "s000": _slot("s000", "a:1", live.pid, partitions=[0, 2]),
+        "s001": _slot("s001", "b:1", live.pid),
+        "s002": _slot("s002", None, None, state="backoff"),  # not routable
+        "s003": _slot("s003", "q:1", 1, state="quarantined"),
+    })
+    joined = RouterServer._rebuild_membership(shim)
+    assert sorted(joined) == ["a:1", "b:1"]
+    hm = shim.table.health_map()["replicas"]
+    assert set(hm) == {"a:1", "b:1"}
+    assert hm["a:1"]["assigned"] == [0, 2] and hm["b:1"]["assigned"] is None
+    # the supervision view rides the same manifest into /healthz
+    view = RouterServer._supervision_view(shim)
+    assert set(view["slots"]) == {"s000", "s001", "s002", "s003"}
+    assert view["generation"] == 5 and view["supervisor_alive"] is False
+    # no manifest configured -> no view, no joins — and a rotted one is
+    # a warning, not a crash
+    shim.cfg.fleet_manifest = None
+    assert RouterServer._supervision_view(shim) is None
+    assert RouterServer._rebuild_membership(shim) == []
+    path = manifest_path(fleet_dir)
+    open(path, "ab").write(b"garbage")
+    shim.cfg.fleet_manifest = fleet_dir
+    shim.table = ReplicaTable([], probe_backoff_s=0.1, probe_max_s=1.0)
+    assert RouterServer._rebuild_membership(shim) == []
+    assert "error" in RouterServer._supervision_view(shim)
+
+
+# ---- scrub: stale_membership is never damage -------------------------------
+
+
+def test_scrub_classifies_stale_membership(tmp_path):
+    from tools.scrub_store import scrub
+
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait(timeout=10)
+    fleet_dir = _manifest_with(tmp_path, {
+        "s000": _slot("s000", "a:1", dead.pid),  # dead pid, no supervisor
+        "s001": _slot("s001", "q:1", dead.pid, state="quarantined",
+                      quarantine_reason="crash loop: pinned"),
+    })
+    # superseded generation snapshots an interrupted publish never gc'd
+    doc = load_manifest(fleet_dir)
+    for g in (1, 2, 5):
+        durableio.atomic_write_json(
+            os.path.join(fleet_dir, f"fleet.g{g:06d}.json"),
+            dict(doc, generation=g),
+        )
+    out = open(os.devnull, "w")
+    rep = scrub([str(tmp_path)], out=out)
+    assert rep["damaged"] == []
+    stale = {os.path.basename(p) for p in rep["stale_membership"]}
+    # gens 1,2 < current (5) are stale; gen 5 is the live snapshot; the
+    # manifest itself is listed for its dead-pid slot compaction
+    assert stale == {"fleet.g000001.json", "fleet.g000002.json", "fleet.json"}
+    # --delete removes/compacts idempotently
+    rep = scrub([str(tmp_path)], delete=True, out=out)
+    assert {os.path.basename(p) for p in rep["stale_membership"]} == stale
+    doc = load_manifest(fleet_dir)
+    assert "s000" not in doc["slots"]  # dead-pid slot compacted out
+    assert doc["slots"]["s001"]["state"] == "quarantined"  # NEVER removed
+    assert not os.path.exists(os.path.join(fleet_dir, "fleet.g000001.json"))
+    assert os.path.exists(os.path.join(fleet_dir, "fleet.g000005.json"))
+    rep = scrub([str(tmp_path)], delete=True, out=out)
+    assert rep["stale_membership"] == [] and rep["damaged"] == []  # converged
+
+
+def test_scrub_leaves_owned_manifest_alone(tmp_path):
+    """A manifest whose recorded supervisor is ALIVE has an owner: its
+    dead-pid slots are that supervisor's to reap, not the scrubber's."""
+    from tools.scrub_store import scrub
+
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait(timeout=10)
+    fleet_dir = _manifest_with(tmp_path, {
+        "s000": _slot("s000", "a:1", dead.pid),
+    })
+    doc = load_manifest(fleet_dir)
+    doc["supervisor_pid"] = os.getpid()  # "alive" supervisor
+    durableio.atomic_write_json(manifest_path(fleet_dir), doc)
+    rep = scrub([str(tmp_path)], delete=True, out=open(os.devnull, "w"))
+    assert rep["stale_membership"] == []
+    assert "s000" in load_manifest(fleet_dir)["slots"]
+
+
+# ---- CLI surface -----------------------------------------------------------
+
+
+def test_supervise_cli_parses():
+    from drep_tpu.argparser import parse_args
+
+    args = parse_args([
+        "index", "supervise", "/tmp/idx", "--spawn", "index serve x",
+        "--replica", "2", "--replica", "1=0-2,5", "--router", "h:1",
+        "--crashloop_k", "4", "--ticks", "3",
+    ])
+    assert args.index_op == "supervise"
+    assert args.replica == ["2", "1=0-2,5"]
+    assert args.crashloop_k == 4 and args.ticks == 3
+    r = parse_args(["index", "route", "/tmp/idx",
+                    "--fleet_manifest", "/tmp/fleet"])
+    assert r.fleet_manifest == "/tmp/fleet"

@@ -1,0 +1,404 @@
+"""Supervisor-tier chaos cells for the fleet lifecycle (ISSUE 20,
+`tools/chaos_matrix.py --supervisor`).
+
+Each cell runs the REAL `index supervise` daemon as a subprocess owning
+real `index serve` replica subprocesses over a federated root, and pins
+the lifecycle contract of the supervision tree:
+
+- SIGKILL the supervisor mid-spawn (an injected ``supervisor_spawn:kill``
+  lands AFTER the manifest records the second slot's intent, BEFORE its
+  fork) -> the replicas it already placed keep serving; a successor
+  supervisor ADOPTS every still-live replica from ``fleet.json`` (same
+  pids — zero duplicate spawns), finishes the interrupted placement
+  exactly once, and the fleet's verdicts stay byte-identical to the
+  single-process oracle.
+- A replica rigged to die at startup -> the supervisor quarantines its
+  slot after exactly DREP_TPU_SUP_CRASHLOOP_K deaths (no further
+  respawns burn), routed traffic over the missing partition degrades to
+  honest stamped PARTIAL (strict clients refused with retry_after_s,
+  never a hang), the quarantine survives the supervisor's own SIGKILL
+  (the reason is durable in the manifest), and a replacement joining
+  via the ``fleet`` op restores oracle-identical full coverage.
+- A restarted router pointed at ``--fleet_manifest`` -> full membership
+  rebuilt from the supervisor's manifest with ZERO ``fleet join``
+  replays (the events log proves it), full-coverage verdicts
+  byte-identical to the oracle — even though the one-shot supervisor
+  itself died of an injected ``supervisor_tick:raise`` long before
+  (replicas outlive their supervisor by design).
+
+Marked slow+chaos: each cell pays several subprocesses (full JAX
+imports) — chaos_matrix runs them by test id, like the router cells.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _index_testlib as lib  # noqa: E402
+
+from drep_tpu.index import build_federated, index_classify, load_resident_index  # noqa: E402
+from drep_tpu.serve import ServeClient, ServeError  # noqa: E402
+from drep_tpu.serve.supervisor import load_manifest  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+P = 3
+
+
+def _strip(verdict: dict) -> dict:
+    out = dict(verdict)
+    out.pop("partitions_consulted", None)
+    out.pop("partitions_unavailable", None)
+    out.pop("partial", None)
+    return out
+
+
+def _build(tmp_path):
+    paths = lib.write_genome_set(str(tmp_path / "g"), [3, 2, 2], seed=3)
+    loc = str(tmp_path / "fed")
+    build_federated(loc, paths, P, length=0)
+    fed = load_resident_index(loc)
+    victim_pid = int(fed.part_of[fed.names.index(os.path.basename(paths[0]))])
+    return loc, paths, victim_pid
+
+
+def _env(extra=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+               DREP_TPU_SERVE_PROBE_BACKOFF_S="0.2",
+               DREP_TPU_SERVE_PROBE_MAX_S="0.5",
+               DREP_TPU_ROUTER_PROBE_BACKOFF_S="0.2")
+    env.update(extra or {})
+    return env
+
+
+def _spawn(argv, extra_env=None):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "drep_tpu"] + argv,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=_env(extra_env),
+    )
+    line = proc.stdout.readline()
+    assert line, "daemon died before its ready line"
+    return proc, json.loads(line)
+
+
+def _serve_cmd(loc):
+    """The spawn command the supervisor forks per slot — a full
+    `index serve` replica over the federated root."""
+    return f"{sys.executable} -m drep_tpu index serve {loc} --batch_window_ms 20"
+
+
+def _spawn_replica(loc, extra=(), extra_env=None):
+    return _spawn(
+        ["index", "serve", loc, "--batch_window_ms", "20"] + list(extra),
+        extra_env,
+    )
+
+
+def _spawn_router(loc, log_dir, replicas, extra=()):
+    argv = ["index", "route", loc, "--batch_window_ms", "20",
+            "--events", "on", "--log_dir", log_dir]
+    for spec in replicas:
+        argv += ["--replica", spec]
+    return _spawn(argv + list(extra))
+
+
+def _events(log_dir):
+    out = []
+    for fn in sorted(os.listdir(log_dir)):
+        if fn.startswith("events.p") and fn.endswith(".jsonl"):
+            with open(os.path.join(log_dir, fn)) as f:
+                for ln in f:
+                    if ln.strip():
+                        try:
+                            out.append(json.loads(ln))
+                        except ValueError:
+                            pass  # torn final line: expected crash evidence
+    return out
+
+
+def _classify_until(c, path, pred, deadline_s=120, strict=False):
+    deadline = time.monotonic() + deadline_s
+    resp = None
+    while time.monotonic() < deadline:
+        resp = c.classify(path, strict=strict)
+        if pred(resp):
+            return resp
+        time.sleep(0.2)
+    raise AssertionError(f"condition never held; last response: {resp}")
+
+
+def _manifest_until(fleet_dir, pred, deadline_s=150):
+    """Poll the durable manifest until `pred(doc)` holds — the
+    supervisor's state machine advances on its own heartbeat."""
+    deadline = time.monotonic() + deadline_s
+    doc = None
+    while time.monotonic() < deadline:
+        try:
+            doc = load_manifest(fleet_dir)
+        except Exception:  # noqa: BLE001 — racing the atomic publish
+            time.sleep(0.2)
+            continue
+        if pred(doc):
+            return doc
+        time.sleep(0.2)
+    raise AssertionError(f"manifest condition never held; last: {doc}")
+
+
+def _kill_fleet(fleet_dir):
+    """Teardown: the supervisor's replicas are NOT our children — reap
+    them by the pids the manifest records."""
+    try:
+        doc = load_manifest(fleet_dir)
+    except Exception:  # noqa: BLE001 — nothing to reap
+        return
+    for slot in (doc.get("slots") or {}).values():
+        pid = slot.get("pid")
+        if pid:
+            try:
+                os.kill(int(pid), signal.SIGKILL)
+            except (OSError, TypeError, ValueError):
+                pass
+
+
+def _reap(*procs):
+    for proc in procs:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+
+
+def test_sigkill_supervisor_midspawn_successor_adopts(tmp_path):
+    """An injected ``supervisor_spawn:kill`` (skip=1) SIGKILLs the
+    supervisor after the manifest records the SECOND slot's intent but
+    before its fork: the first replica keeps serving unsupervised. The
+    successor adopts it from fleet.json (same pid — never a duplicate
+    spawn), finishes the interrupted placement exactly once, and both
+    replicas answer byte-identical to the single-process oracle."""
+    loc, paths, _victim_pid = _build(tmp_path)
+    oracle = index_classify(loc, [paths[0]])[0]
+    fleet_dir = str(tmp_path / "fleet")
+
+    # supervisor A: place 2 unscoped replicas; the fault kills it at
+    # the second slot's pre-fork point (no ready line contract here —
+    # A dies mid-placement by design, so spawn it raw)
+    sup_a = subprocess.Popen(
+        [sys.executable, "-m", "drep_tpu", "index", "supervise", loc,
+         "--fleet_dir", fleet_dir, "--spawn", _serve_cmd(loc),
+         "--replica", "2", "--heartbeat_s", "0.1"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO,
+        env=_env({"DREP_TPU_FAULTS": "supervisor_spawn:kill:skip=1"}),
+    )
+    sup_b = None
+    try:
+        assert sup_a.wait(timeout=180) == -signal.SIGKILL
+        doc = load_manifest(fleet_dir)
+        assert set(doc["slots"]) == {"s000", "s001"}
+        s0 = doc["slots"]["s000"]
+        assert s0["state"] == "healthy" and s0["address"]
+        orphan_pid = int(s0["pid"])
+        # the kill landed between intent and fork: s001 never got a pid
+        assert doc["slots"]["s001"]["state"] == "starting"
+        assert doc["slots"]["s001"]["pid"] is None
+        # the orphan replica outlived its supervisor and still serves
+        with ServeClient(s0["address"], timeout_s=600) as c:
+            r = c.classify(paths[0])
+            assert r["ok"] and _strip(r["verdict"]) == oracle
+
+        # supervisor B: same manifest — adopt, then finish the placement
+        sup_b, b_ready = _spawn(
+            ["index", "supervise", loc, "--fleet_dir", fleet_dir,
+             "--spawn", _serve_cmd(loc), "--replica", "2",
+             "--heartbeat_s", "0.1"],
+        )
+        assert b_ready["adopted"] == 1  # s000 re-attached, not respawned
+        assert b_ready["slots"] == 2    # s001's intent survived too
+        doc = _manifest_until(
+            fleet_dir,
+            lambda d: all(s["state"] == "healthy"
+                          for s in d["slots"].values()),
+        )
+        # zero duplicate spawns: exactly the two intended slots, the
+        # adopted one still the ORIGINAL process, the interrupted one
+        # respawned exactly once (its pre-fork death books one restart)
+        assert set(doc["slots"]) == {"s000", "s001"}
+        assert int(doc["slots"]["s000"]["pid"]) == orphan_pid
+        assert doc["slots"]["s001"]["restarts"] == 1
+        assert doc["supervisor_pid"] == b_ready["pid"]
+        for slot in doc["slots"].values():
+            with ServeClient(slot["address"], timeout_s=600) as c:
+                r = c.classify(paths[0])
+                assert r["ok"] and _strip(r["verdict"]) == oracle
+        sup_b.send_signal(signal.SIGINT)  # KeyboardInterrupt -> clean 0
+        assert sup_b.wait(timeout=60) == 0
+    finally:
+        _kill_fleet(fleet_dir)
+        _reap(sup_a, sup_b)
+
+
+def test_crashloop_replica_quarantined_partial_served(tmp_path):
+    """A replica rigged to die before its ready line crash-loops: the
+    supervisor quarantines the slot after exactly
+    DREP_TPU_SUP_CRASHLOOP_K deaths and stops burning respawns; the
+    routed fleet serves honest stamped PARTIAL over the hole (strict
+    refused with retry_after_s — never a hang); the quarantine reason
+    survives the supervisor's own SIGKILL; a replacement joining via
+    the ``fleet`` op restores oracle-identical coverage."""
+    loc, paths, victim_pid = _build(tmp_path)
+    complement = [p for p in range(P) if p != victim_pid]
+    oracle = index_classify(loc, [paths[0]])[0]
+    fleet_dir = str(tmp_path / "fleet")
+    log_dir = str(tmp_path / "route_log")
+    os.makedirs(log_dir)
+
+    r_good, rg_ready = _spawn_replica(loc)
+    router, rt_ready = _spawn_router(
+        loc, log_dir,
+        [f"{rg_ready['serving']}={','.join(str(p) for p in complement)}"],
+        ["--probe_interval_s", "0.3",
+         "--leg_timeout_s", "30", "--hedge_delay_s", "30"],
+    )
+    # the doomed slot: exits 3 before ever printing a ready line
+    doomed = f"{sys.executable} -c 'import sys; sys.exit(3)'"
+    sup, sup_ready = _spawn(
+        ["index", "supervise", loc, "--fleet_dir", fleet_dir,
+         "--spawn", doomed, "--replica", f"1={victim_pid}",
+         "--router", rt_ready["serving"], "--heartbeat_s", "0.1"],
+        {"DREP_TPU_SUP_CRASHLOOP_K": "2"},
+    )
+    r_fix = None
+    try:
+        assert sup_ready["slots"] == 1
+        doc = _manifest_until(
+            fleet_dir,
+            lambda d: d["slots"].get("s000", {}).get("state") == "quarantined",
+            deadline_s=60,
+        )
+        slot = doc["slots"]["s000"]
+        # exactly K deaths — the knob, not K+1, not a runaway loop
+        assert len(slot["deaths"]) == 2
+        assert slot["restarts"] == 1
+        assert "crash loop: 2 deaths" in slot["quarantine_reason"]
+        assert "exit 3" in slot["quarantine_reason"]
+        # no respawns burn while quarantined
+        time.sleep(1.5)
+        doc = load_manifest(fleet_dir)
+        assert len(doc["slots"]["s000"]["deaths"]) == 2
+
+        # the fleet degrades honestly over the missing partition
+        with ServeClient(rt_ready["serving"], timeout_s=600) as c:
+            r = c.classify(paths[0])
+            assert r["ok"] and r["verdict"]["partial"] is True
+            assert victim_pid in r["verdict"]["partitions_unavailable"]
+            with pytest.raises(ServeError) as ei:
+                c.classify(paths[0], strict=True)
+            assert ei.value.reason == "partial_coverage"
+            assert ei.value.retry_after_s and ei.value.retry_after_s > 0
+
+            # the quarantine is DURABLE: SIGKILL the supervisor, the
+            # reason is still in the manifest for its successor
+            sup.kill()
+            sup.wait(timeout=60)
+            doc = load_manifest(fleet_dir)
+            assert doc["slots"]["s000"]["state"] == "quarantined"
+            assert "crash loop" in doc["slots"]["s000"]["quarantine_reason"]
+
+            # a fixed replica joins over the hole: oracle restored
+            r_fix, rf_ready = _spawn_replica(loc)
+            jr = c.request({
+                "op": "fleet", "action": "join",
+                "address": rf_ready["serving"],
+                "partitions": [victim_pid],
+            })
+            assert jr["ok"] and jr["known"]
+            r2 = _classify_until(
+                c, paths[0],
+                lambda r: r["ok"]
+                and not r["verdict"].get("partitions_unavailable"),
+            )
+            assert _strip(r2["verdict"]) == oracle
+            assert router.poll() is None
+        router.send_signal(signal.SIGTERM)
+        assert router.wait(timeout=120) == 0
+        for proc in (r_good, r_fix):
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=120) == 0
+    finally:
+        _kill_fleet(fleet_dir)
+        _reap(sup, router, r_good, r_fix)
+
+
+def test_router_restart_rebuilds_membership_from_manifest(tmp_path):
+    """A one-shot supervisor places a scoped 2-replica fleet and then
+    dies of an injected ``supervisor_tick:raise`` — harmless by design:
+    the replicas keep serving and the manifest stays adoptable. A
+    router started with ``--fleet_manifest`` serves full-coverage
+    oracle verdicts with ZERO ``fleet join`` replays; SIGKILL it and
+    its replacement rebuilds the SAME membership the same way."""
+    loc, paths, victim_pid = _build(tmp_path)
+    complement = [p for p in range(P) if p != victim_pid]
+    oracle = index_classify(loc, [paths[0]])[0]
+    fleet_dir = str(tmp_path / "fleet")
+    log1, log2 = str(tmp_path / "rt1_log"), str(tmp_path / "rt2_log")
+    os.makedirs(log1)
+    os.makedirs(log2)
+
+    sup, sup_ready = _spawn(
+        ["index", "supervise", loc, "--fleet_dir", fleet_dir,
+         "--spawn", _serve_cmd(loc),
+         "--replica", f"1={victim_pid}",
+         "--replica", f"1={','.join(str(p) for p in complement)}",
+         "--heartbeat_s", "0.1"],
+        {"DREP_TPU_FAULTS": "supervisor_tick:raise"},
+    )
+    router1 = router2 = None
+    try:
+        assert sup_ready["slots"] == 2
+        # the injected raise takes the supervisor down on its FIRST
+        # tick — nonzero exit, replicas untouched, manifest adoptable
+        assert sup.wait(timeout=60) != 0
+        doc = load_manifest(fleet_dir)
+        assert all(s["state"] == "healthy" for s in doc["slots"].values())
+
+        flags = ["--fleet_manifest", fleet_dir,
+                 "--probe_interval_s", "0.3",
+                 "--leg_timeout_s", "30", "--hedge_delay_s", "30"]
+        # router 1: NO --replica flags — membership comes from the
+        # manifest alone
+        router1, rt1_ready = _spawn_router(loc, log1, [], flags)
+        with ServeClient(rt1_ready["serving"], timeout_s=600) as c:
+            r = c.classify(paths[0])
+            assert r["ok"] and not r["verdict"].get("partial")
+            assert _strip(r["verdict"]) == oracle
+            st = c.status()
+            assert len(st["supervision"]["slots"]) == 2
+            assert st["supervision"]["supervisor_alive"] is False
+
+        router1.kill()  # SIGKILL: membership must NOT die with it
+        router1.wait(timeout=60)
+
+        router2, rt2_ready = _spawn_router(loc, log2, [], flags)
+        with ServeClient(rt2_ready["serving"], timeout_s=600) as c:
+            r = c.classify(paths[0])
+            assert r["ok"] and not r["verdict"].get("partial")
+            assert not r["verdict"].get("partitions_unavailable")
+            assert _strip(r["verdict"]) == oracle
+        router2.send_signal(signal.SIGTERM)
+        assert router2.wait(timeout=120) == 0
+        # ZERO fleet-join replays on either router: the table was
+        # rebuilt by reading the manifest, not by re-sent join ops
+        for log_dir in (log1, log2):
+            evs = [e["ev"] for e in _events(log_dir)]
+            assert "fleet_join" not in evs
+    finally:
+        _kill_fleet(fleet_dir)
+        _reap(sup, router1, router2)

@@ -88,6 +88,20 @@ Two tiers:
   refusals spill the leg to honest PARTIAL degradation instead of
   queueing behind it). Delegate to tests/test_router_chaos.py, CPU-only.
 
+- supervisor cells (``--supervisor``): the fleet supervisor's lifecycle
+  contract (ISSUE 20, drep_tpu/serve/supervisor.py driving the
+  ``supervisor_spawn``/``supervisor_tick`` fault sites) — SIGKILL the
+  supervisor mid-spawn (its successor ADOPTS every still-live replica
+  recorded in fleet.json, re-probes each over /healthz, and never
+  double-spawns — verdicts stay byte-identical to the one-daemon
+  oracle), a replica rigged to die at startup (QUARANTINED after
+  exactly DREP_TPU_SUP_CRASHLOOP_K deaths inside the window; the fleet
+  serves honest stamped PARTIAL over the missing coverage and strict
+  clients are refused, never a hang), and a router restart (full
+  membership rebuilt from the durable manifest with zero ``fleet``
+  join replays, full-coverage verdicts oracle-identical). Delegate to
+  tests/test_supervisor_chaos.py, CPU-only.
+
 - wire cells (``--wire``): the serve tier's NDJSON wire itself
   (ISSUE 19, drep_tpu/serve/wirechaos.py driving the ``wire`` fault
   site) — a connection RESET mid-reply surfaces as an honest
@@ -135,6 +149,7 @@ Usage::
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --events  # + traced-pod cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --autoscale # + controller cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --router  # + fleet front-door cells
+    JAX_PLATFORMS=cpu python tools/chaos_matrix.py --supervisor # + fleet lifecycle cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --wire    # + wire-damage cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --maintenance # + index lifecycle cells
     JAX_PLATFORMS=cpu python tools/chaos_matrix.py --pod     # + pod cells
@@ -638,6 +653,33 @@ ROUTER_CELLS = [
 ]
 
 
+# supervisor cells (--supervisor, ISSUE 20): the fleet supervisor's
+# lifecycle contract — durable membership, crash-loop quarantine, and
+# orphan adoption. Every cell runs real `index supervise`/`index route`
+# subprocesses against a shared federation and ends in byte-identical
+# verdicts vs the one-daemon oracle — delegate to their pytest chaos
+# tests. CPU-only, tens of seconds each.
+SUPERVISOR_CELLS = [
+    ("supervisor_spawn", "kill",
+     "SIGKILL supervisor mid-spawn -> successor ADOPTS every still-live "
+     "replica from fleet.json, zero duplicate spawns, verdicts oracle-"
+     "identical",
+     "survive",
+     "tests/test_supervisor_chaos.py::test_sigkill_supervisor_midspawn_successor_adopts"),
+    ("supervisor_tick", "kill",
+     "replica rigged to die at startup -> QUARANTINED after exactly "
+     "CRASHLOOP_K deaths, fleet serves stamped PARTIAL (strict refused), "
+     "never hangs",
+     "survive",
+     "tests/test_supervisor_chaos.py::test_crashloop_replica_quarantined_partial_served"),
+    ("supervisor_tick", "raise",
+     "router restart -> full membership rebuilt from fleet.json with "
+     "zero fleet-join replays, full-coverage verdicts oracle-identical",
+     "survive",
+     "tests/test_supervisor_chaos.py::test_router_restart_rebuilds_membership_from_manifest"),
+]
+
+
 # wire cells (--wire, ISSUE 19): the NDJSON wire under the chaos proxy.
 # Every cell needs a subprocess daemon behind an in-process WireChaos
 # proxy with a fault spec installed — delegate to their pytest tests.
@@ -780,6 +822,7 @@ def main() -> int:
     serve_cells = "--serve" in sys.argv
     fed_serve_cells = "--serve-federated" in sys.argv
     router_cells = "--router" in sys.argv
+    supervisor_cells = "--supervisor" in sys.argv
     wire_cells = "--wire" in sys.argv
     events_cells = "--events" in sys.argv
     autoscale_cells = "--autoscale" in sys.argv
@@ -830,6 +873,7 @@ def main() -> int:
     _pytest_cells(SERVE_CELLS, "--serve", serve_cells)
     _pytest_cells(FED_SERVE_CELLS, "--serve-federated", fed_serve_cells)
     _pytest_cells(ROUTER_CELLS, "--router", router_cells)
+    _pytest_cells(SUPERVISOR_CELLS, "--supervisor", supervisor_cells)
     _pytest_cells(WIRE_CELLS, "--wire", wire_cells)
     _pytest_cells(MAINTENANCE_CELLS, "--maintenance", maintenance_cells)
     _pytest_cells(EVENTS_CELLS, "--events", events_cells)

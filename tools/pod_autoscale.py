@@ -63,6 +63,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="fleet mode: govern the replica fleet behind the "
                          "`index route` front door at ADDR (host:port or "
                          "socket path) instead of a batch pod")
+    ap.add_argument("--fleet_dir", default=None, metavar="DIR",
+                    help="fleet mode: home of the durable fleet.json "
+                         "manifest (the embedded supervisor's memory — "
+                         "spawn/drain are manifest transactions, and a "
+                         "restarted controller adopts its predecessor's "
+                         "replicas from it). Required with --spawn")
     ap.add_argument("--queue_deadline_s", type=float, default=5.0,
                     help="fleet mode: rolling queueing-delay target per "
                          "partition range — the policy scales up a range "
@@ -141,12 +147,16 @@ def main(argv: list[str] | None = None) -> int:
             hysteresis=args.hysteresis,
             max_spawn=max_spawn,
         )
+        if args.spawn and not args.fleet_dir:
+            ap.error("--spawn in fleet mode needs --fleet_dir (actuation "
+                     "is a fleet.json manifest transaction)")
         controller = FleetAutoscaleController(
             ServeClient(args.router), targets,
             queue_deadline_s=args.queue_deadline_s, svc_s=args.svc_s,
             spawn_cmd=args.spawn,
             interval_s=args.interval if args.interval is not None else 2.0,
             decision_log=args.decision_log,
+            fleet_dir=args.fleet_dir,
         )
         try:
             return controller.run(count=args.count)

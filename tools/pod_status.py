@@ -494,6 +494,55 @@ def render_serve(status: dict) -> str:
                 + ", ".join(fleet["breaker_open"])
                 + "  (error rate tripped; half-open probe will test)"
             )
+    # fleet supervision tree (ISSUE 20): a router wired to the
+    # supervisor's fleet.json reports the durable slot table — render
+    # per-slot lifecycle state in the same idiom as the rows above
+    sup = status.get("supervision")
+    if sup:
+        if sup.get("error"):
+            lines.append(f"  supervision: {sup['error']}")
+        else:
+            alive = sup.get("supervisor_alive")
+            lines.append(
+                f"  supervisor: pid {sup.get('supervisor_pid')} "
+                f"({'alive' if alive else 'DEAD — slots adoptable'}), "
+                f"manifest generation {sup.get('generation')}, "
+                f"{len(sup.get('slots') or {})} slot(s)"
+            )
+            # drep-lint: allow[clock-mono] — next_retry_at in the manifest is a wall-clock instant; the ETA column compares on the same clock
+            now = time.time()
+            quarantined = []
+            for sid, s in sorted((sup.get("slots") or {}).items()):
+                scope = (
+                    "all partitions" if s.get("partitions") is None
+                    else "partitions " + ",".join(
+                        str(p) for p in s["partitions"])
+                )
+                detail = (
+                    f"{s.get('address') or 'no address'}, {scope}, "
+                    f"pid {s.get('pid')}, {s.get('restarts', 0)} restart(s)"
+                )
+                if s.get("escalations"):
+                    detail += f", {s['escalations']} SIGKILL escalation(s)"
+                if s.get("state") == "backoff" and s.get("next_retry_at"):
+                    eta = max(0.0, float(s["next_retry_at"]) - now)
+                    detail += f", next retry in {eta:.1f}s"
+                lines.append(f"  {sid:<10} {s.get('state', '?'):<12} {detail}")
+                if s.get("last_death_reason"):
+                    lines.append(
+                        f"            last death: "
+                        f"{str(s['last_death_reason'])[:160]}"
+                    )
+                if s.get("state") == "quarantined":
+                    quarantined.append(sid)
+            if quarantined:
+                lines.append(
+                    "  QUARANTINED slot(s): " + ", ".join(quarantined)
+                    + "  (crash loop; no respawns burn — coverage "
+                    "degrades to stamped PARTIAL. Fix the binary, then "
+                    "unquarantine via `index supervise` or clear the "
+                    "slot in fleet.json)"
+                )
     return "\n".join(lines) + "\n"
 
 

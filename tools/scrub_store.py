@@ -174,14 +174,21 @@ def _maintenance_map(root: str) -> dict[str, str]:
     return out
 
 
+_FLEET_GEN_RE = re.compile(r"^fleet\.g(\d+)\.json$")
+
+
 def _is_json_note(name: str) -> bool:
     # every checked-JSON family the pipeline publishes: store meta, the
     # pod protocol's membership notes (done/death verdicts, plus the
     # ISSUE-9 drain departures and join request/admit pairs), workdir
-    # argument snapshots, ingest poison markers, and the genome-index
-    # manifest (drep_tpu/index/store.py) — all carry the in-band "crc"
+    # argument snapshots, ingest poison markers, the genome-index
+    # manifest (drep_tpu/index/store.py), and the fleet supervisor's
+    # membership manifest + generation snapshots (serve/supervisor.py)
+    # — all carry the in-band "crc"
     return (
-        name in ("meta.json", "manifest.json", "federation.json")
+        name in ("meta.json", "manifest.json", "federation.json",
+                 "fleet.json")
+        or _FLEET_GEN_RE.match(name) is not None
         or name.startswith(
             (
                 ".pod-done.", ".pod-dead.", ".pod-drain.", ".pod-join.",
@@ -190,6 +197,50 @@ def _is_json_note(name: str) -> bool:
         )
         or name.endswith("_arguments.json")
     )
+
+
+def _membership_map(root: str) -> tuple[dict[str, str], dict[str, list[str]]]:
+    """Classify fleet-supervisor leftovers (ISSUE 20) under `root`:
+    returns ``(stale_paths, compactions)`` where `stale_paths` maps a
+    ``fleet.gNNNNNN.json`` generation snapshot OLDER than the current
+    manifest's generation to ``"stale_gen"`` (a crashed supervisor's
+    not-yet-gc'd history), and `compactions` maps a ``fleet.json`` path
+    to the slot ids whose recorded pid is DEAD while the recorded
+    supervisor is dead too (nobody owns the slot; a successor
+    supervisor would reap it at recovery — --delete compacts it first).
+    Expected lifecycle states, NOT damage. QUARANTINED slots are never
+    listed: their durable reason is the contract. A live supervisor's
+    manifest is left entirely alone — the file has an owner."""
+    stale: dict[str, str] = {}
+    compact: dict[str, list[str]] = {}
+    from drep_tpu.serve.supervisor import pid_alive
+
+    for dirpath, _dirs, files in os.walk(root):
+        if "fleet.json" not in files:
+            continue
+        man_path = os.path.join(dirpath, "fleet.json")
+        try:
+            doc = durableio.read_json_checked(man_path, what="fleet manifest")
+        except (OSError, durableio.CorruptPayloadError):
+            continue  # the ordinary walk classifies the rot
+        if not isinstance(doc, dict):
+            continue
+        cur = int(doc.get("generation") or 0)
+        for name in files:
+            m = _FLEET_GEN_RE.match(name)
+            if m and int(m.group(1)) < cur:
+                stale[os.path.join(dirpath, name)] = "stale_gen"
+        if pid_alive(doc.get("supervisor_pid")):
+            continue
+        dead_slots = [
+            sid for sid, slot in (doc.get("slots") or {}).items()
+            if isinstance(slot, dict)
+            and slot.get("state") in ("healthy", "starting", "draining")
+            and not pid_alive(slot.get("pid"))
+        ]
+        if dead_slots:
+            compact[man_path] = sorted(dead_slots)
+    return stale, compact
 
 
 def scrub(roots: list[str], delete: bool = False, out=sys.stdout) -> dict:
@@ -218,10 +269,16 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
     torn_tails: list[str] = []
     staged: list[str] = []
     superseded: list[str] = []
+    stale_membership: list[str] = []
     maint_map: dict[str, str] = {}
+    member_map: dict[str, str] = {}
+    compactions: dict[str, list[str]] = {}
     for root in roots:
         if os.path.isdir(root):
             maint_map.update(_maintenance_map(root))
+            m_stale, m_compact = _membership_map(root)
+            member_map.update(m_stale)
+            compactions.update(m_compact)
 
     def check_events(path: str) -> None:
         """Line-wise validation of a telemetry event log: every COMPLETE
@@ -253,6 +310,12 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
             # artifacts / committed-but-not-yet-gc'd payloads — expected
             # states the next maintenance pass converges, NOT damage
             (staged if cls == "staged" else superseded).append(path)
+            return
+        if path in member_map:
+            # fleet-supervisor lifecycle leftovers (ISSUE 20): a
+            # generation snapshot an interrupted publish never gc'd —
+            # expected crash history, NOT damage
+            stale_membership.append(path)
             return
         if ".tmp-" in name:
             # an orphaned atomic-write tmp (SIGKILL mid-publish — the
@@ -361,6 +424,37 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
         print(f"SUPERSEDED {path}: superseded by a committed index-"
               f"maintenance transaction, gc pending (the next maintenance "
               f"pass removes it, not damage){action}", file=out)
+    for path in stale_membership:
+        action = ""
+        if delete:
+            try:
+                # drep-lint: allow[reader-purity] — --delete repair mode: stale fleet-manifest generation snapshots the supervisor's own gc would remove identically
+                os.remove(path)
+                action = " [deleted — completes the supervisor's gc]"
+            except OSError as e:
+                action = f" [delete failed: {e}]"
+        print(f"STALE-MEMBERSHIP {path}: superseded fleet-manifest "
+              f"generation (crash leftover of an interrupted supervisor "
+              f"publish, not damage){action}", file=out)
+    for man_path, dead_slots in sorted(compactions.items()):
+        action = ""
+        if delete:
+            try:
+                doc = durableio.read_json_checked(
+                    man_path, what="fleet manifest"
+                )
+                for sid in dead_slots:
+                    doc.get("slots", {}).pop(sid, None)
+                # drep-lint: allow[reader-purity] — --delete repair mode: compacting dead-pid slots out of an UNOWNED manifest (recorded supervisor dead); a successor supervisor would reap them identically at recovery
+                durableio.atomic_write_json(man_path, doc)
+                action = " [compacted out]"
+            except (OSError, durableio.CorruptPayloadError) as e:
+                action = f" [compaction failed: {e}]"
+        print(f"STALE-MEMBERSHIP {man_path}: dead-pid slot(s) "
+              f"{','.join(dead_slots)} with no live supervisor (a "
+              f"successor would reap them at recovery, not damage)"
+              f"{action}", file=out)
+        stale_membership.append(man_path)
     if by_partition:
         print(
             "scrub: federated damage by partition: "
@@ -375,12 +469,15 @@ def _scrub(roots: list[str], delete: bool, out) -> dict:
         + (f", {len(torn_tails)} torn event-log tail(s)" if torn_tails else "")
         + (f", {len(staged)} staged maintenance artifact(s)" if staged else "")
         + (f", {len(superseded)} superseded (gc-pending) payload(s)"
-           if superseded else ""),
+           if superseded else "")
+        + (f", {len(stale_membership)} stale membership entr(ies)"
+           if stale_membership else ""),
         file=out,
     )
     return {"verified": verified, "legacy": legacy, "damaged": damaged,
             "artifacts": artifacts, "torn_tails": torn_tails,
             "staged": staged, "superseded": superseded,
+            "stale_membership": stale_membership,
             "by_partition": by_partition}
 
 
