@@ -66,6 +66,7 @@ from __future__ import annotations
 import json
 import os
 import random
+import selectors
 import shlex
 import signal
 import subprocess
@@ -127,7 +128,9 @@ def pid_alive(pid) -> bool:
     """Liveness of an arbitrary (possibly non-child) pid via
     ``kill(pid, 0)`` — the only probe that works for ADOPTED replicas
     the supervisor never forked. EPERM counts as alive (the process
-    exists, we just can't signal it)."""
+    exists, we just can't signal it). CAVEAT: an exited-but-unreaped
+    CHILD (zombie) still answers this probe — anywhere the supervisor
+    holds the Popen handle it must poll()/wait() the handle first."""
     try:
         pid = int(pid)
     except (TypeError, ValueError):
@@ -143,6 +146,35 @@ def pid_alive(pid) -> bool:
     except OSError:
         return False
     return True
+
+
+def _parse_ready(line) -> dict | None:
+    """One daemon ready line (bytes or str) -> its JSON object, or None
+    when the line is noise / not the ready contract."""
+    try:
+        msg = json.loads(line)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if isinstance(msg, dict) and msg.get("serving"):
+        return msg
+    return None
+
+
+def _reap(proc, timeout_s: float = 5.0) -> None:
+    """Harvest a child's exit status so the kernel drops its zombie
+    entry. Without this, ``kill(pid, 0)`` on an exited-but-unreaped
+    child keeps succeeding and every pid_alive()-based transition
+    (drain retirement, death detection) wedges forever. Tolerates
+    spawn_fn test fakes that carry no ``wait``."""
+    if proc is None:
+        return
+    wait = getattr(proc, "wait", None)
+    if wait is None:
+        return
+    try:
+        wait(timeout=timeout_s)
+    except Exception:  # noqa: BLE001 — best-effort: poll() retries next tick
+        pass
 
 
 # -- the durable manifest ----------------------------------------------------
@@ -468,6 +500,7 @@ class FleetSupervisor:
                     proc.send_signal(signal.SIGKILL)
                 except OSError:
                     pass
+                _reap(proc)  # a dropped handle would zombie the child
             self._book_death(slot, reason, now)
             return False
         slot["address"] = str(ready.get("serving"))
@@ -489,22 +522,60 @@ class FleetSupervisor:
     def _await_ready(self, proc) -> dict | None:
         """Parse the daemon's one-JSON-object ready line from its stdout
         under the startup deadline (the same contract every harness in
-        the repo relies on)."""
+        the repo relies on). A real pipe is read NON-blocking through a
+        selector: a replica that stays alive but never prints its ready
+        line costs exactly the deadline — a blocking readline() here
+        would wedge the whole tick loop (heartbeats, respawns, drain
+        escalation for every other slot) behind one silent child."""
         deadline = time.monotonic() + self.startup_deadline_s
-        while time.monotonic() < deadline:
-            line = proc.stdout.readline() if proc.stdout else ""
-            if not line:
-                if proc.poll() is not None:
+        stdout = proc.stdout
+        try:
+            fd = stdout.fileno() if stdout is not None else None
+        except (AttributeError, OSError, ValueError):
+            fd = None  # spawn_fn fakes: readline() that never blocks
+        if fd is None:
+            while time.monotonic() < deadline:
+                line = stdout.readline() if stdout else ""
+                if not line:
+                    if proc.poll() is not None:
+                        return None
+                    time.sleep(0.02)
+                    continue
+                msg = _parse_ready(line)
+                if msg is not None:
+                    return msg
+            return None
+        os.set_blocking(fd, False)
+        sel = selectors.DefaultSelector()
+        sel.register(fd, selectors.EVENT_READ)
+        buf = b""
+        try:
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     return None
-                time.sleep(0.02)
-                continue
-            try:
-                msg = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(msg, dict) and msg.get("serving"):
-                return msg
-        return None
+                if not sel.select(timeout=min(remaining, 0.25)):
+                    continue  # EOF also reports readable; only time passes here
+                try:
+                    chunk = os.read(fd, 65536)
+                except BlockingIOError:
+                    continue
+                except OSError:
+                    return None
+                if not chunk:
+                    # EOF: the child closed stdout (usually: died before
+                    # the ready line) — reap promptly so the caller's
+                    # poll() sees the real exit code, not a zombie
+                    _reap(proc, timeout_s=2.0)
+                    return None
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    msg = _parse_ready(line)
+                    if msg is not None:
+                        return msg
+        finally:
+            sel.close()
 
     # -- the placement API (what autoscale/fleet.py actuates through) ----
     def _next_slot_id(self) -> str:
@@ -539,7 +610,11 @@ class FleetSupervisor:
         are chosen from the MANIFEST, so the choice is correct across
         any number of supervisor/controller restarts. Graceful: fleet
         leave → SIGTERM now; the tick loop escalates to SIGKILL after
-        the drain deadline."""
+        the drain deadline. ``count <= 0`` drains nothing — an explicit
+        zero must never fall back to draining one."""
+        count = int(count)
+        if count <= 0:
+            return []
         key = None if partitions is None and address else (
             "all" if partitions is None
             else ",".join(str(int(p)) for p in sorted(partitions))
@@ -551,7 +626,7 @@ class FleetSupervisor:
             and (key is None or slot_range_key(s) == key)
         ]
         live.sort(key=lambda s: float(s.get("placed_at") or 0.0))
-        victims = live[-int(count):] if count else live[-1:]
+        victims = live[-count:]
         for slot in victims:
             if slot.get("address"):
                 self._fleet_op("leave", slot["address"])
@@ -667,7 +742,7 @@ class FleetSupervisor:
                             os.kill(int(slot["pid"]), signal.SIGKILL)
                         except OSError:
                             pass
-                        self.procs.pop(slot_id, None)
+                        _reap(self.procs.pop(slot_id, None))
                         self._book_death(
                             slot,
                             f"unresponsive ({strikes} probes missed)", now,
@@ -685,8 +760,14 @@ class FleetSupervisor:
                     self._spawn_slot(slot)
                     changed = True
             elif state == "draining":
-                if not pid_alive(slot.get("pid")):
-                    self.procs.pop(slot_id, None)
+                # our OWN child must be judged by poll() — an exited
+                # child we haven't reaped is a zombie, and pid_alive()
+                # keeps answering True for a zombie, so the pid probe
+                # alone would pin the slot in draining forever
+                proc = self.procs.get(slot_id)
+                exited = proc is not None and proc.poll() is not None
+                if exited or not pid_alive(slot.get("pid")):
+                    _reap(self.procs.pop(slot_id, None), timeout_s=1.0)
                     del self.doc["slots"][slot_id]
                     changed = True
                 elif slot.get("drain_started_at") is not None and (
@@ -697,6 +778,7 @@ class FleetSupervisor:
                         os.kill(int(slot["pid"]), signal.SIGKILL)
                     except OSError:
                         pass
+                    _reap(proc)  # harvest now; next tick retires the slot
                     slot["escalations"] = int(slot.get("escalations", 0)) + 1
                     slot["drain_started_at"] = now  # one escalation per deadline
                     self._log.warning(

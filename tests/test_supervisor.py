@@ -275,6 +275,88 @@ def test_heartbeat_books_death_and_respawns(tmp_path, reaper):
     assert len(slot["deaths"]) == 1 and "rc=" in slot["last_death_reason"]
 
 
+# ---- own-child reaping: zombies must never wedge the state machine ---------
+
+
+def _ready_child_cmd():
+    """A real child wearing the daemon ready-line contract: prints one
+    JSON object on a REAL pipe, then sleeps until signalled."""
+    return [sys.executable, "-c",
+            "import json, os, time\n"
+            "print(json.dumps({'serving': 'z:1', 'pid': os.getpid()}),"
+            " flush=True)\n"
+            "time.sleep(3600)\n"]
+
+
+def test_drain_retires_own_exited_child_without_external_reap(tmp_path):
+    """The draining branch must judge the supervisor's OWN child by
+    poll(): an exited-but-unreaped child is a zombie, kill(pid, 0)
+    still succeeds on it, and a pid_alive()-only check would pin the
+    slot in draining forever (SIGKILL escalations firing every drain
+    deadline, one zombie accumulating per drain). Nothing here reaps
+    the child for the supervisor — the tick must do it itself."""
+    def spawn_fn(argv, env):
+        return subprocess.Popen(_ready_child_cmd(),
+                                stdout=subprocess.PIPE, text=True)
+
+    sup = _sup(tmp_path, spawn_fn)
+    (slot,) = sup.place(count=1)
+    assert slot["state"] == "healthy"
+    pid = int(slot["pid"])
+    (victim,) = sup.drain(count=1)  # fleet leave + SIGTERM
+    assert victim["slot_id"] == slot["slot_id"]
+    # wait for the SIGTERMed child to exit WITHOUT reaping it: WNOWAIT
+    # leaves the zombie in place, so pid_alive() still answers True —
+    # exactly the trap the old draining branch fell into
+    os.waitid(os.P_PID, pid, os.WEXITED | os.WNOWAIT)
+    assert pid_alive(pid)
+    sup.tick()
+    assert sup.doc["slots"] == {}  # retired, not stuck in draining
+    assert sup.procs == {}
+    assert not pid_alive(pid)  # reaped for real — no zombie left
+
+
+def test_spawn_silent_replica_times_out_never_hangs(tmp_path):
+    """A spawned replica that stays alive but never prints its ready
+    line must cost exactly the startup deadline — a blocking
+    readline() on the real pipe would wedge the whole tick loop — and
+    the SIGKILLed child must be reaped, not left a zombie."""
+    procs = []
+
+    def spawn_fn(argv, env):
+        p = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(3600)"],
+            stdout=subprocess.PIPE, text=True,
+        )
+        procs.append(p)
+        return p
+
+    sup = _sup(tmp_path, spawn_fn, startup_deadline_s=0.5, crashloop_k=0)
+    t0 = time.monotonic()
+    (slot,) = sup.place(count=1)
+    assert time.monotonic() - t0 < 10.0  # deadline held, no hang
+    assert slot["state"] == "backoff"
+    assert "no ready line" in slot["last_death_reason"]
+    assert procs[0].poll() == -signal.SIGKILL  # killed AND reaped
+    assert not pid_alive(procs[0].pid)
+
+
+def test_drain_zero_count_drains_nothing(tmp_path, reaper):
+    """An explicit drain(count=0) is a no-op — the falsy-count fallback
+    that turned it into 'drain one' is exactly the attribution bug this
+    API exists to prevent."""
+    def spawn_fn(argv, env):
+        p = _LiveReplica(f"r:{len(reaper)}")
+        reaper.append(p)
+        return p
+
+    sup = _sup(tmp_path, spawn_fn)
+    (slot,) = sup.place(count=1)
+    assert sup.drain(count=0) == []
+    assert sup.drain(count=-2) == []
+    assert sup.doc["slots"][slot["slot_id"]]["state"] == "healthy"
+
+
 # ---- adoption: live pid vs stale pid ---------------------------------------
 
 
@@ -477,7 +559,7 @@ def test_scrub_classifies_stale_membership(tmp_path):
     })
     # superseded generation snapshots an interrupted publish never gc'd
     doc = load_manifest(fleet_dir)
-    for g in (1, 2, 5):
+    for g in (1, 2, 4, 5):
         durableio.atomic_write_json(
             os.path.join(fleet_dir, f"fleet.g{g:06d}.json"),
             dict(doc, generation=g),
@@ -486,7 +568,9 @@ def test_scrub_classifies_stale_membership(tmp_path):
     rep = scrub([str(tmp_path)], out=out)
     assert rep["damaged"] == []
     stale = {os.path.basename(p) for p in rep["stale_membership"]}
-    # gens 1,2 < current (5) are stale; gen 5 is the live snapshot; the
+    # gens 1,2 fell out of the KEEP_GENERATIONS retained window; gens
+    # 4,5 are exactly what the supervisor's own gc keeps (deleting gen
+    # cur-1 would undo a retention the supervisor made on purpose); the
     # manifest itself is listed for its dead-pid slot compaction
     assert stale == {"fleet.g000001.json", "fleet.g000002.json", "fleet.json"}
     # --delete removes/compacts idempotently
@@ -496,6 +580,7 @@ def test_scrub_classifies_stale_membership(tmp_path):
     assert "s000" not in doc["slots"]  # dead-pid slot compacted out
     assert doc["slots"]["s001"]["state"] == "quarantined"  # NEVER removed
     assert not os.path.exists(os.path.join(fleet_dir, "fleet.g000001.json"))
+    assert os.path.exists(os.path.join(fleet_dir, "fleet.g000004.json"))
     assert os.path.exists(os.path.join(fleet_dir, "fleet.g000005.json"))
     rep = scrub([str(tmp_path)], delete=True, out=out)
     assert rep["stale_membership"] == [] and rep["damaged"] == []  # converged
@@ -514,9 +599,16 @@ def test_scrub_leaves_owned_manifest_alone(tmp_path):
     doc = load_manifest(fleet_dir)
     doc["supervisor_pid"] = os.getpid()  # "alive" supervisor
     durableio.atomic_write_json(manifest_path(fleet_dir), doc)
+    # even a long-superseded generation snapshot stays: the live
+    # supervisor's own gc owns it (and races any outside deletion)
+    durableio.atomic_write_json(
+        os.path.join(fleet_dir, "fleet.g000001.json"),
+        dict(doc, generation=1),
+    )
     rep = scrub([str(tmp_path)], delete=True, out=open(os.devnull, "w"))
     assert rep["stale_membership"] == []
     assert "s000" in load_manifest(fleet_dir)["slots"]
+    assert os.path.exists(os.path.join(fleet_dir, "fleet.g000001.json"))
 
 
 # ---- CLI surface -----------------------------------------------------------

@@ -202,18 +202,20 @@ def _is_json_note(name: str) -> bool:
 def _membership_map(root: str) -> tuple[dict[str, str], dict[str, list[str]]]:
     """Classify fleet-supervisor leftovers (ISSUE 20) under `root`:
     returns ``(stale_paths, compactions)`` where `stale_paths` maps a
-    ``fleet.gNNNNNN.json`` generation snapshot OLDER than the current
-    manifest's generation to ``"stale_gen"`` (a crashed supervisor's
-    not-yet-gc'd history), and `compactions` maps a ``fleet.json`` path
-    to the slot ids whose recorded pid is DEAD while the recorded
-    supervisor is dead too (nobody owns the slot; a successor
-    supervisor would reap it at recovery — --delete compacts it first).
-    Expected lifecycle states, NOT damage. QUARANTINED slots are never
-    listed: their durable reason is the contract. A live supervisor's
-    manifest is left entirely alone — the file has an owner."""
+    ``fleet.gNNNNNN.json`` generation snapshot the supervisor's own gc
+    would have removed — one OLDER than the KEEP_GENERATIONS newest the
+    supervisor deliberately retains — to ``"stale_gen"`` (a crashed
+    supervisor's not-yet-gc'd history), and `compactions` maps a
+    ``fleet.json`` path to the slot ids whose recorded pid is DEAD
+    while the recorded supervisor is dead too (nobody owns the slot; a
+    successor supervisor would reap it at recovery — --delete compacts
+    it first). Expected lifecycle states, NOT damage. QUARANTINED
+    slots are never listed: their durable reason is the contract. A
+    live supervisor's fleet_dir is left entirely alone — both the
+    manifest and its retained snapshots have an owner racing us."""
     stale: dict[str, str] = {}
     compact: dict[str, list[str]] = {}
-    from drep_tpu.serve.supervisor import pid_alive
+    from drep_tpu.serve.supervisor import KEEP_GENERATIONS, pid_alive
 
     for dirpath, _dirs, files in os.walk(root):
         if "fleet.json" not in files:
@@ -225,13 +227,15 @@ def _membership_map(root: str) -> tuple[dict[str, str], dict[str, list[str]]]:
             continue  # the ordinary walk classifies the rot
         if not isinstance(doc, dict):
             continue
-        cur = int(doc.get("generation") or 0)
-        for name in files:
-            m = _FLEET_GEN_RE.match(name)
-            if m and int(m.group(1)) < cur:
-                stale[os.path.join(dirpath, name)] = "stale_gen"
         if pid_alive(doc.get("supervisor_pid")):
             continue
+        # gens >= cur - (KEEP_GENERATIONS - 1) are the retained window
+        # the supervisor's gc itself keeps — never stale
+        cutoff = int(doc.get("generation") or 0) - (KEEP_GENERATIONS - 1)
+        for name in files:
+            m = _FLEET_GEN_RE.match(name)
+            if m and int(m.group(1)) < cutoff:
+                stale[os.path.join(dirpath, name)] = "stale_gen"
         dead_slots = [
             sid for sid, slot in (doc.get("slots") or {}).items()
             if isinstance(slot, dict)
